@@ -1,0 +1,36 @@
+"""daft_tpu: a TPU-native multimodal data engine.
+
+A brand-new implementation of the reference's capabilities (see SURVEY.md): lazy
+DataFrame + SQL over a columnar Arrow-compatible core, rule/cost-based optimizer,
+streaming morsel-driven execution, and TPU-first compute — relational operator
+pipelines fused into jit-compiled JAX/XLA stage programs over mesh-sharded arrays.
+"""
+
+from .datatype import DataType, Field, ImageMode, TimeUnit
+from .schema import Schema
+from .core import Series, RecordBatch, MicroPartition
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "Field",
+    "ImageMode",
+    "TimeUnit",
+    "Schema",
+    "Series",
+    "RecordBatch",
+    "MicroPartition",
+]
+
+
+def __getattr__(name):
+    # Lazy attributes filled in as the API surface lands (DataFrame, col, lit, ...).
+    if name.startswith("_") or name == "api":
+        raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
+    from . import api as _api
+
+    try:
+        return getattr(_api, name)
+    except AttributeError:
+        raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}") from None

@@ -1,0 +1,4 @@
+"""Top-level user API re-exports (DataFrame, col, lit, read_* functions).
+
+Populated as the API surface lands; daft_tpu/__init__.py lazily forwards here.
+"""

@@ -1,0 +1,5 @@
+from .series import Series
+from .recordbatch import RecordBatch
+from .micropartition import MicroPartition, TableStatistics, ColumnStats
+
+__all__ = ["Series", "RecordBatch", "MicroPartition", "TableStatistics", "ColumnStats"]

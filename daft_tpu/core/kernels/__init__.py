@@ -1,0 +1,6 @@
+"""Host-side vectorized kernels (numpy / arrow).
+
+These mirror the low-level kernels of the reference's src/daft-core/src/kernels/
+(hashing, search_sorted, utf8) plus the sketch crates (hyperloglog, daft-minhash).
+Device-side equivalents live in daft_tpu/ops (JAX / Pallas).
+"""

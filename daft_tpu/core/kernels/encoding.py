@@ -1,0 +1,124 @@
+"""Key encoding: turn one or more key Series into dense int64 codes.
+
+This is the shared foundation for groupby (reference: src/daft-groupby/src/lib.rs
+make_groups), hash join probe tables (src/daft-recordbatch/src/probeable/), sort keys,
+and value partitioning. Codes are order-preserving per column (rank over the sorted
+domain), so multi-column lexicographic order is preserved by tuple order of codes.
+Null gets code -1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def encode_column(series, domain_extra=None) -> np.ndarray:
+    """Order-preserving int64 codes for one column; null -> -1.
+
+    If ``domain_extra`` (another Series of the same dtype) is given, codes are
+    computed over the union domain so both sides of a join share the code space.
+    """
+    from ..series import Series
+
+    if domain_extra is not None:
+        combined = Series.concat([series.rename("k"), domain_extra.rename("k")])
+        codes = encode_column(combined)
+        return codes[: len(series)], codes[len(series) :]
+
+    dt = series.dtype
+    valid = series.validity_numpy()
+    if dt.is_decimal():
+        # decimals keep exact order via Python Decimal objects (np.unique sorts them)
+        vals = np.empty(len(series), dtype=object)
+        from decimal import Decimal
+
+        pyvals = series.to_pylist()
+        for i in range(len(pyvals)):
+            vals[i] = pyvals[i] if pyvals[i] is not None else Decimal(0)
+    elif dt.is_numeric() or dt.is_boolean() or dt.is_temporal():
+        vals = series.to_numpy()
+        if vals.dtype.kind == "f":
+            vals = vals + 0.0  # canonicalize -0.0
+    elif dt.is_string() or dt.is_binary():
+        vals = np.asarray(series.to_arrow().to_numpy(zero_copy_only=False))
+        fillval = "" if dt.is_string() else b""
+        vals = np.where(valid, vals, fillval)
+    else:
+        # fall back to hashing for nested/python values (not order-preserving)
+        vals = series.hash().to_numpy()
+
+    codes = np.empty(len(series), dtype=np.int64)
+    if valid.any():
+        _, inv = np.unique(vals[valid], return_inverse=True)
+        codes[valid] = inv.astype(np.int64)
+    codes[~valid] = -1
+    return codes
+
+
+def combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
+    """Combine per-column codes into one int64 code per row (order-preserving)."""
+    if len(code_cols) == 1:
+        return code_cols[0].astype(np.int64, copy=False)
+    stacked = np.stack(code_cols, axis=1)
+    if stacked.shape[0] == 0:
+        return np.empty(0, dtype=np.int64)
+    # lexicographic rank of rows
+    order = np.lexsort(tuple(stacked[:, i] for i in range(stacked.shape[1] - 1, -1, -1)))
+    sorted_rows = stacked[order]
+    new_group = np.any(sorted_rows[1:] != sorted_rows[:-1], axis=1)
+    ranks_sorted = np.concatenate([[0], np.cumsum(new_group)])
+    out = np.empty(len(order), dtype=np.int64)
+    out[order] = ranks_sorted
+    return out
+
+
+def encode_keys(key_series: list, other_side: Optional[list] = None) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray, Optional[np.ndarray]]:
+    """Encode multi-column keys to single int64 codes.
+
+    Returns (codes, other_codes, any_null_mask, other_any_null_mask); codes for rows
+    containing any null key are still computed (nulls code -1) so callers decide
+    null-match semantics.
+    """
+    if other_side is None:
+        cols = [encode_column(s) for s in key_series]
+        codes = combine_codes(cols)
+        null_mask = np.zeros(len(codes), dtype=bool)
+        for s, c in zip(key_series, cols):
+            null_mask |= c == -1
+        return codes, None, null_mask, None
+
+    lcols, rcols = [], []
+    for ls, rs in zip(key_series, other_side):
+        if ls.dtype != rs.dtype:
+            target = _common_key_dtype(ls.dtype, rs.dtype)
+            ls, rs = ls.cast(target), rs.cast(target)
+        lc, rc = encode_column(ls, rs)
+        lcols.append(lc)
+        rcols.append(rc)
+    n_l = len(lcols[0])
+    joint = combine_codes([np.concatenate([lc, rc]) for lc, rc in zip(lcols, rcols)])
+    lcodes, rcodes = joint[:n_l], joint[n_l:]
+    lnull = np.zeros(n_l, dtype=bool)
+    rnull = np.zeros(len(rcodes), dtype=bool)
+    for lc, rc in zip(lcols, rcols):
+        lnull |= lc == -1
+        rnull |= rc == -1
+    return lcodes, rcodes, lnull, rnull
+
+
+def _common_key_dtype(a, b):
+    from ...datatype import DataType
+
+    if a == b:
+        return a
+    if a.is_null():
+        return b
+    if b.is_null():
+        return a
+    if a.is_numeric() and b.is_numeric():
+        return DataType.from_arrow(
+            __import__("pyarrow").from_numpy_dtype(np.promote_types(a.to_numpy(), b.to_numpy()))
+        )
+    raise ValueError(f"cannot join/compare keys of dtypes {a} and {b}")

@@ -1,0 +1,43 @@
+"""Group index construction.
+
+Reference parity: src/daft-groupby/src/lib.rs (IntoGroups/make_groups). Sort-based
+factorization over encoded key codes — deterministic, vectorized, and the same
+algorithm the device-side segment-reduce kernel uses after an on-device sort.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .encoding import encode_keys
+
+
+def make_groups(key_series: list) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Compute groups over multi-column keys (nulls form their own group).
+
+    Returns (first_occurrence_indices, group_ids, group_counts):
+      - first_occurrence_indices[g] = row index of the first row of group g
+      - group_ids[i] = group of row i (0..G-1, ordered by first occurrence)
+      - group_counts[g] = rows in group g
+    """
+    codes, _, _, _ = encode_keys(key_series)
+    n = len(codes)
+    if n == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64)
+    uniq, first_idx, inverse, counts = np.unique(codes, return_index=True, return_inverse=True, return_counts=True)
+    # reorder groups by first occurrence so output order is deterministic & stream-friendly
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    group_ids = remap[inverse]
+    return first_idx[order].astype(np.int64), group_ids.astype(np.int64), counts[order].astype(np.int64)
+
+
+def group_row_indices(group_ids: np.ndarray, num_groups: int) -> List[np.ndarray]:
+    """Row indices per group (ordered)."""
+    order = np.argsort(group_ids, kind="stable")
+    sorted_gids = group_ids[order]
+    boundaries = np.searchsorted(sorted_gids, np.arange(num_groups + 1))
+    return [order[boundaries[g] : boundaries[g + 1]] for g in range(num_groups)]

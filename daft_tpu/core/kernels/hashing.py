@@ -1,0 +1,160 @@
+"""Deterministic vectorized 64-bit row hashing.
+
+Reference parity: src/daft-core/src/kernels/hashing.rs + src/daft-hash (murmur/xx
+hashers). We use a splitmix64 finalizer over canonical 64-bit encodings for
+fixed-width types and a bytes hash for var-width types; nulls hash to a fixed
+sentinel so they group/join consistently.
+"""
+
+from __future__ import annotations
+
+import pickle
+from hashlib import blake2b
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_C3 = np.uint64(0x9E3779B97F4A7C15)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64."""
+    with np.errstate(over="ignore"):
+        x = (x + _C3).astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * _C1
+        x = (x ^ (x >> np.uint64(27))) * _C2
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def _hash_bytes_vec(values: np.ndarray) -> np.ndarray:
+    """Hash an object-array of bytes/str. Vectorized FNV-1a over a flat byte buffer."""
+    n = len(values)
+    out = np.empty(n, dtype=np.uint64)
+    for i in range(n):
+        v = values[i]
+        if v is None:
+            out[i] = NULL_HASH
+            continue
+        if isinstance(v, str):
+            v = v.encode("utf-8")
+        h = blake2b(v, digest_size=8).digest()
+        out[i] = np.frombuffer(h, dtype=np.uint64)[0]
+    return out
+
+
+def _hash_string_arrow(arr: pa.Array) -> np.ndarray:
+    """Fast path for large_string/large_binary: FNV-style segmented hash over buffers."""
+    buffers = arr.buffers()
+    # large_string: [validity, offsets(int64), data]
+    offsets = np.frombuffer(buffers[1], dtype=np.int64, count=len(arr) + 1 + arr.offset)
+    offsets = offsets[arr.offset : arr.offset + len(arr) + 1]
+    data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] is not None else np.empty(0, np.uint8)
+    lengths = np.diff(offsets)
+    n = len(arr)
+    # Purity requirement: the hash of a value must not depend on what else is in the
+    # batch. Short rows (<= LONG_CUTOFF bytes) use the vectorized FNV pass; long rows
+    # use per-row blake2b — chosen per ROW by the row's own length, so equal values
+    # always take the same code path regardless of batchmates.
+    LONG_CUTOFF = 256
+    P = np.uint64(1099511628211)
+    h = np.full(n, np.uint64(14695981039346656037), dtype=np.uint64)
+    starts = offsets[:-1].astype(np.int64)
+    short = lengths <= LONG_CUTOFF
+    capped = np.minimum(lengths, LONG_CUTOFF)
+    max_len = int(capped.max()) if n else 0
+    with np.errstate(over="ignore"):
+        for k in range(max_len):
+            live = short & (lengths > k)
+            if not live.any():
+                break
+            idx = starts[live] + k
+            b = data[idx].astype(np.uint64)
+            h[live] = (h[live] ^ b) * P
+        # mix in length to distinguish prefixes
+        h = splitmix64(h ^ lengths.astype(np.uint64))
+    if not short.all():
+        long_idx = np.nonzero(~short)[0]
+        for i in long_idx:
+            v = bytes(data[starts[i] : starts[i] + lengths[i]])
+            d = blake2b(v, digest_size=8).digest()
+            h[i] = np.frombuffer(d, dtype=np.uint64)[0]
+    if arr.null_count:
+        valid = np.asarray(pa.compute.is_valid(arr).to_numpy(zero_copy_only=False), dtype=bool)
+        h[~valid] = NULL_HASH
+    return h
+
+
+def hash_series(series, seed: Optional[object] = None):
+    """64-bit hash of each row of a Series; returns a uint64 Series."""
+    from ..series import Series
+
+    dt = series.dtype
+    n = len(series)
+
+    if series._pyobjs is not None:
+        vals = np.empty(n, dtype=np.uint64)
+        for i, v in enumerate(series._pyobjs):
+            if v is None:
+                vals[i] = NULL_HASH
+            else:
+                d = blake2b(pickle.dumps(v), digest_size=8).digest()
+                vals[i] = np.frombuffer(d, dtype=np.uint64)[0]
+        h = vals
+    elif dt.is_string() or dt.kind == "binary":
+        h = _hash_string_arrow(series.to_arrow())
+    elif dt.is_numeric() or dt.is_boolean() or dt.is_temporal():
+        values = series.to_numpy()
+        if values.dtype.kind == "f":
+            # canonicalize -0.0 == 0.0 and all NaNs equal
+            values = values.astype(np.float64, copy=True)
+            values = values + 0.0
+            nan_mask = np.isnan(values)
+            bits = values.view(np.uint64).copy()
+            bits[nan_mask] = np.uint64(0x7FF8000000000000)
+        elif values.dtype.kind in "iu":
+            bits = values.astype(np.int64, copy=False).view(np.uint64).copy()
+        else:  # bool
+            bits = values.astype(np.uint64)
+        h = splitmix64(bits)
+        valid = series.validity_numpy()
+        h[~valid] = NULL_HASH
+    elif dt.is_decimal():
+        vals = np.array([float("nan") if v is None else float(v) for v in series.to_pylist()])
+        bits = vals.view(np.uint64).copy()
+        h = splitmix64(bits)
+        h[~series.validity_numpy()] = NULL_HASH
+    else:
+        # nested / logical types: hash the pickled python value
+        vals = np.empty(n, dtype=np.uint64)
+        for i, v in enumerate(series.to_pylist()):
+            if v is None:
+                vals[i] = NULL_HASH
+            else:
+                if isinstance(v, np.ndarray):
+                    payload = v.tobytes() + str(v.shape).encode()
+                else:
+                    payload = pickle.dumps(v)
+                d = blake2b(payload, digest_size=8).digest()
+                vals[i] = np.frombuffer(d, dtype=np.uint64)[0]
+        h = vals
+
+    if seed is not None:
+        seed_np = seed.to_numpy().astype(np.uint64) if hasattr(seed, "to_numpy") else np.asarray(seed, dtype=np.uint64)
+        h = splitmix64(h ^ seed_np)
+
+    return Series.from_numpy(h, series.name)
+
+
+def combine_hashes(hashes: list) -> np.ndarray:
+    """Combine per-column uint64 hash arrays into one row hash."""
+    out = hashes[0].copy()
+    with np.errstate(over="ignore"):
+        for h in hashes[1:]:
+            out = splitmix64(out ^ (h + _C3 + (out << np.uint64(6)) + (out >> np.uint64(2))))
+    return out

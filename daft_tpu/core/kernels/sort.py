@@ -1,0 +1,63 @@
+"""Multi-column sort.
+
+Reference parity: src/daft-core/src/array/ops/sort.rs and the Sort blocking sink
+(src/daft-local-execution/src/sinks/sort.rs). Host path: np.lexsort over
+order-preserving key encodings (strings sort lexicographically via their rank codes;
+each column contributes a value key plus a null-placement key so int64 keys keep full
+precision). Device path for numeric keys lives in daft_tpu/ops/sort.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .encoding import encode_column
+
+
+def _column_keys(series, descending: bool, nulls_first: bool) -> List[np.ndarray]:
+    """Return [value_key, null_key] for one sort column (null_key is more significant)."""
+    dt = series.dtype
+    valid = series.validity_numpy()
+    if (dt.is_numeric() or dt.is_boolean() or dt.is_temporal()) and not dt.is_decimal():
+        vals = series.to_numpy()
+        if vals.ndim != 1:
+            raise ValueError(f"cannot sort by non-scalar column {series.name!r}")
+        vals = np.asarray(vals)
+        if vals.dtype.kind == "f":
+            # NaN sorts after all numbers (ascending); negation keeps that relative order flipped
+            nan = np.isnan(vals)
+            if nan.any():
+                vals = np.where(nan, np.inf, vals)
+        if vals.dtype.kind == "b":
+            vals = vals.astype(np.int8)
+    else:
+        vals = encode_column(series)
+    if descending:
+        if vals.dtype.kind in "iu":
+            # bitwise-not is an order-reversing bijection for both signed and unsigned
+            # ints, avoiding the overflow of negation at INT64_MIN / uint64 >= 2^63
+            vals = np.bitwise_not(vals)
+        else:
+            vals = -vals
+    vals = np.where(valid, vals, vals.dtype.type(0))
+    # nulls_first: null_key = -1 for nulls, 0 for valid; nulls_last: 1 for nulls, 0 for valid
+    null_key = np.where(valid, np.int8(0), np.int8(-1 if nulls_first else 1))
+    # null_key must dominate the value key within this column
+    return [null_key, vals]
+
+
+def multi_argsort(
+    key_series: Sequence,
+    descending: Sequence[bool],
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> np.ndarray:
+    """Stable multi-column argsort. descending/nulls_first are per-key flags."""
+    if nulls_first is None:
+        nulls_first = list(descending)
+    keys: List[np.ndarray] = []
+    for s, d, nf in zip(key_series, descending, nulls_first):
+        keys.extend(_column_keys(s, d, nf))
+    # np.lexsort: last key is primary; our key list is [primary..secondary] so reverse
+    return np.lexsort(tuple(reversed(keys))).astype(np.int64)

@@ -1,0 +1,208 @@
+"""MicroPartition: the universal unit of execution and exchange.
+
+Reference parity: src/daft-micropartition/src/micropartition.rs:32-50 — schema +
+record-batch chunks + metadata + optional statistics. Operators consume and produce
+MicroPartitions; statistics feed zone-map pruning and cost estimates (daft-stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..datatype import DataType
+from ..schema import Schema
+from .recordbatch import RecordBatch
+from .series import Series
+
+
+@dataclasses.dataclass
+class ColumnStats:
+    """Min/max/null-count zone statistics (reference: src/daft-stats/src/column_stats)."""
+
+    min: Any = None
+    max: Any = None
+    null_count: Optional[int] = None
+
+    def merge(self, other: "ColumnStats") -> "ColumnStats":
+        def _mn(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            return min(a, b)
+
+        def _mx(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            return max(a, b)
+
+        nc = None
+        if self.null_count is not None and other.null_count is not None:
+            nc = self.null_count + other.null_count
+        return ColumnStats(_mn(self.min, other.min), _mx(self.max, other.max), nc)
+
+
+@dataclasses.dataclass
+class TableStatistics:
+    columns: Dict[str, ColumnStats]
+
+    @classmethod
+    def from_batch(cls, batch: RecordBatch) -> "TableStatistics":
+        cols = {}
+        for s in batch.columns():
+            if s.dtype.is_comparable() and not s.dtype.is_null() and s._pyobjs is None:
+                try:
+                    mn = s.min().to_pylist()[0]
+                    mx = s.max().to_pylist()[0]
+                    cols[s.name] = ColumnStats(mn, mx, s.null_count())
+                except Exception:
+                    pass
+        return cls(cols)
+
+
+class MicroPartition:
+    __slots__ = ("_schema", "_batches", "_stats")
+
+    def __init__(self, schema: Schema, batches: List[RecordBatch], stats: Optional[TableStatistics] = None):
+        self._schema = schema
+        self._batches = [b for b in batches if b.num_rows > 0] or []
+        self._stats = stats
+
+    # ---- constructors -------------------------------------------------------------
+    @classmethod
+    def from_pydict(cls, data: Dict[str, Any]) -> "MicroPartition":
+        b = RecordBatch.from_pydict(data)
+        return cls(b.schema, [b])
+
+    @classmethod
+    def from_arrow(cls, table) -> "MicroPartition":
+        b = RecordBatch.from_arrow(table)
+        return cls(b.schema, [b])
+
+    @classmethod
+    def from_batches(cls, batches: List[RecordBatch], schema: Optional[Schema] = None) -> "MicroPartition":
+        if not batches and schema is None:
+            raise ValueError("need a schema for an empty micropartition")
+        schema = schema or batches[0].schema
+        return cls(schema, batches)
+
+    @classmethod
+    def empty(cls, schema: Schema) -> "MicroPartition":
+        return cls(schema, [])
+
+    @classmethod
+    def concat(cls, parts: List["MicroPartition"]) -> "MicroPartition":
+        if not parts:
+            raise ValueError("need at least one micropartition")
+        schema = parts[0].schema
+        batches: List[RecordBatch] = []
+        for p in parts:
+            batches.extend(p._batches)
+        return cls(schema, batches)
+
+    # ---- accessors ----------------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def __len__(self) -> int:
+        return sum(b.num_rows for b in self._batches)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self)
+
+    def size_bytes(self) -> int:
+        return sum(b.size_bytes() for b in self._batches)
+
+    def batches(self) -> List[RecordBatch]:
+        return list(self._batches)
+
+    def statistics(self) -> Optional[TableStatistics]:
+        if self._stats is None and self._batches:
+            stats = TableStatistics.from_batch(self._batches[0])
+            for b in self._batches[1:]:
+                other = TableStatistics.from_batch(b)
+                merged = {}
+                for k in set(stats.columns) & set(other.columns):
+                    merged[k] = stats.columns[k].merge(other.columns[k])
+                stats = TableStatistics(merged)
+            self._stats = stats
+        return self._stats
+
+    def concat_or_empty(self) -> RecordBatch:
+        """Materialize as a single RecordBatch."""
+        if not self._batches:
+            return RecordBatch.empty(self._schema)
+        if len(self._batches) == 1:
+            return self._batches[0]
+        combined = RecordBatch.concat(self._batches)
+        self._batches = [combined]
+        return combined
+
+    def get_column(self, name: str) -> Series:
+        return self.concat_or_empty().get_column(name)
+
+    def __repr__(self) -> str:
+        return f"MicroPartition({self._schema}, rows={len(self)}, batches={len(self._batches)})"
+
+    # ---- conversion ---------------------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        return self.concat_or_empty().to_arrow()
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self.concat_or_empty().to_pydict()
+
+    def to_pandas(self):
+        return self.concat_or_empty().to_pandas()
+
+    # ---- per-batch delegated ops --------------------------------------------------
+    def _map(self, fn) -> "MicroPartition":
+        out = [fn(b) for b in self._batches]
+        schema = out[0].schema if out else None
+        if schema is None:
+            # apply to an empty batch to learn the output schema
+            schema = fn(RecordBatch.empty(self._schema)).schema
+        return MicroPartition(schema, out)
+
+    def select_columns(self, names: List[str]) -> "MicroPartition":
+        return MicroPartition(self._schema.select(names), [b.select_columns(names) for b in self._batches])
+
+    def cast_to_schema(self, schema: Schema) -> "MicroPartition":
+        return MicroPartition(schema, [b.cast_to_schema(schema) for b in self._batches])
+
+    def head(self, n: int) -> "MicroPartition":
+        out = []
+        remaining = n
+        for b in self._batches:
+            if remaining <= 0:
+                break
+            take = min(remaining, b.num_rows)
+            out.append(b.head(take))
+            remaining -= take
+        return MicroPartition(self._schema, out)
+
+    def slice(self, start: int, end: int) -> "MicroPartition":
+        out = []
+        offset = 0
+        for b in self._batches:
+            b_start = max(start - offset, 0)
+            b_end = min(end - offset, b.num_rows)
+            if b_end > b_start:
+                out.append(b.slice(b_start, b_end))
+            offset += b.num_rows
+        return MicroPartition(self._schema, out)
+
+    def split_into_batches(self, rows_per_batch: int) -> List[RecordBatch]:
+        """Morsel splitting for the streaming executor."""
+        out: List[RecordBatch] = []
+        for b in self._batches:
+            for s in range(0, b.num_rows, rows_per_batch):
+                out.append(b.slice(s, s + rows_per_batch))
+        return out

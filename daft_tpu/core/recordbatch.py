@@ -1,0 +1,257 @@
+"""RecordBatch: schema + equal-length columns.
+
+Reference parity: src/daft-recordbatch/src/lib.rs:68 (RecordBatch) including
+expression evaluation (lib.rs:726 eval_expression) and the relational ops under
+ops/ (joins, sort, groups). The universal in-memory unit below MicroPartition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from ..datatype import DataType, Field
+from ..schema import Schema
+from .series import Series
+
+
+class RecordBatch:
+    __slots__ = ("_schema", "_columns", "_num_rows")
+
+    def __init__(self, schema: Schema, columns: List[Series], num_rows: Optional[int] = None):
+        if num_rows is None:
+            num_rows = len(columns[0]) if columns else 0
+        for c in columns:
+            if len(c) != num_rows:
+                raise ValueError(f"column {c.name!r} has {len(c)} rows, expected {num_rows}")
+        self._schema = schema
+        self._columns = columns
+        self._num_rows = num_rows
+
+    # ---- constructors -------------------------------------------------------------
+    @classmethod
+    def from_pydict(cls, data: Dict[str, Any]) -> "RecordBatch":
+        cols = []
+        for name, vals in data.items():
+            if isinstance(vals, Series):
+                cols.append(vals.rename(name))
+            elif isinstance(vals, np.ndarray):
+                cols.append(Series.from_numpy(vals, name))
+            elif isinstance(vals, (pa.Array, pa.ChunkedArray)):
+                cols.append(Series.from_arrow(vals, name))
+            else:
+                cols.append(Series.from_pylist(list(vals), name))
+        schema = Schema([c.field() for c in cols])
+        return cls(schema, cols)
+
+    @classmethod
+    def from_arrow(cls, table: Union[pa.Table, pa.RecordBatch]) -> "RecordBatch":
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        cols = [Series.from_arrow(table.column(i), table.schema.names[i]) for i in range(table.num_columns)]
+        schema = Schema([c.field() for c in cols])
+        return cls(schema, cols, table.num_rows)
+
+    @classmethod
+    def empty(cls, schema: Schema) -> "RecordBatch":
+        return cls(schema, [Series.empty(f.name, f.dtype) for f in schema])
+
+    # ---- accessors ----------------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def num_columns(self) -> int:
+        return len(self._columns)
+
+    def columns(self) -> List[Series]:
+        return list(self._columns)
+
+    def get_column(self, name: str) -> Series:
+        return self._columns[self._schema.index_of(name)]
+
+    def column_names(self) -> List[str]:
+        return self._schema.column_names()
+
+    def size_bytes(self) -> int:
+        total = 0
+        for c in self._columns:
+            if c._pyobjs is not None:
+                total += 64 * len(c)
+            else:
+                total += c.to_arrow().nbytes
+        return total
+
+    def __repr__(self) -> str:
+        return f"RecordBatch({self._schema}, num_rows={self._num_rows})"
+
+    # ---- conversion ---------------------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        arrays = [c.to_arrow() for c in self._columns]
+        return pa.table(arrays, schema=self._schema.to_arrow())
+
+    def to_pydict(self) -> Dict[str, list]:
+        return {c.name: c.to_pylist() for c in self._columns}
+
+    def to_pylist(self) -> List[dict]:
+        d = self.to_pydict()
+        names = self.column_names()
+        return [{n: d[n][i] for n in names} for i in range(self._num_rows)]
+
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for c in self._columns:
+            if c._pyobjs is not None or c.dtype.is_logical():
+                data[c.name] = pd.Series(c.to_pylist(), dtype=object)
+            else:
+                data[c.name] = c.to_arrow().to_pandas()
+        return pd.DataFrame(data)
+
+    # ---- structural ops -----------------------------------------------------------
+    def with_columns(self, new_cols: List[Series]) -> "RecordBatch":
+        by_name = {c.name: c for c in self._columns}
+        order = self.column_names()
+        for c in new_cols:
+            if c.name not in by_name:
+                order.append(c.name)
+            by_name[c.name] = c
+        cols = [by_name[n] for n in order]
+        return RecordBatch(Schema([c.field() for c in cols]), cols, self._num_rows)
+
+    def select_columns(self, names: List[str]) -> "RecordBatch":
+        cols = [self.get_column(n) for n in names]
+        return RecordBatch(self._schema.select(names), cols, self._num_rows)
+
+    def exclude_columns(self, names: Sequence[str]) -> "RecordBatch":
+        keep = [n for n in self.column_names() if n not in set(names)]
+        return self.select_columns(keep)
+
+    def rename(self, mapping: Dict[str, str]) -> "RecordBatch":
+        cols = [c.rename(mapping.get(c.name, c.name)) for c in self._columns]
+        return RecordBatch(Schema([c.field() for c in cols]), cols, self._num_rows)
+
+    def cast_to_schema(self, schema: Schema) -> "RecordBatch":
+        cols = []
+        for f in schema:
+            if f.name in self._schema:
+                cols.append(self.get_column(f.name).cast(f.dtype))
+            else:
+                cols.append(Series.full_null(f.name, f.dtype, self._num_rows))
+        return RecordBatch(schema, cols, self._num_rows)
+
+    # ---- row ops ------------------------------------------------------------------
+    def slice(self, start: int, end: int) -> "RecordBatch":
+        start = max(0, min(start, self._num_rows))
+        end = max(start, min(end, self._num_rows))
+        return RecordBatch(self._schema, [c.slice(start, end) for c in self._columns], end - start)
+
+    def head(self, n: int) -> "RecordBatch":
+        return self.slice(0, n)
+
+    def take(self, indices) -> "RecordBatch":
+        if isinstance(indices, np.ndarray):
+            indices = Series.from_numpy(indices, "idx")
+        n = len(indices)
+        return RecordBatch(self._schema, [c.take(indices) for c in self._columns], n)
+
+    def filter_by_mask(self, mask: Series) -> "RecordBatch":
+        cols = [c.filter(mask) for c in self._columns]
+        n = len(cols[0]) if cols else int(
+            np.count_nonzero(np.nan_to_num(mask.to_numpy()) & mask.validity_numpy())
+        )
+        return RecordBatch(self._schema, cols, n)
+
+    @classmethod
+    def concat(cls, batches: List["RecordBatch"]) -> "RecordBatch":
+        if not batches:
+            raise ValueError("need at least one batch")
+        first = batches[0]
+        if len(batches) == 1:
+            return first
+        cols = []
+        for i, f in enumerate(first.schema):
+            cols.append(Series.concat([b._columns[i] for b in batches]))
+        return cls(first.schema, cols, sum(b.num_rows for b in batches))
+
+    # ---- relational kernels -------------------------------------------------------
+    def argsort(self, key_series: List[Series], descending: List[bool], nulls_first: Optional[List[bool]] = None) -> np.ndarray:
+        from .kernels.sort import multi_argsort
+
+        return multi_argsort(key_series, descending, nulls_first)
+
+    def sort(self, key_series: List[Series], descending: List[bool], nulls_first: Optional[List[bool]] = None) -> "RecordBatch":
+        return self.take(self.argsort(key_series, descending, nulls_first))
+
+    def hash_rows(self, column_names: Optional[List[str]] = None) -> np.ndarray:
+        from .kernels.hashing import combine_hashes
+
+        names = column_names or self.column_names()
+        if not names:
+            return np.zeros(self._num_rows, dtype=np.uint64)
+        hashes = [self.get_column(n).hash().to_numpy().astype(np.uint64) for n in names]
+        return combine_hashes(hashes)
+
+    def partition_by_hash(self, key_series: List[Series], num_partitions: int) -> List["RecordBatch"]:
+        from .kernels.hashing import combine_hashes
+
+        if self._num_rows == 0:
+            return [self] * 0 + [self.slice(0, 0) for _ in range(num_partitions)]
+        hashes = combine_hashes([s.hash().to_numpy().astype(np.uint64) for s in key_series])
+        part_ids = (hashes % np.uint64(num_partitions)).astype(np.int64)
+        return self._split_by_partition_ids(part_ids, num_partitions)
+
+    def partition_by_random(self, num_partitions: int, seed: int) -> List["RecordBatch"]:
+        rng = np.random.default_rng(seed)
+        part_ids = rng.integers(0, num_partitions, size=self._num_rows)
+        return self._split_by_partition_ids(part_ids.astype(np.int64), num_partitions)
+
+    def partition_by_range(self, key_series: List[Series], boundaries: "RecordBatch", descending: List[bool]) -> List["RecordBatch"]:
+        """Range partition using sampled boundary rows (num_partitions = len(boundaries)+1)."""
+        from .kernels.sort import multi_argsort
+
+        nb = boundaries.num_rows
+        if self._num_rows == 0:
+            return [self.slice(0, 0) for _ in range(nb + 1)]
+        # concatenate keys and boundaries, argsort, and find where boundaries land
+        combined = [Series.concat([k, boundaries.get_column(k.name).cast(k.dtype)]) for k in key_series]
+        order = multi_argsort(combined, descending)
+        rank = np.empty(len(order), dtype=np.int64)
+        rank[order] = np.arange(len(order))
+        n = self._num_rows
+        data_ranks = rank[:n]
+        boundary_ranks = np.sort(rank[n:])
+        part_ids = np.searchsorted(boundary_ranks, data_ranks, side="left").astype(np.int64)
+        return self._split_by_partition_ids(part_ids, nb + 1)
+
+    def partition_by_value(self, key_series: List[Series]) -> Tuple[List["RecordBatch"], "RecordBatch"]:
+        from .kernels.groupby import make_groups, group_row_indices
+
+        first_idx, gids, _ = make_groups(key_series)
+        num_groups = len(first_idx)
+        parts = [self.take(idx) for idx in group_row_indices(gids, num_groups)]
+        keys_batch = RecordBatch(
+            Schema([s.field() for s in key_series]), [s.take(first_idx) for s in key_series], num_groups
+        )
+        return parts, keys_batch
+
+    def _split_by_partition_ids(self, part_ids: np.ndarray, num_partitions: int) -> List["RecordBatch"]:
+        order = np.argsort(part_ids, kind="stable")
+        sorted_ids = part_ids[order]
+        boundaries = np.searchsorted(sorted_ids, np.arange(num_partitions + 1))
+        out = []
+        for p in range(num_partitions):
+            idx = order[boundaries[p] : boundaries[p + 1]]
+            out.append(self.take(idx))
+        return out

@@ -1,0 +1,652 @@
+"""Series: a named, typed column of values.
+
+Reference parity: src/daft-core/src/series/mod.rs:32 (Series over SeriesLike) and the
+~65 kernels under src/daft-core/src/array/ops/. Our host storage is a pyarrow.Array
+(Arrow semantics for nulls: kernels propagate nulls); device storage is a
+(values, validity) pair of jax Arrays produced by ``to_device()``.
+
+Kernels lean on pyarrow.compute for host execution — analogous to the reference
+leaning on arrow-rs compute — with numpy fallbacks. Device kernels live in
+daft_tpu.ops and are reached through the stage compiler, not through Series.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatype import DataType, Field
+
+
+def _combine(arr) -> pa.Array:
+    if isinstance(arr, pa.ChunkedArray):
+        return arr.combine_chunks()
+    return arr
+
+
+class Series:
+    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs")
+
+    def __init__(self, name: str, dtype: DataType, arrow: Optional[pa.Array], pyobjs: Optional[list] = None):
+        self._name = name
+        self._dtype = dtype
+        self._arrow = arrow
+        self._pyobjs = pyobjs  # only for DataType.python()
+
+    # ---- constructors -------------------------------------------------------------
+    @classmethod
+    def from_arrow(cls, arr, name: str = "series", dtype: Optional[DataType] = None) -> "Series":
+        arr = _combine(arr)
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        inferred = DataType.from_arrow(arr.type)
+        if dtype is None:
+            dtype = inferred
+        # normalize storage (e.g. string -> large_string) so downstream kernels see one repr
+        target = dtype.to_arrow() if not dtype.is_python() else None
+        if target is not None and arr.type != target:
+            arr = arr.cast(target)
+        return cls(name, dtype, arr)
+
+    @classmethod
+    def from_pylist(cls, data: Sequence[Any], name: str = "series", dtype: Optional[DataType] = None) -> "Series":
+        if dtype is not None and dtype.is_python():
+            return cls(name, dtype, None, list(data))
+        if dtype is not None:
+            arr = pa.array(data, type=dtype.to_arrow())
+            return cls(name, dtype, arr)
+        try:
+            arr = pa.array(data)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            return cls(name, DataType.python(), None, list(data))
+        return cls.from_arrow(arr, name)
+
+    @classmethod
+    def from_numpy(cls, arr: np.ndarray, name: str = "series", dtype: Optional[DataType] = None) -> "Series":
+        if arr.dtype == object:
+            return cls.from_pylist(list(arr), name, dtype)
+        if arr.ndim == 2:
+            # 2D numpy -> fixed-size-list / embedding-style column
+            inner = DataType.from_arrow(pa.from_numpy_dtype(arr.dtype))
+            dt = dtype or DataType.fixed_size_list(inner, arr.shape[1])
+            flat = pa.array(arr.reshape(-1))
+            fsl = pa.FixedSizeListArray.from_arrays(flat, arr.shape[1])
+            return cls.from_arrow(fsl, name, dt)
+        pa_arr = pa.array(arr)
+        s = cls.from_arrow(pa_arr, name)
+        if dtype is not None and s._dtype != dtype:
+            s = s.cast(dtype)
+        return s
+
+    @classmethod
+    def empty(cls, name: str, dtype: DataType) -> "Series":
+        if dtype.is_python():
+            return cls(name, dtype, None, [])
+        return cls(name, dtype, pa.array([], type=dtype.to_arrow()))
+
+    @classmethod
+    def full_null(cls, name: str, dtype: DataType, length: int) -> "Series":
+        if dtype.is_python():
+            return cls(name, dtype, None, [None] * length)
+        return cls(name, dtype, pa.nulls(length, type=dtype.to_arrow()))
+
+    # ---- basic accessors ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    def field(self) -> Field:
+        return Field(self._name, self._dtype)
+
+    def __len__(self) -> int:
+        if self._pyobjs is not None:
+            return len(self._pyobjs)
+        return len(self._arrow)
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self.to_pylist())
+
+    def __repr__(self) -> str:
+        vals = self.to_pylist()
+        preview = ", ".join(repr(v) for v in vals[:8])
+        if len(vals) > 8:
+            preview += ", …"
+        return f"Series[{self._name}: {self._dtype}; {len(self)}]([{preview}])"
+
+    def rename(self, name: str) -> "Series":
+        return Series(name, self._dtype, self._arrow, self._pyobjs)
+
+    def null_count(self) -> int:
+        if self._pyobjs is not None:
+            return sum(1 for v in self._pyobjs if v is None)
+        return self._arrow.null_count
+
+    # ---- conversion ---------------------------------------------------------------
+    def to_arrow(self) -> pa.Array:
+        if self._pyobjs is not None:
+            raise ValueError(f"Series {self._name!r} holds Python objects; no arrow representation")
+        return self._arrow
+
+    def to_pylist(self) -> list:
+        if self._pyobjs is not None:
+            return list(self._pyobjs)
+        if self._dtype.kind in ("embedding", "fixed_shape_tensor", "fixed_shape_image"):
+            np_vals = self.to_numpy()
+            valid = self.validity_numpy()
+            return [np_vals[i] if valid[i] else None for i in range(len(self))]
+        return self._arrow.to_pylist()
+
+    def to_numpy(self) -> np.ndarray:
+        """Dense numpy values. Nulls become 0/NaN; consult validity_numpy() for the mask."""
+        if self._pyobjs is not None:
+            return np.array(self._pyobjs, dtype=object)
+        arr = self._arrow
+        dt = self._dtype
+        if dt.kind in ("embedding", "fixed_shape_tensor", "fixed_shape_image", "fixed_size_list"):
+            if dt.kind == "fixed_shape_image":
+                inner_np = np.dtype(
+                    __import__("daft_tpu.datatype", fromlist=["ImageMode"]).ImageMode.np_dtype(dt.params[0])
+                )
+                shape = dt.shape
+            elif dt.kind == "fixed_shape_tensor":
+                inner_np, shape = dt.inner.to_numpy(), dt.shape
+            else:
+                inner_np, shape = dt.inner.to_numpy(), (dt.size,)
+            flat = arr.flatten()
+            values = np.asarray(flat.to_numpy(zero_copy_only=False), dtype=inner_np)
+            return values.reshape((len(arr),) + tuple(shape))
+        if dt.is_boolean():
+            return np.asarray(arr.to_numpy(zero_copy_only=False), dtype=bool)
+        if dt.is_string() or dt.is_binary() or dt.is_nested() or dt.is_logical():
+            return np.asarray(arr.to_numpy(zero_copy_only=False))
+        np_dtype = dt.to_numpy()
+        if arr.null_count:
+            fill = 0 if np_dtype.kind in "iub" else np.nan
+            arr = arr.fill_null(_null_fill_scalar(arr.type, fill))
+        out = arr.to_numpy(zero_copy_only=False)
+        return np.asarray(out).astype(np_dtype, copy=False)
+
+    def validity_numpy(self) -> np.ndarray:
+        if self._pyobjs is not None:
+            return np.array([v is not None for v in self._pyobjs], dtype=bool)
+        if self._arrow.null_count == 0:
+            return np.ones(len(self._arrow), dtype=bool)
+        return np.asarray(pc.is_valid(self._arrow).to_numpy(zero_copy_only=False), dtype=bool)
+
+    def to_device(self, pad_to: Optional[int] = None):
+        """(values, validity) as jax Arrays, optionally padded to ``pad_to`` rows.
+
+        Padding rows are marked invalid; this is the padding+masking convention the
+        stage compiler uses to keep XLA shapes static (SURVEY.md §7 'hard parts').
+        """
+        from ..utils import jax_setup  # noqa: F401  (enables x64 before device use)
+        import jax.numpy as jnp
+
+        values = self.to_numpy()
+        validity = self.validity_numpy()
+        if pad_to is not None and pad_to > len(self):
+            pad = pad_to - len(self)
+            pad_shape = (pad,) + values.shape[1:]
+            values = np.concatenate([values, np.zeros(pad_shape, dtype=values.dtype)])
+            validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
+        return jnp.asarray(values), jnp.asarray(validity)
+
+    # ---- selection kernels --------------------------------------------------------
+    def slice(self, start: int, end: int) -> "Series":
+        if self._pyobjs is not None:
+            return Series(self._name, self._dtype, None, self._pyobjs[start:end])
+        return Series(self._name, self._dtype, self._arrow.slice(start, end - start))
+
+    def head(self, n: int) -> "Series":
+        return self.slice(0, min(n, len(self)))
+
+    def take(self, indices) -> "Series":
+        idx = _as_index_array(indices)
+        if self._pyobjs is not None:
+            objs = self._pyobjs
+            out = [None if i is None else objs[i] for i in idx.to_pylist()]
+            return Series(self._name, self._dtype, None, out)
+        return Series(self._name, self._dtype, _combine(self._arrow.take(idx)))
+
+    def filter(self, mask: "Series") -> "Series":
+        m = mask._arrow if isinstance(mask, Series) else pa.array(mask, type=pa.bool_())
+        if self._pyobjs is not None:
+            keep = np.asarray(pc.fill_null(m, False).to_numpy(zero_copy_only=False), dtype=bool)
+            return Series(self._name, self._dtype, None, [v for v, k in zip(self._pyobjs, keep) if k])
+        return Series(self._name, self._dtype, _combine(self._arrow.filter(m, null_selection_behavior="drop")))
+
+    @classmethod
+    def concat(cls, series_list: List["Series"]) -> "Series":
+        if not series_list:
+            raise ValueError("need at least one series to concat")
+        first = series_list[0]
+        if any(s._dtype != first._dtype for s in series_list):
+            dts = {s._dtype.kind for s in series_list}
+            raise ValueError(f"cannot concat series of differing dtypes: {dts}")
+        if first._pyobjs is not None:
+            objs: list = []
+            for s in series_list:
+                objs.extend(s._pyobjs)
+            return cls(first._name, first._dtype, None, objs)
+        return cls(first._name, first._dtype, _combine(pa.concat_arrays([s._arrow for s in series_list])))
+
+    # ---- casts --------------------------------------------------------------------
+    def cast(self, dtype: DataType) -> "Series":
+        if dtype == self._dtype:
+            return self
+        if dtype.is_python():
+            return Series(self._name, dtype, None, self.to_pylist())
+        if self._pyobjs is not None:
+            return Series.from_pylist(self._pyobjs, self._name, dtype)
+        if self._dtype.is_string() and dtype.is_numeric():
+            arr = self._arrow.cast(dtype.to_arrow())
+            return Series(self._name, dtype, arr)
+        arr = self._arrow.cast(dtype.to_arrow())
+        return Series(self._name, dtype, arr)
+
+    # ---- null handling ------------------------------------------------------------
+    def is_null(self) -> "Series":
+        if self._pyobjs is not None:
+            return Series.from_pylist([v is None for v in self._pyobjs], self._name, DataType.bool())
+        return Series(self._name, DataType.bool(), pc.is_null(self._arrow))
+
+    def not_null(self) -> "Series":
+        if self._pyobjs is not None:
+            return Series.from_pylist([v is not None for v in self._pyobjs], self._name, DataType.bool())
+        return Series(self._name, DataType.bool(), pc.is_valid(self._arrow))
+
+    def fill_null(self, value: "Series") -> "Series":
+        self._require_arrow("fill_null")
+        fill = value._arrow
+        if len(fill) == 1:
+            fill = fill[0]
+        return Series(self._name, self._dtype, _combine(pc.fill_null(self._arrow, fill)))
+
+    def drop_nulls(self) -> "Series":
+        self._require_arrow("drop_nulls")
+        return Series(self._name, self._dtype, _combine(self._arrow.drop_null()))
+
+    # ---- sorting / hashing --------------------------------------------------------
+    def argsort(self, descending: bool = False, nulls_first: Optional[bool] = None) -> "Series":
+        self._require_arrow("argsort")
+        order = "descending" if descending else "ascending"
+        if nulls_first is None:
+            nulls_first = descending
+        placement = "at_start" if nulls_first else "at_end"
+        idx = pc.array_sort_indices(self._arrow, order=order, null_placement=placement)
+        return Series(self._name, DataType.uint64(), idx.cast(pa.uint64()))
+
+    def sort(self, descending: bool = False, nulls_first: Optional[bool] = None) -> "Series":
+        return self.take(self.argsort(descending, nulls_first))
+
+    def hash(self, seed: Optional["Series"] = None) -> "Series":
+        """Deterministic 64-bit hash per row (nulls hash to a fixed value).
+
+        Reference parity: src/daft-core/src/array/ops/hash.rs. Host implementation
+        vectorizes over numpy; see daft_tpu/core/kernels/hashing.py.
+        """
+        from .kernels.hashing import hash_series
+
+        return hash_series(self, seed)
+
+    # ---- elementwise arithmetic ---------------------------------------------------
+    def _require_arrow(self, op: str) -> pa.Array:
+        if self._pyobjs is not None:
+            raise ValueError(
+                f"operation {op!r} is not supported on Python-object series {self._name!r}; "
+                f"cast to a concrete dtype or use a UDF"
+            )
+        return self._arrow
+
+    def _binary(self, other: "Series", fn, out_dtype: Optional[DataType] = None, scalar_ok: bool = True) -> "Series":
+        a = self._require_arrow("binary op")
+        b = other._require_arrow("binary op")
+        la, lb = len(a), len(b)
+        if la != lb:
+            # broadcast the length-1 side as an O(1) pyarrow scalar where the kernel
+            # allows it, avoiding a full N-row materialization
+            if la == 1:
+                a = a[0] if scalar_ok else _repeat_array(a, lb)
+            elif lb == 1:
+                b = b[0] if scalar_ok else _repeat_array(b, la)
+            else:
+                raise ValueError(f"length mismatch in binary op: {la} vs {lb}")
+        out = fn(a, b)
+        if isinstance(out, pa.ChunkedArray):
+            out = _combine(out)
+        dt = out_dtype or DataType.from_arrow(out.type)
+        return Series(self._name, dt, out)
+
+    def __add__(self, other: "Series") -> "Series":
+        if self._dtype.is_string():
+            return self._binary(
+                other,
+                lambda a, b: pc.binary_join_element_wise(a, b, pa.scalar("", type=pa.large_string())),
+            )
+        return self._binary(other, pc.add)
+
+    def __sub__(self, other: "Series") -> "Series":
+        return self._binary(other, pc.subtract)
+
+    def __mul__(self, other: "Series") -> "Series":
+        return self._binary(other, pc.multiply)
+
+    def __truediv__(self, other: "Series") -> "Series":
+        def div(a, b):
+            a = a.cast(pa.float64()) if not pa.types.is_floating(a.type) else a
+            b = b.cast(pa.float64()) if not pa.types.is_floating(b.type) else b
+            b = _null_out_zeros(b)
+            return pc.divide(a, b)
+
+        return self._binary(other, div)
+
+    def __floordiv__(self, other: "Series") -> "Series":
+        out_int = self._dtype.is_integer() and other._dtype.is_integer()
+
+        def fdiv(a, b):
+            b_safe = _null_out_zeros(b)
+            q = pc.floor(pc.divide(a.cast(pa.float64()), b_safe.cast(pa.float64())))
+            if out_int:
+                return q.cast(_common_int_type(self._dtype.to_arrow(), other._dtype.to_arrow()) or pa.int64())
+            return q
+
+        return self._binary(other, fdiv)
+
+    def __mod__(self, other: "Series") -> "Series":
+        def mod(a, b):
+            an = _np_values(a)
+            bn = _np_values(b)
+            res_dtype = np.result_type(an, bn)
+            an, bn = np.broadcast_arrays(np.asarray(an), np.asarray(bn))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                out = np.mod(an, bn, where=(bn != 0), out=np.zeros(an.shape, dtype=res_dtype))
+            res = pa.array(out)
+            valid = pc.and_(_pa_validity(a, len(res)), _pa_validity(b, len(res)))
+            valid = pc.and_(valid, pa.array(bn != 0))
+            return pc.if_else(valid, res, pa.nulls(len(res), type=res.type))
+
+        return self._binary(other, mod)
+
+    def __pow__(self, other: "Series") -> "Series":
+        return self._binary(other, lambda a, b: pc.power(a.cast(pa.float64()), b.cast(pa.float64())))
+
+    def __neg__(self) -> "Series":
+        return Series(self._name, self._dtype, _combine(pc.negate(self._require_arrow("negate"))))
+
+    def abs(self) -> "Series":
+        return Series(self._name, self._dtype, _combine(pc.abs(self._require_arrow("abs"))))
+
+    # ---- comparisons --------------------------------------------------------------
+    def _cmp(self, other: "Series", fn) -> "Series":
+        return self._binary(other, fn, DataType.bool())
+
+    def __eq__(self, other):  # type: ignore[override]
+        if isinstance(other, Series):
+            return self._cmp(other, pc.equal)
+        return NotImplemented
+
+    def __ne__(self, other):  # type: ignore[override]
+        if isinstance(other, Series):
+            return self._cmp(other, pc.not_equal)
+        return NotImplemented
+
+    def __lt__(self, other: "Series") -> "Series":
+        return self._cmp(other, pc.less)
+
+    def __le__(self, other: "Series") -> "Series":
+        return self._cmp(other, pc.less_equal)
+
+    def __gt__(self, other: "Series") -> "Series":
+        return self._cmp(other, pc.greater)
+
+    def __ge__(self, other: "Series") -> "Series":
+        return self._cmp(other, pc.greater_equal)
+
+    def eq_null_safe(self, other: "Series") -> "Series":
+        def f(a, b):
+            eq = pc.equal(a, b)
+            both_null = pc.and_(pc.is_null(a), pc.is_null(b))
+            return pc.if_else(pc.is_null(eq), both_null, eq)
+
+        return self._binary(other, f, DataType.bool())
+
+    # ---- boolean logic (Kleene) ---------------------------------------------------
+    def __and__(self, other: "Series") -> "Series":
+        return self._binary(other, pc.and_kleene, DataType.bool())
+
+    def __or__(self, other: "Series") -> "Series":
+        return self._binary(other, pc.or_kleene, DataType.bool())
+
+    def __xor__(self, other: "Series") -> "Series":
+        return self._binary(other, pc.xor, DataType.bool())
+
+    def __invert__(self) -> "Series":
+        return Series(self._name, DataType.bool(), _combine(pc.invert(self._require_arrow("invert"))))
+
+    # ---- misc elementwise ---------------------------------------------------------
+    def is_in(self, values: "Series") -> "Series":
+        self._require_arrow("is_in")
+        out = pc.is_in(self._arrow, value_set=values._arrow)
+        out = pc.fill_null(out, False)
+        return Series(self._name, DataType.bool(), _combine(out))
+
+    def between(self, lower: "Series", upper: "Series") -> "Series":
+        ge = self >= lower
+        le = self <= upper
+        return ge & le
+
+    @staticmethod
+    def if_else(predicate: "Series", if_true: "Series", if_false: "Series") -> "Series":
+        n = max(len(predicate), len(if_true), len(if_false))
+
+        def bcast(a: pa.Array) -> pa.Array:
+            if len(a) == 1 and n != 1:
+                return pa.concat_arrays([a] * n)
+            return a
+
+        t, f = bcast(if_true._arrow), bcast(if_false._arrow)
+        p = bcast(predicate._arrow)
+        if t.type != f.type:
+            target = _common_arrow_type(t.type, f.type)
+            t, f = t.cast(target), f.cast(target)
+        out = pc.if_else(p, t, f)
+        return Series(if_true._name, DataType.from_arrow(out.type), _combine(out))
+
+    # ---- aggregations -------------------------------------------------------------
+    def _scalar(self, value, dtype: DataType) -> "Series":
+        return Series.from_pylist([value], self._name, dtype)
+
+    def sum(self) -> "Series":
+        self._require_arrow("sum")
+        if self._dtype.is_null():
+            return Series.full_null(self._name, DataType.int64(), 1)
+        out_dt = _agg_sum_dtype(self._dtype)
+        v = pc.sum(self._arrow).as_py()
+        return self._scalar(v, out_dt)
+
+    def mean(self) -> "Series":
+        self._require_arrow("mean")
+        v = pc.mean(self._arrow).as_py() if len(self._arrow) else None
+        return self._scalar(v, DataType.float64())
+
+    def min(self) -> "Series":
+        self._require_arrow("min")
+        v = pc.min(self._arrow).as_py() if len(self._arrow) else None
+        return self._scalar(v, self._dtype)
+
+    def max(self) -> "Series":
+        self._require_arrow("max")
+        v = pc.max(self._arrow).as_py() if len(self._arrow) else None
+        return self._scalar(v, self._dtype)
+
+    def count(self, mode: str = "valid") -> "Series":
+        if self._pyobjs is not None:
+            n = len(self._pyobjs)
+            nv = self.null_count()
+            v = {"valid": n - nv, "null": nv, "all": n}[mode]
+        else:
+            pc_mode = {"valid": "only_valid", "null": "only_null", "all": "all"}[mode]
+            v = pc.count(self._arrow, mode=pc_mode).as_py()
+        return self._scalar(v, DataType.uint64())
+
+    def count_distinct(self) -> "Series":
+        self._require_arrow("count_distinct")
+        v = pc.count_distinct(self._arrow, mode="only_valid").as_py()
+        return self._scalar(v, DataType.uint64())
+
+    def any_value(self, ignore_nulls: bool = False) -> "Series":
+        arr = self._arrow.drop_null() if ignore_nulls else self._arrow
+        v = arr[0].as_py() if len(arr) else None
+        return self._scalar(v, self._dtype)
+
+    def stddev(self, ddof: int = 0) -> "Series":
+        self._require_arrow("stddev")
+        v = pc.stddev(self._arrow, ddof=ddof).as_py() if len(self._arrow) else None
+        return self._scalar(v, DataType.float64())
+
+    def var(self, ddof: int = 0) -> "Series":
+        self._require_arrow("var")
+        v = pc.variance(self._arrow, ddof=ddof).as_py() if len(self._arrow) else None
+        return self._scalar(v, DataType.float64())
+
+    def skew(self) -> "Series":
+        x = self.to_numpy().astype(np.float64)
+        valid = self.validity_numpy()
+        x = x[valid]
+        if len(x) == 0:
+            return self._scalar(None, DataType.float64())
+        m = x.mean()
+        s2 = ((x - m) ** 2).mean()
+        if s2 == 0:
+            return self._scalar(0.0, DataType.float64())
+        m3 = ((x - m) ** 3).mean()
+        return self._scalar(float(m3 / s2**1.5), DataType.float64())
+
+    def bool_and(self) -> "Series":
+        self._require_arrow("bool_and")
+        v = pc.all(self._arrow, min_count=0).as_py() if len(self._arrow) else None
+        if self._arrow.null_count == len(self._arrow) and len(self._arrow) > 0:
+            v = None
+        return self._scalar(v, DataType.bool())
+
+    def bool_or(self) -> "Series":
+        self._require_arrow("bool_or")
+        v = pc.any(self._arrow, min_count=0).as_py() if len(self._arrow) else None
+        if self._arrow.null_count == len(self._arrow) and len(self._arrow) > 0:
+            v = None
+        return self._scalar(v, DataType.bool())
+
+    def agg_list(self) -> "Series":
+        return Series.from_pylist([self.to_pylist()], self._name, DataType.list(self._dtype))
+
+    def agg_concat(self) -> "Series":
+        if not self._dtype.is_list():
+            raise ValueError(f"agg_concat requires a list dtype, got {self._dtype}")
+        out: list = []
+        for v in self.to_pylist():
+            if v is not None:
+                out.extend(v)
+        return Series.from_pylist([out], self._name, self._dtype)
+
+    def approx_count_distinct(self) -> "Series":
+        from .kernels.sketches import hll_count_distinct
+
+        return self._scalar(hll_count_distinct(self), DataType.uint64())
+
+
+# ---- helpers ---------------------------------------------------------------------
+
+
+def _repeat_array(a: pa.Array, n: int) -> pa.Array:
+    if n == 0:
+        return a.slice(0, 0)
+    return _combine(pa.repeat(a[0], n))
+
+
+def _null_out_zeros(b):
+    """Replace zeros with null (divide-by-zero -> null); works for Array or Scalar."""
+    if isinstance(b, pa.Scalar):
+        if not b.is_valid or b.as_py() == 0:
+            return pa.scalar(None, type=b.type)
+        return b
+    return pc.if_else(pc.equal(b, _zero_like(b.type)), pa.nulls(len(b), type=b.type), b)
+
+
+def _np_values(x) -> np.ndarray:
+    """Dense numpy values of an arrow Array or Scalar (nulls -> 0)."""
+    if isinstance(x, pa.Scalar):
+        v = x.as_py()
+        return np.asarray(0 if v is None else v)
+    from ..datatype import DataType as _DT
+
+    return Series("tmp", _DT.from_arrow(x.type), x).to_numpy()
+
+
+def _pa_validity(x, n: int) -> pa.Array:
+    if isinstance(x, pa.Scalar):
+        return pa.array(np.full(n, x.is_valid))
+    return pc.is_valid(x)
+
+
+def _null_fill_scalar(t: pa.DataType, fill):
+    if pa.types.is_floating(t):
+        return pa.scalar(float("nan"), type=t)
+    if pa.types.is_temporal(t):
+        return pa.scalar(0, type=pa.int64()).cast(t)
+    return pa.scalar(fill, type=t)
+
+
+def _zero_like(t: pa.DataType):
+    if pa.types.is_floating(t):
+        return pa.scalar(0.0, type=t)
+    return pa.scalar(0, type=t)
+
+
+def _common_int_type(a: pa.DataType, b: pa.DataType):
+    if pa.types.is_integer(a) and pa.types.is_integer(b):
+        na, nb = np.dtype(a.to_pandas_dtype()), np.dtype(b.to_pandas_dtype())
+        return pa.from_numpy_dtype(np.promote_types(na, nb))
+    return None
+
+
+def _common_arrow_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
+    if a == b:
+        return a
+    if pa.types.is_null(a):
+        return b
+    if pa.types.is_null(b):
+        return a
+    try:
+        na, nb = np.dtype(a.to_pandas_dtype()), np.dtype(b.to_pandas_dtype())
+        return pa.from_numpy_dtype(np.promote_types(na, nb))
+    except Exception:
+        raise ValueError(f"no common type for {a} and {b}")
+
+
+def _agg_sum_dtype(dt: DataType) -> DataType:
+    if dt.is_signed_integer():
+        return DataType.int64()
+    if dt.is_unsigned_integer():
+        return DataType.uint64()
+    if dt.is_floating():
+        return dt if dt.kind == "float32" else DataType.float64()
+    if dt.is_decimal():
+        return dt
+    if dt.is_boolean():
+        return DataType.uint64()
+    raise ValueError(f"cannot sum dtype {dt}")
+
+
+def _as_index_array(indices) -> pa.Array:
+    if isinstance(indices, Series):
+        return indices.to_arrow()
+    if isinstance(indices, np.ndarray):
+        return pa.array(indices)
+    return pa.array(indices, type=pa.int64())

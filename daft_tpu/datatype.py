@@ -1,0 +1,558 @@
+"""DataType: the logical type system of the engine.
+
+Mirrors the reference's type lattice (reference: src/daft-schema/src/dtype.rs:14-140):
+all Arrow primitive/nested types plus the multimodal logical types Embedding, Image,
+FixedShapeImage, Tensor, FixedShapeTensor, SparseTensor, Python, and File.
+
+Unlike the reference (which wraps arrow2 dtypes in Rust), we keep a small immutable
+Python descriptor and treat the *engine schema* as the source of truth; pyarrow types
+are only the storage representation at the host boundary, and jnp dtypes are the
+storage representation on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+class TimeUnit:
+    SECONDS = "s"
+    MILLISECONDS = "ms"
+    MICROSECONDS = "us"
+    NANOSECONDS = "ns"
+
+    _ALL = ("s", "ms", "us", "ns")
+
+    @staticmethod
+    def check(unit: str) -> str:
+        if unit not in TimeUnit._ALL:
+            raise ValueError(f"invalid time unit {unit!r}; expected one of {TimeUnit._ALL}")
+        return unit
+
+
+class ImageMode:
+    """Supported image modes (reference: src/daft-schema/src/image_mode.rs)."""
+
+    L = "L"
+    LA = "LA"
+    RGB = "RGB"
+    RGBA = "RGBA"
+    L16 = "L16"
+    LA16 = "LA16"
+    RGB16 = "RGB16"
+    RGBA16 = "RGBA16"
+    RGB32F = "RGB32F"
+    RGBA32F = "RGBA32F"
+
+    _CHANNELS = {
+        "L": 1, "LA": 2, "RGB": 3, "RGBA": 4,
+        "L16": 1, "LA16": 2, "RGB16": 3, "RGBA16": 4,
+        "RGB32F": 3, "RGBA32F": 4,
+    }
+    _NP_DTYPE = {
+        "L": np.uint8, "LA": np.uint8, "RGB": np.uint8, "RGBA": np.uint8,
+        "L16": np.uint16, "LA16": np.uint16, "RGB16": np.uint16, "RGBA16": np.uint16,
+        "RGB32F": np.float32, "RGBA32F": np.float32,
+    }
+
+    @staticmethod
+    def num_channels(mode: str) -> int:
+        return ImageMode._CHANNELS[mode]
+
+    @staticmethod
+    def np_dtype(mode: str):
+        return ImageMode._NP_DTYPE[mode]
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: "DataType"
+
+    def __repr__(self) -> str:
+        return f"Field({self.name!r}, {self.dtype})"
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """An immutable logical data type.
+
+    ``kind`` is a string tag; ``params`` holds kind-specific parameters
+    (e.g. time unit, list element type, tensor shape).
+    """
+
+    kind: str
+    params: Tuple[Any, ...] = ()
+
+    # ---- constructors -------------------------------------------------------------
+    @classmethod
+    def null(cls) -> "DataType":
+        return cls("null")
+
+    @classmethod
+    def bool(cls) -> "DataType":
+        return cls("bool")
+
+    @classmethod
+    def int8(cls) -> "DataType":
+        return cls("int8")
+
+    @classmethod
+    def int16(cls) -> "DataType":
+        return cls("int16")
+
+    @classmethod
+    def int32(cls) -> "DataType":
+        return cls("int32")
+
+    @classmethod
+    def int64(cls) -> "DataType":
+        return cls("int64")
+
+    @classmethod
+    def uint8(cls) -> "DataType":
+        return cls("uint8")
+
+    @classmethod
+    def uint16(cls) -> "DataType":
+        return cls("uint16")
+
+    @classmethod
+    def uint32(cls) -> "DataType":
+        return cls("uint32")
+
+    @classmethod
+    def uint64(cls) -> "DataType":
+        return cls("uint64")
+
+    @classmethod
+    def float32(cls) -> "DataType":
+        return cls("float32")
+
+    @classmethod
+    def float64(cls) -> "DataType":
+        return cls("float64")
+
+    @classmethod
+    def bfloat16(cls) -> "DataType":
+        return cls("bfloat16")
+
+    @classmethod
+    def decimal128(cls, precision: int, scale: int) -> "DataType":
+        return cls("decimal128", (precision, scale))
+
+    @classmethod
+    def string(cls) -> "DataType":
+        return cls("string")
+
+    @classmethod
+    def binary(cls) -> "DataType":
+        return cls("binary")
+
+    @classmethod
+    def fixed_size_binary(cls, size: int) -> "DataType":
+        return cls("fixed_size_binary", (size,))
+
+    @classmethod
+    def date(cls) -> "DataType":
+        return cls("date")
+
+    @classmethod
+    def time(cls, unit: str = TimeUnit.MICROSECONDS) -> "DataType":
+        return cls("time", (TimeUnit.check(unit),))
+
+    @classmethod
+    def timestamp(cls, unit: str = TimeUnit.MICROSECONDS, timezone: Optional[str] = None) -> "DataType":
+        return cls("timestamp", (TimeUnit.check(unit), timezone))
+
+    @classmethod
+    def duration(cls, unit: str = TimeUnit.MICROSECONDS) -> "DataType":
+        return cls("duration", (TimeUnit.check(unit),))
+
+    @classmethod
+    def interval(cls) -> "DataType":
+        return cls("interval")
+
+    @classmethod
+    def list(cls, inner: "DataType") -> "DataType":
+        return cls("list", (inner,))
+
+    @classmethod
+    def fixed_size_list(cls, inner: "DataType", size: int) -> "DataType":
+        return cls("fixed_size_list", (inner, size))
+
+    @classmethod
+    def struct(cls, fields: dict) -> "DataType":
+        # field order is significant and preserved (arrow round-trips must not reorder)
+        return cls("struct", tuple(fields.items()) if isinstance(fields, dict) else tuple(fields))
+
+    @classmethod
+    def map(cls, key: "DataType", value: "DataType") -> "DataType":
+        return cls("map", (key, value))
+
+    # ---- multimodal logical types -------------------------------------------------
+    @classmethod
+    def embedding(cls, inner: "DataType", size: int) -> "DataType":
+        if not inner.is_numeric():
+            raise ValueError(f"embedding inner dtype must be numeric, got {inner}")
+        return cls("embedding", (inner, size))
+
+    @classmethod
+    def image(cls, mode: Optional[str] = None) -> "DataType":
+        if mode is not None and mode not in ImageMode._CHANNELS:
+            raise ValueError(f"invalid image mode {mode!r}")
+        return cls("image", (mode,))
+
+    @classmethod
+    def fixed_shape_image(cls, mode: str, height: int, width: int) -> "DataType":
+        if mode not in ImageMode._CHANNELS:
+            raise ValueError(f"invalid image mode {mode!r}")
+        return cls("fixed_shape_image", (mode, height, width))
+
+    @classmethod
+    def tensor(cls, inner: "DataType", shape: Optional[Tuple[int, ...]] = None) -> "DataType":
+        if shape is not None:
+            return cls("fixed_shape_tensor", (inner, tuple(shape)))
+        return cls("tensor", (inner,))
+
+    @classmethod
+    def fixed_shape_tensor(cls, inner: "DataType", shape: Tuple[int, ...]) -> "DataType":
+        return cls("fixed_shape_tensor", (inner, tuple(shape)))
+
+    @classmethod
+    def sparse_tensor(cls, inner: "DataType") -> "DataType":
+        return cls("sparse_tensor", (inner,))
+
+    @classmethod
+    def python(cls) -> "DataType":
+        return cls("python")
+
+    @classmethod
+    def file(cls) -> "DataType":
+        return cls("file")
+
+    # ---- predicates ---------------------------------------------------------------
+    _INTEGER_KINDS = frozenset({"int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64"})
+    _FLOAT_KINDS = frozenset({"float32", "float64", "bfloat16"})
+    _TEMPORAL_KINDS = frozenset({"date", "time", "timestamp", "duration"})
+
+    def is_null(self) -> bool:
+        return self.kind == "null"
+
+    def is_boolean(self) -> bool:
+        return self.kind == "bool"
+
+    def is_integer(self) -> bool:
+        return self.kind in self._INTEGER_KINDS
+
+    def is_signed_integer(self) -> bool:
+        return self.kind in ("int8", "int16", "int32", "int64")
+
+    def is_unsigned_integer(self) -> bool:
+        return self.kind in ("uint8", "uint16", "uint32", "uint64")
+
+    def is_floating(self) -> bool:
+        return self.kind in self._FLOAT_KINDS
+
+    def is_decimal(self) -> bool:
+        return self.kind == "decimal128"
+
+    def is_numeric(self) -> bool:
+        return self.is_integer() or self.is_floating() or self.is_decimal()
+
+    def is_temporal(self) -> bool:
+        return self.kind in self._TEMPORAL_KINDS
+
+    def is_string(self) -> bool:
+        return self.kind == "string"
+
+    def is_binary(self) -> bool:
+        return self.kind in ("binary", "fixed_size_binary")
+
+    def is_list(self) -> bool:
+        return self.kind in ("list", "fixed_size_list")
+
+    def is_struct(self) -> bool:
+        return self.kind == "struct"
+
+    def is_map(self) -> bool:
+        return self.kind == "map"
+
+    def is_nested(self) -> bool:
+        return self.is_list() or self.is_struct() or self.is_map()
+
+    def is_logical(self) -> bool:
+        return self.kind in (
+            "embedding", "image", "fixed_shape_image", "tensor", "fixed_shape_tensor",
+            "sparse_tensor", "file",
+        )
+
+    def is_python(self) -> bool:
+        return self.kind == "python"
+
+    def is_comparable(self) -> bool:
+        return (
+            self.is_numeric() or self.is_boolean() or self.is_string()
+            or self.is_temporal() or self.kind == "binary" or self.is_null()
+        )
+
+    def is_device_compatible(self) -> bool:
+        """True if values of this type can live on a TPU as a fixed-width jnp array."""
+        return (
+            self.is_integer() or self.is_floating() or self.is_boolean()
+            or self.is_temporal() or self.kind in ("embedding", "fixed_shape_tensor", "fixed_shape_image")
+        )
+
+    # ---- accessors ----------------------------------------------------------------
+    @property
+    def inner(self) -> "DataType":
+        if self.kind in ("list", "fixed_size_list", "embedding", "tensor", "fixed_shape_tensor", "sparse_tensor"):
+            return self.params[0]
+        raise ValueError(f"{self} has no inner dtype")
+
+    @property
+    def size(self) -> int:
+        if self.kind in ("fixed_size_list", "embedding"):
+            return self.params[1]
+        if self.kind == "fixed_size_binary":
+            return self.params[0]
+        raise ValueError(f"{self} has no fixed size")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        if self.kind == "fixed_shape_tensor":
+            return self.params[1]
+        if self.kind == "fixed_shape_image":
+            mode, h, w = self.params
+            return (h, w, ImageMode.num_channels(mode))
+        raise ValueError(f"{self} has no fixed shape")
+
+    @property
+    def image_mode(self) -> Optional[str]:
+        if self.kind in ("image", "fixed_shape_image"):
+            return self.params[0]
+        raise ValueError(f"{self} is not an image dtype")
+
+    @property
+    def time_unit(self) -> str:
+        if self.kind in ("time", "timestamp", "duration"):
+            return self.params[0]
+        raise ValueError(f"{self} has no time unit")
+
+    @property
+    def timezone(self) -> Optional[str]:
+        if self.kind == "timestamp":
+            return self.params[1]
+        raise ValueError(f"{self} is not a timestamp")
+
+    @property
+    def struct_fields(self) -> Tuple[Tuple[str, "DataType"], ...]:
+        if self.kind != "struct":
+            raise ValueError(f"{self} is not a struct")
+        return self.params
+
+    # ---- conversion ---------------------------------------------------------------
+    def to_arrow(self) -> pa.DataType:
+        return _to_arrow(self)
+
+    @classmethod
+    def from_arrow(cls, t: pa.DataType) -> "DataType":
+        return _from_arrow(t)
+
+    def to_numpy(self) -> np.dtype:
+        m = _NUMPY_MAP.get(self.kind)
+        if m is None:
+            raise ValueError(f"{self} has no numpy representation")
+        return np.dtype(m)
+
+    def to_jax(self):
+        """The jnp dtype used to represent this column's values on device."""
+        import jax.numpy as jnp
+
+        if self.is_boolean():
+            return jnp.bool_
+        if self.kind == "bfloat16":
+            return jnp.bfloat16
+        if self.is_integer() or self.is_floating():
+            return jnp.dtype(self.kind)
+        if self.kind == "date":
+            return jnp.int32
+        if self.kind in ("timestamp", "duration", "time"):
+            return jnp.int64
+        if self.kind in ("embedding", "fixed_shape_tensor", "fixed_shape_image"):
+            return self.inner.to_jax() if self.kind != "fixed_shape_image" else jnp.dtype(
+                ImageMode.np_dtype(self.params[0])
+            )
+        raise ValueError(f"{self} is not device-compatible")
+
+    # ---- misc ---------------------------------------------------------------------
+    def __repr__(self) -> str:
+        if not self.params:
+            return self.kind.capitalize() if self.kind != "null" else "Null"
+        if self.kind == "list":
+            return f"List[{self.params[0]}]"
+        if self.kind == "fixed_size_list":
+            return f"FixedSizeList[{self.params[0]}; {self.params[1]}]"
+        if self.kind == "embedding":
+            return f"Embedding[{self.params[0]}; {self.params[1]}]"
+        if self.kind == "fixed_shape_tensor":
+            return f"Tensor[{self.params[0]}; {'x'.join(map(str, self.params[1]))}]"
+        if self.kind == "tensor":
+            return f"Tensor[{self.params[0]}]"
+        if self.kind == "sparse_tensor":
+            return f"SparseTensor[{self.params[0]}]"
+        if self.kind == "image":
+            return f"Image[{self.params[0] or 'MIXED'}]"
+        if self.kind == "fixed_shape_image":
+            return f"Image[{self.params[0]}; {self.params[1]}x{self.params[2]}]"
+        if self.kind == "struct":
+            inner = ", ".join(f"{n}: {t}" for n, t in self.params)
+            return f"Struct[{inner}]"
+        if self.kind == "map":
+            return f"Map[{self.params[0]}: {self.params[1]}]"
+        if self.kind == "timestamp":
+            unit, tz = self.params
+            return f"Timestamp({unit}, {tz})" if tz else f"Timestamp({unit})"
+        if self.kind in ("time", "duration"):
+            return f"{self.kind.capitalize()}({self.params[0]})"
+        if self.kind == "decimal128":
+            return f"Decimal128({self.params[0]}, {self.params[1]})"
+        if self.kind == "fixed_size_binary":
+            return f"FixedSizeBinary({self.params[0]})"
+        return f"{self.kind}{self.params}"
+
+
+_NUMPY_MAP = {
+    "bool": "bool",
+    "int8": "int8", "int16": "int16", "int32": "int32", "int64": "int64",
+    "uint8": "uint8", "uint16": "uint16", "uint32": "uint32", "uint64": "uint64",
+    "float32": "float32", "float64": "float64",
+    "date": "int32", "timestamp": "int64", "duration": "int64", "time": "int64",
+}
+
+_ARROW_PRIMITIVES = {
+    "null": pa.null(),
+    "bool": pa.bool_(),
+    "int8": pa.int8(), "int16": pa.int16(), "int32": pa.int32(), "int64": pa.int64(),
+    "uint8": pa.uint8(), "uint16": pa.uint16(), "uint32": pa.uint32(), "uint64": pa.uint64(),
+    "float32": pa.float32(), "float64": pa.float64(),
+    "string": pa.large_string(),
+    "binary": pa.large_binary(),
+    "date": pa.date32(),
+    "interval": pa.month_day_nano_interval(),
+}
+
+
+def _to_arrow(dt: DataType) -> pa.DataType:
+    prim = _ARROW_PRIMITIVES.get(dt.kind)
+    if prim is not None:
+        return prim
+    k = dt.kind
+    if k == "bfloat16":
+        # stored as uint16 bit pattern at the host boundary
+        return pa.uint16()
+    if k == "decimal128":
+        return pa.decimal128(*dt.params)
+    if k == "fixed_size_binary":
+        return pa.binary(dt.params[0])
+    if k == "time":
+        return pa.time64("us" if dt.params[0] in ("s", "ms", "us") else "ns")
+    if k == "timestamp":
+        return pa.timestamp(dt.params[0], tz=dt.params[1])
+    if k == "duration":
+        return pa.duration(dt.params[0])
+    if k == "list":
+        return pa.large_list(_to_arrow(dt.params[0]))
+    if k == "fixed_size_list":
+        return pa.list_(_to_arrow(dt.params[0]), dt.params[1])
+    if k == "struct":
+        return pa.struct([pa.field(n, _to_arrow(t)) for n, t in dt.params])
+    if k == "map":
+        return pa.map_(_to_arrow(dt.params[0]), _to_arrow(dt.params[1]))
+    if k == "embedding":
+        return pa.list_(_to_arrow(dt.params[0]), dt.params[1])
+    if k == "image":
+        # variable-shape image: struct of encoded/decoded payload
+        return pa.struct([
+            pa.field("data", pa.large_binary()),
+            pa.field("mode", pa.uint8()),
+            pa.field("height", pa.uint32()),
+            pa.field("width", pa.uint32()),
+            pa.field("channels", pa.uint8()),
+        ])
+    if k == "fixed_shape_image":
+        mode, h, w = dt.params
+        n = h * w * ImageMode.num_channels(mode)
+        return pa.list_(pa.from_numpy_dtype(ImageMode.np_dtype(mode)), n)
+    if k == "tensor":
+        return pa.struct([
+            pa.field("data", pa.large_list(_to_arrow(dt.params[0]))),
+            pa.field("shape", pa.large_list(pa.uint64())),
+        ])
+    if k == "fixed_shape_tensor":
+        inner, shape = dt.params
+        n = int(np.prod(shape)) if shape else 1
+        return pa.list_(_to_arrow(inner), n)
+    if k == "sparse_tensor":
+        return pa.struct([
+            pa.field("values", pa.large_list(_to_arrow(dt.params[0]))),
+            pa.field("indices", pa.large_list(pa.uint64())),
+            pa.field("shape", pa.large_list(pa.uint64())),
+        ])
+    if k == "file":
+        return pa.struct([
+            pa.field("path", pa.large_string()),
+            pa.field("data", pa.large_binary()),
+        ])
+    if k == "python":
+        raise ValueError("Python dtype has no arrow representation")
+    raise ValueError(f"cannot convert {dt} to arrow")
+
+
+def _from_arrow(t: pa.DataType) -> DataType:
+    if pa.types.is_null(t):
+        return DataType.null()
+    if pa.types.is_boolean(t):
+        return DataType.bool()
+    for kind in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64"):
+        if t == getattr(pa, kind)():
+            return DataType(kind)
+    if pa.types.is_float16(t):
+        return DataType.float32()
+    if pa.types.is_float32(t):
+        return DataType.float32()
+    if pa.types.is_float64(t):
+        return DataType.float64()
+    if pa.types.is_decimal(t):
+        return DataType.decimal128(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or (hasattr(pa.types, "is_string_view") and pa.types.is_string_view(t)):
+        return DataType.string()
+    if pa.types.is_fixed_size_binary(t):
+        return DataType.fixed_size_binary(t.byte_width)
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t) or (hasattr(pa.types, "is_binary_view") and pa.types.is_binary_view(t)):
+        return DataType.binary()
+    if pa.types.is_date(t):
+        return DataType.date()
+    if pa.types.is_time(t):
+        return DataType.time("us" if pa.types.is_time32(t) or t.unit == "us" else t.unit)
+    if pa.types.is_timestamp(t):
+        return DataType.timestamp(t.unit, t.tz)
+    if pa.types.is_duration(t):
+        return DataType.duration(t.unit)
+    if pa.types.is_interval(t):
+        return DataType.interval()
+    if pa.types.is_fixed_size_list(t):
+        return DataType.fixed_size_list(_from_arrow(t.value_type), t.list_size)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return DataType.list(_from_arrow(t.value_type))
+    if pa.types.is_map(t):
+        return DataType.map(_from_arrow(t.key_type), _from_arrow(t.item_type))
+    if pa.types.is_struct(t):
+        return DataType.struct({f.name: _from_arrow(f.type) for f in t})
+    if pa.types.is_dictionary(t):
+        return _from_arrow(t.value_type)
+    raise ValueError(f"unsupported arrow type: {t}")

@@ -1,0 +1,117 @@
+"""Schema: an ordered mapping of field name -> DataType.
+
+Reference parity: src/daft-schema/src/schema.rs:22 (Schema) and field.rs (Field).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Union
+
+import pyarrow as pa
+
+from .datatype import DataType, Field
+
+
+class Schema:
+    def __init__(self, fields: List[Field]):
+        names = [f.name for f in fields]
+        if len(set(names)) != len(names):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate field names in schema: {dupes}")
+        self._fields: List[Field] = list(fields)
+        self._index: Dict[str, int] = {f.name: i for i, f in enumerate(fields)}
+
+    # ---- constructors -------------------------------------------------------------
+    @classmethod
+    def from_pairs(cls, pairs) -> "Schema":
+        return cls([Field(n, t) for n, t in pairs])
+
+    @classmethod
+    def from_pydict(cls, d: Dict[str, DataType]) -> "Schema":
+        return cls([Field(n, t) for n, t in d.items()])
+
+    @classmethod
+    def from_arrow(cls, schema: pa.Schema) -> "Schema":
+        return cls([Field(f.name, DataType.from_arrow(f.type)) for f in schema])
+
+    @classmethod
+    def empty(cls) -> "Schema":
+        return cls([])
+
+    # ---- accessors ----------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._fields)
+
+    def __iter__(self) -> Iterator[Field]:
+        return iter(self._fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __getitem__(self, key: Union[str, int]) -> Field:
+        if isinstance(key, int):
+            return self._fields[key]
+        idx = self._index.get(key)
+        if idx is None:
+            raise KeyError(f"field {key!r} not found in schema; available: {self.column_names()}")
+        return self._fields[idx]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self._fields == other._fields
+
+    def __hash__(self) -> int:
+        return hash(tuple(self._fields))
+
+    def index_of(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            raise KeyError(f"field {name!r} not found in schema; available: {self.column_names()}")
+        return idx
+
+    def get(self, name: str) -> Optional[Field]:
+        idx = self._index.get(name)
+        return self._fields[idx] if idx is not None else None
+
+    def column_names(self) -> List[str]:
+        return [f.name for f in self._fields]
+
+    names = column_names
+
+    def fields(self) -> List[Field]:
+        return list(self._fields)
+
+    def to_pydict(self) -> Dict[str, DataType]:
+        return {f.name: f.dtype for f in self._fields}
+
+    # ---- transforms ---------------------------------------------------------------
+    def select(self, names: List[str]) -> "Schema":
+        return Schema([self[n] for n in names])
+
+    def exclude(self, names) -> "Schema":
+        drop = set(names)
+        return Schema([f for f in self._fields if f.name not in drop])
+
+    def union(self, other: "Schema") -> "Schema":
+        """Disjoint union — raises on duplicate names."""
+        return Schema(self._fields + other._fields)
+
+    def non_distinct_union(self, other: "Schema") -> "Schema":
+        out = list(self._fields)
+        for f in other:
+            if f.name not in self._index:
+                out.append(f)
+        return Schema(out)
+
+    def rename(self, mapping: Dict[str, str]) -> "Schema":
+        return Schema([Field(mapping.get(f.name, f.name), f.dtype) for f in self._fields])
+
+    # ---- conversion ---------------------------------------------------------------
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema([pa.field(f.name, f.dtype.to_arrow()) for f in self._fields])
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{f.name}: {f.dtype}" for f in self._fields)
+        return f"Schema({inner})"
+
+    def _truncated_table_string(self) -> str:
+        return "\n".join(f"  {f.name:<24} {f.dtype}" for f in self._fields)

@@ -1,0 +1,16 @@
+"""Central JAX configuration for the engine.
+
+Import this module before any device work. Enables 64-bit mode: a data engine's
+aggregation semantics (int64 sums, float64 means) require x64; compute-heavy kernels
+opt into bf16/f32 explicitly where precision allows (SURVEY.md §7 MXU notes).
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+
+def get_jax():
+    return jax

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh (SURVEY.md §7 / task environment notes)
+so multi-chip sharding paths are exercised without TPU hardware. Must run before the
+first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def make_df():
+    import daft_tpu
+
+    def _make(data):
+        return daft_tpu.from_pydict(data)
+
+    return _make

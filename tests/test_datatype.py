@@ -1,0 +1,95 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu import DataType, Schema, Field
+
+
+def test_primitives_roundtrip_arrow():
+    for dt in [
+        DataType.bool(), DataType.int8(), DataType.int16(), DataType.int32(), DataType.int64(),
+        DataType.uint8(), DataType.uint16(), DataType.uint32(), DataType.uint64(),
+        DataType.float32(), DataType.float64(), DataType.string(), DataType.binary(),
+        DataType.date(), DataType.timestamp("us"), DataType.timestamp("ns", "UTC"),
+        DataType.duration("ms"), DataType.decimal128(10, 2), DataType.null(),
+    ]:
+        assert DataType.from_arrow(dt.to_arrow()) == dt
+
+
+def test_nested_roundtrip():
+    dt = DataType.list(DataType.int64())
+    assert DataType.from_arrow(dt.to_arrow()) == dt
+    dt = DataType.struct({"a": DataType.int64(), "b": DataType.string()})
+    assert DataType.from_arrow(dt.to_arrow()) == dt
+    dt = DataType.map(DataType.string(), DataType.int64())
+    assert DataType.from_arrow(dt.to_arrow()) == dt
+    dt = DataType.fixed_size_list(DataType.float32(), 4)
+    assert DataType.from_arrow(dt.to_arrow()) == dt
+
+
+def test_predicates():
+    assert DataType.int32().is_integer()
+    assert DataType.int32().is_numeric()
+    assert not DataType.int32().is_floating()
+    assert DataType.float32().is_floating()
+    assert DataType.uint8().is_unsigned_integer()
+    assert DataType.string().is_string()
+    assert DataType.timestamp().is_temporal()
+    assert DataType.list(DataType.int64()).is_nested()
+    assert DataType.embedding(DataType.float32(), 128).is_logical()
+    assert DataType.image().is_logical()
+
+
+def test_multimodal_types():
+    emb = DataType.embedding(DataType.float32(), 512)
+    assert emb.inner == DataType.float32()
+    assert emb.size == 512
+    assert emb.is_device_compatible()
+
+    img = DataType.fixed_shape_image("RGB", 224, 224)
+    assert img.shape == (224, 224, 3)
+    assert img.is_device_compatible()
+
+    t = DataType.tensor(DataType.float32(), (3, 4))
+    assert t.kind == "fixed_shape_tensor"
+    assert t.shape == (3, 4)
+
+    with pytest.raises(ValueError):
+        DataType.embedding(DataType.string(), 4)
+    with pytest.raises(ValueError):
+        DataType.image("BAD")
+
+
+def test_jax_dtypes():
+    import jax.numpy as jnp
+
+    assert DataType.float32().to_jax() == jnp.float32
+    assert DataType.int64().to_jax() == jnp.int64
+    assert DataType.bool().to_jax() == jnp.bool_
+    assert DataType.date().to_jax() == jnp.int32
+    assert DataType.embedding(DataType.float32(), 8).to_jax() == jnp.float32
+    assert not DataType.string().is_device_compatible()
+
+
+def test_schema_basic():
+    s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string()})
+    assert len(s) == 2
+    assert s.column_names() == ["a", "b"]
+    assert s["a"].dtype == DataType.int64()
+    assert "b" in s
+    assert s.index_of("b") == 1
+    with pytest.raises(KeyError):
+        s["zzz"]
+    with pytest.raises(ValueError):
+        Schema([Field("x", DataType.int64()), Field("x", DataType.int32())])
+
+
+def test_schema_ops():
+    s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string(), "c": DataType.float64()})
+    assert s.select(["c", "a"]).column_names() == ["c", "a"]
+    assert s.exclude(["b"]).column_names() == ["a", "c"]
+    s2 = Schema.from_pydict({"d": DataType.bool()})
+    assert s.union(s2).column_names() == ["a", "b", "c", "d"]
+    assert s.rename({"a": "x"}).column_names() == ["x", "b", "c"]
+    arrow = s.to_arrow()
+    assert Schema.from_arrow(arrow) == s
