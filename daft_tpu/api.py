@@ -1,4 +1,9 @@
 """Top-level user API re-exports (DataFrame, col, lit, read_* functions).
 
-Populated as the API surface lands; daft_tpu/__init__.py lazily forwards here.
+daft_tpu/__init__.py lazily forwards attribute access here.
 """
+
+from .expressions import Expression, col, lit
+from .udf import func
+
+__all__ = ["Expression", "col", "lit", "func"]

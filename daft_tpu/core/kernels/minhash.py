@@ -1,0 +1,62 @@
+"""MinHash signatures for LSH dedup.
+
+Reference parity: src/daft-minhash/src/lib.rs:279 (pub fn minhash) — word-shingle
+MinHash with k universal-hash permutations h_i(x) = (a_i * x + b_i) mod p.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import splitmix64
+
+_MERSENNE_P = np.uint64((1 << 61) - 1)
+_MAX_HASH = np.uint64(0xFFFFFFFF)
+
+
+def _permutations(num_hashes: int, seed: int):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(1, 1 << 32, size=num_hashes, dtype=np.uint64)
+    b = rng.integers(0, 1 << 32, size=num_hashes, dtype=np.uint64)
+    return a, b
+
+
+def minhash_series(series, num_hashes: int = 16, ngram_size: int = 1, seed: int = 1):
+    from ..series import Series
+    from ...datatype import DataType
+
+    a, b = _permutations(num_hashes, seed)
+    out = np.full((len(series), num_hashes), _MAX_HASH, dtype=np.uint64)
+    valid = series.validity_numpy()
+    values = series.to_pylist()
+    for i, text in enumerate(values):
+        if text is None:
+            continue
+        words = text.split()
+        if len(words) < ngram_size:
+            shingles = [" ".join(words)] if words else []
+        else:
+            shingles = [" ".join(words[j : j + ngram_size]) for j in range(len(words) - ngram_size + 1)]
+        if not shingles:
+            continue
+        base = np.frombuffer(
+            b"".join(
+                __import__("hashlib").blake2b(s.encode(), digest_size=8).digest() for s in shingles
+            ),
+            dtype=np.uint64,
+        )
+        with np.errstate(over="ignore"):
+            # universal hashing into 32-bit space per permutation
+            hashed = (base[:, None] * a[None, :] + b[None, :]) % _MERSENNE_P
+            hashed = hashed & _MAX_HASH
+        out[i] = hashed.min(axis=0)
+    flat = out.reshape(-1)
+    import pyarrow as pa
+
+    fsl = pa.FixedSizeListArray.from_arrays(pa.array(flat), num_hashes)
+    if not valid.all():
+        mask_taken = pa.array(~valid)
+        import pyarrow.compute as pc
+
+        fsl = pc.if_else(pa.array(valid), fsl, pa.nulls(len(series), type=fsl.type))
+    return Series.from_arrow(fsl, series.name, DataType.fixed_size_list(DataType.uint64(), num_hashes))

@@ -185,6 +185,17 @@ class RecordBatch:
             cols.append(Series.concat([b._columns[i] for b in batches]))
         return cls(first.schema, cols, sum(b.num_rows for b in batches))
 
+    # ---- expression evaluation ----------------------------------------------------
+    def eval_expression(self, expr) -> Series:
+        from ..expressions.eval import eval_expression
+
+        return eval_expression(self, expr)
+
+    def eval_expression_list(self, exprs) -> "RecordBatch":
+        from ..expressions.eval import eval_projection
+
+        return eval_projection(self, exprs)
+
     # ---- relational kernels -------------------------------------------------------
     def argsort(self, key_series: List[Series], descending: List[bool], nulls_first: Optional[List[bool]] = None) -> np.ndarray:
         from .kernels.sort import multi_argsort

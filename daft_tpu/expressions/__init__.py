@@ -1,0 +1,23 @@
+from .expressions import (
+    AggExpr,
+    Alias,
+    Between,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expression,
+    Function,
+    IfElse,
+    IsIn,
+    Literal,
+    UnaryOp,
+    col,
+    lit,
+)
+from .eval import eval_expression, eval_projection
+
+__all__ = [
+    "Expression", "ColumnRef", "Literal", "Alias", "Cast", "BinaryOp", "UnaryOp",
+    "IsIn", "Between", "IfElse", "Function", "AggExpr", "col", "lit",
+    "eval_expression", "eval_projection",
+]

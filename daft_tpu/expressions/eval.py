@@ -1,0 +1,165 @@
+"""Host expression evaluation over RecordBatch.
+
+Reference parity: src/daft-recordbatch/src/lib.rs:726,1120 (eval_expression /
+eval_expression_list). Returns Series; literals evaluate to length-1 Series which
+broadcast through kernels and are expanded at projection boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.series import Series
+from ..schema import Schema
+from .expressions import (
+    AggExpr,
+    Alias,
+    Between,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expression,
+    Function,
+    IfElse,
+    IsIn,
+    Literal,
+    UnaryOp,
+)
+
+
+def eval_expression(batch, expr: Expression) -> Series:
+    """Evaluate to a Series of batch.num_rows rows (or 1 row for pure literals)."""
+    if isinstance(expr, ColumnRef):
+        return batch.get_column(expr._name)
+    if isinstance(expr, Literal):
+        return Series.from_pylist([expr.value], "literal", expr.dtype if not expr.dtype.is_null() else None)
+    if isinstance(expr, Alias):
+        return eval_expression(batch, expr.child).rename(expr._alias)
+    if isinstance(expr, Cast):
+        return eval_expression(batch, expr.child).cast(expr.dtype)
+    if isinstance(expr, UnaryOp):
+        s = eval_expression(batch, expr.child)
+        if expr.op == "not":
+            return ~s
+        if expr.op == "neg":
+            return -s
+        if expr.op == "abs":
+            return s.abs()
+        if expr.op == "is_null":
+            return s.is_null()
+        if expr.op == "not_null":
+            return s.not_null()
+        raise ValueError(f"unknown unary op {expr.op!r}")
+    if isinstance(expr, BinaryOp):
+        l = eval_expression(batch, expr.left)
+        r = eval_expression(batch, expr.right)
+        op = expr.op
+        if op == "add":
+            out = l + r
+        elif op == "sub":
+            out = l - r
+        elif op == "mul":
+            out = l * r
+        elif op == "div":
+            out = l / r
+        elif op == "floordiv":
+            out = l // r
+        elif op == "mod":
+            out = l % r
+        elif op == "pow":
+            out = l**r
+        elif op == "eq":
+            out = l == r
+        elif op == "neq":
+            out = l != r
+        elif op == "lt":
+            out = l < r
+        elif op == "le":
+            out = l <= r
+        elif op == "gt":
+            out = l > r
+        elif op == "ge":
+            out = l >= r
+        elif op == "and":
+            out = l & r
+        elif op == "or":
+            out = l | r
+        elif op == "xor":
+            out = l ^ r
+        elif op == "eq_null_safe":
+            out = l.eq_null_safe(r)
+        elif op == "fill_null":
+            out = l.fill_null(r)
+        else:
+            raise ValueError(f"unknown binary op {op!r}")
+        return out.rename(expr.name())
+    if isinstance(expr, IsIn):
+        s = eval_expression(batch, expr.child)
+        if not expr.items:
+            return Series.from_pylist([False] * len(s), s.name)
+        items = [eval_expression(batch, i) for i in expr.items]
+        values = Series.concat(items) if len(items) > 1 else items[0]
+        return s.is_in(values)
+    if isinstance(expr, Between):
+        s = eval_expression(batch, expr.child)
+        lo = eval_expression(batch, expr.lower)
+        hi = eval_expression(batch, expr.upper)
+        return s.between(lo, hi)
+    if isinstance(expr, IfElse):
+        p = eval_expression(batch, expr.predicate)
+        t = eval_expression(batch, expr.if_true)
+        f = eval_expression(batch, expr.if_false)
+        return Series.if_else(p, t, f).rename(expr.name())
+    if isinstance(expr, Function):
+        from ..functions.registry import get_function
+
+        spec = get_function(expr.fname)
+        args = [eval_expression(batch, a) for a in expr.args]
+        out = spec.host(args, expr.kwargs)
+        return out.rename(expr.name())
+    if isinstance(expr, AggExpr):
+        raise ValueError(
+            f"aggregation expression {expr!r} cannot be evaluated in a projection context; "
+            "use .agg()/groupby"
+        )
+    from ..udf.expr import UdfCall
+
+    if isinstance(expr, UdfCall):
+        args = [eval_expression(batch, a) for a in expr.args]
+        return expr.eval_host(args, batch.num_rows)
+    raise ValueError(f"cannot evaluate expression node {type(expr).__name__}")
+
+
+def eval_projection(batch, exprs: List[Expression]):
+    """Project: evaluate expressions and assemble an output RecordBatch,
+    broadcasting length-1 results to the batch length."""
+    from ..core.recordbatch import RecordBatch
+
+    n = batch.num_rows
+    out: List[Series] = []
+    names = []
+    for e in exprs:
+        s = eval_expression(batch, e)
+        if len(s) == 1 and n != 1:
+            s = _broadcast(s, n)
+        elif len(s) != n and not (n == 0 and len(s) <= 1):
+            raise ValueError(f"projection result {e!r} has {len(s)} rows, expected {n}")
+        if n == 0 and len(s) != 0:
+            s = s.slice(0, 0)
+        out.append(s)
+        names.append(s.name)
+    if len(set(names)) != len(names):
+        dupes = sorted({x for x in names if names.count(x) > 1})
+        raise ValueError(f"duplicate output column names in projection: {dupes}; use .alias()")
+    return RecordBatch(Schema([s.field() for s in out]), out, n)
+
+
+def _broadcast(s: Series, n: int) -> Series:
+    import pyarrow as pa
+
+    from ..core.series import _combine
+
+    if s._pyobjs is not None:
+        return Series(s.name, s.dtype, None, s._pyobjs * n)
+    arr = s.to_arrow()
+    return Series(s.name, s.dtype, _combine(pa.repeat(arr[0], n)))

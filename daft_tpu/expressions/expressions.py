@@ -1,0 +1,996 @@
+"""Expression IR.
+
+Reference parity: src/daft-dsl/src/expr/mod.rs:222-307 (Expr enum: Column, Alias,
+Agg, BinaryOp, Cast, Function, Not, IsNull, FillNull, IsIn, Between, Literal,
+IfElse, ScalarFn, ...) and daft/expressions/expressions.py (the Python Expression
+class with .str/.dt/.list/.float/.embedding namespaces).
+
+One Python class hierarchy serves as both the user-facing Expression and the plan
+IR. Host evaluation lives in daft_tpu/expressions/eval.py, device (JAX) evaluation
+in daft_tpu/ops/device_eval.py; both dispatch over these node types.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..datatype import DataType, Field
+from ..schema import Schema
+
+
+class Expression:
+    """Base class; subclasses are the IR nodes."""
+
+    # ---- naming -------------------------------------------------------------------
+    def name(self) -> str:
+        raise NotImplementedError(type(self).__name__)
+
+    def alias(self, name: str) -> "Expression":
+        return Alias(self, name)
+
+    def cast(self, dtype: DataType) -> "Expression":
+        return Cast(self, dtype)
+
+    # ---- structure ----------------------------------------------------------------
+    def children(self) -> List["Expression"]:
+        return []
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        if children:
+            raise ValueError(f"{type(self).__name__} takes no children")
+        return self
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+    def transform(self, fn: Callable[["Expression"], Optional["Expression"]]) -> "Expression":
+        """Bottom-up rewrite: fn returns a replacement or None to keep."""
+        old_children = self.children()
+        new_children = [c.transform(fn) for c in old_children]
+        changed = any(a is not b for a, b in zip(new_children, old_children))
+        node = self.with_children(new_children) if changed else self
+        out = fn(node)
+        return out if out is not None else node
+
+    def referenced_columns(self) -> List[str]:
+        out: List[str] = []
+        seen = set()
+        for node in self.walk():
+            if isinstance(node, ColumnRef) and node._name not in seen:
+                seen.add(node._name)
+                out.append(node._name)
+        return out
+
+    def has_agg(self) -> bool:
+        return any(isinstance(n, AggExpr) for n in self.walk())
+
+    def has_udf(self) -> bool:
+        from ..udf.expr import UdfCall
+
+        return any(isinstance(n, UdfCall) for n in self.walk())
+
+    def is_literal_true(self) -> bool:
+        return isinstance(self, Literal) and self.value is True
+
+    # ---- typing -------------------------------------------------------------------
+    def to_field(self, schema: Schema) -> Field:
+        raise NotImplementedError(type(self).__name__)
+
+    def get_type(self, schema: Schema) -> DataType:
+        return self.to_field(schema).dtype
+
+    # ---- operators ----------------------------------------------------------------
+    def _other(self, other) -> "Expression":
+        return other if isinstance(other, Expression) else lit(other)
+
+    def __add__(self, other):
+        return BinaryOp("add", self, self._other(other))
+
+    def __radd__(self, other):
+        return BinaryOp("add", self._other(other), self)
+
+    def __sub__(self, other):
+        return BinaryOp("sub", self, self._other(other))
+
+    def __rsub__(self, other):
+        return BinaryOp("sub", self._other(other), self)
+
+    def __mul__(self, other):
+        return BinaryOp("mul", self, self._other(other))
+
+    def __rmul__(self, other):
+        return BinaryOp("mul", self._other(other), self)
+
+    def __truediv__(self, other):
+        return BinaryOp("div", self, self._other(other))
+
+    def __rtruediv__(self, other):
+        return BinaryOp("div", self._other(other), self)
+
+    def __floordiv__(self, other):
+        return BinaryOp("floordiv", self, self._other(other))
+
+    def __rfloordiv__(self, other):
+        return BinaryOp("floordiv", self._other(other), self)
+
+    def __mod__(self, other):
+        return BinaryOp("mod", self, self._other(other))
+
+    def __rmod__(self, other):
+        return BinaryOp("mod", self._other(other), self)
+
+    def __pow__(self, other):
+        return BinaryOp("pow", self, self._other(other))
+
+    def __eq__(self, other):  # type: ignore[override]
+        return BinaryOp("eq", self, self._other(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return BinaryOp("neq", self, self._other(other))
+
+    def __lt__(self, other):
+        return BinaryOp("lt", self, self._other(other))
+
+    def __le__(self, other):
+        return BinaryOp("le", self, self._other(other))
+
+    def __gt__(self, other):
+        return BinaryOp("gt", self, self._other(other))
+
+    def __ge__(self, other):
+        return BinaryOp("ge", self, self._other(other))
+
+    def __and__(self, other):
+        return BinaryOp("and", self, self._other(other))
+
+    def __rand__(self, other):
+        return BinaryOp("and", self._other(other), self)
+
+    def __or__(self, other):
+        return BinaryOp("or", self, self._other(other))
+
+    def __ror__(self, other):
+        return BinaryOp("or", self._other(other), self)
+
+    def __xor__(self, other):
+        return BinaryOp("xor", self, self._other(other))
+
+    def __invert__(self):
+        return UnaryOp("not", self)
+
+    def __neg__(self):
+        return UnaryOp("neg", self)
+
+    def __hash__(self):
+        return hash(repr(self))
+
+    def __bool__(self):
+        raise ValueError(
+            "Expressions are lazy; cannot convert to bool. Use & | ~ instead of and/or/not."
+        )
+
+    # ---- null / conditional -------------------------------------------------------
+    def is_null(self) -> "Expression":
+        return UnaryOp("is_null", self)
+
+    def not_null(self) -> "Expression":
+        return UnaryOp("not_null", self)
+
+    def fill_null(self, value) -> "Expression":
+        return BinaryOp("fill_null", self, self._other(value))
+
+    def eq_null_safe(self, other) -> "Expression":
+        return BinaryOp("eq_null_safe", self, self._other(other))
+
+    def is_in(self, values) -> "Expression":
+        if isinstance(values, Expression):
+            items = [values]
+        else:
+            items = [v if isinstance(v, Expression) else lit(v) for v in values]
+        return IsIn(self, items)
+
+    def between(self, lower, upper) -> "Expression":
+        return Between(self, self._other(lower), self._other(upper))
+
+    def if_else(self, if_true, if_false) -> "Expression":
+        return IfElse(self, self._other(if_true), self._other(if_false))
+
+    def abs(self) -> "Expression":
+        return UnaryOp("abs", self)
+
+    # ---- scalar function sugar ------------------------------------------------------
+    def _fn(__self, __fname: str, *args, **kwargs) -> "Expression":
+        exprs = [__self] + [a if isinstance(a, Expression) else lit(a) for a in args]
+        return Function(__fname, exprs, kwargs)
+
+    def exp(self):
+        return self._fn("exp")
+
+    def log(self, base: Optional[float] = None):
+        return self._fn("log", **({"base": base} if base else {}))
+
+    def log2(self):
+        return self._fn("log2")
+
+    def log10(self):
+        return self._fn("log10")
+
+    def sqrt(self):
+        return self._fn("sqrt")
+
+    def sin(self):
+        return self._fn("sin")
+
+    def cos(self):
+        return self._fn("cos")
+
+    def tan(self):
+        return self._fn("tan")
+
+    def arctan(self):
+        return self._fn("arctan")
+
+    def arcsin(self):
+        return self._fn("arcsin")
+
+    def arccos(self):
+        return self._fn("arccos")
+
+    def floor(self):
+        return self._fn("floor")
+
+    def ceil(self):
+        return self._fn("ceil")
+
+    def round(self, decimals: int = 0):
+        return self._fn("round", decimals=decimals)
+
+    def sign(self):
+        return self._fn("sign")
+
+    def clip(self, min=None, max=None):
+        return self._fn("clip", clip_min=min, clip_max=max)
+
+    def hash(self, seed=None):
+        return self._fn("hash", **({"seed": seed} if seed is not None else {}))
+
+    def minhash(self, num_hashes: int = 16, ngram_size: int = 1, seed: int = 1):
+        return self._fn("minhash", num_hashes=num_hashes, ngram_size=ngram_size, seed=seed)
+
+    def apply(self, fn: Callable, return_dtype: DataType) -> "Expression":
+        from ..udf.expr import UdfCall
+        from ..udf.udf import Func
+
+        f = Func(fn=fn, return_dtype=return_dtype, is_batch=False, name=getattr(fn, "__name__", "apply"))
+        return UdfCall(f, [self], {})
+
+    # ---- aggregation sugar ----------------------------------------------------------
+    def sum(self):
+        return AggExpr("sum", self)
+
+    def mean(self):
+        return AggExpr("mean", self)
+
+    def avg(self):
+        return AggExpr("mean", self)
+
+    def min(self):
+        return AggExpr("min", self)
+
+    def max(self):
+        return AggExpr("max", self)
+
+    def count(self, mode: str = "valid"):
+        return AggExpr("count", self, {"mode": mode})
+
+    def count_distinct(self):
+        return AggExpr("count_distinct", self)
+
+    def any_value(self, ignore_nulls: bool = False):
+        return AggExpr("any_value", self, {"ignore_nulls": ignore_nulls})
+
+    def stddev(self):
+        return AggExpr("stddev", self)
+
+    def var(self):
+        return AggExpr("var", self)
+
+    def skew(self):
+        return AggExpr("skew", self)
+
+    def bool_and(self):
+        return AggExpr("bool_and", self)
+
+    def bool_or(self):
+        return AggExpr("bool_or", self)
+
+    def agg_list(self):
+        return AggExpr("list", self)
+
+    def agg_concat(self):
+        return AggExpr("concat", self)
+
+    def approx_count_distinct(self):
+        return AggExpr("approx_count_distinct", self)
+
+    # ---- namespaces -----------------------------------------------------------------
+    @property
+    def str(self) -> "StringNamespace":
+        return StringNamespace(self)
+
+    @property
+    def dt(self) -> "TemporalNamespace":
+        return TemporalNamespace(self)
+
+    @property
+    def list(self) -> "ListNamespace":
+        return ListNamespace(self)
+
+    @property
+    def float(self) -> "FloatNamespace":
+        return FloatNamespace(self)
+
+    @property
+    def embedding(self) -> "EmbeddingNamespace":
+        return EmbeddingNamespace(self)
+
+    @property
+    def struct(self) -> "StructNamespace":
+        return StructNamespace(self)
+
+
+class ColumnRef(Expression):
+    def __init__(self, name: str):
+        self._name = name
+
+    def name(self) -> str:
+        return self._name
+
+    def to_field(self, schema: Schema) -> Field:
+        return schema[self._name]
+
+    def __repr__(self):
+        return f"col({self._name})"
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[DataType] = None):
+        self.value = value
+        self.dtype = dtype or _infer_literal_dtype(value)
+
+    def name(self) -> str:
+        return "literal"
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field("literal", self.dtype)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.child = child
+        self._alias = alias
+
+    def name(self) -> str:
+        return self._alias
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return Alias(children[0], self._alias)
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self._alias, self.child.to_field(schema).dtype)
+
+    def __repr__(self):
+        return f"{self.child!r}.alias({self._alias!r})"
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, dtype: DataType):
+        self.child = child
+        self.dtype = dtype
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return Cast(children[0], self.dtype)
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.child.to_field(schema).name, self.dtype)
+
+    def __repr__(self):
+        return f"{self.child!r}.cast({self.dtype})"
+
+
+_COMPARISON_OPS = {"eq", "neq", "lt", "le", "gt", "ge", "eq_null_safe"}
+_LOGICAL_OPS = {"and", "or", "xor"}
+_ARITH_OPS = {"add", "sub", "mul", "div", "floordiv", "mod", "pow"}
+
+
+class BinaryOp(Expression):
+    def __init__(self, op: str, left: Expression, right: Expression):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def name(self) -> str:
+        return self.left.name()
+
+    def children(self):
+        return [self.left, self.right]
+
+    def with_children(self, children):
+        return BinaryOp(self.op, children[0], children[1])
+
+    def to_field(self, schema: Schema) -> Field:
+        lf = self.left.to_field(schema)
+        rf = self.right.to_field(schema)
+        name = lf.name if not isinstance(self.left, Literal) else rf.name
+        op = self.op
+        if op in _COMPARISON_OPS:
+            return Field(name, DataType.bool())
+        if op in _LOGICAL_OPS:
+            if not (lf.dtype.is_boolean() or lf.dtype.is_null()) or not (rf.dtype.is_boolean() or rf.dtype.is_null()):
+                raise ValueError(f"logical op {op!r} requires boolean operands, got {lf.dtype} and {rf.dtype}")
+            return Field(name, DataType.bool())
+        if op == "fill_null":
+            return Field(lf.name, lf.dtype if not lf.dtype.is_null() else rf.dtype)
+        if op in _ARITH_OPS:
+            return Field(name, _arith_result_type(op, lf.dtype, rf.dtype))
+        raise ValueError(f"unknown binary op {op!r}")
+
+    def __repr__(self):
+        sym = {
+            "add": "+", "sub": "-", "mul": "*", "div": "/", "floordiv": "//", "mod": "%",
+            "pow": "**", "eq": "==", "neq": "!=", "lt": "<", "le": "<=", "gt": ">",
+            "ge": ">=", "and": "&", "or": "|", "xor": "^",
+        }.get(self.op)
+        if sym:
+            return f"({self.left!r} {sym} {self.right!r})"
+        return f"{self.op}({self.left!r}, {self.right!r})"
+
+
+class UnaryOp(Expression):
+    def __init__(self, op: str, child: Expression):
+        self.op = op
+        self.child = child
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return UnaryOp(self.op, children[0])
+
+    def to_field(self, schema: Schema) -> Field:
+        f = self.child.to_field(schema)
+        if self.op in ("is_null", "not_null", "not"):
+            return Field(f.name, DataType.bool())
+        if self.op in ("neg", "abs"):
+            if not f.dtype.is_numeric():
+                raise ValueError(f"{self.op} requires numeric input, got {f.dtype}")
+            return f
+        raise ValueError(f"unknown unary op {self.op!r}")
+
+    def __repr__(self):
+        return f"{self.op}({self.child!r})"
+
+
+class IsIn(Expression):
+    def __init__(self, child: Expression, items: List[Expression]):
+        self.child = child
+        self.items = items
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child] + self.items
+
+    def with_children(self, children):
+        return IsIn(children[0], children[1:])
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.child.to_field(schema).name, DataType.bool())
+
+    def __repr__(self):
+        return f"{self.child!r}.is_in({self.items!r})"
+
+
+class Between(Expression):
+    def __init__(self, child: Expression, lower: Expression, upper: Expression):
+        self.child = child
+        self.lower = lower
+        self.upper = upper
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child, self.lower, self.upper]
+
+    def with_children(self, children):
+        return Between(children[0], children[1], children[2])
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.child.to_field(schema).name, DataType.bool())
+
+    def __repr__(self):
+        return f"{self.child!r}.between({self.lower!r}, {self.upper!r})"
+
+
+class IfElse(Expression):
+    def __init__(self, predicate: Expression, if_true: Expression, if_false: Expression):
+        self.predicate = predicate
+        self.if_true = if_true
+        self.if_false = if_false
+
+    def name(self) -> str:
+        try:
+            return self.if_true.name()
+        except Exception:
+            return self.predicate.name()
+
+    def children(self):
+        return [self.predicate, self.if_true, self.if_false]
+
+    def with_children(self, children):
+        return IfElse(children[0], children[1], children[2])
+
+    def to_field(self, schema: Schema) -> Field:
+        t = self.if_true.to_field(schema)
+        f = self.if_false.to_field(schema)
+        dt = _common_supertype(t.dtype, f.dtype)
+        return Field(self.name(), dt)
+
+    def __repr__(self):
+        return f"{self.predicate!r}.if_else({self.if_true!r}, {self.if_false!r})"
+
+
+class Function(Expression):
+    """A call into the scalar function registry (reference: ScalarUDF trait,
+    src/daft-dsl/src/functions/scalar.rs:205)."""
+
+    def __init__(self, fname: str, args: List[Expression], kwargs: Optional[Dict[str, Any]] = None):
+        self.fname = fname
+        self.args = args
+        self.kwargs = kwargs or {}
+
+    def name(self) -> str:
+        return self.args[0].name() if self.args else self.fname
+
+    def children(self):
+        return list(self.args)
+
+    def with_children(self, children):
+        return Function(self.fname, children, self.kwargs)
+
+    def to_field(self, schema: Schema) -> Field:
+        from ..functions.registry import get_function
+
+        spec = get_function(self.fname)
+        arg_fields = [a.to_field(schema) for a in self.args]
+        dtype = spec.return_type(arg_fields, self.kwargs)
+        return Field(self.name(), dtype)
+
+    def __repr__(self):
+        inner = ", ".join(repr(a) for a in self.args)
+        return f"{self.fname}({inner})"
+
+
+_AGG_OPS = {
+    "sum", "mean", "min", "max", "count", "count_distinct", "any_value", "stddev",
+    "var", "skew", "bool_and", "bool_or", "list", "concat", "approx_count_distinct",
+}
+
+
+class AggExpr(Expression):
+    def __init__(self, op: str, child: Expression, params: Optional[Dict[str, Any]] = None):
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregation {op!r}")
+        self.op = op
+        self.child = child
+        self.params = params or {}
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return AggExpr(self.op, children[0], self.params)
+
+    def to_field(self, schema: Schema) -> Field:
+        f = self.child.to_field(schema)
+        op = self.op
+        if op == "sum":
+            from ..core.series import _agg_sum_dtype
+
+            return Field(f.name, _agg_sum_dtype(f.dtype))
+        if op in ("mean", "stddev", "var", "skew"):
+            return Field(f.name, DataType.float64())
+        if op in ("count", "count_distinct", "approx_count_distinct"):
+            return Field(f.name, DataType.uint64())
+        if op in ("min", "max", "any_value"):
+            return Field(f.name, f.dtype)
+        if op in ("bool_and", "bool_or"):
+            return Field(f.name, DataType.bool())
+        if op == "list":
+            return Field(f.name, DataType.list(f.dtype))
+        if op == "concat":
+            if not f.dtype.is_list():
+                raise ValueError(f"agg_concat requires list dtype, got {f.dtype}")
+            return Field(f.name, f.dtype)
+        raise ValueError(op)
+
+    def __repr__(self):
+        return f"{self.child!r}.{self.op}()"
+
+
+# ---- namespaces -------------------------------------------------------------------
+
+
+class _Namespace:
+    def __init__(self, expr: Expression):
+        self._e = expr
+
+
+class StringNamespace(_Namespace):
+    def upper(self):
+        return self._e._fn("utf8_upper")
+
+    def lower(self):
+        return self._e._fn("utf8_lower")
+
+    def length(self):
+        return self._e._fn("utf8_length")
+
+    def length_bytes(self):
+        return self._e._fn("utf8_length_bytes")
+
+    def contains(self, pat):
+        return self._e._fn("utf8_contains", pat)
+
+    def startswith(self, pat):
+        return self._e._fn("utf8_startswith", pat)
+
+    def endswith(self, pat):
+        return self._e._fn("utf8_endswith", pat)
+
+    def split(self, pat, regex: bool = False):
+        return self._e._fn("utf8_split", pat, regex=regex)
+
+    def concat(self, other):
+        return BinaryOp("add", self._e, self._e._other(other))
+
+    def substr(self, start, length=None):
+        return self._e._fn("utf8_substr", start, length)
+
+    def replace(self, pat, replacement, regex: bool = False):
+        return self._e._fn("utf8_replace", pat, replacement, regex=regex)
+
+    def match(self, pattern):
+        return self._e._fn("utf8_match", pattern)
+
+    def extract(self, pattern, index: int = 0):
+        return self._e._fn("utf8_extract", pattern, index=index)
+
+    def extract_all(self, pattern, index: int = 0):
+        return self._e._fn("utf8_extract_all", pattern, index=index)
+
+    def find(self, substr):
+        return self._e._fn("utf8_find", substr)
+
+    def lstrip(self):
+        return self._e._fn("utf8_lstrip")
+
+    def rstrip(self):
+        return self._e._fn("utf8_rstrip")
+
+    def strip(self):
+        return self._e._fn("utf8_strip")
+
+    def reverse(self):
+        return self._e._fn("utf8_reverse")
+
+    def capitalize(self):
+        return self._e._fn("utf8_capitalize")
+
+    def left(self, n):
+        return self._e._fn("utf8_left", n)
+
+    def right(self, n):
+        return self._e._fn("utf8_right", n)
+
+    def repeat(self, n):
+        return self._e._fn("utf8_repeat", n)
+
+    def like(self, pattern):
+        return self._e._fn("utf8_like", pattern)
+
+    def ilike(self, pattern):
+        return self._e._fn("utf8_ilike", pattern)
+
+    def rpad(self, length, pad=" "):
+        return self._e._fn("utf8_rpad", length, pad)
+
+    def lpad(self, length, pad=" "):
+        return self._e._fn("utf8_lpad", length, pad)
+
+    def to_date(self, format: str):
+        return self._e._fn("utf8_to_date", format=format)
+
+    def to_datetime(self, format: str, timezone: Optional[str] = None):
+        return self._e._fn("utf8_to_datetime", format=format, timezone=timezone)
+
+    def normalize(self, remove_punct=False, lowercase=False, nfd_unicode=False, white_space=False):
+        return self._e._fn(
+            "utf8_normalize",
+            remove_punct=remove_punct, lowercase=lowercase,
+            nfd_unicode=nfd_unicode, white_space=white_space,
+        )
+
+    def count_matches(self, patterns, whole_words: bool = False, case_sensitive: bool = True):
+        return self._e._fn(
+            "utf8_count_matches", patterns, whole_words=whole_words, case_sensitive=case_sensitive
+        )
+
+    def tokenize_encode(self, tokenizer: str = "r50k_base"):
+        return self._e._fn("tokenize_encode", tokenizer=tokenizer)
+
+    def tokenize_decode(self, tokenizer: str = "r50k_base"):
+        return self._e._fn("tokenize_decode", tokenizer=tokenizer)
+
+
+class TemporalNamespace(_Namespace):
+    def year(self):
+        return self._e._fn("dt_year")
+
+    def month(self):
+        return self._e._fn("dt_month")
+
+    def day(self):
+        return self._e._fn("dt_day")
+
+    def hour(self):
+        return self._e._fn("dt_hour")
+
+    def minute(self):
+        return self._e._fn("dt_minute")
+
+    def second(self):
+        return self._e._fn("dt_second")
+
+    def millisecond(self):
+        return self._e._fn("dt_millisecond")
+
+    def microsecond(self):
+        return self._e._fn("dt_microsecond")
+
+    def day_of_week(self):
+        return self._e._fn("dt_day_of_week")
+
+    def day_of_month(self):
+        return self._e._fn("dt_day")
+
+    def day_of_year(self):
+        return self._e._fn("dt_day_of_year")
+
+    def week_of_year(self):
+        return self._e._fn("dt_week_of_year")
+
+    def date(self):
+        return self._e._fn("dt_date")
+
+    def time(self):
+        return self._e._fn("dt_time")
+
+    def truncate(self, interval: str):
+        return self._e._fn("dt_truncate", interval=interval)
+
+    def to_unix_epoch(self, unit: str = "s"):
+        return self._e._fn("dt_to_unix_epoch", unit=unit)
+
+    def strftime(self, format: Optional[str] = None):
+        return self._e._fn("dt_strftime", format=format)
+
+
+class ListNamespace(_Namespace):
+    def length(self):
+        return self._e._fn("list_length")
+
+    def get(self, idx, default=None):
+        return self._e._fn("list_get", idx, default)
+
+    def sum(self):
+        return self._e._fn("list_sum")
+
+    def mean(self):
+        return self._e._fn("list_mean")
+
+    def min(self):
+        return self._e._fn("list_min")
+
+    def max(self):
+        return self._e._fn("list_max")
+
+    def count(self, mode: str = "valid"):
+        return self._e._fn("list_count", mode=mode)
+
+    def join(self, delimiter: str):
+        return self._e._fn("list_join", delimiter)
+
+    def contains(self, value):
+        return self._e._fn("list_contains", value)
+
+    def slice(self, start, end=None):
+        return self._e._fn("list_slice", start, end)
+
+    def sort(self, desc: bool = False):
+        return self._e._fn("list_sort", desc=desc)
+
+    def distinct(self):
+        return self._e._fn("list_distinct")
+
+    def value_counts(self):
+        return self._e._fn("list_value_counts")
+
+    def chunk(self, size: int):
+        return self._e._fn("list_chunk", size=size)
+
+
+class FloatNamespace(_Namespace):
+    def is_nan(self):
+        return self._e._fn("is_nan")
+
+    def is_inf(self):
+        return self._e._fn("is_inf")
+
+    def not_nan(self):
+        return self._e._fn("not_nan")
+
+    def fill_nan(self, value):
+        return self._e._fn("fill_nan", value)
+
+
+class EmbeddingNamespace(_Namespace):
+    def cosine_distance(self, other):
+        return self._e._fn("cosine_distance", other)
+
+    def dot(self, other):
+        return self._e._fn("dot", other)
+
+    def euclidean_distance(self, other):
+        return self._e._fn("euclidean_distance", other)
+
+    def norm(self):
+        return self._e._fn("embedding_norm")
+
+
+class StructNamespace(_Namespace):
+    def get(self, name: str):
+        return self._e._fn("struct_get", name=name)
+
+
+# ---- public constructors ----------------------------------------------------------
+
+
+def col(name: str) -> Expression:
+    return ColumnRef(name)
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> Expression:
+    return Literal(value, dtype)
+
+
+def _infer_literal_dtype(v: Any) -> DataType:
+    if v is None:
+        return DataType.null()
+    if isinstance(v, bool):
+        return DataType.bool()
+    if isinstance(v, (int, np.integer)):
+        return DataType.int64() if not isinstance(v, np.unsignedinteger) else DataType.uint64()
+    if isinstance(v, (float, np.floating)):
+        return DataType.float64()
+    if isinstance(v, str):
+        return DataType.string()
+    if isinstance(v, bytes):
+        return DataType.binary()
+    if isinstance(v, decimal.Decimal):
+        d = v.as_tuple()
+        return DataType.decimal128(max(len(d.digits), 1), max(-d.exponent, 0))
+    if isinstance(v, datetime.datetime):
+        return DataType.timestamp("us", v.tzinfo.tzname(None) if v.tzinfo else None)
+    if isinstance(v, datetime.date):
+        return DataType.date()
+    if isinstance(v, datetime.timedelta):
+        return DataType.duration("us")
+    if isinstance(v, (list, tuple)):
+        if not v:
+            return DataType.list(DataType.null())
+        return DataType.list(_infer_literal_dtype(v[0]))
+    if isinstance(v, np.ndarray):
+        inner = DataType.from_arrow(__import__("pyarrow").from_numpy_dtype(v.dtype))
+        return DataType.fixed_shape_tensor(inner, v.shape)
+    return DataType.python()
+
+
+# ---- type promotion ---------------------------------------------------------------
+
+
+def _arith_result_type(op: str, l: DataType, r: DataType) -> DataType:
+    if op == "add" and l.is_string() and r.is_string():
+        return DataType.string()
+    if op == "div":
+        if l.is_numeric() and r.is_numeric():
+            return DataType.float64()
+        raise ValueError(f"cannot divide {l} by {r}")
+    if op == "pow":
+        return DataType.float64()
+    # temporal arithmetic
+    if l.is_temporal() or r.is_temporal():
+        return _temporal_arith_type(op, l, r)
+    if l.is_null():
+        return r
+    if r.is_null():
+        return l
+    if not (l.is_numeric() and r.is_numeric()):
+        raise ValueError(f"arith op {op!r} unsupported between {l} and {r}")
+    if l.is_decimal() or r.is_decimal():
+        return l if l.is_decimal() else r
+    out = np.promote_types(l.to_numpy(), r.to_numpy())
+    return DataType.from_arrow(__import__("pyarrow").from_numpy_dtype(out))
+
+
+def _temporal_arith_type(op: str, l: DataType, r: DataType) -> DataType:
+    if op == "sub":
+        if l.kind == "timestamp" and r.kind == "timestamp":
+            return DataType.duration(l.time_unit)
+        if l.kind == "date" and r.kind == "date":
+            return DataType.duration("s")
+        if l.kind == "timestamp" and r.kind == "duration":
+            return l
+        if l.kind == "date" and r.kind == "duration":
+            return l
+    if op == "add":
+        if l.kind == "timestamp" and r.kind == "duration":
+            return l
+        if l.kind == "duration" and r.kind == "timestamp":
+            return r
+        if l.kind == "date" and r.kind == "duration":
+            return l
+        if l.kind == "duration" and r.kind == "duration":
+            return l
+    raise ValueError(f"temporal arithmetic {op!r} unsupported between {l} and {r}")
+
+
+def _common_supertype(a: DataType, b: DataType) -> DataType:
+    if a == b:
+        return a
+    if a.is_null():
+        return b
+    if b.is_null():
+        return a
+    if a.is_numeric() and b.is_numeric() and not (a.is_decimal() or b.is_decimal()):
+        out = np.promote_types(a.to_numpy(), b.to_numpy())
+        return DataType.from_arrow(__import__("pyarrow").from_numpy_dtype(out))
+    if a.is_string() and b.is_string():
+        return a
+    raise ValueError(f"no common supertype for {a} and {b}")

@@ -1,0 +1,3 @@
+from .registry import FunctionSpec, get_function, has_function, register
+
+__all__ = ["FunctionSpec", "get_function", "has_function", "register"]

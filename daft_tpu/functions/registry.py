@@ -1,0 +1,926 @@
+"""Scalar function registry.
+
+Reference parity: the ScalarUDF trait + per-domain function crates
+(src/daft-dsl/src/functions/scalar.rs:205; src/daft-functions-utf8, -list,
+-temporal, numeric ops in daft-functions). Each FunctionSpec carries a return-type
+rule and a host kernel; device-compatible functions also register a jax kernel used
+by the stage compiler (daft_tpu/ops/device_eval.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..core.series import Series, _combine
+from ..datatype import DataType, Field
+
+_REGISTRY: Dict[str, "FunctionSpec"] = {}
+
+
+@dataclasses.dataclass
+class FunctionSpec:
+    name: str
+    return_type: Callable[[List[Field], Dict[str, Any]], DataType]
+    host: Callable[[List[Series], Dict[str, Any]], Series]
+    device: Optional[Callable] = None  # jax kernel: (*(vals, valid) pairs, **kwargs) -> (vals, valid)
+
+
+def register(name: str, return_type, host, device=None, aliases=()):
+    spec = FunctionSpec(name, return_type, host, device)
+    _REGISTRY[name] = spec
+    for a in aliases:
+        _REGISTRY[a] = spec
+    return spec
+
+
+def get_function(name: str) -> FunctionSpec:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise ValueError(f"unknown function {name!r}; known: {sorted(_REGISTRY)[:40]}...")
+    return spec
+
+
+def has_function(name: str) -> bool:
+    return name in _REGISTRY
+
+
+# ---- return-type helpers ----------------------------------------------------------
+
+
+def _rt_same(fields, kwargs):
+    return fields[0].dtype
+
+
+def _rt_const(dt: DataType):
+    return lambda fields, kwargs: dt
+
+
+def _rt_float(fields, kwargs):
+    return DataType.float32() if fields[0].dtype.kind == "float32" else DataType.float64()
+
+
+def _rt_inner(fields, kwargs):
+    return fields[0].dtype.inner
+
+
+# ---- host kernel helpers ----------------------------------------------------------
+
+
+def _pc1(fn, out_dt=None, pre_cast=None):
+    """Lift a unary pyarrow.compute kernel to a host function."""
+
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        arr = s.to_arrow()
+        if pre_cast is not None:
+            arr = arr.cast(pre_cast)
+        out = _combine(fn(arr))
+        dt = out_dt or DataType.from_arrow(out.type)
+        return Series(s.name, dt, out)
+
+    return host
+
+
+def _np1(fn, out_np_dtype=None):
+    """Lift a unary numpy ufunc-style kernel; preserves validity."""
+
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        vals = s.to_numpy().astype(np.float64 if s.dtype.kind != "float32" else np.float32)
+        with np.errstate(all="ignore"):
+            out = fn(vals)
+        if out_np_dtype is not None:
+            out = out.astype(out_np_dtype)
+        arr = pa.array(out)
+        valid = s.validity_numpy()
+        if not valid.all():
+            arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), type=arr.type))
+        return Series(s.name, DataType.from_arrow(arr.type), _combine(arr))
+
+    return host
+
+
+def _binary_arrow(fn):
+    """Lift a binary arrow kernel with length-1 broadcasting."""
+
+    def host(args: List[Series], kwargs) -> Series:
+        a, b = args[0], args[1]
+        return a._binary(b, fn)
+
+    return host
+
+
+# ===================================================================================
+# numeric
+# ===================================================================================
+
+for _name, _np_fn in [
+    ("exp", np.exp), ("sqrt", np.sqrt), ("sin", np.sin), ("cos", np.cos),
+    ("tan", np.tan), ("arctan", np.arctan), ("arcsin", np.arcsin),
+    ("arccos", np.arccos), ("log2", np.log2), ("log10", np.log10),
+    ("cbrt", np.cbrt), ("expm1", np.expm1), ("log1p", np.log1p),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+    ("degrees", np.degrees), ("radians", np.radians),
+]:
+    register(_name, _rt_float, _np1(_np_fn), device=_np_fn)
+
+
+def _log_host(args, kwargs):
+    base = kwargs.get("base")
+    s = args[0]
+    vals = s.to_numpy().astype(np.float64)
+    with np.errstate(all="ignore"):
+        out = np.log(vals) if not base else np.log(vals) / np.log(base)
+    arr = pa.array(out)
+    valid = s.validity_numpy()
+    if not valid.all():
+        arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), type=arr.type))
+    return Series(s.name, DataType.float64(), _combine(arr))
+
+
+register("log", _rt_float, _log_host)
+register("floor", _rt_same, _np1(np.floor))
+register("ceil", _rt_same, _np1(np.ceil))
+register("sign", _rt_same, _pc1(pc.sign))
+
+
+def _round_host(args, kwargs):
+    d = kwargs.get("decimals", 0)
+    s = args[0]
+    out = _combine(pc.round(s.to_arrow(), ndigits=d))
+    return Series(s.name, s.dtype, out)
+
+
+register("round", _rt_same, _round_host)
+
+
+def _clip_host(args, kwargs):
+    s = args[0]
+    lo, hi = kwargs.get("clip_min"), kwargs.get("clip_max")
+    vals = s.to_numpy()
+    out = np.clip(vals, lo, hi)
+    arr = pa.array(out)
+    valid = s.validity_numpy()
+    if not valid.all():
+        arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), type=arr.type))
+    return Series(s.name, DataType.from_arrow(arr.type), _combine(arr))
+
+
+register("clip", _rt_same, _clip_host)
+
+
+def _hash_host(args, kwargs):
+    seed = kwargs.get("seed")
+    seed_series = None
+    if seed is not None:
+        seed_series = Series.from_numpy(np.full(len(args[0]), seed, dtype=np.uint64), "seed")
+    return args[0].hash(seed_series)
+
+
+register("hash", _rt_const(DataType.uint64()), _hash_host)
+
+
+# ===================================================================================
+# float namespace
+# ===================================================================================
+
+register("is_nan", _rt_const(DataType.bool()), _pc1(pc.is_nan))
+
+
+def _is_inf_host(args, kwargs):
+    s = args[0]
+    return Series(s.name, DataType.bool(), _combine(pc.is_inf(s.to_arrow())))
+
+
+register("is_inf", _rt_const(DataType.bool()), _is_inf_host)
+
+
+def _not_nan_host(args, kwargs):
+    s = args[0]
+    return Series(s.name, DataType.bool(), _combine(pc.invert(pc.is_nan(s.to_arrow()))))
+
+
+register("not_nan", _rt_const(DataType.bool()), _not_nan_host)
+
+
+def _fill_nan_host(args, kwargs):
+    s, fill = args[0], args[1]
+    nan_mask = pc.is_nan(s.to_arrow())
+    fill_arr = fill.to_arrow()
+    fv = fill_arr[0] if len(fill_arr) == 1 else fill_arr
+    out = _combine(pc.if_else(nan_mask, fv, s.to_arrow()))
+    return Series(s.name, s.dtype, out)
+
+
+register("fill_nan", _rt_same, _fill_nan_host)
+
+
+# ===================================================================================
+# utf8
+# ===================================================================================
+
+register("utf8_upper", _rt_same, _pc1(pc.utf8_upper))
+register("utf8_lower", _rt_same, _pc1(pc.utf8_lower))
+register("utf8_length", _rt_const(DataType.uint64()), _pc1(pc.utf8_length, DataType.uint64()))
+register("utf8_length_bytes", _rt_const(DataType.uint64()), _pc1(pc.binary_length, DataType.uint64()))
+register("utf8_capitalize", _rt_same, _pc1(pc.utf8_capitalize))
+register("utf8_reverse", _rt_same, _pc1(pc.utf8_reverse))
+register("utf8_lstrip", _rt_same, _pc1(pc.utf8_ltrim_whitespace))
+register("utf8_rstrip", _rt_same, _pc1(pc.utf8_rtrim_whitespace))
+register("utf8_strip", _rt_same, _pc1(pc.utf8_trim_whitespace))
+
+
+def _scalar_arg(s: Series):
+    """Extract a python scalar from a length-1 Series argument."""
+    vals = s.to_pylist()
+    if len(vals) != 1:
+        raise ValueError("expected a scalar argument")
+    return vals[0]
+
+
+def _utf8_contains(args, kwargs):
+    s, pat = args[0], args[1]
+    if len(pat) == 1:
+        out = pc.match_substring(s.to_arrow(), _scalar_arg(pat))
+    else:
+        out = pa.array([
+            None if (a is None or b is None) else (b in a)
+            for a, b in zip(s.to_pylist(), pat.to_pylist())
+        ])
+    return Series(s.name, DataType.bool(), _combine(out))
+
+
+register("utf8_contains", _rt_const(DataType.bool()), _utf8_contains)
+
+
+def _utf8_startswith(args, kwargs):
+    s, pat = args[0], args[1]
+    out = pc.starts_with(s.to_arrow(), _scalar_arg(pat))
+    return Series(s.name, DataType.bool(), _combine(out))
+
+
+def _utf8_endswith(args, kwargs):
+    s, pat = args[0], args[1]
+    out = pc.ends_with(s.to_arrow(), _scalar_arg(pat))
+    return Series(s.name, DataType.bool(), _combine(out))
+
+
+register("utf8_startswith", _rt_const(DataType.bool()), _utf8_startswith)
+register("utf8_endswith", _rt_const(DataType.bool()), _utf8_endswith)
+
+
+def _utf8_match(args, kwargs):
+    s, pat = args[0], args[1]
+    out = pc.match_substring_regex(s.to_arrow(), _scalar_arg(pat))
+    return Series(s.name, DataType.bool(), _combine(out))
+
+
+register("utf8_match", _rt_const(DataType.bool()), _utf8_match)
+
+
+def _utf8_split(args, kwargs):
+    s, pat = args[0], args[1]
+    p = _scalar_arg(pat)
+    if kwargs.get("regex"):
+        out = pc.split_pattern_regex(s.to_arrow(), p)
+    else:
+        out = pc.split_pattern(s.to_arrow(), p)
+    return Series(s.name, DataType.list(DataType.string()), _combine(out).cast(pa.large_list(pa.large_string())))
+
+
+register("utf8_split", lambda f, k: DataType.list(DataType.string()), _utf8_split)
+
+
+def _utf8_substr(args, kwargs):
+    s = args[0]
+    start = _scalar_arg(args[1])
+    length = _scalar_arg(args[2]) if len(args) > 2 else None
+    stop = None if length is None else start + length
+    out = pc.utf8_slice_codeunits(s.to_arrow(), start=start, stop=stop)
+    return Series(s.name, DataType.string(), _combine(out))
+
+
+register("utf8_substr", _rt_const(DataType.string()), _utf8_substr)
+
+
+def _utf8_replace(args, kwargs):
+    s, pat, rep = args[0], args[1], args[2]
+    p, r = _scalar_arg(pat), _scalar_arg(rep)
+    if kwargs.get("regex"):
+        out = pc.replace_substring_regex(s.to_arrow(), pattern=p, replacement=r)
+    else:
+        out = pc.replace_substring(s.to_arrow(), pattern=p, replacement=r)
+    return Series(s.name, DataType.string(), _combine(out))
+
+
+register("utf8_replace", _rt_const(DataType.string()), _utf8_replace)
+
+
+def _utf8_extract(args, kwargs):
+    s, pat = args[0], args[1]
+    p = _scalar_arg(pat)
+    idx = kwargs.get("index", 0)
+    rx = re.compile(p)
+
+    def f(v):
+        if v is None:
+            return None
+        m = rx.search(v)
+        if m is None:
+            return None
+        return m.group(idx)
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, DataType.string())
+
+
+register("utf8_extract", _rt_const(DataType.string()), _utf8_extract)
+
+
+def _utf8_extract_all(args, kwargs):
+    s, pat = args[0], args[1]
+    p = _scalar_arg(pat)
+    idx = kwargs.get("index", 0)
+    rx = re.compile(p)
+
+    def f(v):
+        if v is None:
+            return None
+        return [m.group(idx) for m in rx.finditer(v)]
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, DataType.list(DataType.string()))
+
+
+register("utf8_extract_all", lambda f, k: DataType.list(DataType.string()), _utf8_extract_all)
+
+
+def _utf8_find(args, kwargs):
+    s, sub = args[0], args[1]
+    out = pc.find_substring(s.to_arrow(), _scalar_arg(sub))
+    return Series(s.name, DataType.int64(), _combine(out).cast(pa.int64()))
+
+
+register("utf8_find", _rt_const(DataType.int64()), _utf8_find)
+
+
+def _utf8_left(args, kwargs):
+    s, n = args[0], _scalar_arg(args[1])
+    out = pc.utf8_slice_codeunits(s.to_arrow(), start=0, stop=n)
+    return Series(s.name, DataType.string(), _combine(out))
+
+
+def _utf8_right(args, kwargs):
+    s, n = args[0], _scalar_arg(args[1])
+    lengths = pc.utf8_length(s.to_arrow())
+    starts = pc.max_element_wise(pc.subtract(lengths, n), 0)
+    out = pa.array([
+        None if v is None else v[-n:] if n > 0 else ""
+        for v in s.to_pylist()
+    ], type=pa.large_string())
+    _ = starts
+    return Series(s.name, DataType.string(), out)
+
+
+register("utf8_left", _rt_const(DataType.string()), _utf8_left)
+register("utf8_right", _rt_const(DataType.string()), _utf8_right)
+
+
+def _utf8_repeat(args, kwargs):
+    s, n = args[0], _scalar_arg(args[1])
+    out = pc.binary_repeat(s.to_arrow(), n)
+    return Series(s.name, DataType.string(), _combine(out))
+
+
+register("utf8_repeat", _rt_const(DataType.string()), _utf8_repeat)
+
+
+def _like_to_regex(pattern: str, case_insensitive: bool) -> re.Pattern:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.IGNORECASE if case_insensitive else 0)
+
+
+def _utf8_like(args, kwargs, ci=False):
+    s, pat = args[0], args[1]
+    rx = _like_to_regex(_scalar_arg(pat), ci)
+    return Series.from_pylist(
+        [None if v is None else bool(rx.match(v)) for v in s.to_pylist()], s.name, DataType.bool()
+    )
+
+
+register("utf8_like", _rt_const(DataType.bool()), _utf8_like)
+register("utf8_ilike", _rt_const(DataType.bool()), lambda a, k: _utf8_like(a, k, ci=True))
+
+
+def _utf8_pad(args, kwargs, left: bool):
+    s, n, pad = args[0], _scalar_arg(args[1]), _scalar_arg(args[2])
+    fn = pc.utf8_lpad if left else pc.utf8_rpad
+    out = fn(s.to_arrow(), width=n, padding=pad)
+    return Series(s.name, DataType.string(), _combine(out))
+
+
+register("utf8_lpad", _rt_const(DataType.string()), lambda a, k: _utf8_pad(a, k, True))
+register("utf8_rpad", _rt_const(DataType.string()), lambda a, k: _utf8_pad(a, k, False))
+
+
+def _utf8_to_date(args, kwargs):
+    s = args[0]
+    fmt = kwargs["format"]
+    out = pc.strptime(s.to_arrow(), format=fmt, unit="s", error_is_null=True)
+    return Series(s.name, DataType.date(), _combine(out.cast(pa.date32())))
+
+
+register("utf8_to_date", _rt_const(DataType.date()), _utf8_to_date)
+
+
+def _utf8_to_datetime(args, kwargs):
+    s = args[0]
+    fmt = kwargs["format"]
+    tz = kwargs.get("timezone")
+    out = pc.strptime(s.to_arrow(), format=fmt, unit="us", error_is_null=True)
+    dt = DataType.timestamp("us", tz)
+    if tz:
+        out = out.cast(pa.timestamp("us")).cast(pa.timestamp("us", tz))
+    return Series(s.name, dt, _combine(out))
+
+
+register(
+    "utf8_to_datetime",
+    lambda f, k: DataType.timestamp("us", k.get("timezone")),
+    _utf8_to_datetime,
+)
+
+
+def _utf8_normalize(args, kwargs):
+    import unicodedata
+
+    s = args[0]
+
+    def f(v):
+        if v is None:
+            return None
+        if kwargs.get("nfd_unicode"):
+            v = unicodedata.normalize("NFD", v)
+        if kwargs.get("lowercase"):
+            v = v.lower()
+        if kwargs.get("remove_punct"):
+            v = re.sub(r"[^\w\s]", "", v)
+        if kwargs.get("white_space"):
+            v = " ".join(v.split())
+        return v
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, DataType.string())
+
+
+register("utf8_normalize", _rt_const(DataType.string()), _utf8_normalize)
+
+
+def _utf8_count_matches(args, kwargs):
+    s, patterns = args[0], args[1]
+    pats = patterns.to_pylist()
+    if pats and isinstance(pats[0], list):
+        pats = pats[0]
+    ci = not kwargs.get("case_sensitive", True)
+    ww = kwargs.get("whole_words", False)
+    parts = [(r"\b" + re.escape(p) + r"\b") if ww else re.escape(p) for p in pats]
+    rx = re.compile("|".join(parts), re.IGNORECASE if ci else 0)
+    return Series.from_pylist(
+        [None if v is None else len(rx.findall(v)) for v in s.to_pylist()],
+        s.name,
+        DataType.uint64(),
+    )
+
+
+register("utf8_count_matches", _rt_const(DataType.uint64()), _utf8_count_matches)
+
+
+# ===================================================================================
+# temporal
+# ===================================================================================
+
+def _dt1(fn, out_dt):
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        out = _combine(fn(s.to_arrow()))
+        return Series(s.name, out_dt, out.cast(out_dt.to_arrow()))
+
+    return host
+
+
+register("dt_year", _rt_const(DataType.int32()), _dt1(pc.year, DataType.int32()))
+register("dt_month", _rt_const(DataType.uint32()), _dt1(pc.month, DataType.uint32()))
+register("dt_day", _rt_const(DataType.uint32()), _dt1(pc.day, DataType.uint32()))
+register("dt_hour", _rt_const(DataType.uint32()), _dt1(pc.hour, DataType.uint32()))
+register("dt_minute", _rt_const(DataType.uint32()), _dt1(pc.minute, DataType.uint32()))
+register("dt_second", _rt_const(DataType.uint32()), _dt1(pc.second, DataType.uint32()))
+register("dt_millisecond", _rt_const(DataType.uint32()), _dt1(pc.millisecond, DataType.uint32()))
+register("dt_microsecond", _rt_const(DataType.uint32()), _dt1(pc.microsecond, DataType.uint32()))
+register("dt_day_of_year", _rt_const(DataType.uint32()), _dt1(pc.day_of_year, DataType.uint32()))
+register("dt_week_of_year", _rt_const(DataType.uint32()), _dt1(pc.iso_week, DataType.uint32()))
+
+
+def _dt_day_of_week(args, kwargs):
+    s = args[0]
+    out = _combine(pc.day_of_week(s.to_arrow()))  # Monday=0
+    return Series(s.name, DataType.uint32(), out.cast(pa.uint32()))
+
+
+register("dt_day_of_week", _rt_const(DataType.uint32()), _dt_day_of_week)
+
+
+def _dt_date(args, kwargs):
+    s = args[0]
+    return Series(s.name, DataType.date(), _combine(s.to_arrow().cast(pa.date32())))
+
+
+register("dt_date", _rt_const(DataType.date()), _dt_date)
+
+
+def _dt_time(args, kwargs):
+    s = args[0]
+    out = _combine(pc.cast(s.to_arrow(), pa.time64("us")))
+    return Series(s.name, DataType.time("us"), out)
+
+
+register("dt_time", lambda f, k: DataType.time("us"), _dt_time)
+
+
+def _dt_truncate(args, kwargs):
+    s = args[0]
+    interval = kwargs["interval"]  # e.g. "1 day", "1 hour"
+    count, unit = interval.split()
+    unit = unit.rstrip("s")
+    out = _combine(pc.floor_temporal(s.to_arrow(), multiple=int(count), unit=unit))
+    return Series(s.name, s.dtype, out)
+
+
+register("dt_truncate", _rt_same, _dt_truncate)
+
+
+def _dt_to_unix_epoch(args, kwargs):
+    s = args[0]
+    unit = kwargs.get("unit", "s")
+    arr = s.to_arrow()
+    if pa.types.is_date(arr.type):
+        arr = arr.cast(pa.timestamp("s"))
+    target_unit = {"s": "s", "ms": "ms", "us": "us", "ns": "ns"}[unit]
+    arr = arr.cast(pa.timestamp(target_unit)) if not pa.types.is_timestamp(arr.type) else arr.cast(
+        pa.timestamp(target_unit, getattr(arr.type, "tz", None))
+    )
+    return Series(s.name, DataType.int64(), _combine(arr.cast(pa.int64())))
+
+
+register("dt_to_unix_epoch", _rt_const(DataType.int64()), _dt_to_unix_epoch)
+
+
+def _dt_strftime(args, kwargs):
+    s = args[0]
+    fmt = kwargs.get("format") or "%Y-%m-%dT%H:%M:%S%.f"
+    arr = s.to_arrow()
+    if pa.types.is_date(arr.type):
+        fmt = kwargs.get("format") or "%Y-%m-%d"
+        arr = arr.cast(pa.timestamp("s"))
+    fmt = fmt.replace("%.f", "%f")
+    out = pc.strftime(arr, format=fmt)
+    return Series(s.name, DataType.string(), _combine(out).cast(pa.large_string()))
+
+
+register("dt_strftime", _rt_const(DataType.string()), _dt_strftime)
+
+
+# ===================================================================================
+# list
+# ===================================================================================
+
+
+def _list_length(args, kwargs):
+    s = args[0]
+    out = pc.list_value_length(s.to_arrow())
+    return Series(s.name, DataType.uint64(), _combine(out).cast(pa.uint64()))
+
+
+register("list_length", _rt_const(DataType.uint64()), _list_length)
+
+
+def _list_offsets_values(arr: pa.Array):
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    offsets = np.asarray(arr.offsets.to_numpy(zero_copy_only=False), dtype=np.int64)
+    return offsets, arr.values
+
+
+def _list_agg(np_reduce, needs_float):
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        arr = s.to_arrow()
+        offsets, values = _list_offsets_values(arr)
+        inner = Series("v", s.dtype.inner, values)
+        vals = inner.to_numpy().astype(np.float64)
+        valid_inner = inner.validity_numpy()
+        n = len(arr)
+        out = np.empty(n, dtype=np.float64)
+        out_valid = np.empty(n, dtype=bool)
+        for i in range(n):
+            seg = vals[offsets[i] : offsets[i + 1]]
+            segv = valid_inner[offsets[i] : offsets[i + 1]]
+            seg = seg[segv]
+            if len(seg) == 0:
+                out_valid[i] = False
+                out[i] = 0
+            else:
+                out_valid[i] = True
+                out[i] = np_reduce(seg)
+        out_valid &= s.validity_numpy()
+        res = pa.array(out)
+        if needs_float:
+            dt = DataType.float64()
+        else:
+            dt = s.dtype.inner
+            res = res.cast(dt.to_arrow())
+        res = pc.if_else(pa.array(out_valid), res, pa.nulls(n, type=res.type))
+        return Series(s.name, dt, _combine(res))
+
+    return host
+
+
+register("list_sum", lambda f, k: f[0].dtype.inner, _list_agg(np.sum, False))
+register("list_mean", _rt_const(DataType.float64()), _list_agg(np.mean, True))
+register("list_min", _rt_inner, _list_agg(np.min, False))
+register("list_max", _rt_inner, _list_agg(np.max, False))
+
+
+def _list_get(args, kwargs):
+    s = args[0]
+    idx = _scalar_arg(args[1])
+    default = args[2].to_pylist()[0] if len(args) > 2 and args[2] is not None else None
+
+    def f(v):
+        if v is None:
+            return None
+        if -len(v) <= idx < len(v):
+            return v[idx]
+        return default
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, s.dtype.inner)
+
+
+register("list_get", _rt_inner, _list_get)
+
+
+def _list_join(args, kwargs):
+    s, delim = args[0], _scalar_arg(args[1])
+    out = pc.binary_join(s.to_arrow(), pa.scalar(delim, type=pa.large_string()))
+    return Series(s.name, DataType.string(), _combine(out).cast(pa.large_string()))
+
+
+register("list_join", _rt_const(DataType.string()), _list_join)
+
+
+def _list_contains(args, kwargs):
+    s, v = args[0], args[1]
+    target = v.to_pylist()[0]
+    return Series.from_pylist(
+        [None if row is None else (target in row) for row in s.to_pylist()],
+        s.name,
+        DataType.bool(),
+    )
+
+
+register("list_contains", _rt_const(DataType.bool()), _list_contains)
+
+
+def _list_slice(args, kwargs):
+    s = args[0]
+    start = _scalar_arg(args[1])
+    end = _scalar_arg(args[2]) if len(args) > 2 and args[2] is not None else None
+    return Series.from_pylist(
+        [None if v is None else v[start:end] for v in s.to_pylist()], s.name, s.dtype
+    )
+
+
+register("list_slice", _rt_same, _list_slice)
+
+
+def _list_sort(args, kwargs):
+    s = args[0]
+    desc = kwargs.get("desc", False)
+    return Series.from_pylist(
+        [None if v is None else sorted([x for x in v if x is not None], reverse=desc) + [None] * sum(1 for x in v if x is None) for v in s.to_pylist()],
+        s.name,
+        s.dtype,
+    )
+
+
+register("list_sort", _rt_same, _list_sort)
+
+
+def _list_distinct(args, kwargs):
+    s = args[0]
+
+    def f(v):
+        if v is None:
+            return None
+        seen = set()
+        out = []
+        for x in v:
+            if x is not None and x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, s.dtype)
+
+
+register("list_distinct", _rt_same, _list_distinct)
+
+
+def _list_chunk(args, kwargs):
+    s = args[0]
+    size = kwargs["size"]
+
+    def f(v):
+        if v is None:
+            return None
+        return [v[i : i + size] for i in range(0, len(v) - size + 1, size)]
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, DataType.list(s.dtype))
+
+
+register("list_chunk", lambda f, k: DataType.list(f[0].dtype), _list_chunk)
+
+
+def _list_count(args, kwargs):
+    s = args[0]
+    mode = kwargs.get("mode", "valid")
+
+    def f(v):
+        if v is None:
+            return 0
+        if mode == "valid":
+            return sum(1 for x in v if x is not None)
+        if mode == "null":
+            return sum(1 for x in v if x is None)
+        return len(v)
+
+    return Series.from_pylist([f(v) for v in s.to_pylist()], s.name, DataType.uint64())
+
+
+register("list_count", _rt_const(DataType.uint64()), _list_count)
+
+
+def _list_value_counts(args, kwargs):
+    s = args[0]
+
+    def f(v):
+        if v is None:
+            return None
+        counts: Dict[Any, int] = {}
+        for x in v:
+            if x is not None:
+                counts[x] = counts.get(x, 0) + 1
+        return [{"key": k2, "value": c} for k2, c in counts.items()]
+
+    inner = s.dtype.inner
+    return Series.from_pylist(
+        [f(v) for v in s.to_pylist()],
+        s.name,
+        DataType.list(DataType.struct({"key": inner, "value": DataType.uint64()})),
+    )
+
+
+register(
+    "list_value_counts",
+    lambda f, k: DataType.list(DataType.struct({"key": f[0].dtype.inner, "value": DataType.uint64()})),
+    _list_value_counts,
+)
+
+
+# ===================================================================================
+# struct
+# ===================================================================================
+
+
+def _struct_get(args, kwargs):
+    s = args[0]
+    name = kwargs["name"]
+    out = pc.struct_field(s.to_arrow(), name)
+    return Series(name, DataType.from_arrow(out.type), _combine(out))
+
+
+def _rt_struct_get(fields, kwargs):
+    for n, t in fields[0].dtype.struct_fields:
+        if n == kwargs["name"]:
+            return t
+    raise ValueError(f"struct has no field {kwargs['name']!r}")
+
+
+register("struct_get", _rt_struct_get, _struct_get)
+
+
+# ===================================================================================
+# embedding / vector distance
+# ===================================================================================
+
+
+def _vec_pair(args):
+    a, b = args[0], args[1]
+    av, bv = a.to_numpy().astype(np.float64), b.to_numpy().astype(np.float64)
+    if bv.ndim == 1:
+        bv = bv[None, :]
+    valid = a.validity_numpy() & (b.validity_numpy() if len(b) == len(a) else np.ones(len(a), bool))
+    return a, av, bv, valid
+
+
+def _mk_dist(fn):
+    def host(args, kwargs):
+        a, av, bv, valid = _vec_pair(args)
+        with np.errstate(all="ignore"):
+            out = fn(av, bv)
+        arr = pa.array(out)
+        arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), type=arr.type))
+        return Series(a.name, DataType.float64(), _combine(arr))
+
+    return host
+
+
+def _cosine(av, bv):
+    num = (av * bv).sum(axis=1)
+    den = np.linalg.norm(av, axis=1) * np.linalg.norm(bv, axis=1)
+    return 1.0 - num / den
+
+
+register("cosine_distance", _rt_const(DataType.float64()), _mk_dist(_cosine))
+register("dot", _rt_const(DataType.float64()), _mk_dist(lambda a, b: (a * b).sum(axis=1)))
+register(
+    "euclidean_distance",
+    _rt_const(DataType.float64()),
+    _mk_dist(lambda a, b: np.linalg.norm(a - b, axis=1)),
+)
+
+
+def _embedding_norm(args, kwargs):
+    s = args[0]
+    av = s.to_numpy().astype(np.float64)
+    out = np.linalg.norm(av, axis=1)
+    arr = pa.array(out)
+    arr = pc.if_else(pa.array(s.validity_numpy()), arr, pa.nulls(len(arr), type=arr.type))
+    return Series(s.name, DataType.float64(), _combine(arr))
+
+
+register("embedding_norm", _rt_const(DataType.float64()), _embedding_norm)
+
+
+# ===================================================================================
+# minhash (LSH dedup; reference: src/daft-minhash)
+# ===================================================================================
+
+
+def _minhash(args, kwargs):
+    from ..core.kernels.minhash import minhash_series
+
+    return minhash_series(
+        args[0],
+        num_hashes=kwargs.get("num_hashes", 16),
+        ngram_size=kwargs.get("ngram_size", 1),
+        seed=kwargs.get("seed", 1),
+    )
+
+
+register(
+    "minhash",
+    lambda f, k: DataType.fixed_size_list(DataType.uint64(), k.get("num_hashes", 16)),
+    _minhash,
+)
+
+
+# ===================================================================================
+# misc
+# ===================================================================================
+
+
+def _monotonically_increasing_id(args, kwargs):
+    raise ValueError("monotonically_increasing_id is evaluated by the executor, not as a scalar fn")
+
+
+register("monotonically_increasing_id", _rt_const(DataType.uint64()), _monotonically_increasing_id)
+
+
+def _uuid_host(args, kwargs):
+    import uuid as _uuid
+
+    n = kwargs.get("__num_rows", 1)
+    return Series.from_pylist([str(_uuid.uuid4()) for _ in range(n)], "uuid", DataType.string())
+
+
+register("uuid", _rt_const(DataType.string()), _uuid_host)

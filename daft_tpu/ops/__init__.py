@@ -1,0 +1,7 @@
+"""Device-side (JAX/XLA/Pallas) operator kernels.
+
+The TPU equivalents of the reference's daft-core compute kernels (SURVEY.md §7):
+expressions compile to jnp programs over (values, validity) pairs; groupby lowers to
+sort + segment-reduce; joins to sort-probe; all with static shapes via the
+padding+masking convention so XLA caches compilations per bucket size.
+"""

@@ -1,0 +1,329 @@
+"""Compile Expression trees into JAX functions over (values, validity) pairs.
+
+This is the device half of expression evaluation (host half:
+daft_tpu/expressions/eval.py). The stage compiler traces a whole
+Project/Filter/Agg chain through these builders into ONE jit program, so XLA fuses
+elementwise work into a single HBM pass — the TPU replacement for the reference's
+per-operator vectorized kernels (src/daft-recordbatch eval_expression +
+daft-core/array/ops), per SURVEY.md §7.
+
+Null semantics mirror the host kernels exactly: validity masks propagate through
+arithmetic, Kleene logic for and/or, divide-by-zero nulls, SQL CASE semantics for
+if_else. Padding rows ride along as invalid and are masked out at aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401  — enables x64 before any jnp use
+import jax.numpy as jnp
+
+from ..datatype import DataType
+from ..expressions.expressions import (
+    AggExpr,
+    Alias,
+    Between,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expression,
+    Function,
+    IfElse,
+    IsIn,
+    Literal,
+    UnaryOp,
+)
+from ..schema import Schema
+
+# (values, validity) pair; validity is bool[n]
+DCol = Tuple[jnp.ndarray, jnp.ndarray]
+
+_DEVICE_FNS: Dict[str, Callable] = {
+    "exp": jnp.exp,
+    "sqrt": jnp.sqrt,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arctan": jnp.arctan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "cbrt": jnp.cbrt,
+    "expm1": jnp.expm1,
+    "log1p": jnp.log1p,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "sign": jnp.sign,
+}
+
+_FLOAT_RESULT_FNS = set(_DEVICE_FNS) - {"floor", "ceil", "sign"}
+
+
+def is_device_evaluable(expr: Expression, schema: Schema) -> bool:
+    """True if the whole expression tree can run on device for this input schema."""
+    try:
+        out_dt = expr.to_field(schema).dtype
+    except Exception:
+        return False
+    if not _dtype_on_device(out_dt):
+        return False
+    for node in expr.walk():
+        if isinstance(node, ColumnRef):
+            if not _dtype_on_device(schema[node._name].dtype):
+                return False
+        elif isinstance(node, Literal):
+            if not (node.dtype.is_numeric() or node.dtype.is_boolean() or node.dtype.is_null()) or node.dtype.is_decimal():
+                return False
+        elif isinstance(node, (Alias, Between, IfElse, IsIn)):
+            pass
+        elif isinstance(node, Cast):
+            if not _dtype_on_device(node.dtype):
+                return False
+        elif isinstance(node, BinaryOp):
+            if node.op not in (
+                "add", "sub", "mul", "div", "floordiv", "mod", "pow",
+                "eq", "neq", "lt", "le", "gt", "ge", "and", "or", "xor",
+                "fill_null", "eq_null_safe",
+            ):
+                return False
+        elif isinstance(node, UnaryOp):
+            if node.op not in ("not", "neg", "abs", "is_null", "not_null"):
+                return False
+        elif isinstance(node, Function):
+            if node.fname not in _DEVICE_FNS and node.fname not in ("is_nan", "is_inf", "not_nan", "fill_nan", "round", "clip", "log"):
+                return False
+        elif isinstance(node, AggExpr):
+            if node.op not in ("sum", "mean", "min", "max", "count"):
+                return False
+        else:
+            return False
+    return True
+
+
+def _dtype_on_device(dt: DataType) -> bool:
+    return (dt.is_numeric() and not dt.is_decimal()) or dt.is_boolean() or dt.is_temporal()
+
+
+def build_device_expr(expr: Expression, schema: Schema) -> Callable[[Dict[str, DCol]], DCol]:
+    """Return fn(cols) -> (values, validity); traceable under jit."""
+
+    def ev(node: Expression, cols: Dict[str, DCol]) -> DCol:
+        if isinstance(node, ColumnRef):
+            return cols[node._name]
+        if isinstance(node, Literal):
+            if node.value is None:
+                return jnp.zeros((), dtype=jnp.float64), jnp.zeros((), dtype=bool)
+            dt = node.dtype.to_jax()
+            return jnp.asarray(node.value, dtype=dt), jnp.ones((), dtype=bool)
+        if isinstance(node, Alias):
+            return ev(node.child, cols)
+        if isinstance(node, Cast):
+            v, m = ev(node.child, cols)
+            return v.astype(node.dtype.to_jax()), m
+        if isinstance(node, UnaryOp):
+            v, m = ev(node.child, cols)
+            if node.op == "not":
+                return ~v.astype(bool), m
+            if node.op == "neg":
+                return -v, m
+            if node.op == "abs":
+                return jnp.abs(v), m
+            if node.op == "is_null":
+                val = ~m & jnp.ones(jnp.shape(v), dtype=bool)
+                return val, jnp.ones_like(val)
+            if node.op == "not_null":
+                val = m & jnp.ones(jnp.shape(v), dtype=bool)
+                return val, jnp.ones_like(val)
+            raise ValueError(node.op)
+        if isinstance(node, BinaryOp):
+            lv, lm = ev(node.left, cols)
+            rv, rm = ev(node.right, cols)
+            return _binop(node.op, lv, lm, rv, rm)
+        if isinstance(node, Between):
+            v, m = ev(node.child, cols)
+            lo, lom = ev(node.lower, cols)
+            hi, him = ev(node.upper, cols)
+            val = (v >= lo) & (v <= hi)
+            return val, m & lom & him
+        if isinstance(node, IsIn):
+            # host semantics: null input -> False, result never null
+            v, m = ev(node.child, cols)
+            acc = jnp.zeros(jnp.shape(v), dtype=bool)
+            for item in node.items:
+                iv, im = ev(item, cols)
+                acc = acc | ((v == iv) & im)
+            val = acc & m
+            return val, jnp.ones_like(val)
+        if isinstance(node, IfElse):
+            pv, pm = ev(node.predicate, cols)
+            tv, tm = ev(node.if_true, cols)
+            fv, fm = ev(node.if_false, cols)
+            cond = pv.astype(bool)
+            tv, fv = _promote_pair(tv, fv)
+            val = jnp.where(cond, tv, fv)
+            # arrow semantics (matches host pc.if_else): null predicate -> null
+            valid = pm & jnp.where(cond, tm & jnp.ones_like(cond), fm & jnp.ones_like(cond))
+            return val, valid
+        if isinstance(node, Function):
+            return _fn_node(node, ev, cols)
+        raise ValueError(f"not device-evaluable: {type(node).__name__}")
+
+    def run(cols: Dict[str, DCol]) -> DCol:
+        return ev(expr, cols)
+
+    return run
+
+
+def _promote_pair(a, b):
+    dt = jnp.promote_types(a.dtype, b.dtype)
+    return a.astype(dt), b.astype(dt)
+
+
+def _broadcast_valid(v, m):
+    """Ensure validity mask has the same shape as values."""
+    return m & jnp.ones(jnp.shape(v), dtype=bool) if jnp.shape(m) != jnp.shape(v) else m
+
+
+def _binop(op: str, lv, lm, rv, rm) -> DCol:
+    if op in ("add", "sub", "mul"):
+        lv2, rv2 = _promote_pair(lv, rv)
+        val = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}[op](lv2, rv2)
+        return val, _broadcast_valid(val, lm & rm)
+    if op == "div":
+        lvf = lv.astype(jnp.float64)
+        rvf = rv.astype(jnp.float64)
+        val = lvf / jnp.where(rv == 0, jnp.ones_like(rvf), rvf)
+        valid = lm & rm & (rv != 0)
+        return val, _broadcast_valid(val, valid)
+    if op == "floordiv":
+        lvf = lv.astype(jnp.float64)
+        rvf = rv.astype(jnp.float64)
+        q = jnp.floor(lvf / jnp.where(rv == 0, jnp.ones_like(rvf), rvf))
+        if jnp.issubdtype(lv.dtype, jnp.integer) and jnp.issubdtype(rv.dtype, jnp.integer):
+            q = q.astype(jnp.promote_types(lv.dtype, rv.dtype))
+        valid = lm & rm & (rv != 0)
+        return q, _broadcast_valid(q, valid)
+    if op == "mod":
+        safe_r = jnp.where(rv == 0, jnp.ones_like(rv), rv)
+        val = jnp.mod(lv, safe_r)
+        valid = lm & rm & (rv != 0)
+        return val, _broadcast_valid(val, valid)
+    if op == "pow":
+        val = jnp.power(lv.astype(jnp.float64), rv.astype(jnp.float64))
+        return val, _broadcast_valid(val, lm & rm)
+    if op in ("eq", "neq", "lt", "le", "gt", "ge"):
+        val = {
+            "eq": lv == rv, "neq": lv != rv, "lt": lv < rv,
+            "le": lv <= rv, "gt": lv > rv, "ge": lv >= rv,
+        }[op]
+        return val, _broadcast_valid(val, lm & rm)
+    if op == "eq_null_safe":
+        both_valid = lm & rm
+        val = jnp.where(both_valid, lv == rv, ~(lm ^ rm))
+        return val, jnp.ones_like(_broadcast_valid(val, both_valid))
+    if op == "and":
+        lb, rb = lv.astype(bool), rv.astype(bool)
+        val = lb & rb
+        # Kleene: false AND anything = false (valid); null only if both maybe-true
+        valid = (lm & rm) | (lm & ~lb) | (rm & ~rb)
+        return val & valid, _broadcast_valid(val, valid)
+    if op == "or":
+        lb, rb = lv.astype(bool), rv.astype(bool)
+        val = lb & lm | rb & rm
+        valid = (lm & rm) | (lm & lb) | (rm & rb)
+        return val, _broadcast_valid(val, valid)
+    if op == "xor":
+        val = lv.astype(bool) ^ rv.astype(bool)
+        return val, _broadcast_valid(val, lm & rm)
+    if op == "fill_null":
+        lv2, rv2 = _promote_pair(lv, rv)
+        val = jnp.where(lm, lv2, rv2)
+        valid = lm | rm
+        return val, _broadcast_valid(val, valid)
+    raise ValueError(f"unsupported device binop {op!r}")
+
+
+def _fn_node(node: Function, ev, cols) -> DCol:
+    name = node.fname
+    if name in _DEVICE_FNS:
+        v, m = ev(node.args[0], cols)
+        if name in _FLOAT_RESULT_FNS:
+            v = v.astype(jnp.float64) if not jnp.issubdtype(v.dtype, jnp.floating) else v
+        return _DEVICE_FNS[name](v), m
+    if name == "log":
+        v, m = ev(node.args[0], cols)
+        v = v.astype(jnp.float64)
+        base = node.kwargs.get("base")
+        out = jnp.log(v) if not base else jnp.log(v) / np.log(base)
+        return out, m
+    if name == "round":
+        v, m = ev(node.args[0], cols)
+        return jnp.round(v, node.kwargs.get("decimals", 0)), m
+    if name == "clip":
+        v, m = ev(node.args[0], cols)
+        return jnp.clip(v, node.kwargs.get("clip_min"), node.kwargs.get("clip_max")), m
+    if name == "is_nan":
+        v, m = ev(node.args[0], cols)
+        return jnp.isnan(v), m
+    if name == "not_nan":
+        v, m = ev(node.args[0], cols)
+        return ~jnp.isnan(v), m
+    if name == "is_inf":
+        v, m = ev(node.args[0], cols)
+        return jnp.isinf(v), m
+    if name == "fill_nan":
+        v, m = ev(node.args[0], cols)
+        fv, fm = ev(node.args[1], cols)
+        # null rows carry NaN in the dense values array — only replace *valid* NaNs
+        nan = jnp.isnan(v) & m
+        val = jnp.where(nan, fv.astype(v.dtype), v)
+        valid = jnp.where(nan, _broadcast_valid(val, fm), _broadcast_valid(val, m))
+        return val, valid
+    raise ValueError(f"function {name!r} has no device kernel")
+
+
+# ---- whole-column (ungrouped) aggregation on device -------------------------------
+
+
+def device_agg(op: str, v: jnp.ndarray, m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Aggregate a masked column to a scalar: returns (value, valid) 0-d arrays."""
+    count = jnp.sum(m)
+    if op == "count":
+        return count.astype(jnp.uint64), jnp.asarray(True)
+    if op == "sum":
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.uint64)
+        s = jnp.sum(jnp.where(m, v, jnp.zeros_like(v)))
+        if jnp.issubdtype(s.dtype, jnp.signedinteger):
+            s = s.astype(jnp.int64)
+        elif jnp.issubdtype(s.dtype, jnp.unsignedinteger):
+            s = s.astype(jnp.uint64)
+        return s, count > 0
+    if op == "mean":
+        s = jnp.sum(jnp.where(m, v.astype(jnp.float64), 0.0))
+        return s / jnp.maximum(count, 1), count > 0
+    if op == "min":
+        big = _extreme(v.dtype, True)
+        return jnp.min(jnp.where(m, v, big)), count > 0
+    if op == "max":
+        small = _extreme(v.dtype, False)
+        return jnp.max(jnp.where(m, v, small)), count > 0
+    raise ValueError(f"no device agg {op!r}")
+
+
+def _extreme(dtype, positive: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if positive else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(positive, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if positive else info.min, dtype=dtype)

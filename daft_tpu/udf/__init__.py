@@ -1,0 +1,4 @@
+from .udf import Func, func, method
+from .expr import UdfCall
+
+__all__ = ["Func", "func", "method", "UdfCall"]

@@ -1,0 +1,60 @@
+"""UDF call expression node.
+
+Reference parity: src/daft-dsl/src/functions/python (ScalarFn python UDF exprs);
+the SplitUDFs optimizer rule isolates these into their own UDFProject plan nodes so
+device-stage fusion is never broken by opaque Python (SURVEY.md §7 'hard parts').
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List
+
+from ..core.series import Series
+from ..datatype import Field
+from ..expressions.expressions import Expression
+from ..schema import Schema
+
+
+class UdfCall(Expression):
+    def __init__(self, func, args: List[Expression], kwargs: Dict[str, Any]):
+        self.func = func
+        self.args = args
+        self.kwargs = kwargs
+
+    def name(self) -> str:
+        return self.args[0].name() if self.args else self.func.name
+
+    def children(self) -> List[Expression]:
+        return list(self.args)
+
+    def with_children(self, children):
+        return UdfCall(self.func, children, self.kwargs)
+
+    def to_field(self, schema: Schema) -> Field:
+        return Field(self.name(), self.func.return_dtype)
+
+    def __repr__(self):
+        inner = ", ".join(repr(a) for a in self.args)
+        return f"udf:{self.func.name}({inner})"
+
+    # ---- execution ------------------------------------------------------------------
+    def eval_host(self, arg_series: List[Series], num_rows: int) -> Series:
+        f = self.func
+        if f.is_batch:
+            out = f.fn(*arg_series, **self.kwargs)
+            if not isinstance(out, Series):
+                out = Series.from_pylist(list(out), f.name, f.return_dtype)
+            return out.rename(self.name())
+
+        cols = [s.to_pylist() for s in arg_series]
+        # broadcast length-1 args
+        cols = [c * num_rows if len(c) == 1 and num_rows != 1 else c for c in cols]
+        if f.is_async:
+            async def run_all():
+                return await asyncio.gather(*(f.fn(*vals, **self.kwargs) for vals in zip(*cols)))
+
+            results = asyncio.run(run_all())
+        else:
+            results = [f.fn(*vals, **self.kwargs) for vals in zip(*cols)]
+        return Series.from_pylist(results, self.name(), f.return_dtype)

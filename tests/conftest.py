@@ -1,18 +1,27 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU mesh (SURVEY.md §7 / task environment notes)
-so multi-chip sharding paths are exercised without TPU hardware. Must run before the
-first ``import jax`` anywhere in the test process.
+so multi-chip sharding paths are exercised without TPU hardware.
+
+NOTE: this environment pre-imports jax (sitecustomize) with JAX_PLATFORMS=axon (a
+tunneled TPU), so setting the env var here is too late for the config default —
+we must go through jax.config, which works as long as no backend has initialized
+yet (backends init lazily on first device use).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read at backend-init time, so mutating it here still works.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# Deliberately NOT exporting JAX_PLATFORMS=cpu: it's a no-op in-process (jax is
+# pre-imported) and a child python inheriting it hangs in the axon shim.
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

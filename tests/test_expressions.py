@@ -1,0 +1,225 @@
+import datetime
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import DataType, RecordBatch, Schema, Series
+from daft_tpu.expressions import col, lit
+
+
+def B(**data):
+    return RecordBatch.from_pydict(data)
+
+
+def test_column_and_literal():
+    b = B(a=[1, 2, 3])
+    assert b.eval_expression(col("a")).to_pylist() == [1, 2, 3]
+    out = b.eval_expression_list([col("a"), lit(7).alias("seven")])
+    assert out.to_pydict() == {"a": [1, 2, 3], "seven": [7, 7, 7]}
+
+
+def test_arithmetic_and_schema():
+    b = B(a=[1, 2, None], x=[1.5, 2.5, 3.5])
+    s = Schema.from_pydict({"a": DataType.int64(), "x": DataType.float64()})
+    e = (col("a") + 1) * col("x")
+    assert e.to_field(s).dtype == DataType.float64()
+    assert b.eval_expression(e).to_pylist() == [3.0, 7.5, None]
+    assert (col("a") / 2).to_field(s).dtype == DataType.float64()
+    assert b.eval_expression(col("a") / 2).to_pylist() == [0.5, 1.0, None]
+    assert b.eval_expression(2 - col("a")).to_pylist() == [1, 0, None]
+
+
+def test_comparison_and_logic():
+    b = B(a=[1, 2, 3, None])
+    e = (col("a") > 1) & (col("a") < 3)
+    assert b.eval_expression(e).to_pylist() == [False, True, False, None]
+    assert b.eval_expression(~(col("a") >= 2)).to_pylist() == [True, False, False, None]
+
+
+def test_null_ops():
+    b = B(a=[1, None, 3])
+    assert b.eval_expression(col("a").is_null()).to_pylist() == [False, True, False]
+    assert b.eval_expression(col("a").not_null()).to_pylist() == [True, False, True]
+    assert b.eval_expression(col("a").fill_null(0)).to_pylist() == [1, 0, 3]
+
+
+def test_is_in_between_if_else():
+    b = B(a=[1, 2, 3, 4])
+    assert b.eval_expression(col("a").is_in([2, 4])).to_pylist() == [False, True, False, True]
+    assert b.eval_expression(col("a").between(2, 3)).to_pylist() == [False, True, True, False]
+    e = (col("a") % 2 == 0).if_else(lit("even"), lit("odd"))
+    assert b.eval_expression(e).to_pylist() == ["odd", "even", "odd", "even"]
+
+
+def test_cast_and_alias():
+    b = B(a=[1, 2])
+    out = b.eval_expression_list([col("a").cast(DataType.string()).alias("s")])
+    assert out.to_pydict() == {"s": ["1", "2"]}
+    s = Schema.from_pydict({"a": DataType.int64()})
+    assert col("a").cast(DataType.float32()).to_field(s).dtype == DataType.float32()
+
+
+def test_numeric_functions():
+    b = B(x=[1.0, 4.0, None])
+    assert b.eval_expression(col("x").sqrt()).to_pylist() == [1.0, 2.0, None]
+    out = b.eval_expression(col("x").exp()).to_pylist()
+    assert abs(out[0] - np.e) < 1e-9 and out[2] is None
+    assert b.eval_expression(col("x").log2()).to_pylist()[1] == 2.0
+    b2 = B(x=[1.4, -2.7])
+    assert b2.eval_expression(col("x").floor()).to_pylist() == [1.0, -3.0]
+    assert b2.eval_expression(col("x").ceil()).to_pylist() == [2.0, -2.0]
+    assert b2.eval_expression(col("x").abs()).to_pylist() == [1.4, 2.7]
+    assert b2.eval_expression(col("x").round(0)).to_pylist() == [1.0, -3.0]
+
+
+def test_string_functions():
+    b = B(s=["Hello World", "foo", None])
+    assert b.eval_expression(col("s").str.upper()).to_pylist() == ["HELLO WORLD", "FOO", None]
+    assert b.eval_expression(col("s").str.lower()).to_pylist() == ["hello world", "foo", None]
+    assert b.eval_expression(col("s").str.length()).to_pylist() == [11, 3, None]
+    assert b.eval_expression(col("s").str.contains("oo")).to_pylist() == [False, True, None]
+    assert b.eval_expression(col("s").str.startswith("He")).to_pylist() == [True, False, None]
+    assert b.eval_expression(col("s").str.endswith("ld")).to_pylist() == [True, False, None]
+    assert b.eval_expression(col("s").str.split(" ")).to_pylist() == [["Hello", "World"], ["foo"], None]
+    assert b.eval_expression(col("s").str.substr(0, 4)).to_pylist() == ["Hell", "foo", None]
+    assert b.eval_expression(col("s").str.replace("o", "0")).to_pylist() == ["Hell0 W0rld", "f00", None]
+    assert b.eval_expression(col("s").str.reverse()).to_pylist() == ["dlroW olleH", "oof", None]
+    assert b.eval_expression(col("s").str.left(2)).to_pylist() == ["He", "fo", None]
+    assert b.eval_expression(col("s").str.like("He%")).to_pylist() == [True, False, None]
+    assert b.eval_expression(col("s").str.find("World")).to_pylist() == [6, -1, None]
+
+
+def test_string_concat_expr():
+    b = B(a=["x", "y"], b=["1", "2"])
+    out = b.eval_expression(col("a") + col("b"))
+    assert out.to_pylist() == ["x1", "y2"]
+    out = b.eval_expression(col("a").str.concat("-suffix"))
+    assert out.to_pylist() == ["x-suffix", "y-suffix"]
+
+
+def test_temporal_functions():
+    ts = [datetime.datetime(2024, 3, 15, 10, 30, 45), datetime.datetime(2021, 12, 1, 0, 0, 0), None]
+    b = B(t=ts)
+    assert b.eval_expression(col("t").dt.year()).to_pylist() == [2024, 2021, None]
+    assert b.eval_expression(col("t").dt.month()).to_pylist() == [3, 12, None]
+    assert b.eval_expression(col("t").dt.day()).to_pylist() == [15, 1, None]
+    assert b.eval_expression(col("t").dt.hour()).to_pylist() == [10, 0, None]
+    assert b.eval_expression(col("t").dt.minute()).to_pylist() == [30, 0, None]
+    assert b.eval_expression(col("t").dt.date()).to_pylist() == [
+        datetime.date(2024, 3, 15), datetime.date(2021, 12, 1), None,
+    ]
+    # temporal arithmetic typing
+    s = Schema.from_pydict({"t": DataType.timestamp("us")})
+    assert (col("t") - col("t")).to_field(s).dtype == DataType.duration("us")
+
+
+def test_to_date_parse():
+    b = B(s=["2024-01-05", "not a date", None])
+    out = b.eval_expression(col("s").str.to_date("%Y-%m-%d")).to_pylist()
+    assert out == [datetime.date(2024, 1, 5), None, None]
+
+
+def test_list_functions():
+    b = B(l=[[1, 2, 3], [4], None, []])
+    assert b.eval_expression(col("l").list.length()).to_pylist() == [3, 1, None, 0]
+    assert b.eval_expression(col("l").list.sum()).to_pylist() == [6, 4, None, None]
+    assert b.eval_expression(col("l").list.mean()).to_pylist() == [2.0, 4.0, None, None]
+    assert b.eval_expression(col("l").list.min()).to_pylist() == [1, 4, None, None]
+    assert b.eval_expression(col("l").list.max()).to_pylist() == [3, 4, None, None]
+    assert b.eval_expression(col("l").list.get(0)).to_pylist() == [1, 4, None, None]
+    assert b.eval_expression(col("l").list.get(5, default=-1)).to_pylist() == [-1, -1, None, -1]
+    assert b.eval_expression(col("l").list.contains(2)).to_pylist() == [True, False, None, False]
+    assert b.eval_expression(col("l").list.slice(0, 2)).to_pylist() == [[1, 2], [4], None, []]
+
+
+def test_list_join():
+    b = B(l=[["a", "b"], ["c"], None])
+    assert b.eval_expression(col("l").list.join(",")).to_pylist() == ["a,b", "c", None]
+
+
+def test_float_namespace():
+    b = B(x=[1.0, float("nan"), None, float("inf")])
+    assert b.eval_expression(col("x").float.is_nan()).to_pylist() == [False, True, None, False]
+    assert b.eval_expression(col("x").float.is_inf()).to_pylist() == [False, False, None, True]
+    out = b.eval_expression(col("x").float.fill_nan(0.0)).to_pylist()
+    assert out == [1.0, 0.0, None, float("inf")]
+
+
+def test_embedding_distance():
+    b = RecordBatch.from_pydict({
+        "e": Series.from_numpy(np.array([[1.0, 0.0], [0.0, 1.0]]), "e",
+                               DataType.embedding(DataType.float64(), 2)),
+    })
+    q = np.array([1.0, 0.0])
+    out = b.eval_expression(col("e").embedding.cosine_distance(lit(q))).to_pylist()
+    assert abs(out[0] - 0.0) < 1e-9
+    assert abs(out[1] - 1.0) < 1e-9
+
+
+def test_struct_get():
+    b = B(s=[{"x": 1, "y": "a"}, {"x": 2, "y": "b"}])
+    assert b.eval_expression(col("s").struct.get("x")).to_pylist() == [1, 2]
+    assert b.eval_expression(col("s").struct.get("y")).to_pylist() == ["a", "b"]
+
+
+def test_hash_and_minhash_exprs():
+    b = B(s=["hello world", "hello world", "goodbye"])
+    h = b.eval_expression(col("s").hash()).to_pylist()
+    assert h[0] == h[1] != h[2]
+    mh = b.eval_expression(col("s").minhash(num_hashes=8, ngram_size=1)).to_pylist()
+    assert list(mh[0]) == list(mh[1])
+    assert list(mh[0]) != list(mh[2])
+
+
+def test_udf_rowwise():
+    @daft_tpu.func
+    def add_one(x: int) -> int:
+        return x + 1
+
+    b = B(a=[1, 2, 3])
+    assert b.eval_expression(add_one(col("a"))).to_pylist() == [2, 3, 4]
+
+
+def test_udf_batch():
+    @daft_tpu.func(is_batch=True, return_dtype=DataType.float64())
+    def double(s):
+        import numpy as np
+        return Series.from_numpy(s.to_numpy() * 2.0, "out")
+
+    b = B(a=[1.0, 2.0])
+    assert b.eval_expression(double(col("a"))).to_pylist() == [2.0, 4.0]
+
+
+def test_type_errors():
+    s = Schema.from_pydict({"a": DataType.int64(), "s": DataType.string()})
+    with pytest.raises(ValueError):
+        (col("a") & col("a")).to_field(s)  # logical op on ints
+    with pytest.raises(ValueError):
+        (col("s") * col("a")).to_field(s)
+    with pytest.raises(KeyError):
+        col("zzz").to_field(s)
+    with pytest.raises(ValueError):
+        bool(col("a") > 1)
+
+
+def test_agg_expr_typing():
+    s = Schema.from_pydict({"a": DataType.int32(), "f": DataType.float32()})
+    assert col("a").sum().to_field(s).dtype == DataType.int64()
+    assert col("a").mean().to_field(s).dtype == DataType.float64()
+    assert col("a").count().to_field(s).dtype == DataType.uint64()
+    assert col("f").min().to_field(s).dtype == DataType.float32()
+    assert col("a").agg_list().to_field(s).dtype == DataType.list(DataType.int32())
+    with pytest.raises(ValueError):
+        b = B(a=[1])
+        b.eval_expression(col("a").sum())
+
+
+def test_referenced_columns_and_transform():
+    e = (col("a") + col("b")) * col("a")
+    assert e.referenced_columns() == ["a", "b"]
+    # rewrite col(a) -> col(z)
+    from daft_tpu.expressions.expressions import ColumnRef
+
+    e2 = e.transform(lambda n: ColumnRef("z") if isinstance(n, ColumnRef) and n._name == "a" else None)
+    assert e2.referenced_columns() == ["z", "b"]
