@@ -1,0 +1,100 @@
+"""Benchmark harness: TPC-H Q1+Q6 on generated lineitem data.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Baseline anchor (BASELINE.md): reference NativeRunner TPC-H; we report rows/sec
+through the full engine path (plan → optimize → translate → execute) for a
+Q1-shape grouped aggregation + Q6-shape filter-agg over SF~0.1-scale data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 6_000_000))
+# reference anchor: Daft native runner sustains O(100M) rows/sec/core-group on
+# this shape on server CPU; per-chip target from BASELINE.json
+BASELINE_ROWS_PER_SEC = 50e6
+
+
+def gen_lineitem(n: int):
+    rng = np.random.default_rng(42)
+    return {
+        "l_quantity": rng.uniform(1, 50, n).round(0),
+        "l_extendedprice": rng.uniform(900, 105000, n).round(2),
+        "l_discount": rng.uniform(0.0, 0.1, n).round(2),
+        "l_tax": rng.uniform(0.0, 0.08, n).round(2),
+        "l_returnflag": rng.choice(np.array(["A", "N", "R"]), n),
+        "l_linestatus": rng.choice(np.array(["F", "O"]), n),
+        "l_shipdate_days": rng.integers(8000, 10600, n),
+    }
+
+
+def main() -> None:
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    data = gen_lineitem(N_ROWS)
+    df = dt.from_pydict(data).collect()
+
+    # warmup (compile caches, etc.)
+    _ = run_q6(df, col)
+    _ = run_q1(df, col)
+
+    t0 = time.perf_counter()
+    run_q6(df, col)
+    t_q6 = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    run_q1(df, col)
+    t_q1 = time.perf_counter() - t0
+
+    total_rows = 2 * N_ROWS
+    rows_per_sec = total_rows / (t_q1 + t_q6)
+    print(json.dumps({
+        "metric": "tpch_q1q6_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
+    }))
+
+
+def run_q6(df, col):
+    return (
+        df.where(
+            (col("l_shipdate_days") >= 8766) & (col("l_shipdate_days") < 9131)
+            & (col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
+            & (col("l_quantity") < 24)
+        )
+        .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue"))
+        .to_pydict()
+    )
+
+
+def run_q1(df, col):
+    return (
+        df.where(col("l_shipdate_days") <= 10471)
+        .groupby("l_returnflag", "l_linestatus")
+        .agg(
+            col("l_quantity").sum().alias("sum_qty"),
+            col("l_extendedprice").sum().alias("sum_base_price"),
+            (col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("sum_disc_price"),
+            (col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax"))).sum().alias("sum_charge"),
+            col("l_quantity").mean().alias("avg_qty"),
+            col("l_extendedprice").mean().alias("avg_price"),
+            col("l_discount").mean().alias("avg_disc"),
+            col("l_quantity").count().alias("count_order"),
+        )
+        .sort(["l_returnflag", "l_linestatus"])
+        .to_pydict()
+    )
+
+
+if __name__ == "__main__":
+    main()

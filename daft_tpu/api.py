@@ -1,9 +1,105 @@
-"""Top-level user API re-exports (DataFrame, col, lit, read_* functions).
+"""Top-level user API re-exports (DataFrame, col, lit, from_*/read_* functions).
 
 daft_tpu/__init__.py lazily forwards attribute access here.
+Reference parity: daft/__init__.py + daft/convert.py + daft/io/__init__.py:19-37.
 """
 
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from .core.micropartition import MicroPartition
+from .dataframe import DataFrame, GroupedDataFrame
 from .expressions import Expression, col, lit
+from .plan.builder import LogicalPlanBuilder
+from .schema import Schema
 from .udf import func
 
-__all__ = ["Expression", "col", "lit", "func"]
+__all__ = [
+    "DataFrame", "GroupedDataFrame", "Expression", "col", "lit", "element", "func",
+    "from_pydict", "from_pylist", "from_arrow", "from_pandas",
+    "read_parquet", "read_csv", "read_json", "from_glob_path", "sql", "sql_expr",
+]
+
+
+def element() -> Expression:
+    """Placeholder for the current list element in list.map-style expressions."""
+    return col("")
+
+
+# ---- in-memory constructors ----------------------------------------------------------
+
+
+def from_pydict(data: Dict[str, Any]) -> DataFrame:
+    part = MicroPartition.from_pydict(data)
+    return DataFrame(LogicalPlanBuilder.from_in_memory(part.schema, [part]))
+
+
+def from_pylist(rows: List[dict]) -> DataFrame:
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    return from_pydict({k: [r.get(k) for r in rows] for k in keys})
+
+
+def from_arrow(tables) -> DataFrame:
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    parts = [MicroPartition.from_arrow(t) for t in tables]
+    return DataFrame(LogicalPlanBuilder.from_in_memory(parts[0].schema, list(parts)))
+
+
+def from_pandas(dfs) -> DataFrame:
+    import pyarrow as pa
+
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    return from_arrow([pa.Table.from_pandas(d) for d in dfs])
+
+
+def _from_partitions(parts: List[MicroPartition], schema: Schema) -> DataFrame:
+    return DataFrame(LogicalPlanBuilder.from_in_memory(schema, parts))
+
+
+# ---- file readers --------------------------------------------------------------------
+
+
+def read_parquet(path: Union[str, List[str]], **options) -> DataFrame:
+    from .io.parquet import ParquetScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(ParquetScanOperator(path, **options)))
+
+
+def read_csv(path: Union[str, List[str]], **options) -> DataFrame:
+    from .io.csv import CsvScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(CsvScanOperator(path, **options)))
+
+
+def read_json(path: Union[str, List[str]], **options) -> DataFrame:
+    from .io.json import JsonScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(JsonScanOperator(path, **options)))
+
+
+def from_glob_path(path: str) -> DataFrame:
+    from .io.glob_files import GlobPathScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(GlobPathScanOperator(path)))
+
+
+# ---- SQL -----------------------------------------------------------------------------
+
+
+def sql(query: str, **bindings) -> DataFrame:
+    from .sql import sql as _sql
+
+    return _sql(query, **bindings)
+
+
+def sql_expr(text: str) -> Expression:
+    from .sql import sql_expr as _sql_expr
+
+    return _sql_expr(text)

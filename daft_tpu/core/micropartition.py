@@ -55,7 +55,7 @@ class TableStatistics:
     @classmethod
     def from_batch(cls, batch: RecordBatch) -> "TableStatistics":
         cols = {}
-        for s in batch.columns():
+        for s in batch.columns:
             if s.dtype.is_comparable() and not s.dtype.is_null() and s._pyobjs is None:
                 try:
                     mn = s.min().to_pylist()[0]
@@ -121,6 +121,7 @@ class MicroPartition:
     def size_bytes(self) -> int:
         return sum(b.size_bytes() for b in self._batches)
 
+    @property
     def batches(self) -> List[RecordBatch]:
         return list(self._batches)
 

@@ -74,6 +74,7 @@ class RecordBatch:
     def num_columns(self) -> int:
         return len(self._columns)
 
+    @property
     def columns(self) -> List[Series]:
         return list(self._columns)
 

@@ -1,0 +1,544 @@
+"""RecordBatch-level relational operators: grouped/ungrouped aggregation, joins,
+distinct, explode, unpivot, pivot, sample.
+
+Reference parity: src/daft-micropartition/src/ops/*.rs and
+src/daft-recordbatch/src/ops/ (agg, joins, groups). Host implementations are
+vectorized numpy/arrow; the device (TPU) fast path for numeric grouped aggregation
+lives in ops/device_eval.py (segment-reduce after sort) and is selected by the
+executor when dtypes allow.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatype import DataType, Field
+from ..expressions import AggExpr, Alias, Expression
+from ..expressions.eval import eval_expression, eval_projection
+from ..schema import Schema
+from .kernels.encoding import encode_column, encode_keys
+from .kernels.groupby import make_groups
+from .kernels.join import cross_join_indices, join_indices
+from .recordbatch import RecordBatch
+from .series import Series
+
+
+def _unalias(e: Expression) -> Tuple[Expression, str]:
+    """Strip Alias wrappers; return (inner, output_name)."""
+    name = e.name()
+    while isinstance(e, Alias):
+        e = e.child
+    return e, name
+
+
+def _eval_keys(batch: RecordBatch, exprs: Sequence[Expression]) -> List[Series]:
+    out = []
+    for e in exprs:
+        s = eval_expression(batch, e)
+        if len(s) == 1 and batch.num_rows != 1:
+            from ..expressions.eval import _broadcast
+
+            s = _broadcast(s, batch.num_rows)
+        out.append(s)
+    return out
+
+
+# ======================================================================================
+# Aggregation
+# ======================================================================================
+
+_SERIES_AGG = {
+    "sum": lambda s: s.sum(),
+    "mean": lambda s: s.mean(),
+    "min": lambda s: s.min(),
+    "max": lambda s: s.max(),
+    "stddev": lambda s: s.stddev(),
+    "var": lambda s: s.var(),
+    "skew": lambda s: s.skew(),
+    "count_distinct": lambda s: s.count_distinct(),
+    "bool_and": lambda s: s.bool_and(),
+    "bool_or": lambda s: s.bool_or(),
+    "list": lambda s: s.agg_list(),
+    "concat": lambda s: s.agg_concat(),
+    "approx_count_distinct": lambda s: s.approx_count_distinct(),
+}
+
+
+def ungrouped_agg(batch: RecordBatch, aggs: Sequence[Expression]) -> RecordBatch:
+    """Aggregate the whole batch to one row."""
+    out: List[Series] = []
+    for e in aggs:
+        inner, name = _unalias(e)
+        if not isinstance(inner, AggExpr):
+            raise ValueError(f"expected aggregation expression, got {inner!r}")
+        s = eval_expression(batch, inner.child)
+        if len(s) == 1 and batch.num_rows != 1:
+            from ..expressions.eval import _broadcast
+
+            s = _broadcast(s, batch.num_rows)
+        op = inner.op
+        if op == "count":
+            mode = inner.params.get("mode", "valid")
+            res = s.count(mode)
+        elif op == "any_value":
+            res = s.any_value(inner.params.get("ignore_nulls", False))
+        else:
+            res = _SERIES_AGG[op](s)
+        out.append(res.rename(name))
+    return RecordBatch(Schema([s.field() for s in out]), out, 1)
+
+
+def _group_starts(sorted_gids: np.ndarray) -> np.ndarray:
+    if len(sorted_gids) == 0:
+        return np.empty(0, np.int64)
+    change = np.flatnonzero(np.diff(sorted_gids)) + 1
+    return np.concatenate([[0], change]).astype(np.int64)
+
+
+def grouped_agg(batch: RecordBatch, groupby: Sequence[Expression],
+                aggs: Sequence[Expression]) -> RecordBatch:
+    """Hash-group rows by the groupby keys and aggregate each group.
+
+    Output columns: [groupby keys..., aggs...]; group order = first occurrence.
+    """
+    key_series = _eval_keys(batch, groupby)
+    first_idx, group_ids, counts = make_groups(key_series)
+    num_groups = len(first_idx)
+
+    # sort rows by group id so each group is one contiguous segment
+    order = np.argsort(group_ids, kind="stable")
+    sorted_gids = group_ids[order]
+    starts = _group_starts(sorted_gids)
+    # map segment s -> group id (first occurrence order)
+    seg_gid = sorted_gids[starts] if num_groups else np.empty(0, np.int64)
+
+    out_cols: List[Series] = [s.take(first_idx) for s in key_series]
+
+    for e in aggs:
+        inner, name = _unalias(e)
+        if not isinstance(inner, AggExpr):
+            raise ValueError(f"expected aggregation expression, got {inner!r}")
+        s = eval_expression(batch, inner.child)
+        if len(s) == 1 and batch.num_rows != 1:
+            from ..expressions.eval import _broadcast
+
+            s = _broadcast(s, batch.num_rows)
+        res = _grouped_agg_one(s, inner, order, starts, seg_gid, counts, num_groups)
+        out_cols.append(res.rename(name))
+
+    n = num_groups
+    return RecordBatch(Schema([c.field() for c in out_cols]), out_cols, n)
+
+
+def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndarray,
+                     seg_gid: np.ndarray, counts: np.ndarray, num_groups: int) -> Series:
+    op = agg.op
+    # derive output dtype from the already-evaluated child series
+    from ..expressions import ColumnRef
+
+    synth = AggExpr(op, ColumnRef(s.name), agg.params)
+    out_field = synth.to_field(Schema([s.field()]))
+    out_dtype = out_field.dtype
+
+    valid = s.validity_numpy()[order]
+    valid_counts = np.add.reduceat(valid.astype(np.int64), starts) if num_groups else np.empty(0, np.int64)
+    # scatter from segment order back to group-id (first-occurrence) order
+    def unseg(arr: np.ndarray) -> np.ndarray:
+        out = np.empty(num_groups, dtype=arr.dtype)
+        out[seg_gid] = arr
+        return out
+
+    if op == "count":
+        mode = agg.params.get("mode", "valid")
+        if mode == "valid":
+            data = unseg(valid_counts)
+        elif mode == "null":
+            # counts is already in first-occurrence group order
+            data = counts - unseg(valid_counts)
+        else:  # "all"
+            data = counts
+        return Series.from_numpy(data.astype(np.uint64), s.name, DataType.uint64())
+
+    if op in ("count_distinct", "approx_count_distinct"):
+        codes = encode_column(s)[order]
+        gid_for_rows = seg_gid[np.searchsorted(starts, np.arange(len(codes)), side="right") - 1] if len(codes) else np.empty(0, np.int64)
+        keep = valid
+        pairs = np.stack([gid_for_rows[keep], codes[keep]], axis=1) if len(codes) else np.empty((0, 2), np.int64)
+        if len(pairs):
+            uniq = np.unique(pairs, axis=0)
+            cnt = np.bincount(uniq[:, 0].astype(np.int64), minlength=num_groups)
+        else:
+            cnt = np.zeros(num_groups, np.int64)
+        return Series.from_numpy(cnt.astype(np.uint64), s.name, DataType.uint64())
+
+    if op in ("bool_and", "bool_or"):
+        vals = s.to_numpy()[order]
+        if op == "bool_and":
+            filled = np.where(valid, vals.astype(bool), True)
+            res = np.logical_and.reduceat(filled, starts) if num_groups else np.empty(0, bool)
+        else:
+            filled = np.where(valid, vals.astype(bool), False)
+            res = np.logical_or.reduceat(filled, starts) if num_groups else np.empty(0, bool)
+        res = unseg(res)
+        vc = unseg(valid_counts)
+        arr = pa.array(res, type=pa.bool_())
+        arr = pc.if_else(pa.array(vc > 0), arr, pa.nulls(num_groups, pa.bool_()))
+        return Series.from_arrow(arr, s.name)
+
+    if op == "any_value":
+        # first valid row index per group (or first row if ignore_nulls False)
+        n = len(order)
+        idx_sorted = order  # original row index in segment order
+        if agg.params.get("ignore_nulls", False):
+            big = np.iinfo(np.int64).max
+            cand = np.where(valid, np.arange(n), big)
+            pos = np.minimum.reduceat(cand, starts) if num_groups else np.empty(0, np.int64)
+            pos = np.where(pos == big, starts, pos)  # all-null group: take first row (null)
+        else:
+            pos = starts
+        take_idx = idx_sorted[pos] if n else np.empty(0, np.int64)
+        return s.take(unseg(take_idx.astype(np.int64)))
+
+    if op in ("list", "concat"):
+        taken = s.take(order)
+        if op == "list":
+            offsets = np.concatenate([starts, [len(order)]]).astype(np.int32) if num_groups else np.zeros(1, np.int32)
+            values = taken.to_arrow()
+            lst = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()), values)
+            out = Series.from_arrow(lst, s.name)
+            # reorder segments to group order
+            return out.take(_invert_to_group_order(seg_gid, num_groups))
+        # concat: child must be list; concatenate element lists per group
+        res = []
+        py = taken.to_pylist()
+        bounds = list(starts) + [len(order)]
+        for g in range(num_groups):
+            chunk = py[bounds[g]:bounds[g + 1]]
+            merged: list = []
+            saw = False
+            for item in chunk:
+                if item is None:
+                    continue
+                saw = True
+                if isinstance(item, list):
+                    merged.extend(item)
+                elif isinstance(item, str):
+                    merged.append(item)
+            if not saw:
+                res.append(None)
+            elif py and isinstance(next((x for x in py if x is not None), None), str):
+                res.append("".join(merged))
+            else:
+                res.append(merged)
+        out = Series.from_pylist(res, s.name, s.dtype)
+        return out.take(_invert_to_group_order(seg_gid, num_groups))
+
+    # numeric family
+    if s.dtype.is_numeric() or s.dtype.is_boolean() or s.dtype.is_temporal() or s.dtype.is_null():
+        if s.dtype.is_null():
+            return Series.full_null(s.name, out_dtype, num_groups)
+        vals = s.to_numpy()[order]
+        if vals.dtype == object or s.dtype.is_temporal():
+            return _grouped_agg_arrow_fallback(s, op, order, starts, seg_gid, num_groups, out_dtype)
+        fvals = vals.astype(np.float64) if op in ("mean", "stddev", "var", "skew") else vals
+        vc = valid_counts.astype(np.float64)
+
+        def null_where_empty(data: np.ndarray, dtype: DataType) -> Series:
+            g = unseg(data)
+            vcg = unseg(valid_counts)
+            arr = pa.array(g)
+            arr = pc.if_else(pa.array(vcg > 0), arr, pa.nulls(num_groups, arr.type))
+            return Series.from_arrow(arr.cast(dtype.to_arrow()), s.name)
+
+        if op == "sum":
+            z = np.where(valid, vals, np.zeros(1, dtype=vals.dtype))
+            data = np.add.reduceat(z, starts) if num_groups else z[:0]
+            return null_where_empty(data, out_dtype)
+        if op == "mean":
+            z = np.where(valid, fvals, 0.0)
+            sums = np.add.reduceat(z, starts) if num_groups else z[:0]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                data = sums / vc
+            return null_where_empty(data, DataType.float64())
+        if op in ("min", "max"):
+            if np.issubdtype(vals.dtype, np.floating):
+                fill = np.inf if op == "min" else -np.inf
+                z = np.where(valid, vals, fill)
+            else:
+                info = np.iinfo(vals.dtype) if vals.dtype != bool else None
+                if vals.dtype == bool:
+                    z = np.where(valid, vals, op == "min")
+                else:
+                    z = np.where(valid, vals, info.max if op == "min" else info.min)
+            uf = np.minimum if op == "min" else np.maximum
+            data = uf.reduceat(z, starts) if num_groups else z[:0]
+            return null_where_empty(data, out_dtype)
+        if op in ("stddev", "var", "skew"):
+            ddof = agg.params.get("ddof", 0)
+            z = np.where(valid, fvals, 0.0)
+            s1 = np.add.reduceat(z, starts) if num_groups else z[:0]
+            s2 = np.add.reduceat(z * z, starts) if num_groups else z[:0]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                m = s1 / vc
+                var = s2 / vc - m * m
+                var = np.maximum(var, 0.0)
+                if ddof:
+                    var = var * vc / np.maximum(vc - ddof, 0)
+                if op == "var":
+                    data = var
+                elif op == "stddev":
+                    data = np.sqrt(var)
+                else:
+                    s3 = np.add.reduceat(z * z * z, starts) if num_groups else z[:0]
+                    m3 = s3 / vc - 3 * m * s2 / vc + 2 * m**3
+                    sd = np.sqrt(var)
+                    data = np.where(sd > 0, m3 / sd**3, np.nan)
+            res = null_where_empty(data, DataType.float64())
+            if op == "skew":
+                arr = res.to_arrow()
+                arr = pc.if_else(pc.is_nan(arr), pa.nulls(len(arr), arr.type), arr)
+                res = Series.from_arrow(arr, s.name)
+            return res
+    # non-numeric min/max/sum-ish → arrow per-group fallback
+    return _grouped_agg_arrow_fallback(s, op, order, starts, seg_gid, num_groups, out_dtype)
+
+
+def _invert_to_group_order(seg_gid: np.ndarray, num_groups: int) -> np.ndarray:
+    """Index array mapping group id -> segment position."""
+    inv = np.empty(num_groups, dtype=np.int64)
+    inv[seg_gid] = np.arange(num_groups)
+    return inv
+
+
+def _grouped_agg_arrow_fallback(s: Series, op: str, order: np.ndarray, starts: np.ndarray,
+                                seg_gid: np.ndarray, num_groups: int, out_dtype: DataType) -> Series:
+    taken = s.take(order).to_arrow()
+    bounds = list(starts) + [len(order)]
+    out = []
+    for g in range(num_groups):
+        sl = taken.slice(bounds[g], bounds[g + 1] - bounds[g])
+        if op == "min":
+            v = pc.min(sl).as_py()
+        elif op == "max":
+            v = pc.max(sl).as_py()
+        elif op == "sum":
+            v = pc.sum(sl).as_py()
+        elif op == "mean":
+            v = pc.mean(sl).as_py()
+        else:
+            raise ValueError(f"unsupported grouped aggregation {op!r} for dtype {s.dtype}")
+        out.append(v)
+    res = Series.from_pylist(out, s.name, out_dtype)
+    return res.take(_invert_to_group_order(seg_gid, num_groups))
+
+
+# ======================================================================================
+# Distinct / sample
+# ======================================================================================
+
+
+def distinct(batch: RecordBatch, on: Optional[Sequence[Expression]] = None) -> RecordBatch:
+    if batch.num_rows == 0:
+        return batch
+    if on:
+        keys = _eval_keys(batch, on)
+    else:
+        keys = batch.columns
+    first_idx, _, _ = make_groups(keys)
+    return batch.take(np.sort(first_idx))
+
+
+def sample(batch: RecordBatch, fraction: float, with_replacement: bool, seed: Optional[int]) -> RecordBatch:
+    n = batch.num_rows
+    k = int(round(n * fraction))
+    rng = np.random.default_rng(seed)
+    if with_replacement:
+        idx = rng.integers(0, n, size=k) if n else np.empty(0, np.int64)
+    else:
+        k = min(k, n)
+        idx = rng.choice(n, size=k, replace=False) if n else np.empty(0, np.int64)
+    return batch.take(np.sort(idx))
+
+
+# ======================================================================================
+# Joins
+# ======================================================================================
+
+
+def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expression],
+              right_on: Sequence[Expression], how: str,
+              output_schema: Schema, merged_keys: Sequence[str],
+              right_rename: dict) -> RecordBatch:
+    """Hash join via encoded key codes (kernels/join.py).
+
+    `merged_keys` = right column names that merge into the left key column.
+    `right_rename` = mapping right name -> output name for non-merged columns.
+    """
+    lkeys = _eval_keys(left, left_on)
+    rkeys = _eval_keys(right, right_on)
+    lidx, ridx = join_indices(lkeys, rkeys, how)
+
+    if how in ("semi", "anti"):
+        return left.take(lidx)
+
+    cols: List[Series] = []
+    for s in left.columns:
+        cols.append(_take_optional(s, lidx))
+    for s in right.columns:
+        if s.name in merged_keys:
+            continue
+        name = right_rename.get(s.name, s.name)
+        cols.append(_take_optional(s, ridx).rename(name))
+
+    # outer joins: merged key columns must be coalesced from both sides
+    if how in ("outer", "right"):
+        for li, (le, re) in enumerate(zip(left_on, right_on)):
+            if re.name() in merged_keys:
+                lpos = _find_col(cols, le.name(), output_schema)
+                rk = _take_optional(rkeys[li].rename(le.name()), ridx)
+                if how == "right":
+                    merged = rk
+                else:
+                    # rows with no left match (lidx == -1) take the right key
+                    lnull = pa.array(lidx < 0)
+                    merged = Series.from_arrow(
+                        pc.if_else(lnull, rk.to_arrow(), cols[lpos].to_arrow()), le.name()
+                    )
+                cols[lpos] = merged
+
+    out = RecordBatch(output_schema, [c.cast(f.dtype) if c.dtype != f.dtype else c
+                                      for c, f in zip(cols, output_schema.fields)],
+                      len(lidx))
+    return out
+
+
+def _find_col(cols: List[Series], name: str, schema: Schema) -> int:
+    for i, c in enumerate(cols):
+        if c.name == name:
+            return i
+    raise KeyError(name)
+
+
+def _take_optional(s: Series, idx: np.ndarray) -> Series:
+    """take() where idx == -1 produces null."""
+    if len(idx) and (idx < 0).any():
+        arr = pa.array(idx.astype(np.int64))
+        arr = pc.if_else(pa.array(idx >= 0), arr, pa.nulls(len(idx), pa.int64()))
+        taken = s.to_arrow().take(arr)
+        return Series.from_arrow(taken, s.name)
+    return s.take(idx)
+
+
+def cross_join(left: RecordBatch, right: RecordBatch, output_schema: Schema,
+               right_rename: dict) -> RecordBatch:
+    lidx, ridx = cross_join_indices(left.num_rows, right.num_rows)
+    cols = [s.take(lidx) for s in left.columns]
+    for s in right.columns:
+        cols.append(s.take(ridx).rename(right_rename.get(s.name, s.name)))
+    return RecordBatch(output_schema, cols, len(lidx))
+
+
+# ======================================================================================
+# Explode / unpivot / pivot
+# ======================================================================================
+
+
+def explode(batch: RecordBatch, to_explode: Sequence[Expression], output_schema: Schema) -> RecordBatch:
+    """Explode list columns; all exploded columns must agree on lengths per row.
+    Null/empty lists produce a single null row (reference explode semantics)."""
+    names = [e.name() for e in to_explode]
+    exploded_series = {e.name(): eval_expression(batch, e) for e in to_explode}
+
+    first = exploded_series[names[0]]
+    arr = first.to_arrow()
+    if not (first.dtype.is_list()):
+        raise ValueError(f"cannot explode non-list column {first.name} ({first.dtype})")
+
+    lengths = pc.list_value_length(arr)
+    lengths_np = np.asarray(lengths.fill_null(0).to_numpy(zero_copy_only=False), dtype=np.int64)
+    out_counts = np.maximum(lengths_np, 1)  # null/empty list -> one null row
+    parent = np.repeat(np.arange(batch.num_rows), out_counts)
+
+    cols: List[Series] = []
+    for f in output_schema.fields:
+        if f.name in exploded_series:
+            s = exploded_series[f.name]
+            a = s.to_arrow()
+            ln = np.asarray(pc.list_value_length(a).fill_null(0).to_numpy(zero_copy_only=False), dtype=np.int64)
+            if not np.array_equal(np.maximum(ln, 1), out_counts):
+                raise ValueError("exploded columns must have matching list lengths per row")
+            flat = pc.list_flatten(a)
+            # positions of flat values within output rows: rows with empty/null list hold a null
+            res_idx = np.cumsum(out_counts) - out_counts  # start of each row's output
+            flat_offsets = np.cumsum(ln) - ln
+            take_idx = np.full(int(out_counts.sum()), -1, np.int64)
+            pos_in_row = np.arange(int(out_counts.sum())) - np.repeat(res_idx, out_counts)
+            valid_out = pos_in_row < np.repeat(ln, out_counts)
+            take_idx[valid_out] = (np.repeat(flat_offsets, out_counts) + pos_in_row)[valid_out]
+            taken = Series.from_arrow(flat, f.name)
+            cols.append(_take_optional(taken, take_idx).rename(f.name))
+        else:
+            cols.append(batch.get_column(f.name).take(parent))
+    return RecordBatch(output_schema, [c.cast(f.dtype) if c.dtype != f.dtype else c
+                                       for c, f in zip(cols, output_schema.fields)], len(parent))
+
+
+def unpivot(batch: RecordBatch, ids: Sequence[Expression], values: Sequence[Expression],
+            variable_name: str, value_name: str, output_schema: Schema) -> RecordBatch:
+    n = batch.num_rows
+    k = len(values)
+    id_series = _eval_keys(batch, ids)
+    val_series = _eval_keys(batch, values)
+    vt = output_schema[value_name].dtype
+
+    idx = np.repeat(np.arange(n), k)  # row-major: row0 all vars, row1 ...
+    cols: List[Series] = [s.take(idx) for s in id_series]
+    var_col = Series.from_pylist([v.name for v in val_series] * n, variable_name, DataType.string()) \
+        if n else Series.empty(variable_name, DataType.string())
+    # interleave values: for each row, each value column in order
+    casted = [v.cast(vt) if v.dtype != vt else v for v in val_series]
+    if n:
+        arrays = [c.to_arrow() for c in casted]
+        combined = pa.concat_arrays([pa.concat_arrays([a.slice(i, 1) for a in arrays]) for i in range(n)]) \
+            if n * k <= 4096 else None
+        if combined is None:
+            # vectorized interleave via take on a concatenated array
+            cat = pa.concat_arrays(arrays)  # column-major: [c0 rows..., c1 rows...]
+            take_idx = (np.tile(np.arange(k) * n, n) + np.repeat(np.arange(n), k)).astype(np.int64)
+            combined = cat.take(pa.array(take_idx))
+        val_col = Series.from_arrow(combined, value_name)
+    else:
+        val_col = Series.empty(value_name, vt)
+    cols.append(var_col)
+    cols.append(val_col)
+    return RecordBatch(output_schema, [c.cast(f.dtype) if c.dtype != f.dtype else c
+                                       for c, f in zip(cols, output_schema.fields)], n * k)
+
+
+def pivot(batch: RecordBatch, groupby: Sequence[Expression], pivot_expr: Expression,
+          value_expr: Expression, agg_op: str, names: List[str], output_schema: Schema) -> RecordBatch:
+    # group by (groupby + pivot), aggregate value, then scatter into per-name columns
+    sub = grouped_agg(batch, list(groupby) + [pivot_expr], [AggExpr(agg_op, value_expr)])
+    gcols = [sub.columns[i] for i in range(len(groupby))]
+    pivot_col = sub.columns[len(groupby)]
+    value_col = sub.columns[len(groupby) + 1]
+
+    first_idx, group_ids, _ = make_groups(gcols) if sub.num_rows else (np.empty(0, np.int64),) * 3
+    num_out = len(first_idx)
+    out_cols: List[Series] = [c.take(first_idx) for c in gcols]
+
+    pv = [str(x) if x is not None else None for x in pivot_col.to_pylist()]
+    name_pos = {n: i for i, n in enumerate(names)}
+    for out_i, nm in enumerate(names):
+        take_idx = np.full(num_out, -1, np.int64)
+        for row in range(sub.num_rows):
+            if pv[row] == nm:
+                take_idx[group_ids[row]] = row
+        col = _take_optional(value_col, take_idx).rename(nm)
+        out_cols.append(col)
+    return RecordBatch(output_schema, [c.cast(f.dtype) if c.dtype != f.dtype else c
+                                       for c, f in zip(out_cols, output_schema.fields)], num_out)

@@ -1,0 +1,3 @@
+from .dataframe import DataFrame, GroupedDataFrame
+
+__all__ = ["DataFrame", "GroupedDataFrame"]

@@ -1,0 +1,392 @@
+"""DataFrame: the lazy user-facing API.
+
+Reference parity: daft/dataframe/dataframe.py:115 (~150 methods). Every method
+appends to a LogicalPlanBuilder; collect() optimizes, translates and executes,
+caching result partitions so downstream queries reuse them (reference's
+PartitionSetCache behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+from ..core.micropartition import MicroPartition
+from ..expressions import AggExpr, Expression, col, lit
+from ..plan.builder import ColumnInput, LogicalPlanBuilder, _to_expr, _to_exprs
+from ..schema import Schema
+
+
+class DataFrame:
+    def __init__(self, builder: LogicalPlanBuilder):
+        self._builder = builder
+        self._result: Optional[List[MicroPartition]] = None
+
+    # ---- metadata ----------------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._builder.schema()
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.column_names()
+
+    def __repr__(self) -> str:
+        if self._result is not None:
+            return self._preview_string()
+        return f"DataFrame(schema={self.schema}, not materialized)"
+
+    def explain(self, show_all: bool = False) -> str:
+        s = "== Unoptimized Logical Plan ==\n" + self._builder.plan.display()
+        if show_all:
+            opt = self._builder.optimize()
+            s += "\n\n== Optimized Logical Plan ==\n" + opt.plan.display()
+            from ..plan.physical import translate
+
+            s += "\n\n== Physical Plan ==\n" + translate(opt.plan).display()
+        return s
+
+    def _next(self, builder: LogicalPlanBuilder) -> "DataFrame":
+        return DataFrame(builder)
+
+    # ---- transforms --------------------------------------------------------------
+    def select(self, *columns: ColumnInput) -> "DataFrame":
+        return self._next(self._builder.select(_to_exprs(columns)))
+
+    def with_column(self, name: str, expr: ColumnInput) -> "DataFrame":
+        return self.with_columns({name: expr})
+
+    def with_columns(self, columns: Dict[str, ColumnInput]) -> "DataFrame":
+        exprs = [_to_expr(e).alias(n) for n, e in columns.items()]
+        return self._next(self._builder.with_columns(exprs))
+
+    def with_column_renamed(self, existing: str, new: str) -> "DataFrame":
+        return self._next(self._builder.rename({existing: new}))
+
+    def with_columns_renamed(self, mapping: Dict[str, str]) -> "DataFrame":
+        return self._next(self._builder.rename(mapping))
+
+    def exclude(self, *names: str) -> "DataFrame":
+        return self._next(self._builder.exclude(list(names)))
+
+    def where(self, predicate: ColumnInput) -> "DataFrame":
+        if isinstance(predicate, str):
+            from ..sql import sql_expr
+
+            predicate = sql_expr(predicate)
+        return self._next(self._builder.filter(_to_expr(predicate)))
+
+    filter = where
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._next(self._builder.limit(n))
+
+    def offset(self, n: int) -> "DataFrame":
+        return self._next(self._builder.offset(n))
+
+    def sample(self, fraction: float, with_replacement: bool = False,
+               seed: Optional[int] = None) -> "DataFrame":
+        return self._next(self._builder.sample(fraction, with_replacement, seed))
+
+    def explode(self, *columns: ColumnInput) -> "DataFrame":
+        return self._next(self._builder.explode(_to_exprs(columns)))
+
+    def unpivot(self, ids: Sequence[ColumnInput], values: Sequence[ColumnInput] = (),
+                variable_name: str = "variable", value_name: str = "value") -> "DataFrame":
+        ids_ex = _to_exprs(ids if isinstance(ids, (list, tuple)) else [ids])
+        if not values:
+            id_names = {e.name() for e in ids_ex}
+            values = [c for c in self.column_names if c not in id_names]
+        vals_ex = _to_exprs(values if isinstance(values, (list, tuple)) else [values])
+        return self._next(self._builder.unpivot(ids_ex, vals_ex, variable_name, value_name))
+
+    melt = unpivot
+
+    def distinct(self, *on: ColumnInput) -> "DataFrame":
+        return self._next(self._builder.distinct(_to_exprs(on) if on else None))
+
+    unique = distinct
+    drop_duplicates = distinct
+
+    def sort(self, by: Union[ColumnInput, List[ColumnInput]],
+             desc: Union[bool, List[bool]] = False,
+             nulls_first: Optional[Union[bool, List[bool]]] = None) -> "DataFrame":
+        by_list = by if isinstance(by, list) else [by]
+        return self._next(self._builder.sort(by_list, desc, nulls_first))
+
+    def _add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
+        return self._next(self._builder.add_monotonically_increasing_id(column_name))
+
+    def repartition(self, num: Optional[int], *partition_by: ColumnInput) -> "DataFrame":
+        if partition_by:
+            return self._next(self._builder.repartition(num, "hash", _to_exprs(partition_by)))
+        return self._next(self._builder.repartition(num, "random"))
+
+    def into_partitions(self, num: int) -> "DataFrame":
+        return self._next(self._builder.into_partitions(num))
+
+    def into_batches(self, batch_size: int) -> "DataFrame":
+        return self._next(self._builder.into_batches(batch_size))
+
+    # ---- joins -------------------------------------------------------------------
+    def join(self, other: "DataFrame",
+             on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
+             left_on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
+             right_on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
+             how: str = "inner", prefix: Optional[str] = None, suffix: Optional[str] = None,
+             strategy: Optional[str] = None) -> "DataFrame":
+        if on is not None:
+            left_on = right_on = on
+        if how == "cross":
+            return self._next(self._builder.cross_join(other._builder, prefix, suffix))
+        if left_on is None or right_on is None:
+            raise ValueError("join requires `on` or both `left_on` and `right_on`")
+        lo = left_on if isinstance(left_on, list) else [left_on]
+        ro = right_on if isinstance(right_on, list) else [right_on]
+        return self._next(self._builder.join(other._builder, lo, ro, how, prefix, suffix, strategy))
+
+    def concat(self, other: "DataFrame") -> "DataFrame":
+        return self._next(self._builder.concat(other._builder))
+
+    union_all = concat
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self.concat(other).distinct()
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        # semi join on all columns + distinct (reference: ops/intersect.rs semantics)
+        names = self.column_names
+        return self.join(other, left_on=[col(n) for n in names],
+                         right_on=[col(n) for n in names], how="semi").distinct()
+
+    def except_distinct(self, other: "DataFrame") -> "DataFrame":
+        names = self.column_names
+        return self.join(other, left_on=[col(n) for n in names],
+                         right_on=[col(n) for n in names], how="anti").distinct()
+
+    # ---- aggregation -------------------------------------------------------------
+    def groupby(self, *group_by: ColumnInput) -> "GroupedDataFrame":
+        return GroupedDataFrame(self, _to_exprs(group_by))
+
+    group_by = groupby
+
+    def agg(self, *aggs: Expression) -> "DataFrame":
+        return self._next(self._builder.aggregate(_flatten_aggs(aggs), []))
+
+    def sum(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).sum() for c in cols])
+
+    def mean(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).mean() for c in cols])
+
+    def min(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).min() for c in cols])
+
+    def max(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).max() for c in cols])
+
+    def count(self, *cols: ColumnInput) -> "DataFrame":
+        if not cols:
+            return self.agg(lit(1).count("all").alias("count"))
+        return self.agg(*[_to_expr(c).count() for c in cols])
+
+    def count_rows(self) -> int:
+        return self.count().to_pydict()["count"][0]
+
+    def __len__(self) -> int:
+        return self.count_rows()
+
+    def pivot(self, group_by: Union[ColumnInput, List[ColumnInput]], pivot_col: ColumnInput,
+              value_col: ColumnInput, agg_fn: str,
+              names: Optional[List[str]] = None) -> "DataFrame":
+        gb = group_by if isinstance(group_by, list) else [group_by]
+        if names is None:
+            pc_expr = _to_expr(pivot_col)
+            vals = (self.select(pc_expr).distinct().sort(pc_expr.name()).to_pydict())[pc_expr.name()]
+            names = [str(v) for v in vals if v is not None]
+        return self._next(self._builder.pivot(gb, pivot_col, value_col, agg_fn, names))
+
+    # ---- materialization ---------------------------------------------------------
+    def _materialize(self) -> List[MicroPartition]:
+        if self._result is None:
+            from ..runners import get_or_create_runner
+
+            self._result = get_or_create_runner().run(self._builder)
+        return self._result
+
+    def collect(self) -> "DataFrame":
+        parts = self._materialize()
+        # pin results into the plan so downstream ops read from memory
+        new = DataFrame(LogicalPlanBuilder.from_in_memory(self.schema, parts))
+        new._result = parts
+        return new
+
+    def iter_partitions(self) -> Iterator[MicroPartition]:
+        if self._result is not None:
+            yield from self._result
+            return
+        from ..runners import get_or_create_runner
+
+        yield from get_or_create_runner().run_iter(self._builder)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for part in self.iter_partitions():
+            for b in part.batches:
+                yield from b.to_pylist()
+
+    def __iter__(self):
+        return self.iter_rows()
+
+    def show(self, n: int = 8) -> None:
+        print(self.limit(n)._preview_string(n))
+
+    def _preview_string(self, n: int = 8) -> str:
+        parts = self.limit(n)._materialize()
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        return _format_table(mp, self.schema)
+
+    # ---- conversions -------------------------------------------------------------
+    def to_pydict(self) -> Dict[str, list]:
+        parts = self._materialize()
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        return mp.to_pydict()
+
+    def to_pylist(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def to_arrow(self):
+        parts = self._materialize()
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        return mp.to_arrow()
+
+    def to_arrow_iter(self):
+        for part in self.iter_partitions():
+            for b in part.batches:
+                yield from b.to_arrow().to_batches()
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def to_torch_map_dataset(self):
+        from .to_torch import DataFrameMapDataset
+
+        return DataFrameMapDataset(self)
+
+    def to_torch_iter_dataset(self):
+        from .to_torch import DataFrameIterDataset
+
+        return DataFrameIterDataset(self)
+
+    def to_jax(self, pad_to: Optional[int] = None) -> Dict[str, Any]:
+        """Materialize device-compatible columns as jax Arrays (host→HBM transfer)."""
+        parts = self._materialize()
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        batch = mp.concat_or_empty()
+        out = {}
+        for s in batch.columns:
+            if s.dtype.is_device_compatible():
+                out[s.name] = s.to_device(pad_to=pad_to)
+        return out
+
+    # ---- writes ------------------------------------------------------------------
+    def write_parquet(self, root_dir: str, compression: str = "snappy",
+                      partition_cols: Optional[List[ColumnInput]] = None,
+                      write_mode: str = "append") -> "DataFrame":
+        from ..io.writers import WriteInfo
+
+        info = WriteInfo("parquet", root_dir, {"compression": compression},
+                         _to_exprs(partition_cols) if partition_cols else None, write_mode)
+        return self._write(info)
+
+    def write_csv(self, root_dir: str, partition_cols: Optional[List[ColumnInput]] = None,
+                  write_mode: str = "append") -> "DataFrame":
+        from ..io.writers import WriteInfo
+
+        info = WriteInfo("csv", root_dir, {},
+                         _to_exprs(partition_cols) if partition_cols else None, write_mode)
+        return self._write(info)
+
+    def write_json(self, root_dir: str, write_mode: str = "append") -> "DataFrame":
+        from ..io.writers import WriteInfo
+
+        info = WriteInfo("json", root_dir, {}, None, write_mode)
+        return self._write(info)
+
+    def _write(self, info) -> "DataFrame":
+        return DataFrame(self._builder.write(info)).collect()
+
+    # ---- misc --------------------------------------------------------------------
+    def num_partitions(self) -> int:
+        if self._result is not None:
+            return len(self._result)
+        return 1
+
+
+class GroupedDataFrame:
+    def __init__(self, df: DataFrame, group_by: List[Expression]):
+        self._df = df
+        self._group_by = group_by
+
+    def agg(self, *aggs: Expression) -> DataFrame:
+        return self._df._next(self._df._builder.aggregate(_flatten_aggs(aggs), self._group_by))
+
+    def sum(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).sum() for c in cols])
+
+    def mean(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).mean() for c in cols])
+
+    def min(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).min() for c in cols])
+
+    def max(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).max() for c in cols])
+
+    def count(self, *cols: ColumnInput) -> DataFrame:
+        if not cols:
+            return self.agg(lit(1).count("all").alias("count"))
+        return self.agg(*[_to_expr(c).count() for c in cols])
+
+    def any_value(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).any_value() for c in cols])
+
+    def agg_list(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[AggExpr("list", _to_expr(c)) for c in cols])
+
+    def agg_concat(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[AggExpr("concat", _to_expr(c)) for c in cols])
+
+
+def _flatten_aggs(aggs) -> List[Expression]:
+    out: List[Expression] = []
+    for a in aggs:
+        if isinstance(a, (list, tuple)):
+            out.extend(_flatten_aggs(a))
+        else:
+            out.append(a)
+    return out
+
+
+def _format_table(mp: MicroPartition, schema: Schema, max_width: int = 30) -> str:
+    d = mp.to_pydict()
+    names = schema.column_names()
+    dtypes = [str(schema[n].dtype) for n in names]
+    rows = mp.num_rows
+
+    def fmt(v) -> str:
+        s = "None" if v is None else str(v)
+        return s if len(s) <= max_width else s[: max_width - 1] + "…"
+
+    cols = [[fmt(v) for v in d[n]] for n in names]
+    widths = [max(len(n), len(t), *(len(v) for v in c) if c else (0,)) for n, t, c in zip(names, dtypes, cols)]
+    sep = "╭" + "┬".join("─" * (w + 2) for w in widths) + "╮"
+    mid = "├" + "┼".join("─" * (w + 2) for w in widths) + "┤"
+    bot = "╰" + "┴".join("─" * (w + 2) for w in widths) + "╯"
+    lines = [sep]
+    lines.append("│" + "│".join(f" {n:<{w}} " for n, w in zip(names, widths)) + "│")
+    lines.append("│" + "│".join(f" {t:<{w}} " for t, w in zip(dtypes, widths)) + "│")
+    lines.append(mid)
+    for i in range(rows):
+        lines.append("│" + "│".join(f" {c[i]:<{w}} " for c, w in zip(cols, widths)) + "│")
+    lines.append(bot)
+    lines.append(f"(Showing {rows} rows)")
+    return "\n".join(lines)

@@ -1,0 +1,36 @@
+"""Torch dataset adapters (reference parity: daft/dataframe/to_torch.py)."""
+
+from __future__ import annotations
+
+
+class DataFrameMapDataset:
+    """torch.utils.data.Dataset view of a materialized DataFrame."""
+
+    def __init__(self, df):
+        import torch.utils.data  # noqa: F401  — fail early if torch missing
+
+        self._rows = df.to_pylist()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __getitem__(self, i: int) -> dict:
+        return self._rows[i]
+
+
+class DataFrameIterDataset:
+    """torch.utils.data.IterableDataset view streaming partitions."""
+
+    def __init__(self, df):
+        import torch.utils.data
+
+        self._df = df
+
+        class _Iter(torch.utils.data.IterableDataset):
+            def __iter__(_self):
+                return self._df.iter_rows()
+
+        self._inner = _Iter()
+
+    def __iter__(self):
+        return iter(self._inner)

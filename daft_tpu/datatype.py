@@ -307,6 +307,28 @@ class DataType:
             or self.is_temporal() or self.kind in ("embedding", "fixed_shape_tensor", "fixed_shape_image")
         )
 
+    @staticmethod
+    def common_supertype(a: "DataType", b: "DataType") -> "DataType":
+        """Smallest type both sides can be losslessly cast to (reference:
+        src/daft-schema supertype lattice). Falls back via Arrow promotion."""
+        if a == b:
+            return a
+        if a.is_null():
+            return b
+        if b.is_null():
+            return a
+        if a.is_numeric() and b.is_numeric():
+            import numpy as _np
+
+            return DataType.from_numpy(_np.result_type(a.to_numpy(), b.to_numpy()))
+        if a.is_string() or b.is_string():
+            return DataType.string()
+        raise ValueError(f"no common supertype for {a} and {b}")
+
+    @classmethod
+    def from_numpy(cls, np_dtype) -> "DataType":
+        return cls.from_arrow(pa.from_numpy_dtype(np.dtype(np_dtype)))
+
     # ---- accessors ----------------------------------------------------------------
     @property
     def inner(self) -> "DataType":

@@ -1,0 +1,3 @@
+from .executor import execute_plan
+
+__all__ = ["execute_plan"]

@@ -1,0 +1,313 @@
+"""Single-host streaming executor.
+
+Reference parity: src/daft-local-execution ("Swordfish", run.rs:397 + pipeline.rs:358).
+This is the pull-based core: each physical node is interpreted as a generator of
+MicroPartitions, so streaming ops (project/filter/limit) never materialize the
+whole input, while blocking ops (sort/agg/join build side) gather what they need.
+
+Morsel/thread parallelism and bounded-queue pipelining are layered on in
+pipeline.py (M5); device (TPU) stage fusion is selected in stage compilation
+(ops/device_eval.py) when a Project/Filter chain is device-evaluable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..core import relational as rel
+from ..core.micropartition import MicroPartition
+from ..core.recordbatch import RecordBatch
+from ..expressions import ColumnRef, Expression
+from ..expressions.eval import eval_expression, eval_projection
+from ..plan import physical as pp
+
+
+def execute_plan(plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+    """Stream result MicroPartitions for a physical plan."""
+    return _exec(plan)
+
+
+def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+    if isinstance(node, pp.InMemoryScan):
+        yield from node.partitions
+        return
+
+    if isinstance(node, pp.TaskScan):
+        remaining = node.post_limit
+        for task in node.tasks:
+            for part in task.read():
+                if node.post_filter is not None and not task.filters_applied:
+                    part = _filter_part(part, node.post_filter)
+                if remaining is not None:
+                    if remaining <= 0:
+                        return
+                    if part.num_rows > remaining:
+                        part = part.head(remaining)
+                    remaining -= part.num_rows
+                yield part
+        return
+
+    if isinstance(node, pp.Project):
+        for part in _exec(node.input):
+            batches = [eval_projection(b, node.projection) for b in part.batches]
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.UDFProject):
+        exprs = list(node.passthrough) + [node.udf_expr]
+        for part in _exec(node.input):
+            batches = [eval_projection(b, exprs) for b in part.batches]
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.PhysFilter):
+        for part in _exec(node.input):
+            yield _filter_part(part, node.predicate)
+        return
+
+    if isinstance(node, pp.PhysLimit):
+        to_skip = node.offset
+        remaining = node.limit if node.limit >= 0 else None
+        for part in _exec(node.input):
+            if to_skip > 0:
+                if part.num_rows <= to_skip:
+                    to_skip -= part.num_rows
+                    continue
+                part = part.slice(to_skip, part.num_rows)
+                to_skip = 0
+            if remaining is None:
+                yield part
+                continue
+            if remaining <= 0:
+                return
+            if part.num_rows > remaining:
+                part = part.head(remaining)
+            remaining -= part.num_rows
+            yield part
+            if remaining <= 0:
+                return
+        return
+
+    if isinstance(node, pp.PhysExplode):
+        for part in _exec(node.input):
+            batches = [rel.explode(b, node.to_explode, node.schema) for b in part.batches]
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.PhysUnpivot):
+        for part in _exec(node.input):
+            batches = [rel.unpivot(b, node.ids, node.values, node.variable_name,
+                                   node.value_name, node.schema) for b in part.batches]
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.PhysSample):
+        seed = node.seed
+        for i, part in enumerate(_exec(node.input)):
+            s = None if seed is None else seed + i
+            batches = [rel.sample(b, node.fraction, node.with_replacement, s) for b in part.batches]
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.PhysMonotonicId):
+        # 36-bit local row counter + 28-bit partition id, like the reference's scheme
+        from ..core.series import Series
+        from ..datatype import DataType
+
+        for part_id, part in enumerate(_exec(node.input)):
+            offset = 0
+            batches = []
+            for b in part.batches:
+                ids = (np.uint64(part_id) << np.uint64(36)) + np.arange(
+                    offset, offset + b.num_rows, dtype=np.uint64
+                )
+                offset += b.num_rows
+                id_col = Series.from_numpy(ids, node.column_name, DataType.uint64())
+                cols = [id_col] + list(b.columns)
+                batches.append(RecordBatch(node.schema, cols, b.num_rows))
+            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        return
+
+    if isinstance(node, pp.PhysSort):
+        batch = _gather(node.input, node.schema)
+        keys = [eval_expression(batch, e) for e in node.sort_by]
+        out = batch.sort(keys, node.descending, node.nulls_first)
+        yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.PhysTopN):
+        # streaming top-n: keep only best (limit+offset) rows seen so far
+        k = node.limit + node.offset
+        best: Optional[RecordBatch] = None
+        for part in _exec(node.input):
+            for b in part.batches:
+                cur = b if best is None else RecordBatch.concat([best, b])
+                keys = [eval_expression(cur, e) for e in node.sort_by]
+                srt = cur.sort(keys, node.descending, node.nulls_first)
+                best = srt.head(k)
+        out = best if best is not None else RecordBatch.empty(node.schema)
+        if node.offset:
+            out = out.slice(min(node.offset, out.num_rows), out.num_rows)
+        yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.UngroupedAggregate):
+        batch = _gather(node.input, node.input.schema)
+        out = rel.ungrouped_agg(batch, node.aggregations)
+        yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+        return
+
+    if isinstance(node, pp.HashAggregate):
+        batch = _gather(node.input, node.input.schema)
+        out = rel.grouped_agg(batch, node.groupby, node.aggregations)
+        yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+        return
+
+    if isinstance(node, pp.Dedup):
+        # streaming dedup: keep first occurrence across the stream
+        seen: Optional[RecordBatch] = None
+        for part in _exec(node.input):
+            for b in part.batches:
+                cur = b if seen is None else RecordBatch.concat([seen, b])
+                deduped = rel.distinct(cur, node.on)
+                new_rows = deduped.slice(0 if seen is None else seen.num_rows, deduped.num_rows)
+                # distinct() keeps first occurrences in row order, so prior rows stay a prefix
+                seen = deduped
+                if new_rows.num_rows:
+                    yield MicroPartition(node.schema, [new_rows])
+        if seen is None:
+            yield MicroPartition.empty(node.schema)
+        return
+
+    if isinstance(node, pp.PhysPivot):
+        batch = _gather(node.input, node.input.schema)
+        out = rel.pivot(batch, node.groupby, node.pivot_col, node.value_col,
+                        node.agg_op, node.names, node.schema)
+        yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.PhysWindow):
+        from .window import eval_window
+
+        batch = _gather(node.input, node.input.schema)
+        out = eval_window(batch, node.window_exprs, node.spec, node.schema)
+        yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.PhysConcat):
+        for child in node.inputs:
+            yield from _exec(child)
+        return
+
+    if isinstance(node, pp.HashJoin):
+        right = _gather(node.right, node.right.schema)  # build side
+        parts = list(_exec(node.left))
+        if node.how in ("right", "outer"):
+            # need full left side to find unmatched build rows exactly once
+            left = _concat_parts(parts, node.left.schema)
+            out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
+                                node.schema, node.merged_keys, node.right_rename)
+            yield MicroPartition(node.schema, [out])
+            return
+        for part in parts:
+            for b in part.batches:
+                out = rel.hash_join(b, right, node.left_on, node.right_on, node.how,
+                                    node.schema, node.merged_keys, node.right_rename)
+                yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.CrossJoin):
+        right = _gather(node.right, node.right.schema)
+        for part in _exec(node.left):
+            for b in part.batches:
+                out = rel.cross_join(b, right, node.schema, node.right_rename)
+                yield MicroPartition(node.schema, [out])
+        return
+
+    if isinstance(node, pp.PhysRepartition):
+        yield from _repartition(node)
+        return
+
+    if isinstance(node, pp.PhysIntoBatches):
+        buffer: List[RecordBatch] = []
+        buffered = 0
+        for part in _exec(node.input):
+            for b in part.batches:
+                buffer.append(b)
+                buffered += b.num_rows
+                while buffered >= node.batch_size:
+                    big = RecordBatch.concat(buffer)
+                    out = big.head(node.batch_size)
+                    rest = big.slice(node.batch_size, big.num_rows)
+                    yield MicroPartition(node.schema, [out])
+                    buffer = [rest] if rest.num_rows else []
+                    buffered = rest.num_rows
+        if buffered:
+            yield MicroPartition(node.schema, [RecordBatch.concat(buffer)])
+        return
+
+    if isinstance(node, pp.PhysWrite):
+        yield from node.info.execute_write(_exec(node.input), node.input.schema)
+        return
+
+    raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")
+
+
+def _filter_part(part: MicroPartition, predicate: Expression) -> MicroPartition:
+    batches = []
+    for b in part.batches:
+        mask = eval_expression(b, predicate)
+        if len(mask) == 1 and b.num_rows != 1:
+            val = mask.to_pylist()[0]
+            batches.append(b if val else b.head(0))
+        else:
+            batches.append(b.filter_by_mask(mask))
+    return MicroPartition(part.schema, batches or [RecordBatch.empty(part.schema)])
+
+
+def _gather(node: pp.PhysicalPlan, schema) -> RecordBatch:
+    parts = list(_exec(node))
+    return _concat_parts(parts, schema)
+
+
+def _concat_parts(parts: List[MicroPartition], schema) -> RecordBatch:
+    batches = [b for p in parts for b in p.batches if b.num_rows > 0]
+    if not batches:
+        return RecordBatch.empty(schema)
+    return RecordBatch.concat(batches)
+
+
+def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
+    from ..core.series import Series
+
+    n = node.num_partitions or 1
+    if node.scheme == "into":
+        batch = _gather(node.input, node.schema)
+        rows = batch.num_rows
+        sizes = [rows // n + (1 if i < rows % n else 0) for i in range(n)]
+        start = 0
+        for size in sizes:
+            yield MicroPartition(node.schema, [batch.slice(start, start + size)])
+            start += size
+        return
+
+    buckets: List[List[RecordBatch]] = [[] for _ in range(n)]
+    for i, part in enumerate(_exec(node.input)):
+        for b in part.batches:
+            if node.scheme == "hash":
+                keys = [eval_expression(b, e) for e in node.by]
+                pieces = b.partition_by_hash(keys, n)
+            elif node.scheme == "random":
+                pieces = b.partition_by_random(n, seed=i)
+            else:
+                raise NotImplementedError(f"repartition scheme {node.scheme}")
+            for j, piece in enumerate(pieces):
+                if piece.num_rows:
+                    buckets[j].append(piece)
+    for j in range(n):
+        if buckets[j]:
+            yield MicroPartition(node.schema, buckets[j])
+        else:
+            yield MicroPartition.empty(node.schema)

@@ -1,0 +1,7 @@
+"""Window function evaluation (placeholder until M3 window milestone)."""
+
+from __future__ import annotations
+
+
+def eval_window(batch, window_exprs, spec, schema):
+    raise NotImplementedError("window functions land in the window milestone (M3)")

@@ -106,6 +106,20 @@ def _np1(fn, out_np_dtype=None):
     return host
 
 
+def _np1_keep_dtype(fn):
+    """Like _np1 but casts the result back to the input dtype (floor/ceil on ints
+    must return ints so materialized data matches the planned schema)."""
+    base = _np1(fn)
+
+    def host(args: List[Series], kwargs) -> Series:
+        out = base(args, kwargs)
+        if out.dtype != args[0].dtype:
+            out = out.cast(args[0].dtype)
+        return out
+
+    return host
+
+
 def _binary_arrow(fn):
     """Lift a binary arrow kernel with length-1 broadcasting."""
 
@@ -145,8 +159,8 @@ def _log_host(args, kwargs):
 
 
 register("log", _rt_float, _log_host)
-register("floor", _rt_same, _np1(np.floor))
-register("ceil", _rt_same, _np1(np.ceil))
+register("floor", _rt_same, _np1_keep_dtype(np.floor))
+register("ceil", _rt_same, _np1_keep_dtype(np.ceil))
 register("sign", _rt_same, _pc1(pc.sign))
 
 
@@ -376,13 +390,19 @@ def _utf8_left(args, kwargs):
 
 def _utf8_right(args, kwargs):
     s, n = args[0], _scalar_arg(args[1])
-    lengths = pc.utf8_length(s.to_arrow())
+    if n <= 0:
+        arr = s.to_arrow()
+        out = pc.if_else(pc.is_valid(arr), pa.array([""] * len(arr), pa.large_string()),
+                         pa.nulls(len(arr), pa.large_string()))
+        return Series(s.name, DataType.string(), _combine(out))
+    arr = s.to_arrow()
+    lengths = pc.utf8_length(arr)
     starts = pc.max_element_wise(pc.subtract(lengths, n), 0)
+    # per-row start offsets: pyarrow has no vectorized per-row slice, so python loop
     out = pa.array([
-        None if v is None else v[-n:] if n > 0 else ""
-        for v in s.to_pylist()
+        None if v is None else v[st:]
+        for v, st in zip(arr.to_pylist(), starts.to_pylist())
     ], type=pa.large_string())
-    _ = starts
     return Series(s.name, DataType.string(), out)
 
 

@@ -1,0 +1,3 @@
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+__all__ = ["Pushdowns", "ScanOperator", "ScanTask"]

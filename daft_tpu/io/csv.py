@@ -1,0 +1,95 @@
+"""CSV scan operator (reference parity: src/daft-csv — streaming reader with schema
+inference, delimiter/header options; local-filesystem subset, pyarrow-backed)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Union
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from ..core.micropartition import MicroPartition
+from ..schema import Schema
+from .paths import expand_paths
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+
+class CsvScanOperator(ScanOperator):
+    def __init__(self, path: Union[str, List[str]], schema: Optional[Schema] = None,
+                 has_headers: bool = True, delimiter: str = ",", **_options):
+        self._paths = expand_paths(path, (".csv", ".tsv"))
+        if not self._paths:
+            raise FileNotFoundError(f"no csv files matched {path!r}")
+        self._schema = schema
+        self._has_headers = has_headers
+        self._delimiter = delimiter
+
+    def name(self) -> str:
+        return f"CsvScan({len(self._paths)} files)"
+
+    def _read_opts(self):
+        ropts = pacsv.ReadOptions(autogenerate_column_names=not self._has_headers)
+        popts = pacsv.ParseOptions(delimiter=self._delimiter)
+        return ropts, popts
+
+    def schema(self) -> Schema:
+        if self._schema is None:
+            ropts, popts = self._read_opts()
+            # infer from the first block of the first file
+            ropts_head = pacsv.ReadOptions(
+                autogenerate_column_names=not self._has_headers, block_size=1 << 20
+            )
+            with pacsv.open_csv(self._paths[0], read_options=ropts_head, parse_options=popts) as r:
+                batch = r.read_next_batch()
+            if not self._has_headers:
+                # rename f0.. to column_1.. (reference naming)
+                t = pa.Table.from_batches([batch])
+                t = t.rename_columns([f"column_{i+1}" for i in range(t.num_columns)])
+                batch = t.to_batches()[0] if t.num_rows else t.schema.empty_table().to_batches()
+                self._schema = Schema.from_arrow(t.schema)
+            else:
+                self._schema = Schema.from_arrow(batch.schema)
+        return self._schema
+
+    def can_absorb_select(self) -> bool:
+        return True
+
+    def can_absorb_limit(self) -> bool:
+        return True
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        schema = self.schema()
+        columns = pushdowns.columns
+        out_schema = Schema([schema[c] for c in columns]) if columns is not None else schema
+        tasks = []
+        for path in self._paths:
+            tasks.append(ScanTask(
+                read=self._make_reader(path, columns, pushdowns.limit, out_schema),
+                schema=out_schema,
+                size_bytes=os.path.getsize(path) if os.path.exists(path) else None,
+                source_label=path,
+            ))
+        return tasks
+
+    def _make_reader(self, path: str, columns, limit, out_schema: Schema):
+        ropts, popts = self._read_opts()
+
+        def read():
+            produced = 0
+            with pacsv.open_csv(path, read_options=ropts, parse_options=popts) as reader:
+                for batch in reader:
+                    t = pa.Table.from_batches([batch])
+                    if not self._has_headers:
+                        t = t.rename_columns([f"column_{i+1}" for i in range(t.num_columns)])
+                    if columns is not None:
+                        t = t.select(columns)
+                    if limit is not None:
+                        if produced >= limit:
+                            return
+                        if produced + t.num_rows > limit:
+                            t = t.slice(0, limit - produced)
+                    produced += t.num_rows
+                    yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
+
+        return read

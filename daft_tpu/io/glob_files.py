@@ -1,0 +1,42 @@
+"""from_glob_path: a DataFrame of file metadata (reference: daft.from_glob_path)."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..core.micropartition import MicroPartition
+from ..datatype import DataType, Field
+from ..schema import Schema
+from .paths import expand_paths
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+
+class GlobPathScanOperator(ScanOperator):
+    def __init__(self, pattern: str):
+        self._pattern = pattern
+        self._out_schema = Schema([
+            Field("path", DataType.string()),
+            Field("size", DataType.int64()),
+            Field("num_rows", DataType.int64()),
+        ])
+
+    def name(self) -> str:
+        return f"GlobPathScan({self._pattern})"
+
+    def schema(self) -> Schema:
+        return self._out_schema
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        schema = self.schema()
+
+        def read():
+            paths = expand_paths(self._pattern)
+            sizes = [os.path.getsize(p) if os.path.exists(p) else None for p in paths]
+            yield MicroPartition.from_pydict({
+                "path": paths,
+                "size": sizes,
+                "num_rows": [None] * len(paths),
+            }).cast_to_schema(schema)
+
+        return [ScanTask(read=read, schema=schema, source_label=self._pattern)]

@@ -1,0 +1,63 @@
+"""JSON-lines scan operator (reference parity: src/daft-json — line-split streaming
+reads with schema inference; local-filesystem subset, pyarrow-backed)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Union
+
+import pyarrow as pa
+import pyarrow.json as pajson
+
+from ..core.micropartition import MicroPartition
+from ..schema import Schema
+from .paths import expand_paths
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+
+class JsonScanOperator(ScanOperator):
+    def __init__(self, path: Union[str, List[str]], schema: Optional[Schema] = None, **_options):
+        self._paths = expand_paths(path, (".json", ".jsonl", ".ndjson"))
+        if not self._paths:
+            raise FileNotFoundError(f"no json files matched {path!r}")
+        self._schema = schema
+
+    def name(self) -> str:
+        return f"JsonScan({len(self._paths)} files)"
+
+    def schema(self) -> Schema:
+        if self._schema is None:
+            t = pajson.read_json(self._paths[0])
+            self._schema = Schema.from_arrow(t.schema)
+        return self._schema
+
+    def can_absorb_select(self) -> bool:
+        return True
+
+    def can_absorb_limit(self) -> bool:
+        return True
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        schema = self.schema()
+        columns = pushdowns.columns
+        out_schema = Schema([schema[c] for c in columns]) if columns is not None else schema
+        tasks = []
+        for path in self._paths:
+            def make(path=path):
+                def read():
+                    t = pajson.read_json(path)
+                    if columns is not None:
+                        t = t.select(columns)
+                    if pushdowns.limit is not None:
+                        t = t.slice(0, pushdowns.limit)
+                    yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
+
+                return read
+
+            tasks.append(ScanTask(
+                read=make(),
+                schema=out_schema,
+                size_bytes=os.path.getsize(path) if os.path.exists(path) else None,
+                source_label=path,
+            ))
+        return tasks

@@ -1,0 +1,33 @@
+"""Path expansion: globs, directories, lists (reference: daft-io object_store_glob.rs,
+local-filesystem subset; object stores land with the native IO milestone)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import List, Sequence, Union
+
+
+def expand_paths(path: Union[str, List[str]], extensions: Sequence[str] = ()) -> List[str]:
+    paths = [path] if isinstance(path, str) else list(path)
+    out: List[str] = []
+    for p in paths:
+        if p.startswith("file://"):
+            p = p[len("file://"):]
+        if any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p, recursive=True)))
+        elif os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if not extensions or f.endswith(tuple(extensions)):
+                        out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    # de-dup, preserve order
+    seen = set()
+    uniq = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq

@@ -1,0 +1,82 @@
+"""Scan planning abstractions.
+
+Reference parity: src/daft-scan/src/scan_operator.rs:12 (ScanOperator trait),
+src/daft-scan/src/lib.rs:346 (ScanTask), src/daft-scan/src/pushdowns.rs (Pushdowns).
+
+A ScanOperator describes an external data source; the optimizer attaches Pushdowns
+(column pruning, predicate, limit) and physical translation materializes ScanTasks —
+each an independently-executable unit reading some files/byte-ranges and yielding
+MicroPartitions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, List, Optional
+
+from ..expressions import Expression
+from ..schema import Schema
+
+
+@dataclasses.dataclass
+class Pushdowns:
+    """Pushed-down hints a scan may exploit (all optional; scans may ignore filters/
+    limits as long as they report whether they applied them exactly)."""
+
+    columns: Optional[List[str]] = None
+    filters: Optional[Expression] = None
+    limit: Optional[int] = None
+
+    def __repr__(self) -> str:
+        parts = []
+        if self.columns is not None:
+            parts.append(f"columns={self.columns}")
+        if self.filters is not None:
+            parts.append(f"filters={self.filters}")
+        if self.limit is not None:
+            parts.append(f"limit={self.limit}")
+        return "Pushdowns(" + ", ".join(parts) + ")"
+
+    def is_empty(self) -> bool:
+        return self.columns is None and self.filters is None and self.limit is None
+
+
+@dataclasses.dataclass
+class ScanTask:
+    """One unit of scan work: a closure producing MicroPartitions plus metadata for
+    scheduling/stats (reference ScanTask carries sources+pushdowns+size estimates)."""
+
+    read: Callable[[], Iterator[Any]]  # yields MicroPartition
+    schema: Schema
+    size_bytes: Optional[int] = None
+    num_rows: Optional[int] = None
+    # True when the reader already applied the pushdown exactly (so the executor can
+    # skip re-filtering / re-limiting).
+    filters_applied: bool = False
+    limit_applied: bool = False
+    source_label: str = ""
+
+
+class ScanOperator:
+    """Base class for external sources (parquet/csv/json readers, Python DataSources)."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def can_absorb_select(self) -> bool:
+        return False
+
+    def can_absorb_filter(self) -> bool:
+        return False
+
+    def can_absorb_limit(self) -> bool:
+        return False
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        raise NotImplementedError
+
+    def approx_num_rows(self, pushdowns: Pushdowns) -> Optional[float]:
+        return None

@@ -1,0 +1,177 @@
+"""Device stage compiler: fuse Filter→Project→Aggregate chains into ONE jit program.
+
+This is the TPU replacement for the reference's per-operator pipeline
+(src/daft-local-execution intermediate ops): instead of running project/filter/agg
+as separate vectorized kernels over morsels, the whole chain is traced into a
+single XLA computation per stage, so elementwise work fuses into one HBM pass and
+reductions stay on-chip (SURVEY.md §7 "Swordfish morsel pipeline" mapping).
+
+Dynamic shapes: XLA requires static shapes, so batches are padded to power-of-two
+length buckets (padding rows ride along with validity=False) — SURVEY.md §7's
+"quantized batching" answer to data-dependent row counts. The jit cache is then
+bounded by O(log max_rows) compilations per stage structure.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from ..datatype import DataType
+from ..expressions.expressions import AggExpr, Alias, Expression
+from ..schema import Schema
+from . import device_eval as dev
+
+_MIN_BUCKET = 512
+
+
+def pad_bucket(n: int) -> int:
+    """Smallest power-of-two >= n (>= _MIN_BUCKET) — quantized padding length."""
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _decompose_agg(op: str) -> List[str]:
+    """Partial aggregations needed to compute `op` across batches/shards."""
+    if op == "mean":
+        return ["sum", "count"]
+    if op in ("sum", "count", "min", "max"):
+        return [op]
+    raise ValueError(f"agg {op!r} has no device decomposition")
+
+
+def _combine_partials(op: str, parts: List[Dict[str, Tuple[float, bool]]], name: str):
+    """Combine per-batch partials on host into the final scalar (None if no valid rows)."""
+    if op == "count":
+        return int(sum(p[(name, "count")][0] for p in parts))
+    vals = [p[(name, op if op != "mean" else "sum")] for p in parts]
+    if op == "mean":
+        total = sum(v for v, ok in vals if ok)
+        cnt = sum(p[(name, "count")][0] for p in parts)
+        return (total / cnt) if cnt else None
+    good = [v for v, ok in vals if ok]
+    if not good:
+        return None
+    if op == "sum":
+        return sum(good)
+    return min(good) if op == "min" else max(good)
+
+
+class FilterAggStage:
+    """Compiled scan→filter→ungrouped-agg stage (the TPC-H Q6 shape).
+
+    aggs: list of (output_name, AggExpr). Call feed(columns, n) per batch
+    (columns: name → (np values, np validity)); finalize() returns final scalars.
+    """
+
+    def __init__(self, schema: Schema, predicate: Optional[Expression],
+                 aggs: Sequence[Tuple[str, AggExpr]]):
+        self.schema = schema
+        self.predicate = predicate
+        self.aggs = list(aggs)
+        self._jitted: Dict[int, Callable] = {}
+        self._partials: List[Dict] = []
+        self._input_cols = self._referenced_columns()
+
+    def _referenced_columns(self) -> List[str]:
+        cols: List[str] = []
+        exprs: List[Expression] = [a.child for _, a in self.aggs]
+        if self.predicate is not None:
+            exprs.append(self.predicate)
+        for e in exprs:
+            for c in e.referenced_columns():
+                if c not in cols:
+                    cols.append(c)
+        return cols
+
+    def _build(self, bucket: int) -> Callable:
+        schema = self.schema
+        pred_fn = dev.build_device_expr(self.predicate, schema) if self.predicate is not None else None
+        agg_specs = []
+        for name, agg in self.aggs:
+            child_fn = dev.build_device_expr(agg.child, schema)
+            count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
+            agg_specs.append((name, agg.op, count_all, child_fn))
+
+        def stage(cols: Dict[str, dev.DCol]):
+            if pred_fn is not None:
+                pv, pm = pred_fn(cols)
+                keep = pv.astype(bool) & pm
+            else:
+                any_col = next(iter(cols.values()))
+                keep = jnp.ones(jnp.shape(any_col[0]), dtype=bool)
+            out = {}
+            for name, op, count_all, child_fn in agg_specs:
+                v, m = child_fn(cols)
+                m = dev._broadcast_valid(v, m) & keep
+                if count_all:
+                    m = dev._broadcast_valid(v, keep)
+                for partial_op in _decompose_agg(op):
+                    val, ok = dev.device_agg(partial_op, v, m)
+                    out[(name, partial_op)] = (val, ok)
+            return out
+
+        return jax.jit(stage)
+
+    def feed(self, columns: Dict[str, Tuple[np.ndarray, np.ndarray]], n: int) -> None:
+        bucket = pad_bucket(n)
+        if bucket not in self._jitted:
+            self._jitted[bucket] = self._build(bucket)
+        dcols = {}
+        for name in self._input_cols:
+            vals, valid = columns[name]
+            if len(vals) < bucket:
+                pad = bucket - len(vals)
+                vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
+                valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
+            dcols[name] = (jnp.asarray(vals), jnp.asarray(valid))
+        res = self._jitted[bucket](dcols)
+        self._partials.append({k: (np.asarray(v[0]).item(), bool(np.asarray(v[1]))) for k, v in res.items()})
+
+    def feed_batch(self, batch) -> None:
+        """Feed a host RecordBatch (converts referenced columns to device arrays)."""
+        cols = {}
+        for name in self._input_cols:
+            s = batch.get_column(name)
+            cols[name] = (s.to_numpy(), s.validity_numpy())
+        self.feed(cols, batch.num_rows)
+
+    def finalize(self) -> Dict[str, Optional[float]]:
+        out = {}
+        for name, agg in self.aggs:
+            if not self._partials:
+                out[name] = 0 if agg.op == "count" else None
+            else:
+                out[name] = _combine_partials(agg.op, self._partials, name)
+        self._partials = []
+        return out
+
+
+def try_build_filter_agg_stage(schema: Schema, predicate: Optional[Expression],
+                               agg_exprs: Sequence[Expression]) -> Optional[FilterAggStage]:
+    """Build a device stage for filter+ungrouped-agg if every expression qualifies."""
+    if predicate is not None and not dev.is_device_evaluable(predicate, schema):
+        return None
+    aggs: List[Tuple[str, AggExpr]] = []
+    for e in agg_exprs:
+        name = e.name()
+        inner = e
+        while isinstance(inner, Alias):
+            inner = inner.child
+        if not isinstance(inner, AggExpr):
+            return None
+        if inner.op not in ("sum", "mean", "min", "max", "count"):
+            return None
+        if inner.op == "count" and inner.params.get("mode", "valid") == "null":
+            return None
+        if not dev.is_device_evaluable(inner.child, schema):
+            return None
+        aggs.append((name, inner))
+    return FilterAggStage(schema, predicate, aggs)

@@ -1,0 +1,13 @@
+from .distributed import (
+    default_mesh,
+    sharded_filter_agg_step,
+    sharded_grouped_agg_step,
+    shard_columns,
+)
+
+__all__ = [
+    "default_mesh",
+    "sharded_filter_agg_step",
+    "sharded_grouped_agg_step",
+    "shard_columns",
+]

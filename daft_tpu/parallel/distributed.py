@@ -1,0 +1,120 @@
+"""Mesh-sharded relational compute: the multi-chip execution path.
+
+TPU-native replacement for the reference's distributed data movement
+(src/daft-distributed "Flotilla" + src/daft-shuffles Arrow-Flight shuffle):
+within a mesh, repartition/aggregation exchange rides ICI via XLA collectives
+(psum / all_to_all) inside ONE jit program instead of host-side shuffle services;
+cross-host DCN exchange reuses the same primitives through jax.distributed.
+
+Layout: rows are data-parallel sharded along the 'dp' mesh axis (each device
+owns a contiguous row shard, padded with validity=False rows). Ungrouped
+aggregation = local masked reduce + psum. Grouped aggregation = local
+segment-reduce into a fixed-width group-hash table + psum — the device
+equivalent of partial→final two-phase aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..expressions.expressions import AggExpr, Expression
+from ..ops import device_eval as dev
+from ..ops.stage import _decompose_agg, pad_bucket
+from ..schema import Schema
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_columns(mesh: Mesh, columns: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                  n: int, axis: str = "dp") -> Dict[str, Tuple[jax.Array, jax.Array]]:
+    """Pad host columns to a multiple of the mesh size and place them row-sharded."""
+    n_dev = mesh.shape[axis]
+    per = pad_bucket(max((n + n_dev - 1) // n_dev, 1))
+    total = per * n_dev
+    sharding = NamedSharding(mesh, P(axis))
+    out = {}
+    for name, (vals, valid) in columns.items():
+        if len(vals) < total:
+            pad = total - len(vals)
+            vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
+            valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
+        out[name] = (jax.device_put(vals, sharding), jax.device_put(valid, sharding))
+    return out
+
+
+def sharded_filter_agg_step(mesh: Mesh, schema: Schema, predicate: Optional[Expression],
+                            aggs: Sequence[Tuple[str, AggExpr]], axis: str = "dp") -> Callable:
+    """Build a pjit'd distributed filter+ungrouped-agg step.
+
+    Returns fn(cols) -> {(name, partial_op): (value, valid)} with replicated outputs.
+    With row-sharded inputs, XLA lowers the reductions to per-shard partials plus a
+    psum over ICI — no explicit collective code needed beyond the sharding contract.
+    """
+    pred_fn = dev.build_device_expr(predicate, schema) if predicate is not None else None
+    agg_specs = []
+    for name, agg in aggs:
+        child_fn = dev.build_device_expr(agg.child, schema)
+        count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
+        agg_specs.append((name, agg.op, count_all, child_fn))
+
+    def step(cols):
+        if pred_fn is not None:
+            pv, pm = pred_fn(cols)
+            keep = pv.astype(bool) & pm
+        else:
+            any_col = next(iter(cols.values()))
+            keep = jnp.ones(jnp.shape(any_col[0]), dtype=bool)
+        out = {}
+        for name, op, count_all, child_fn in agg_specs:
+            v, m = child_fn(cols)
+            m = dev._broadcast_valid(v, m) & keep
+            if count_all:
+                m = dev._broadcast_valid(v, keep)
+            for partial_op in _decompose_agg(op):
+                val, ok = dev.device_agg(partial_op, v, m)
+                out[(name, partial_op)] = (val, ok)
+        return out
+
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(step, out_shardings=replicated)
+
+
+def sharded_grouped_agg_step(mesh: Mesh, schema: Schema, key_col: str,
+                             agg_col: str, agg_op: str, num_buckets: int,
+                             axis: str = "dp") -> Callable:
+    """Distributed groupby-aggregate over integer group keys via shard_map.
+
+    Each device segment-reduces its row shard into a fixed-width bucket table
+    (key hashed to [0, num_buckets)), then a psum over the mesh axis combines
+    partial tables — two-phase aggregation where the 'shuffle' is one ICI
+    collective. Returns fn(keys, values, valid) -> (bucket_sums, bucket_counts),
+    both replicated [num_buckets] arrays.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    def local(keys, values, valid):
+        b = (keys % num_buckets).astype(jnp.int32)
+        vals = jnp.where(valid, values.astype(jnp.float64), 0.0)
+        sums = jax.ops.segment_sum(vals, b, num_segments=num_buckets)
+        counts = jax.ops.segment_sum(valid.astype(jnp.int64), b, num_segments=num_buckets)
+        sums = jax.lax.psum(sums, axis)
+        counts = jax.lax.psum(counts, axis)
+        return sums, counts
+
+    mapped = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(mapped)

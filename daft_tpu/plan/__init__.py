@@ -1,0 +1,34 @@
+from .logical import (
+    Aggregate,
+    Concat,
+    Distinct,
+    Explode,
+    Filter,
+    InMemorySource,
+    IntoBatches,
+    IntoPartitions,
+    Join,
+    Limit,
+    LogicalPlan,
+    MonotonicallyIncreasingId,
+    Offset,
+    Pivot,
+    Project,
+    Repartition,
+    Sample,
+    ScanSource,
+    Sink,
+    Sort,
+    TopN,
+    UDFProject,
+    Unpivot,
+    Window,
+)
+from .builder import LogicalPlanBuilder
+
+__all__ = [
+    "LogicalPlan", "InMemorySource", "ScanSource", "Project", "UDFProject", "Filter",
+    "Limit", "Offset", "Explode", "Unpivot", "Sort", "Repartition", "IntoPartitions",
+    "Distinct", "Aggregate", "Pivot", "Concat", "Join", "Sink", "Sample",
+    "MonotonicallyIncreasingId", "Window", "TopN", "IntoBatches", "LogicalPlanBuilder",
+]

@@ -1,0 +1,177 @@
+"""LogicalPlanBuilder: the fluent façade every DataFrame method appends through.
+
+Reference parity: daft/logical/builder.py:54 + src/daft-logical-plan/src/builder/mod.rs:61.
+Expression normalization (strings → col(), literals → lit()) happens here so the
+plan IR only ever holds Expression nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+from ..expressions import AggExpr, Alias, ColumnRef, Expression, col, lit
+from ..schema import Schema
+from . import logical as lp
+
+ColumnInput = Union[str, Expression]
+
+
+def _to_expr(c: ColumnInput) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return col(c)
+    return lit(c)
+
+
+def _to_exprs(cols: Sequence[ColumnInput]) -> List[Expression]:
+    out: List[Expression] = []
+    for c in cols:
+        if isinstance(c, (list, tuple)):
+            out.extend(_to_exprs(c))
+        else:
+            out.append(_to_expr(c))
+    return out
+
+
+class LogicalPlanBuilder:
+    def __init__(self, plan: lp.LogicalPlan):
+        self._plan = plan
+
+    # ---- constructors ------------------------------------------------------------
+    @classmethod
+    def from_in_memory(cls, schema: Schema, partitions: List[Any]) -> "LogicalPlanBuilder":
+        return cls(lp.InMemorySource(schema, partitions))
+
+    @classmethod
+    def from_scan(cls, scan_op: Any) -> "LogicalPlanBuilder":
+        return cls(lp.ScanSource(scan_op))
+
+    # ---- accessors ---------------------------------------------------------------
+    @property
+    def plan(self) -> lp.LogicalPlan:
+        return self._plan
+
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    def _next(self, plan: lp.LogicalPlan) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(plan)
+
+    # ---- row ops -----------------------------------------------------------------
+    def select(self, to_select: Sequence[ColumnInput]) -> "LogicalPlanBuilder":
+        return self._next(lp.Project(self._plan, _to_exprs(to_select)))
+
+    def with_columns(self, new_columns: Sequence[Expression]) -> "LogicalPlanBuilder":
+        existing = self._plan.schema.column_names()
+        new_names = {e.name() for e in new_columns}
+        projection: List[Expression] = [col(n) for n in existing if n not in new_names]
+        projection.extend(new_columns)
+        return self.select(projection)
+
+    def exclude(self, names: Sequence[str]) -> "LogicalPlanBuilder":
+        keep = [c for c in self._plan.schema.column_names() if c not in set(names)]
+        return self.select([col(n) for n in keep])
+
+    def rename(self, mapping: dict) -> "LogicalPlanBuilder":
+        projection = []
+        for n in self._plan.schema.column_names():
+            projection.append(Alias(col(n), mapping[n]) if n in mapping else col(n))
+        return self.select(projection)
+
+    def filter(self, predicate: Expression) -> "LogicalPlanBuilder":
+        return self._next(lp.Filter(self._plan, _to_expr(predicate)))
+
+    def explode(self, to_explode: Sequence[ColumnInput]) -> "LogicalPlanBuilder":
+        return self._next(lp.Explode(self._plan, _to_exprs(to_explode)))
+
+    def unpivot(self, ids: Sequence[ColumnInput], values: Sequence[ColumnInput],
+                variable_name: str, value_name: str) -> "LogicalPlanBuilder":
+        return self._next(
+            lp.Unpivot(self._plan, _to_exprs(ids), _to_exprs(values), variable_name, value_name)
+        )
+
+    def sample(self, fraction: float, with_replacement: bool = False,
+               seed: Optional[int] = None) -> "LogicalPlanBuilder":
+        return self._next(lp.Sample(self._plan, fraction, with_replacement, seed))
+
+    def add_monotonically_increasing_id(self, column_name: str = "id") -> "LogicalPlanBuilder":
+        return self._next(lp.MonotonicallyIncreasingId(self._plan, column_name))
+
+    # ---- cardinality -------------------------------------------------------------
+    def limit(self, n: int) -> "LogicalPlanBuilder":
+        return self._next(lp.Limit(self._plan, n))
+
+    def offset(self, n: int) -> "LogicalPlanBuilder":
+        return self._next(lp.Offset(self._plan, n))
+
+    def distinct(self, on: Optional[Sequence[ColumnInput]] = None) -> "LogicalPlanBuilder":
+        return self._next(lp.Distinct(self._plan, _to_exprs(on) if on else None))
+
+    # ---- ordering ----------------------------------------------------------------
+    def sort(self, sort_by: Sequence[ColumnInput], descending: Union[bool, List[bool]] = False,
+             nulls_first: Optional[Union[bool, List[bool]]] = None) -> "LogicalPlanBuilder":
+        exprs = _to_exprs(sort_by)
+        desc = [descending] * len(exprs) if isinstance(descending, bool) else list(descending)
+        nf: Optional[List[bool]]
+        if nulls_first is None:
+            nf = None
+        elif isinstance(nulls_first, bool):
+            nf = [nulls_first] * len(exprs)
+        else:
+            nf = list(nulls_first)
+        return self._next(lp.Sort(self._plan, exprs, desc, nf))
+
+    # ---- aggregation -------------------------------------------------------------
+    def aggregate(self, aggs: Sequence[Expression], groupby: Sequence[ColumnInput]) -> "LogicalPlanBuilder":
+        return self._next(lp.Aggregate(self._plan, _to_exprs(groupby), list(aggs)))
+
+    def pivot(self, groupby: Sequence[ColumnInput], pivot_col: ColumnInput, value_col: ColumnInput,
+              agg_op: str, names: List[str]) -> "LogicalPlanBuilder":
+        return self._next(
+            lp.Pivot(self._plan, _to_exprs(groupby), _to_expr(pivot_col), _to_expr(value_col),
+                     agg_op, names)
+        )
+
+    def window(self, window_exprs: Sequence[Expression], spec: Any) -> "LogicalPlanBuilder":
+        return self._next(lp.Window(self._plan, list(window_exprs), spec))
+
+    # ---- multi-input -------------------------------------------------------------
+    def concat(self, other: "LogicalPlanBuilder") -> "LogicalPlanBuilder":
+        return self._next(lp.Concat([self._plan, other._plan]))
+
+    def join(self, right: "LogicalPlanBuilder", left_on: Sequence[ColumnInput],
+             right_on: Sequence[ColumnInput], how: str = "inner",
+             prefix: Optional[str] = None, suffix: Optional[str] = None,
+             strategy: Optional[str] = None) -> "LogicalPlanBuilder":
+        return self._next(
+            lp.Join(self._plan, right._plan, _to_exprs(left_on), _to_exprs(right_on),
+                    how, prefix, suffix, strategy)
+        )
+
+    def cross_join(self, right: "LogicalPlanBuilder", prefix: Optional[str] = None,
+                   suffix: Optional[str] = None) -> "LogicalPlanBuilder":
+        return self._next(lp.Join(self._plan, right._plan, [], [], "cross", prefix, suffix))
+
+    # ---- partitioning ------------------------------------------------------------
+    def repartition(self, num_partitions: Optional[int], scheme: str = "hash",
+                    by: Optional[Sequence[ColumnInput]] = None) -> "LogicalPlanBuilder":
+        return self._next(
+            lp.Repartition(self._plan, num_partitions, scheme, _to_exprs(by) if by else None)
+        )
+
+    def into_partitions(self, num_partitions: int) -> "LogicalPlanBuilder":
+        return self._next(lp.IntoPartitions(self._plan, num_partitions))
+
+    def into_batches(self, batch_size: int) -> "LogicalPlanBuilder":
+        return self._next(lp.IntoBatches(self._plan, batch_size))
+
+    # ---- sinks -------------------------------------------------------------------
+    def write(self, info: Any) -> "LogicalPlanBuilder":
+        return self._next(lp.Sink(self._plan, info))
+
+    # ---- optimize ----------------------------------------------------------------
+    def optimize(self, config: Any = None) -> "LogicalPlanBuilder":
+        from .optimizer import Optimizer
+
+        return self._next(Optimizer(config).optimize(self._plan))

@@ -1,0 +1,690 @@
+"""Logical plan IR.
+
+Reference parity: src/daft-logical-plan/src/logical_plan.rs:34-63 (27-op LogicalPlan
+enum, one file per op under ops/) and src/daft-logical-plan/src/builder/mod.rs:61.
+
+Design: immutable tree of nodes; each node derives its output Schema from its
+children (the reference resolves/binds expressions at build time — we do the same
+via Expression.to_field against the child schema). Optimizer rules rewrite the
+tree bottom-up/top-down via transform hooks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..datatype import DataType, Field
+from ..expressions import AggExpr, Alias, ColumnRef, Expression
+from ..schema import Schema
+
+_plan_ids = itertools.count()
+
+
+class LogicalPlan:
+    """Base logical plan node. Subclasses set _schema lazily via _compute_schema."""
+
+    def __init__(self) -> None:
+        self._id = next(_plan_ids)
+        self._schema_cache: Optional[Schema] = None
+
+    # ---- structure ---------------------------------------------------------------
+    def children(self) -> List["LogicalPlan"]:
+        return []
+
+    def with_children(self, children: List["LogicalPlan"]) -> "LogicalPlan":
+        if children:
+            raise ValueError(f"{type(self).__name__} takes no children")
+        return self
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema_cache is None:
+            self._schema_cache = self._compute_schema()
+        return self._schema_cache
+
+    def _compute_schema(self) -> Schema:
+        raise NotImplementedError(type(self).__name__)
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    # ---- traversal ---------------------------------------------------------------
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+    def transform_up(self, fn) -> "LogicalPlan":
+        """Bottom-up rewrite; fn(node) returns replacement or None to keep."""
+        old = self.children()
+        new = [c.transform_up(fn) for c in old]
+        node = self.with_children(new) if any(a is not b for a, b in zip(new, old)) else self
+        out = fn(node)
+        return out if out is not None else node
+
+    def transform_down(self, fn) -> "LogicalPlan":
+        out = fn(self)
+        node = out if out is not None else self
+        old = node.children()
+        new = [c.transform_down(fn) for c in old]
+        if any(a is not b for a, b in zip(new, old)):
+            node = node.with_children(new)
+        return node
+
+    # ---- display -----------------------------------------------------------------
+    def display(self) -> str:
+        lines: List[str] = []
+
+        def rec(node: "LogicalPlan", depth: int) -> None:
+            lines.append("  " * depth + "* " + node.describe())
+            for c in node.children():
+                rec(c, depth + 1)
+
+        rec(self, 0)
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name()
+
+    def __repr__(self) -> str:
+        return self.display()
+
+    # ---- stats (filled by optimizer enrich pass; see stats.py) ---------------------
+    @property
+    def approx_num_rows(self) -> Optional[float]:
+        return getattr(self, "_approx_num_rows", None)
+
+
+# ======================================================================================
+# Sources
+# ======================================================================================
+
+
+class InMemorySource(LogicalPlan):
+    """Scan over already-materialized MicroPartitions (reference: ops/source.rs InMemory).
+
+    `partitions` is a PartitionSet-like list of MicroPartition.
+    """
+
+    def __init__(self, schema: Schema, partitions: List[Any]):
+        super().__init__()
+        self._schema = schema
+        self.partitions = partitions
+
+    def _compute_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"InMemorySource[{len(self.partitions)} partitions, {self._schema.short_repr()}]"
+
+
+class ScanSource(LogicalPlan):
+    """Scan over external storage via a ScanOperator (reference: SourceInfo::Physical).
+
+    Pushdowns (columns/filters/limit) are attached by optimizer rules; the scan
+    operator is asked for ScanTasks at physical-translate time (MaterializeScans).
+    """
+
+    def __init__(self, scan_op: Any, pushdowns: Optional[Any] = None):
+        super().__init__()
+        from ..io.scan import Pushdowns  # local import to avoid cycle
+
+        self.scan_op = scan_op
+        self.pushdowns = pushdowns if pushdowns is not None else Pushdowns()
+
+    def _compute_schema(self) -> Schema:
+        base = self.scan_op.schema()
+        if self.pushdowns.columns is not None:
+            return Schema([base[c] for c in self.pushdowns.columns])
+        return base
+
+    def describe(self) -> str:
+        return f"ScanSource[{self.scan_op.name()}, pushdowns={self.pushdowns}]"
+
+
+# ======================================================================================
+# Row-wise ops
+# ======================================================================================
+
+
+class Project(LogicalPlan):
+    def __init__(self, input: LogicalPlan, projection: List[Expression]):
+        super().__init__()
+        self.input = input
+        self.projection = list(projection)
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Project(children[0], self.projection)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        return Schema([e.to_field(in_schema) for e in self.projection])
+
+    def describe(self) -> str:
+        return f"Project[{', '.join(e.name() for e in self.projection)}]"
+
+
+class UDFProject(LogicalPlan):
+    """A project isolated because it contains an expensive Python UDF
+    (reference: ops/udf_project.rs, created by the SplitUDFs optimizer rule).
+
+    Holds exactly one UDF expression plus passthrough columns.
+    """
+
+    def __init__(self, input: LogicalPlan, udf_expr: Expression, passthrough: List[Expression]):
+        super().__init__()
+        self.input = input
+        self.udf_expr = udf_expr
+        self.passthrough = list(passthrough)
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return UDFProject(children[0], self.udf_expr, self.passthrough)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = [e.to_field(in_schema) for e in self.passthrough]
+        fields.append(self.udf_expr.to_field(in_schema))
+        return Schema(fields)
+
+    def describe(self) -> str:
+        return f"UDFProject[{self.udf_expr.name()}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, input: LogicalPlan, predicate: Expression):
+        super().__init__()
+        self.input = input
+        self.predicate = predicate
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Filter(children[0], self.predicate)
+
+    def _compute_schema(self) -> Schema:
+        dt = self.predicate.get_type(self.input.schema)
+        if not dt.is_boolean() and not dt.is_null():
+            raise ValueError(f"filter predicate must be boolean, got {dt}")
+        return self.input.schema
+
+    def describe(self) -> str:
+        return f"Filter[{self.predicate}]"
+
+
+class Explode(LogicalPlan):
+    def __init__(self, input: LogicalPlan, to_explode: List[Expression]):
+        super().__init__()
+        self.input = input
+        self.to_explode = list(to_explode)
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Explode(children[0], self.to_explode)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        exploded = {}
+        for e in self.to_explode:
+            f = e.to_field(in_schema)
+            inner = f.dtype.inner if f.dtype.is_list() else f.dtype
+            exploded[f.name] = Field(f.name, inner)
+        fields = [exploded.get(f.name, f) for f in in_schema.fields]
+        return Schema(fields)
+
+    def describe(self) -> str:
+        return f"Explode[{', '.join(e.name() for e in self.to_explode)}]"
+
+
+class Unpivot(LogicalPlan):
+    def __init__(self, input: LogicalPlan, ids: List[Expression], values: List[Expression],
+                 variable_name: str, value_name: str):
+        super().__init__()
+        self.input = input
+        self.ids = list(ids)
+        self.values = list(values)
+        self.variable_name = variable_name
+        self.value_name = value_name
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Unpivot(children[0], self.ids, self.values, self.variable_name, self.value_name)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = [e.to_field(in_schema) for e in self.ids]
+        value_fields = [e.to_field(in_schema) for e in self.values]
+        if not value_fields:
+            raise ValueError("unpivot requires at least one value column")
+        vt = value_fields[0].dtype
+        for f in value_fields[1:]:
+            if f.dtype != vt:
+                vt = DataType.common_supertype(vt, f.dtype)
+        fields.append(Field(self.variable_name, DataType.string()))
+        fields.append(Field(self.value_name, vt))
+        return Schema(fields)
+
+
+class Sample(LogicalPlan):
+    def __init__(self, input: LogicalPlan, fraction: float, with_replacement: bool, seed: Optional[int]):
+        super().__init__()
+        self.input = input
+        self.fraction = fraction
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Sample(children[0], self.fraction, self.with_replacement, self.seed)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+
+class MonotonicallyIncreasingId(LogicalPlan):
+    def __init__(self, input: LogicalPlan, column_name: str = "id"):
+        super().__init__()
+        self.input = input
+        self.column_name = column_name
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return MonotonicallyIncreasingId(children[0], self.column_name)
+
+    def _compute_schema(self) -> Schema:
+        return Schema([Field(self.column_name, DataType.uint64())] + list(self.input.schema.fields))
+
+
+# ======================================================================================
+# Cardinality ops
+# ======================================================================================
+
+
+class Limit(LogicalPlan):
+    def __init__(self, input: LogicalPlan, limit: int):
+        super().__init__()
+        self.input = input
+        self.limit = limit
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Limit(children[0], self.limit)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+    def describe(self) -> str:
+        return f"Limit[{self.limit}]"
+
+
+class Offset(LogicalPlan):
+    def __init__(self, input: LogicalPlan, offset: int):
+        super().__init__()
+        self.input = input
+        self.offset = offset
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Offset(children[0], self.offset)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, input: LogicalPlan, on: Optional[List[Expression]] = None):
+        super().__init__()
+        self.input = input
+        self.on = on  # None = all columns
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Distinct(children[0], self.on)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+
+# ======================================================================================
+# Ordering
+# ======================================================================================
+
+
+class Sort(LogicalPlan):
+    def __init__(self, input: LogicalPlan, sort_by: List[Expression], descending: List[bool],
+                 nulls_first: Optional[List[bool]] = None):
+        super().__init__()
+        self.input = input
+        self.sort_by = list(sort_by)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first) if nulls_first is not None else [d for d in self.descending]
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Sort(children[0], self.sort_by, self.descending, self.nulls_first)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+    def describe(self) -> str:
+        keys = ", ".join(
+            f"{e.name()} {'desc' if d else 'asc'}" for e, d in zip(self.sort_by, self.descending)
+        )
+        return f"Sort[{keys}]"
+
+
+class TopN(LogicalPlan):
+    """Sort + Limit(+Offset) fused (reference: ops/top_n.rs, detected by optimizer)."""
+
+    def __init__(self, input: LogicalPlan, sort_by: List[Expression], descending: List[bool],
+                 nulls_first: List[bool], limit: int, offset: int = 0):
+        super().__init__()
+        self.input = input
+        self.sort_by = list(sort_by)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first)
+        self.limit = limit
+        self.offset = offset
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return TopN(children[0], self.sort_by, self.descending, self.nulls_first, self.limit, self.offset)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+    def describe(self) -> str:
+        return f"TopN[{self.limit}]"
+
+
+# ======================================================================================
+# Aggregation
+# ======================================================================================
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, input: LogicalPlan, groupby: List[Expression], aggregations: List[Expression]):
+        super().__init__()
+        self.input = input
+        self.groupby = list(groupby)
+        self.aggregations = list(aggregations)
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Aggregate(children[0], self.groupby, self.aggregations)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = [e.to_field(in_schema) for e in self.groupby]
+        fields += [e.to_field(in_schema) for e in self.aggregations]
+        return Schema(fields)
+
+    def describe(self) -> str:
+        g = ", ".join(e.name() for e in self.groupby)
+        a = ", ".join(e.name() for e in self.aggregations)
+        return f"Aggregate[groupby=({g}) aggs=({a})]"
+
+
+class Pivot(LogicalPlan):
+    def __init__(self, input: LogicalPlan, groupby: List[Expression], pivot_col: Expression,
+                 value_col: Expression, agg_op: str, names: List[str]):
+        super().__init__()
+        self.input = input
+        self.groupby = list(groupby)
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.agg_op = agg_op
+        self.names = list(names)
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Pivot(children[0], self.groupby, self.pivot_col, self.value_col, self.agg_op, self.names)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = [e.to_field(in_schema) for e in self.groupby]
+        agg = AggExpr(self.agg_op, self.value_col)
+        value_field = agg.to_field(in_schema)
+        for n in self.names:
+            fields.append(Field(n, value_field.dtype))
+        return Schema(fields)
+
+
+class Window(LogicalPlan):
+    """Window functions over a WindowSpec (reference: ops/window.rs + expr/window.rs:92)."""
+
+    def __init__(self, input: LogicalPlan, window_exprs: List[Expression], spec: Any):
+        super().__init__()
+        self.input = input
+        self.window_exprs = list(window_exprs)  # WindowExpr nodes with output names
+        self.spec = spec
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Window(children[0], self.window_exprs, self.spec)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = list(in_schema.fields)
+        for e in self.window_exprs:
+            fields.append(e.to_field(in_schema))
+        return Schema(fields)
+
+
+# ======================================================================================
+# Multi-input ops
+# ======================================================================================
+
+
+class Concat(LogicalPlan):
+    def __init__(self, inputs: List[LogicalPlan]):
+        super().__init__()
+        if not inputs:
+            raise ValueError("concat of zero plans")
+        self.inputs = list(inputs)
+        s0 = inputs[0].schema
+        for p in inputs[1:]:
+            if p.schema.column_names() != s0.column_names():
+                raise ValueError(
+                    f"concat requires matching schemas: {s0.column_names()} vs {p.schema.column_names()}"
+                )
+
+    def children(self):
+        return self.inputs
+
+    def with_children(self, children):
+        return Concat(children)
+
+    def _compute_schema(self) -> Schema:
+        return self.inputs[0].schema
+
+
+class Join(LogicalPlan):
+    JOIN_TYPES = ("inner", "left", "right", "outer", "anti", "semi", "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, left_on: List[Expression],
+                 right_on: List[Expression], how: str = "inner",
+                 prefix: Optional[str] = None, suffix: Optional[str] = None,
+                 strategy: Optional[str] = None):
+        super().__init__()
+        if how not in self.JOIN_TYPES:
+            raise ValueError(f"unknown join type {how!r}")
+        self.left = left
+        self.right = right
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.prefix = prefix
+        self.suffix = suffix
+        self.strategy = strategy  # None=auto, 'hash', 'sort_merge', 'broadcast', 'cross'
+
+    def children(self):
+        return [self.left, self.right]
+
+    def with_children(self, children):
+        return Join(children[0], children[1], self.left_on, self.right_on, self.how,
+                    self.prefix, self.suffix, self.strategy)
+
+    def output_naming(self):
+        """(merged_keys, right_rename): join keys with identical names merge into one
+        output column; clashing right value columns get prefix/suffix or 'right.'."""
+        left_names = set(self.left.schema.column_names())
+        merged_keys = set()
+        for lo, ro in zip(self.left_on, self.right_on):
+            if lo.name() == ro.name():
+                merged_keys.add(ro.name())
+        right_rename = {}
+        for f in self.right.schema.fields:
+            if f.name in merged_keys:
+                continue
+            if f.name in left_names:
+                if self.prefix is not None or self.suffix is not None:
+                    right_rename[f.name] = f"{self.prefix or ''}{f.name}{self.suffix or ''}"
+                else:
+                    right_rename[f.name] = f"right.{f.name}"
+        return merged_keys, right_rename
+
+    def _renamed_right_fields(self) -> List[Field]:
+        merged_keys, right_rename = self.output_naming()
+        return [
+            Field(right_rename.get(f.name, f.name), f.dtype)
+            for f in self.right.schema.fields
+            if f.name not in merged_keys
+        ]
+
+    def _compute_schema(self) -> Schema:
+        if self.how in ("anti", "semi"):
+            return self.left.schema
+        fields = list(self.left.schema.fields)
+        fields += self._renamed_right_fields()
+        return Schema(fields)
+
+    def describe(self) -> str:
+        keys = ", ".join(
+            f"{l.name()}={r.name()}" for l, r in zip(self.left_on, self.right_on)
+        )
+        return f"Join[{self.how} on ({keys}) strategy={self.strategy or 'auto'}]"
+
+
+# ======================================================================================
+# Partitioning ops
+# ======================================================================================
+
+
+class Repartition(LogicalPlan):
+    """Hash/random/range repartition (reference: ops/repartition.rs + RepartitionSpec)."""
+
+    def __init__(self, input: LogicalPlan, num_partitions: Optional[int], scheme: str,
+                 by: Optional[List[Expression]] = None):
+        super().__init__()
+        if scheme not in ("hash", "random", "range", "into"):
+            raise ValueError(f"unknown repartition scheme {scheme!r}")
+        self.input = input
+        self.num_partitions = num_partitions
+        self.scheme = scheme
+        self.by = list(by) if by else []
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Repartition(children[0], self.num_partitions, self.scheme, self.by)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+    def describe(self) -> str:
+        return f"Repartition[{self.scheme} n={self.num_partitions}]"
+
+
+class IntoPartitions(LogicalPlan):
+    def __init__(self, input: LogicalPlan, num_partitions: int):
+        super().__init__()
+        self.input = input
+        self.num_partitions = num_partitions
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return IntoPartitions(children[0], self.num_partitions)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+
+class IntoBatches(LogicalPlan):
+    def __init__(self, input: LogicalPlan, batch_size: int):
+        super().__init__()
+        self.input = input
+        self.batch_size = batch_size
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return IntoBatches(children[0], self.batch_size)
+
+    def _compute_schema(self) -> Schema:
+        return self.input.schema
+
+
+# ======================================================================================
+# Sinks
+# ======================================================================================
+
+
+class Sink(LogicalPlan):
+    """Write sink (reference: ops/sink.rs; SinkInfo Output/Catalog/DataSink).
+
+    `info` is a WriteInfo from daft_tpu.io.writers describing format/path/options.
+    The output schema is the write-result manifest (file paths + row counts).
+    """
+
+    def __init__(self, input: LogicalPlan, info: Any):
+        super().__init__()
+        self.input = input
+        self.info = info
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return Sink(children[0], self.info)
+
+    def _compute_schema(self) -> Schema:
+        return self.info.result_schema()
+
+    def describe(self) -> str:
+        return f"Sink[{self.info}]"

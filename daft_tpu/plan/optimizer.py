@@ -1,0 +1,224 @@
+"""Rule-based logical optimizer.
+
+Reference parity: src/daft-logical-plan/src/optimization/optimizer.rs:60,309
+(RuleBatch fixed-point pass manager) and optimization/rules/*. Rules are
+functions plan→plan|None applied bottom-up to fixed point per batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..expressions import ColumnRef, Expression, col
+from . import logical as lp
+
+Rule = Callable[[lp.LogicalPlan], Optional[lp.LogicalPlan]]
+
+
+class RuleBatch:
+    def __init__(self, name: str, rules: List[Rule], max_passes: int = 5):
+        self.name = name
+        self.rules = rules
+        self.max_passes = max_passes
+
+
+class Optimizer:
+    def __init__(self, config=None):
+        self.config = config
+        self.batches = default_rule_batches(config)
+
+    def optimize(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        for batch in self.batches:
+            for _ in range(batch.max_passes):
+                changed = False
+                for rule in batch.rules:
+                    new = plan.transform_up(_track(rule))
+                    if new is not plan:
+                        plan = new
+                        changed = True
+                if not changed:
+                    break
+        return plan
+
+
+def _track(rule: Rule) -> Rule:
+    def wrapped(node):
+        out = rule(node)
+        return out
+
+    return wrapped
+
+
+# ======================================================================================
+# Rules
+# ======================================================================================
+
+
+def rule_drop_trivial_filter(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter(lit(True)) → input (part of SimplifyExpressions in the reference)."""
+    if isinstance(node, lp.Filter) and node.predicate.is_literal_true():
+        return node.input
+    return None
+
+
+def rule_merge_filters(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter(Filter(x, a), b) → Filter(x, a & b)."""
+    if isinstance(node, lp.Filter) and isinstance(node.input, lp.Filter):
+        return lp.Filter(node.input.input, node.input.predicate & node.predicate)
+    return None
+
+
+def rule_merge_limits(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    if isinstance(node, lp.Limit) and isinstance(node.input, lp.Limit):
+        return lp.Limit(node.input.input, min(node.limit, node.input.limit))
+    return None
+
+
+def rule_push_filter_into_scan(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter over a ScanSource whose operator can absorb filters → pushdown.
+
+    Reference: rules/push_down_filter.rs. We keep the Filter node (scans may apply
+    pushdown filters only approximately, e.g. via zone maps) unless the scan
+    promises exact application; translate() checks task.filters_applied.
+    """
+    if not (isinstance(node, lp.Filter) and isinstance(node.input, lp.ScanSource)):
+        return None
+    scan = node.input
+    if not scan.scan_op.can_absorb_filter():
+        return None
+    pd = scan.pushdowns
+    # idempotence: the Filter node is kept above the scan (pushdown filters may be
+    # applied only approximately), so skip once this predicate is already pushed
+    if pd.filters is not None and repr(pd.filters) == repr(node.predicate):
+        return None
+    from ..io.scan import Pushdowns
+
+    if pd.filters is not None:
+        new_filters = pd.filters & node.predicate
+    else:
+        new_filters = node.predicate
+    new_scan = lp.ScanSource(scan.scan_op, Pushdowns(pd.columns, new_filters, pd.limit))
+    return lp.Filter(new_scan, new_filters)
+
+
+def rule_push_limit_into_scan(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    if not (isinstance(node, lp.Limit) and isinstance(node.input, lp.ScanSource)):
+        return None
+    scan = node.input
+    pd = scan.pushdowns
+    if pd.filters is not None:
+        return None  # limit-after-filter can't be pushed below the filter
+    if pd.limit is not None and pd.limit <= node.limit:
+        return None
+    from ..io.scan import Pushdowns
+
+    new_scan = lp.ScanSource(scan.scan_op, Pushdowns(pd.columns, pd.filters, node.limit))
+    return lp.Limit(new_scan, node.limit)
+
+
+def rule_push_limit_through(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Limit commutes with Project (row-preserving)."""
+    if isinstance(node, lp.Limit) and isinstance(node.input, lp.Project):
+        proj = node.input
+        if not any(e.has_udf() for e in proj.projection):
+            return lp.Project(lp.Limit(proj.input, node.limit), proj.projection)
+    return None
+
+
+def rule_detect_topn(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Limit(Sort) → TopN (reference: extract TopN)."""
+    if isinstance(node, lp.Limit) and isinstance(node.input, lp.Sort):
+        s = node.input
+        return lp.TopN(s.input, s.sort_by, s.descending, s.nulls_first, node.limit)
+    if (isinstance(node, lp.Limit) and isinstance(node.input, lp.Offset)
+            and isinstance(node.input.input, lp.Sort)):
+        s = node.input.input
+        return lp.TopN(s.input, s.sort_by, s.descending, s.nulls_first,
+                       node.limit, node.input.offset)
+    return None
+
+
+def _projection_is_passthrough(projection: List[Expression], input_schema) -> bool:
+    names = input_schema.column_names()
+    if len(projection) != len(names):
+        return False
+    for e, n in zip(projection, names):
+        if not (isinstance(e, ColumnRef) and e._name == n):
+            return False
+    return True
+
+
+def rule_drop_noop_project(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    if isinstance(node, lp.Project) and _projection_is_passthrough(node.projection, node.input.schema):
+        return node.input
+    return None
+
+
+def rule_column_pruning(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Push column selection into ScanSource when a Project only needs a subset.
+
+    Reference: rules/push_down_projection.rs (materialized as scan pushdown here;
+    general projection pushdown through intermediate ops lands with M2).
+    """
+    if not (isinstance(node, lp.Project) and isinstance(node.input, lp.ScanSource)):
+        return None
+    scan = node.input
+    needed: List[str] = []
+    for e in node.projection:
+        for c in e.referenced_columns():
+            if c not in needed:
+                needed.append(c)
+    if scan.pushdowns.filters is not None:
+        for c in scan.pushdowns.filters.referenced_columns():
+            if c not in needed:
+                needed.append(c)
+    all_cols = scan.scan_op.schema().column_names()
+    needed = [c for c in all_cols if c in set(needed)]
+    if len(needed) >= len(scan.schema.column_names()):
+        return None
+    from ..io.scan import Pushdowns
+
+    pd = scan.pushdowns
+    new_scan = lp.ScanSource(scan.scan_op, Pushdowns(needed, pd.filters, pd.limit))
+    return lp.Project(new_scan, node.projection)
+
+
+def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Isolate UDF-bearing expressions into their own UDFProject nodes
+    (reference: rules/split_udfs.rs) so host UDFs don't break device stage fusion."""
+    if not isinstance(node, lp.Project):
+        return None
+    udf_exprs = [e for e in node.projection if e.has_udf()]
+    if not udf_exprs or len(node.projection) == len(udf_exprs) == 1:
+        return None
+    if isinstance(node.input, lp.UDFProject):
+        return None
+    # take the first UDF expression out into its own node
+    target = udf_exprs[0]
+    input_cols = node.input.schema.column_names()
+    passthrough = [col(c) for c in input_cols if c != target.name()]
+    udf_node = lp.UDFProject(node.input, target, passthrough)
+    # remaining projection runs on top, referencing the udf output by name
+    new_projection = [col(target.name()) if e is target else e for e in node.projection]
+    return lp.Project(udf_node, new_projection)
+
+
+def default_rule_batches(config) -> List[RuleBatch]:
+    return [
+        RuleBatch("simplify", [
+            rule_drop_trivial_filter,
+            rule_merge_filters,
+            rule_merge_limits,
+            rule_drop_noop_project,
+        ]),
+        RuleBatch("pushdowns", [
+            rule_push_filter_into_scan,
+            rule_push_limit_through,
+            rule_push_limit_into_scan,
+            rule_column_pruning,
+        ]),
+        RuleBatch("physical-prep", [
+            rule_detect_topn,
+            rule_split_udfs,
+        ], max_passes=3),
+    ]

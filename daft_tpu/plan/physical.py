@@ -1,0 +1,345 @@
+"""Physical plan + logical→physical translation.
+
+Reference parity: src/daft-local-plan/src/plan.rs:61-115 (LocalPhysicalPlan enum)
+and src/daft-local-plan/src/translate.rs:21. Physical nodes are what the executor
+interprets; translation picks join strategies and lowers logical ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..expressions import Expression
+from ..schema import Schema
+from . import logical as lp
+
+
+class PhysicalPlan:
+    def __init__(self) -> None:
+        self.schema: Schema = None  # type: ignore[assignment]
+
+    def children(self) -> List["PhysicalPlan"]:
+        return []
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def display(self) -> str:
+        lines: List[str] = []
+
+        def rec(node, depth):
+            lines.append("  " * depth + "* " + node.name())
+            for c in node.children():
+                rec(c, depth + 1)
+
+        rec(self, 0)
+        return "\n".join(lines)
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+
+class _Unary(PhysicalPlan):
+    def __init__(self, input: PhysicalPlan, schema: Schema):
+        super().__init__()
+        self.input = input
+        self.schema = schema
+
+    def children(self):
+        return [self.input]
+
+
+class InMemoryScan(PhysicalPlan):
+    def __init__(self, partitions: List[Any], schema: Schema):
+        super().__init__()
+        self.partitions = partitions
+        self.schema = schema
+
+
+class TaskScan(PhysicalPlan):
+    """Scan over materialized ScanTasks (post-MaterializeScans)."""
+
+    def __init__(self, tasks: List[Any], schema: Schema,
+                 post_filter: Optional[Expression], post_limit: Optional[int]):
+        super().__init__()
+        self.tasks = tasks
+        self.schema = schema
+        self.post_filter = post_filter
+        self.post_limit = post_limit
+
+
+class Project(_Unary):
+    def __init__(self, input: PhysicalPlan, projection: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.projection = projection
+
+
+class UDFProject(_Unary):
+    def __init__(self, input: PhysicalPlan, udf_expr: Expression, passthrough: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.udf_expr = udf_expr
+        self.passthrough = passthrough
+
+
+class PhysFilter(_Unary):
+    def __init__(self, input: PhysicalPlan, predicate: Expression, schema: Schema):
+        super().__init__(input, schema)
+        self.predicate = predicate
+
+
+class PhysLimit(_Unary):
+    def __init__(self, input: PhysicalPlan, limit: int, offset: int, schema: Schema):
+        super().__init__(input, schema)
+        self.limit = limit
+        self.offset = offset
+
+
+class PhysExplode(_Unary):
+    def __init__(self, input: PhysicalPlan, to_explode: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.to_explode = to_explode
+
+
+class PhysUnpivot(_Unary):
+    def __init__(self, input: PhysicalPlan, ids, values, variable_name, value_name, schema: Schema):
+        super().__init__(input, schema)
+        self.ids = ids
+        self.values = values
+        self.variable_name = variable_name
+        self.value_name = value_name
+
+
+class PhysSample(_Unary):
+    def __init__(self, input: PhysicalPlan, fraction: float, with_replacement: bool,
+                 seed: Optional[int], schema: Schema):
+        super().__init__(input, schema)
+        self.fraction = fraction
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+
+class PhysMonotonicId(_Unary):
+    def __init__(self, input: PhysicalPlan, column_name: str, schema: Schema):
+        super().__init__(input, schema)
+        self.column_name = column_name
+
+
+class PhysSort(_Unary):
+    def __init__(self, input: PhysicalPlan, sort_by, descending, nulls_first, schema: Schema):
+        super().__init__(input, schema)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+
+
+class PhysTopN(_Unary):
+    def __init__(self, input: PhysicalPlan, sort_by, descending, nulls_first, limit, offset, schema: Schema):
+        super().__init__(input, schema)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.limit = limit
+        self.offset = offset
+
+
+class UngroupedAggregate(_Unary):
+    def __init__(self, input: PhysicalPlan, aggregations: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.aggregations = aggregations
+
+
+class HashAggregate(_Unary):
+    def __init__(self, input: PhysicalPlan, groupby: List[Expression],
+                 aggregations: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.groupby = groupby
+        self.aggregations = aggregations
+
+
+class Dedup(_Unary):
+    def __init__(self, input: PhysicalPlan, on: Optional[List[Expression]], schema: Schema):
+        super().__init__(input, schema)
+        self.on = on
+
+
+class PhysPivot(_Unary):
+    def __init__(self, input: PhysicalPlan, groupby, pivot_col, value_col, agg_op, names, schema: Schema):
+        super().__init__(input, schema)
+        self.groupby = groupby
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.agg_op = agg_op
+        self.names = names
+
+
+class PhysWindow(_Unary):
+    def __init__(self, input: PhysicalPlan, window_exprs, spec, schema: Schema):
+        super().__init__(input, schema)
+        self.window_exprs = window_exprs
+        self.spec = spec
+
+
+class PhysConcat(PhysicalPlan):
+    def __init__(self, inputs: List[PhysicalPlan], schema: Schema):
+        super().__init__()
+        self.inputs = inputs
+        self.schema = schema
+
+    def children(self):
+        return self.inputs
+
+
+class HashJoin(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on, how,
+                 merged_keys, right_rename, schema: Schema):
+        super().__init__()
+        self.left = left
+        self.right = right
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.merged_keys = merged_keys
+        self.right_rename = right_rename
+        self.schema = schema
+
+    def children(self):
+        return [self.left, self.right]
+
+
+class CrossJoin(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, right_rename, schema: Schema):
+        super().__init__()
+        self.left = left
+        self.right = right
+        self.right_rename = right_rename
+        self.schema = schema
+
+    def children(self):
+        return [self.left, self.right]
+
+
+class PhysRepartition(_Unary):
+    def __init__(self, input: PhysicalPlan, num_partitions, scheme, by, schema: Schema):
+        super().__init__(input, schema)
+        self.num_partitions = num_partitions
+        self.scheme = scheme
+        self.by = by
+
+
+class PhysIntoBatches(_Unary):
+    def __init__(self, input: PhysicalPlan, batch_size: int, schema: Schema):
+        super().__init__(input, schema)
+        self.batch_size = batch_size
+
+
+class PhysWrite(_Unary):
+    def __init__(self, input: PhysicalPlan, info: Any, schema: Schema):
+        super().__init__(input, schema)
+        self.info = info
+
+
+# ======================================================================================
+# Translation
+# ======================================================================================
+
+
+def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
+    """Lower an (optimized) logical plan to a physical plan."""
+    if isinstance(plan, lp.InMemorySource):
+        return InMemoryScan(plan.partitions, plan.schema)
+
+    if isinstance(plan, lp.ScanSource):
+        tasks = plan.scan_op.to_scan_tasks(plan.pushdowns)
+        post_filter = None
+        post_limit = plan.pushdowns.limit
+        if plan.pushdowns.filters is not None:
+            if not all(t.filters_applied for t in tasks):
+                post_filter = plan.pushdowns.filters
+        if post_limit is not None and all(t.limit_applied for t in tasks):
+            # limit fully absorbed per-task; still cap globally
+            pass
+        return TaskScan(tasks, plan.schema, post_filter, post_limit)
+
+    if isinstance(plan, lp.Project):
+        return Project(translate(plan.input, config), plan.projection, plan.schema)
+
+    if isinstance(plan, lp.UDFProject):
+        return UDFProject(translate(plan.input, config), plan.udf_expr, plan.passthrough, plan.schema)
+
+    if isinstance(plan, lp.Filter):
+        return PhysFilter(translate(plan.input, config), plan.predicate, plan.schema)
+
+    if isinstance(plan, lp.Limit):
+        return PhysLimit(translate(plan.input, config), plan.limit, 0, plan.schema)
+
+    if isinstance(plan, lp.Offset):
+        # standalone offset = skip n rows
+        return PhysLimit(translate(plan.input, config), -1, plan.offset, plan.schema)
+
+    if isinstance(plan, lp.Explode):
+        return PhysExplode(translate(plan.input, config), plan.to_explode, plan.schema)
+
+    if isinstance(plan, lp.Unpivot):
+        return PhysUnpivot(translate(plan.input, config), plan.ids, plan.values,
+                           plan.variable_name, plan.value_name, plan.schema)
+
+    if isinstance(plan, lp.Sample):
+        return PhysSample(translate(plan.input, config), plan.fraction, plan.with_replacement,
+                          plan.seed, plan.schema)
+
+    if isinstance(plan, lp.MonotonicallyIncreasingId):
+        return PhysMonotonicId(translate(plan.input, config), plan.column_name, plan.schema)
+
+    if isinstance(plan, lp.Sort):
+        return PhysSort(translate(plan.input, config), plan.sort_by, plan.descending,
+                        plan.nulls_first, plan.schema)
+
+    if isinstance(plan, lp.TopN):
+        return PhysTopN(translate(plan.input, config), plan.sort_by, plan.descending,
+                        plan.nulls_first, plan.limit, plan.offset, plan.schema)
+
+    if isinstance(plan, lp.Aggregate):
+        child = translate(plan.input, config)
+        if plan.groupby:
+            return HashAggregate(child, plan.groupby, plan.aggregations, plan.schema)
+        return UngroupedAggregate(child, plan.aggregations, plan.schema)
+
+    if isinstance(plan, lp.Distinct):
+        return Dedup(translate(plan.input, config), plan.on, plan.schema)
+
+    if isinstance(plan, lp.Pivot):
+        return PhysPivot(translate(plan.input, config), plan.groupby, plan.pivot_col,
+                         plan.value_col, plan.agg_op, plan.names, plan.schema)
+
+    if isinstance(plan, lp.Window):
+        return PhysWindow(translate(plan.input, config), plan.window_exprs, plan.spec, plan.schema)
+
+    if isinstance(plan, lp.Concat):
+        return PhysConcat([translate(c, config) for c in plan.inputs], plan.schema)
+
+    if isinstance(plan, lp.Join):
+        left = translate(plan.left, config)
+        right = translate(plan.right, config)
+        merged_keys, right_rename = plan.output_naming()
+        if plan.how == "cross":
+            return CrossJoin(left, right, right_rename, plan.schema)
+        return HashJoin(left, right, plan.left_on, plan.right_on, plan.how,
+                        merged_keys, right_rename, plan.schema)
+
+    if isinstance(plan, lp.Repartition):
+        return PhysRepartition(translate(plan.input, config), plan.num_partitions,
+                               plan.scheme, plan.by, plan.schema)
+
+    if isinstance(plan, lp.IntoPartitions):
+        return PhysRepartition(translate(plan.input, config), plan.num_partitions,
+                               "into", None, plan.schema)
+
+    if isinstance(plan, lp.IntoBatches):
+        return PhysIntoBatches(translate(plan.input, config), plan.batch_size, plan.schema)
+
+    if isinstance(plan, lp.Sink):
+        return PhysWrite(translate(plan.input, config), plan.info, plan.schema)
+
+    raise NotImplementedError(f"cannot translate {type(plan).__name__}")

@@ -1,0 +1,29 @@
+"""Single-host runner: optimize → translate → stream execute.
+
+Reference parity: daft/runners/native_runner.py:64 (NativeRunner.run/run_iter).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..core.micropartition import MicroPartition
+from ..plan.builder import LogicalPlanBuilder
+
+
+class Runner:
+    def run(self, builder: LogicalPlanBuilder) -> List[MicroPartition]:
+        return list(self.run_iter(builder))
+
+    def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
+        raise NotImplementedError
+
+
+class NativeRunner(Runner):
+    def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
+        from ..execution.executor import execute_plan
+        from ..plan.physical import translate
+
+        optimized = builder.optimize()
+        phys = translate(optimized.plan)
+        yield from execute_plan(phys)

@@ -77,6 +77,7 @@ class Schema:
 
     names = column_names
 
+    @property
     def fields(self) -> List[Field]:
         return list(self._fields)
 
@@ -112,6 +113,12 @@ class Schema:
     def __repr__(self) -> str:
         inner = ", ".join(f"{f.name}: {f.dtype}" for f in self._fields)
         return f"Schema({inner})"
+
+    def short_repr(self) -> str:
+        names = self.column_names()
+        if len(names) > 6:
+            names = names[:6] + ["..."]
+        return ", ".join(names)
 
     def _truncated_table_string(self) -> str:
         return "\n".join(f"  {f.name:<24} {f.dtype}" for f in self._fields)

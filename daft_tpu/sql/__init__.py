@@ -1,0 +1,19 @@
+"""SQL frontend (reference parity: src/daft-sql SQLPlanner + daft/sql/sql.py)."""
+
+from __future__ import annotations
+
+
+def sql(query: str, **bindings):
+    try:
+        from .planner import plan_sql
+    except ImportError as e:
+        raise NotImplementedError("SQL planner not built yet (see SQL milestone)") from e
+    return plan_sql(query, bindings)
+
+
+def sql_expr(text: str):
+    try:
+        from .parser import parse_expression
+    except ImportError as e:
+        raise NotImplementedError("SQL expression parser not built yet (see SQL milestone)") from e
+    return parse_expression(text)

@@ -1,0 +1,236 @@
+"""DataFrame API tests (reference test model: tests/dataframe/*)."""
+
+import os
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, lit
+
+
+@pytest.fixture
+def df():
+    return dt.from_pydict({
+        "a": [1, 2, 3, 4, 5],
+        "b": [10.0, 20.0, 30.0, 40.0, 50.0],
+        "k": ["x", "y", "x", "y", "x"],
+    })
+
+
+def test_select_project(df):
+    out = df.select(col("a"), (col("b") * 2).alias("b2")).to_pydict()
+    assert out == {"a": [1, 2, 3, 4, 5], "b2": [20.0, 40.0, 60.0, 80.0, 100.0]}
+
+
+def test_filter(df):
+    out = df.where(col("a") > 3).to_pydict()
+    assert out["a"] == [4, 5]
+
+
+def test_with_column(df):
+    out = df.with_column("c", col("a") + col("b")).to_pydict()
+    assert out["c"] == [11.0, 22.0, 33.0, 44.0, 55.0]
+    assert list(out.keys()) == ["a", "b", "k", "c"]
+
+
+def test_exclude_rename(df):
+    assert df.exclude("b").column_names == ["a", "k"]
+    assert df.with_column_renamed("a", "aa").column_names == ["aa", "b", "k"]
+
+
+def test_limit_offset(df):
+    assert df.limit(2).to_pydict()["a"] == [1, 2]
+    assert df.offset(3).to_pydict()["a"] == [4, 5]
+    assert df.offset(1).limit(2).to_pydict()["a"] == [2, 3]
+
+
+def test_sort(df):
+    assert df.sort("b", desc=True).to_pydict()["a"] == [5, 4, 3, 2, 1]
+    out = df.sort(["k", "b"], desc=[False, True]).to_pydict()
+    assert out["k"] == ["x", "x", "x", "y", "y"]
+    assert out["b"] == [50.0, 30.0, 10.0, 40.0, 20.0]
+
+
+def test_topn_via_sort_limit(df):
+    out = df.sort("b", desc=True).limit(2).to_pydict()
+    assert out["b"] == [50.0, 40.0]
+
+
+def test_grouped_agg(df):
+    out = df.groupby("k").agg(
+        col("b").sum(),
+        col("a").count().alias("n"),
+        col("b").mean().alias("avg"),
+        col("a").min().alias("lo"),
+        col("a").max().alias("hi"),
+    ).sort("k").to_pydict()
+    assert out["k"] == ["x", "y"]
+    assert out["b"] == [90.0, 60.0]
+    assert out["n"] == [3, 2]
+    assert out["avg"] == [30.0, 30.0]
+    assert out["lo"] == [1, 2]
+    assert out["hi"] == [5, 4]
+
+
+def test_global_agg(df):
+    out = df.agg(col("b").sum().alias("s"), col("a").mean().alias("m")).to_pydict()
+    assert out == {"s": [150.0], "m": [3.0]}
+
+
+def test_count_rows(df):
+    assert len(df) == 5
+    assert df.where(col("k") == "x").count_rows() == 3
+
+
+def test_distinct(df):
+    out = df.select("k").distinct().sort("k").to_pydict()
+    assert out["k"] == ["x", "y"]
+
+
+def test_grouped_agg_nulls():
+    d = dt.from_pydict({"k": ["a", "a", "b", None], "v": [1, None, 3, 4]})
+    out = d.groupby("k").agg(
+        col("v").sum(), col("v").count().alias("n")
+    ).sort("k", nulls_first=False).to_pydict()
+    assert out["k"] == ["a", "b", None]
+    assert out["v"] == [1, 3, 4]
+    assert out["n"] == [1, 1, 1]
+
+
+def test_joins():
+    left = dt.from_pydict({"k": [1, 2, 3], "x": ["a", "b", "c"]})
+    right = dt.from_pydict({"k": [2, 3, 4], "y": [20, 30, 40]})
+    inner = left.join(right, on="k").sort("k").to_pydict()
+    assert inner == {"k": [2, 3], "x": ["b", "c"], "y": [20, 30]}
+    l = left.join(right, on="k", how="left").sort("k").to_pydict()
+    assert l == {"k": [1, 2, 3], "x": ["a", "b", "c"], "y": [None, 20, 30]}
+    outer = left.join(right, on="k", how="outer").sort("k").to_pydict()
+    assert outer["k"] == [1, 2, 3, 4]
+    assert outer["y"] == [None, 20, 30, 40]
+    anti = left.join(right, on="k", how="anti").to_pydict()
+    assert anti == {"k": [1], "x": ["a"]}
+    semi = left.join(right, on="k", how="semi").sort("k").to_pydict()
+    assert semi == {"k": [2, 3], "x": ["b", "c"]}
+
+
+def test_join_name_collision():
+    left = dt.from_pydict({"k": [1, 2], "v": [1.0, 2.0]})
+    right = dt.from_pydict({"k": [1, 2], "v": [10.0, 20.0]})
+    out = left.join(right, on="k").sort("k").to_pydict()
+    assert out == {"k": [1, 2], "v": [1.0, 2.0], "right.v": [10.0, 20.0]}
+
+
+def test_cross_join():
+    a = dt.from_pydict({"x": [1, 2]})
+    b = dt.from_pydict({"y": ["p", "q"]})
+    out = a.join(b, how="cross").to_pydict()
+    assert out == {"x": [1, 1, 2, 2], "y": ["p", "q", "p", "q"]}
+
+
+def test_concat(df):
+    out = df.concat(df).count_rows()
+    assert out == 10
+
+
+def test_explode():
+    d = dt.from_pydict({"id": [1, 2, 3], "vals": [[1, 2], [], [3]]})
+    out = d.explode("vals").to_pydict()
+    assert out["id"] == [1, 1, 2, 3]
+    assert out["vals"] == [1, 2, None, 3]
+
+
+def test_unpivot():
+    d = dt.from_pydict({"id": [1, 2], "x": [10, 20], "y": [100, 200]})
+    out = d.unpivot(["id"], ["x", "y"]).to_pydict()
+    assert out["id"] == [1, 1, 2, 2]
+    assert out["variable"] == ["x", "y", "x", "y"]
+    assert out["value"] == [10, 100, 20, 200]
+
+
+def test_pivot():
+    d = dt.from_pydict({"g": ["a", "a", "b"], "p": ["x", "y", "x"], "v": [1, 2, 3]})
+    out = d.pivot("g", "p", "v", "sum").sort("g").to_pydict()
+    assert out == {"g": ["a", "b"], "x": [1, 3], "y": [2, None]}
+
+
+def test_sample(df):
+    out = df.sample(0.6, seed=42)
+    assert 0 <= out.count_rows() <= 5
+
+
+def test_monotonic_id(df):
+    out = df._add_monotonically_increasing_id().to_pydict()
+    assert out["id"] == [0, 1, 2, 3, 4]
+
+
+def test_iter_rows(df):
+    rows = list(df.limit(2))
+    assert rows == [{"a": 1, "b": 10.0, "k": "x"}, {"a": 2, "b": 20.0, "k": "y"}]
+
+
+def test_into_batches(df):
+    parts = list(df.into_batches(2).iter_partitions())
+    sizes = [p.num_rows for p in parts]
+    assert sizes == [2, 2, 1]
+
+
+def test_repartition_hash(df):
+    out = df.repartition(3, "k")
+    assert out.count_rows() == 5
+
+
+def test_intersect_except():
+    a = dt.from_pydict({"x": [1, 2, 3, 3]})
+    b = dt.from_pydict({"x": [2, 3, 4]})
+    assert sorted(a.intersect(b).to_pydict()["x"]) == [2, 3]
+    assert sorted(a.except_distinct(b).to_pydict()["x"]) == [1]
+
+
+def test_collect_caches(df):
+    c = df.collect()
+    assert c.to_pydict()["a"] == [1, 2, 3, 4, 5]
+    # downstream query on collected df
+    assert c.where(col("a") > 4).to_pydict()["a"] == [5]
+
+
+def test_to_pandas_arrow(df):
+    pdf = df.to_pandas()
+    assert list(pdf["a"]) == [1, 2, 3, 4, 5]
+    t = df.to_arrow()
+    assert t.num_rows == 5
+
+
+def test_show_smoke(df, capsys):
+    df.show()
+    out = capsys.readouterr().out
+    assert "Showing" in out
+
+
+def test_explain(df):
+    s = df.where(col("a") > 1).explain(True)
+    assert "Filter" in s and "Physical" in s
+
+
+def test_agg_list_concat():
+    d = dt.from_pydict({"k": ["a", "a", "b"], "v": [1, 2, 3]})
+    out = d.groupby("k").agg_list("v").sort("k").to_pydict()
+    assert out["v"] == [[1, 2], [3]]
+
+
+def test_any_value():
+    d = dt.from_pydict({"k": ["a", "a", "b"], "v": [None, 2, 3]})
+    out = d.groupby("k").any_value("v").sort("k").to_pydict()
+    assert out["v"][1] == 3
+
+
+def test_stddev_grouped():
+    d = dt.from_pydict({"k": ["a", "a", "a", "b"], "v": [1.0, 2.0, 3.0, 5.0]})
+    out = d.groupby("k").agg(col("v").stddev().alias("sd")).sort("k").to_pydict()
+    assert out["sd"][0] == pytest.approx(0.8164965809)
+    assert out["sd"][1] == 0.0
+
+
+def test_count_distinct_grouped():
+    d = dt.from_pydict({"k": ["a", "a", "a", "b"], "v": [1, 1, 2, None]})
+    out = d.groupby("k").agg(col("v").count_distinct().alias("n")).sort("k").to_pydict()
+    assert out["n"] == [2, 0]

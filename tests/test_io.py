@@ -1,0 +1,105 @@
+"""IO reader/writer tests (reference test model: tests/io/*)."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+
+
+@pytest.fixture
+def pq_dir(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    for i in range(3):
+        t = pa.table({
+            "a": list(range(i * 10, i * 10 + 10)),
+            "b": [float(x) * 1.5 for x in range(10)],
+            "s": [f"row{i}_{j}" for j in range(10)],
+        })
+        pq.write_table(t, d / f"part{i}.parquet")
+    return str(d)
+
+
+def test_read_parquet_dir(pq_dir):
+    df = dt.read_parquet(pq_dir)
+    assert df.column_names == ["a", "b", "s"]
+    assert df.count_rows() == 30
+
+
+def test_read_parquet_glob(pq_dir):
+    df = dt.read_parquet(pq_dir + "/*.parquet")
+    assert df.count_rows() == 30
+
+
+def test_parquet_column_pushdown(pq_dir):
+    df = dt.read_parquet(pq_dir).select("a")
+    out = df.to_pydict()
+    assert sorted(out["a"]) == list(range(30))
+    # check the optimized plan pushed columns into the scan
+    opt = df._builder.optimize().plan
+    from daft_tpu.plan.logical import Project, ScanSource
+
+    scans = [n for n in opt.walk() if isinstance(n, ScanSource)]
+    assert scans and scans[0].pushdowns.columns == ["a"]
+
+
+def test_parquet_filter_pushdown(pq_dir):
+    df = dt.read_parquet(pq_dir).where(col("a") < 5)
+    assert sorted(df.to_pydict()["a"]) == [0, 1, 2, 3, 4]
+
+
+def test_parquet_limit_pushdown(pq_dir):
+    df = dt.read_parquet(pq_dir).limit(7)
+    assert df.count_rows() == 7
+
+
+def test_write_parquet_roundtrip(tmp_path):
+    df = dt.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    res = df.write_parquet(str(tmp_path / "out"))
+    paths = res.to_pydict()["path"]
+    assert len(paths) == 1
+    back = dt.read_parquet(paths).sort("x").to_pydict()
+    assert back == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
+
+
+def test_write_parquet_partitioned(tmp_path):
+    df = dt.from_pydict({"x": [1, 2, 3, 4], "p": ["a", "b", "a", "b"]})
+    res = df.write_parquet(str(tmp_path / "out"), partition_cols=["p"])
+    paths = sorted(res.to_pydict()["path"])
+    assert len(paths) == 2
+    assert any("p=a" in p for p in paths) and any("p=b" in p for p in paths)
+
+
+def test_csv_roundtrip(tmp_path):
+    df = dt.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    res = df.write_csv(str(tmp_path / "out"))
+    paths = res.to_pydict()["path"]
+    back = dt.read_csv(paths).sort("x").to_pydict()
+    assert back == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
+
+
+def test_json_roundtrip(tmp_path):
+    df = dt.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    res = df.write_json(str(tmp_path / "out"))
+    paths = res.to_pydict()["path"]
+    back = dt.read_json(paths).sort("x").to_pydict()
+    assert back == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
+
+
+def test_from_glob_path(pq_dir):
+    df = dt.from_glob_path(pq_dir + "/*.parquet")
+    out = df.to_pydict()
+    assert len(out["path"]) == 3
+    assert all(s > 0 for s in out["size"])
+
+
+def test_read_csv_no_headers(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("1,a\n2,b\n")
+    df = dt.read_csv(str(p), has_headers=False)
+    assert df.column_names == ["column_1", "column_2"]
+    assert df.count_rows() == 2
