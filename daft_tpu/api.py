@@ -14,6 +14,8 @@ from .expressions import Expression, col, lit
 from .plan.builder import LogicalPlanBuilder
 from .schema import Schema
 from .udf import func
+from .window import Window
+from . import functions
 
 __all__ = [
     "DataFrame", "GroupedDataFrame", "Expression", "col", "lit", "element", "func",

@@ -1,7 +1,313 @@
-"""Window function evaluation (placeholder until M3 window milestone)."""
+"""Window function evaluation.
+
+Reference parity: src/daft-local-execution/src/sinks/window_* (4 sink variants:
+partition-only, partition+order, row-frame, range-frame) — here unified in one
+vectorized kernel: rows are sorted by (partition, order keys) once, every window
+expression is computed in sorted order with numpy segment arithmetic, and results
+are scattered back to the original row order.
+"""
 
 from __future__ import annotations
 
+from typing import List, Optional
 
-def eval_window(batch, window_exprs, spec, schema):
-    raise NotImplementedError("window functions land in the window milestone (M3)")
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..core.kernels.groupby import make_groups
+from ..core.kernels.sort import multi_argsort
+from ..core.recordbatch import RecordBatch
+from ..core.series import Series
+from ..datatype import DataType
+from ..expressions.eval import eval_expression
+from ..schema import Schema
+from ..window import Window
+
+
+def eval_window(batch: RecordBatch, window_exprs, spec, schema: Schema) -> RecordBatch:
+    n = batch.num_rows
+    if n == 0:
+        return RecordBatch.empty(schema)
+
+    # ---- partition ids -------------------------------------------------------------
+    if spec.partition_by_exprs:
+        key_series = [eval_expression(batch, e) for e in spec.partition_by_exprs]
+        _, group_ids, _ = make_groups(key_series)
+    else:
+        group_ids = np.zeros(n, dtype=np.int64)
+
+    # ---- global sort: by (partition, order keys) ------------------------------------
+    if spec.order_by_exprs:
+        order_series = [eval_expression(batch, e) for e in spec.order_by_exprs]
+        gid_series = Series.from_numpy(group_ids, "__gid__")
+        sorted_idx = multi_argsort(
+            [gid_series] + order_series,
+            [False] + list(spec.descending),
+            [False] + list(spec.nulls_first),
+        )
+    else:
+        order_series = []
+        sorted_idx = np.argsort(group_ids, kind="stable")
+
+    sg = group_ids[sorted_idx]                      # group id per sorted row
+    seg_start_flag = np.empty(n, dtype=bool)
+    seg_start_flag[0] = True
+    seg_start_flag[1:] = sg[1:] != sg[:-1]
+    seg_id_sorted = np.cumsum(seg_start_flag) - 1   # 0..S-1 segment index in sorted order
+    seg_starts = np.flatnonzero(seg_start_flag)
+    seg_ends = np.append(seg_starts[1:], n)         # exclusive
+    seg_len = seg_ends - seg_starts
+    row_start = seg_starts[seg_id_sorted]           # per-row segment start
+    row_len = seg_len[seg_id_sorted]
+    pos_in_seg = np.arange(n) - row_start           # 0-based position within partition
+
+    # ---- peer groups (rows equal on all order keys within a partition) --------------
+    if order_series:
+        from ..core.kernels.encoding import encode_column
+
+        peer_new = seg_start_flag.copy()
+        for s in order_series:
+            codes = encode_column(s.take(sorted_idx))  # nulls get their own code
+            peer_new[1:] |= codes[1:] != codes[:-1]
+    else:
+        peer_new = seg_start_flag.copy()
+    peer_gid = np.cumsum(peer_new) - 1
+    # first and last row (sorted positions) of each peer group
+    peer_first = np.flatnonzero(peer_new)
+    peer_last = np.append(peer_first[1:], n) - 1
+    row_peer_first = peer_first[peer_gid]
+    row_peer_last = peer_last[peer_gid]
+
+    out_cols: List[Series] = list(batch.columns)
+    for we in window_exprs:
+        name = we.name()
+        res = _eval_one(we, batch, spec, sorted_idx, n, row_start, row_len, pos_in_seg,
+                        peer_new, row_peer_first, row_peer_last, seg_id_sorted)
+        out_cols.append(res.rename(name))
+    cols = [c.cast(f.dtype) if c.dtype != f.dtype else c for c, f in zip(out_cols, schema.fields)]
+    return RecordBatch(schema, cols, n)
+
+
+def _scatter(sorted_vals: np.ndarray, sorted_idx: np.ndarray, n: int) -> np.ndarray:
+    out = np.empty(n, dtype=sorted_vals.dtype)
+    out[sorted_idx] = sorted_vals
+    return out
+
+
+def _scatter_series(sorted_series: Series, sorted_idx: np.ndarray, n: int) -> Series:
+    inv = np.empty(n, dtype=np.int64)
+    inv[sorted_idx] = np.arange(n)
+    return sorted_series.take(inv)
+
+
+def _eval_one(we, batch, spec, sorted_idx, n, row_start, row_len, pos_in_seg,
+              peer_new, row_peer_first, row_peer_last, seg_id_sorted) -> Series:
+    func = we.func
+    name = we.name()
+
+    # ---- ranking -------------------------------------------------------------------
+    if func == "row_number":
+        vals = pos_in_seg + 1
+        return Series.from_numpy(_scatter(vals.astype(np.uint64), sorted_idx, n), name, DataType.uint64())
+    if func == "rank":
+        vals = (row_peer_first - row_start) + 1
+        return Series.from_numpy(_scatter(vals.astype(np.uint64), sorted_idx, n), name, DataType.uint64())
+    if func == "dense_rank":
+        # dense rank = peer-group index within segment + 1
+        peer_idx_global = np.cumsum(peer_new) - 1
+        first_peer_of_seg = np.zeros(seg_id_sorted.max() + 1, dtype=np.int64)
+        starts_idx = np.flatnonzero(peer_new)
+        for_seg = seg_id_sorted[starts_idx]
+        # first peer id per segment = min peer id with that seg
+        first_peer_of_seg[for_seg[::-1]] = peer_idx_global[starts_idx][::-1]
+        vals = peer_idx_global - first_peer_of_seg[seg_id_sorted] + 1
+        return Series.from_numpy(_scatter(vals.astype(np.uint64), sorted_idx, n), name, DataType.uint64())
+    if func == "percent_rank":
+        rank = (row_peer_first - row_start).astype(np.float64)
+        denom = np.maximum(row_len - 1, 1).astype(np.float64)
+        vals = np.where(row_len > 1, rank / denom, 0.0)
+        return Series.from_numpy(_scatter(vals, sorted_idx, n), name, DataType.float64())
+    if func == "cume_dist":
+        vals = (row_peer_last - row_start + 1).astype(np.float64) / row_len
+        return Series.from_numpy(_scatter(vals, sorted_idx, n), name, DataType.float64())
+    if func == "ntile":
+        k = int(we.params["n"])
+        # SQL ntile: first (len % k) buckets get ceil(len/k) rows
+        base = row_len // k
+        rem = row_len % k
+        big = (base + 1) * rem
+        vals = np.where(
+            pos_in_seg < big,
+            pos_in_seg // np.maximum(base + 1, 1),
+            np.where(base > 0, rem + (pos_in_seg - big) // np.maximum(base, 1), rem),
+        ) + 1
+        return Series.from_numpy(_scatter(vals.astype(np.uint64), sorted_idx, n), name, DataType.uint64())
+
+    # ---- value functions -------------------------------------------------------------
+    child = eval_expression(batch, we.child) if we.child is not None else None
+    if child is not None and len(child) == 1 and n != 1:
+        from ..expressions.eval import _broadcast
+
+        child = _broadcast(child, n)
+    if func in ("lag", "lead"):
+        offset = int(we.params.get("offset", 1))
+        if func == "lead":
+            offset = -offset
+        src = np.arange(n) - offset
+        valid = (src >= row_start) & (src < row_start + row_len)
+        take = np.where(valid, np.clip(src, 0, n - 1), 0)
+        sorted_child = child.take(sorted_idx)
+        taken = sorted_child.take(take)
+        default = we.params.get("default")
+        if default is None:
+            fill = Series.full_null(name, child.dtype, n)
+        else:
+            fill = Series.from_pylist([default] * n, name, child.dtype)
+        picked = Series.if_else(Series.from_numpy(valid, "m"), taken, fill)
+        return _scatter_series(picked, sorted_idx, n)
+    if func in ("first_value", "last_value"):
+        sorted_child = child.take(sorted_idx)
+        lo, hi, empty = _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg,
+                                      row_peer_first, row_peer_last)
+        take = lo if func == "first_value" else hi
+        picked = sorted_child.take(np.clip(take, 0, n - 1))
+        if empty.any():
+            fill = Series.full_null(name, child.dtype, n)
+            picked = Series.if_else(Series.from_numpy(~empty, "m"), picked, fill)
+        return _scatter_series(picked, sorted_idx, n)
+
+    # ---- windowed aggregations --------------------------------------------------------
+    sorted_child = child.take(sorted_idx)
+    lo, hi, empty = _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg,
+                                  row_peer_first, row_peer_last)
+    frame_rows = np.where(empty, 0, hi + 1 - lo)
+    if spec.min_periods > 1:
+        empty = empty | (frame_rows < spec.min_periods)
+        frame_rows = np.where(empty, 0, frame_rows)
+    # empty frames: collapse to a zero-width span so prefix-diffs read 0
+    lo_e = np.where(empty, row_start, lo)
+    hi_e = np.where(empty, row_start - 1, hi)
+
+    if sorted_child.dtype.is_null():
+        if func == "count":
+            out = np.zeros(n, np.uint64) if we.params.get("mode", "valid") != "all" \
+                else frame_rows.astype(np.uint64)
+            return Series.from_numpy(_scatter(out, sorted_idx, n), name, DataType.uint64())
+        out_dtype = we.to_field(batch.schema).dtype
+        return Series.full_null(name, out_dtype, n)
+
+    vals = sorted_child.to_numpy()
+    valid = sorted_child.validity_numpy()
+    if vals.dtype == object:
+        raise ValueError(f"windowed aggregation over non-numeric column {name!r} not supported")
+    is_int = np.issubdtype(vals.dtype, np.integer) or vals.dtype == bool
+    # integers aggregate in int64 (exact above 2^53); floats in float64
+    fvals = np.where(valid, vals.astype(np.int64 if is_int else np.float64),
+                     np.int64(0) if is_int else 0.0)
+
+    zero = np.zeros(1, dtype=fvals.dtype)
+    csum = np.concatenate([zero, np.cumsum(fvals)])
+    ccnt = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+    wsum = csum[hi_e + 1] - csum[lo_e]
+    wcnt = ccnt[hi_e + 1] - ccnt[lo_e]
+    has = (wcnt > 0) & ~empty
+
+    def _null_where_invalid(np_out, cast_to=None):
+        arr = pa.array(np_out)
+        arr = pc.if_else(pa.array(has), arr, pa.nulls(n, arr.type))
+        s = Series.from_arrow(arr, name)
+        if cast_to is not None and s.dtype != cast_to:
+            s = s.cast(cast_to)
+        return _scatter_series(s, sorted_idx, n)
+
+    if func == "count":
+        mode = we.params.get("mode", "valid")
+        out = frame_rows.astype(np.uint64) if mode == "all" else np.where(empty, 0, wcnt).astype(np.uint64)
+        return Series.from_numpy(_scatter(out, sorted_idx, n), name, DataType.uint64())
+    if func == "sum":
+        return _null_where_invalid(wsum, we.to_field(batch.schema).dtype)
+    if func == "mean":
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = wsum.astype(np.float64) / wcnt
+        return _null_where_invalid(out)
+    if func in ("stddev", "var"):
+        f64 = np.where(valid, vals.astype(np.float64), 0.0)
+        csq = np.concatenate([[0.0], np.cumsum(f64 * f64)])
+        cs = np.concatenate([[0.0], np.cumsum(f64)])
+        wsq = csq[hi_e + 1] - csq[lo_e]
+        ws = cs[hi_e + 1] - cs[lo_e]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            m = ws / wcnt
+            var = np.maximum(wsq / wcnt - m * m, 0.0)
+            out = np.sqrt(var) if func == "stddev" else var
+        return _null_where_invalid(out)
+    if func in ("min", "max"):
+        out = _sliding_minmax(fvals, valid, lo_e, np.maximum(hi_e, lo_e), func == "min")
+        return _null_where_invalid(out, we.child.to_field(batch.schema).dtype)
+    raise ValueError(f"window aggregation {func!r} not supported")
+
+
+def _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg, row_peer_first, row_peer_last):
+    """Per-row inclusive [lo, hi] sorted-position frame bounds + empty-frame mask."""
+    seg_end = row_start + row_len - 1
+    no_empty = np.zeros(len(row_start), dtype=bool)
+    if spec.frame_type == "rows":
+        lo = _row_bound(spec.frame_start, row_start, seg_end, pos_in_seg)
+        hi = _row_bound(spec.frame_end, row_start, seg_end, pos_in_seg)
+        # a frame that lies entirely outside the partition (or is inverted) is empty → NULL
+        empty = (lo > seg_end) | (hi < row_start) | (lo > hi)
+        return np.clip(lo, row_start, seg_end), np.clip(hi, row_start, seg_end), empty
+    if spec.frame_type == "range":
+        raise NotImplementedError("range_between frames: use rows_between or default frame")
+    if spec.order_by_exprs:
+        # SQL default frame: RANGE UNBOUNDED PRECEDING .. CURRENT ROW (peers included)
+        return row_start, row_peer_last, no_empty
+    return row_start, seg_end, no_empty
+
+
+def _row_bound(bound, row_start, seg_end, pos_in_seg):
+    cur = row_start + pos_in_seg
+    if bound is Window.unbounded_preceding:
+        return row_start.copy()
+    if bound is Window.unbounded_following:
+        return seg_end.copy()
+    return cur + int(bound)
+
+
+def _sliding_minmax(fvals, valid, lo, hi, is_min: bool):
+    """Per-row min/max over inclusive [lo, hi] via a sparse table (O(n log n) build,
+    O(1) per query); invalid rows are masked to ±extreme."""
+    n = len(fvals)
+    if np.issubdtype(fvals.dtype, np.integer):
+        info = np.iinfo(fvals.dtype)
+        ext = info.max if is_min else info.min
+    else:
+        ext = np.inf if is_min else -np.inf
+    masked = np.where(valid, fvals, ext)
+    # sparse table over masked values
+    if n == 0:
+        return masked
+    levels = max(1, int(np.floor(np.log2(max(hi.max() - lo.min() + 1, 1)))) + 1)
+    table = [masked]
+    width = 1
+    for _ in range(1, levels):
+        prev = table[-1]
+        m = len(prev) - width
+        if m <= 0:
+            break
+        nxt = (np.minimum if is_min else np.maximum)(prev[:m], prev[width:width + m])
+        table.append(nxt)
+        width *= 2
+    length = hi - lo + 1
+    k = np.where(length > 0, np.floor(np.log2(np.maximum(length, 1))).astype(np.int64), 0)
+    k = np.minimum(k, len(table) - 1)
+    out = np.empty(n, dtype=masked.dtype)
+    for kk in np.unique(k):
+        sel = k == kk
+        w = 1 << int(kk)
+        t = table[int(kk)]
+        a = np.clip(lo[sel], 0, len(t) - 1)
+        b = np.clip(hi[sel] - w + 1, 0, len(t) - 1)
+        out[sel] = (np.minimum if is_min else np.maximum)(t[a], t[b])
+    return out

@@ -319,6 +319,28 @@ class Expression:
     def approx_count_distinct(self):
         return AggExpr("approx_count_distinct", self)
 
+    # ---- window ---------------------------------------------------------------------
+    def over(self, spec) -> "WindowExpr":
+        """Evaluate this aggregation over a Window spec (reference: Expr::Over)."""
+        if isinstance(self, AggExpr):
+            return WindowExpr(self.op, self.child, spec, self.params)
+        raise ValueError(
+            f"only aggregation expressions support .over(); got {type(self).__name__} "
+            "(use daft_tpu.functions.row_number()/rank()/... for ranking window fns)"
+        )
+
+    def lag(self, offset: int = 1, default=None) -> "Expression":
+        return _UnboundWindowFn("lag", self, {"offset": offset, "default": default})
+
+    def lead(self, offset: int = 1, default=None) -> "Expression":
+        return _UnboundWindowFn("lead", self, {"offset": offset, "default": default})
+
+    def first_value(self) -> "Expression":
+        return _UnboundWindowFn("first_value", self, {})
+
+    def last_value(self) -> "Expression":
+        return _UnboundWindowFn("last_value", self, {})
+
     # ---- namespaces -----------------------------------------------------------------
     @property
     def str(self) -> "StringNamespace":
@@ -642,6 +664,90 @@ class AggExpr(Expression):
 
     def __repr__(self):
         return f"{self.child!r}.{self.op}()"
+
+
+class _UnboundWindowFn(Expression):
+    """A window function (lag/lead/first/last/row_number/rank/...) before .over()
+    binds it to a Window spec."""
+
+    def __init__(self, func: str, child: Optional[Expression], params: Dict[str, Any]):
+        self.func = func
+        self.child = child
+        self.params = params
+
+    def name(self) -> str:
+        return self.child.name() if self.child is not None else self.func
+
+    def children(self):
+        return [self.child] if self.child is not None else []
+
+    def with_children(self, children):
+        return _UnboundWindowFn(self.func, children[0] if children else None, self.params)
+
+    def over(self, spec) -> "WindowExpr":
+        return WindowExpr(self.func, self.child, spec, self.params)
+
+    def to_field(self, schema: Schema) -> Field:
+        raise ValueError(f"{self.func}() must be bound with .over(window)")
+
+    def __repr__(self):
+        return f"{self.child!r}.{self.func}({self.params})"
+
+
+# ranking functions need no child; value functions (lag/lead/first/last) take one
+_WINDOW_FNS = {
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
+    "lag", "lead", "first_value", "last_value",
+}
+
+
+class WindowExpr(Expression):
+    """A window function or windowed aggregation bound to a Window spec.
+
+    Reference parity: src/daft-dsl/src/expr/mod.rs:464 (WindowExpr) +
+    Expr::Over. `func` is either a name from _WINDOW_FNS or an AggExpr op; `child`
+    is the value expression (None for pure ranking fns).
+    """
+
+    def __init__(self, func: str, child: Optional[Expression], spec: Any,
+                 params: Optional[Dict[str, Any]] = None, out_name: Optional[str] = None):
+        if func not in _WINDOW_FNS and func not in _AGG_OPS:
+            raise ValueError(f"unknown window function {func!r}")
+        self.func = func
+        self.child = child
+        self.spec = spec
+        self.params = params or {}
+        self._out_name = out_name
+
+    def name(self) -> str:
+        if self._out_name:
+            return self._out_name
+        return self.child.name() if self.child is not None else self.func
+
+    def alias(self, name: str) -> "WindowExpr":
+        return WindowExpr(self.func, self.child, self.spec, self.params, name)
+
+    def children(self):
+        return [self.child] if self.child is not None else []
+
+    def with_children(self, children):
+        return WindowExpr(self.func, children[0] if children else None, self.spec,
+                          self.params, self._out_name)
+
+    def to_field(self, schema: Schema) -> Field:
+        name = self.name()
+        if self.func in ("row_number", "rank", "dense_rank", "ntile"):
+            return Field(name, DataType.uint64())
+        if self.func in ("percent_rank", "cume_dist"):
+            return Field(name, DataType.float64())
+        if self.func in ("lag", "lead", "first_value", "last_value"):
+            return Field(name, self.child.to_field(schema).dtype)
+        agg = AggExpr(self.func, self.child, self.params)
+        return Field(name, agg.to_field(schema).dtype)
+
+    def __repr__(self):
+        base = f"{self.child!r}.{self.func}" if self.child is not None else self.func
+        return f"{base}.over({self.spec!r})"
 
 
 # ---- namespaces -------------------------------------------------------------------

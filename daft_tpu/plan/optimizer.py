@@ -203,6 +203,51 @@ def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     return lp.Project(udf_node, new_projection)
 
 
+def rule_extract_windows(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Pull WindowExpr nodes out of projections into Window plan nodes
+    (reference: rules/extract_window_function.rs)."""
+    if not isinstance(node, lp.Project):
+        return None
+    from ..expressions.expressions import WindowExpr
+
+    found: List = []
+    seen_ids = set()
+    for e in node.projection:
+        for sub in e.walk():
+            if isinstance(sub, WindowExpr) and id(sub) not in seen_ids:
+                seen_ids.add(id(sub))
+                found.append(sub)
+    if not found:
+        return None
+
+    # group by spec *content* so equal-but-distinct Window() objects share one
+    # sort+segment pass, and dedupe identical window computations within a spec
+    by_spec = {}
+    replacement = {}
+    for w in found:
+        spec_key = repr(w.spec)
+        spec, ws = by_spec.setdefault(spec_key, (w.spec, {}))
+        expr_key = (w.func, repr(w.child), repr(sorted(w.params.items(), key=str)))
+        if expr_key not in ws:
+            ws[expr_key] = (f"__window_{len(replacement)}", w)
+        replacement[id(w)] = ws[expr_key][0]
+
+    input_node = node.input
+    for spec, ws in by_spec.values():
+        named = [w.alias(internal) for internal, w in ws.values()]
+        input_node = lp.Window(input_node, named, spec)
+
+    def rewrite(e: Expression) -> Optional[Expression]:
+        if id(e) in replacement:
+            from ..expressions import Alias
+
+            return Alias(col(replacement[id(e)]), e.name())
+        return None
+
+    new_proj = [e.transform(rewrite) for e in node.projection]
+    return lp.Project(input_node, new_proj)
+
+
 def default_rule_batches(config) -> List[RuleBatch]:
     return [
         RuleBatch("simplify", [
@@ -219,6 +264,7 @@ def default_rule_batches(config) -> List[RuleBatch]:
         ]),
         RuleBatch("physical-prep", [
             rule_detect_topn,
+            rule_extract_windows,
             rule_split_udfs,
         ], max_passes=3),
     ]

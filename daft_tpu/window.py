@@ -1,0 +1,93 @@
+"""Window specification.
+
+Reference parity: daft/window.py:12 (Window: partition_by/order_by/rows_between/
+range_between) and src/daft-dsl/src/expr/window.rs:92 (WindowSpec).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+
+class Window:
+    """Immutable window spec built fluently:
+    Window().partition_by("k").order_by("t").rows_between(Window.unbounded_preceding, 0)
+    """
+
+    unbounded_preceding = object()
+    unbounded_following = object()
+    current_row = 0
+
+    def __init__(self):
+        self.partition_by_exprs: List = []
+        self.order_by_exprs: List = []
+        self.descending: List[bool] = []
+        self.nulls_first: List[bool] = []
+        # frame: None = default (whole partition, or running if ordered)
+        self.frame_type: Optional[str] = None  # 'rows' | 'range'
+        self.frame_start = None
+        self.frame_end = None
+        self.min_periods: int = 1
+
+    def _copy(self) -> "Window":
+        w = Window.__new__(Window)
+        w.partition_by_exprs = list(self.partition_by_exprs)
+        w.order_by_exprs = list(self.order_by_exprs)
+        w.descending = list(self.descending)
+        w.nulls_first = list(self.nulls_first)
+        w.frame_type = self.frame_type
+        w.frame_start = self.frame_start
+        w.frame_end = self.frame_end
+        w.min_periods = self.min_periods
+        return w
+
+    def partition_by(self, *cols) -> "Window":
+        from .plan.builder import _to_exprs
+
+        w = self._copy()
+        w.partition_by_exprs.extend(_to_exprs(cols))
+        return w
+
+    def order_by(self, *cols, desc: Union[bool, Sequence[bool]] = False,
+                 nulls_first: Optional[Union[bool, Sequence[bool]]] = None) -> "Window":
+        from .plan.builder import _to_exprs
+
+        w = self._copy()
+        exprs = _to_exprs(cols)
+        descs = [desc] * len(exprs) if isinstance(desc, bool) else list(desc)
+        if nulls_first is None:
+            nfs = [d for d in descs]
+        elif isinstance(nulls_first, bool):
+            nfs = [nulls_first] * len(exprs)
+        else:
+            nfs = list(nulls_first)
+        w.order_by_exprs.extend(exprs)
+        w.descending.extend(descs)
+        w.nulls_first.extend(nfs)
+        return w
+
+    def rows_between(self, start, end, min_periods: int = 1) -> "Window":
+        w = self._copy()
+        w.frame_type = "rows"
+        w.frame_start = start
+        w.frame_end = end
+        w.min_periods = min_periods
+        return w
+
+    def range_between(self, start, end, min_periods: int = 1) -> "Window":
+        w = self._copy()
+        w.frame_type = "range"
+        w.frame_start = start
+        w.frame_end = end
+        w.min_periods = min_periods
+        return w
+
+    def __repr__(self) -> str:
+        parts = []
+        if self.partition_by_exprs:
+            parts.append(f"partition_by={[e.name() for e in self.partition_by_exprs]}")
+        if self.order_by_exprs:
+            parts.append(f"order_by={[e.name() for e in self.order_by_exprs]}")
+        if self.frame_type:
+            parts.append(f"{self.frame_type}=[{self.frame_start},{self.frame_end}]")
+        return "Window(" + ", ".join(parts) + ")"

@@ -1,0 +1,178 @@
+"""Window function tests (reference test model: tests/window/*)."""
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import Window, col
+from daft_tpu.functions import cume_dist, dense_rank, ntile, percent_rank, rank, row_number
+
+
+@pytest.fixture
+def df():
+    return dt.from_pydict({
+        "k": ["a", "a", "a", "b", "b"],
+        "t": [1, 2, 2, 1, 2],
+        "v": [10.0, 20.0, 30.0, 5.0, 15.0],
+    })
+
+
+def test_row_number(df):
+    w = Window().partition_by("k").order_by("t")
+    out = df.select(col("k"), col("v"), row_number().over(w).alias("rn")).sort(["k", "v"]).to_pydict()
+    assert out["rn"] == [1, 2, 3, 1, 2]
+
+
+def test_rank_dense_rank(df):
+    w = Window().partition_by("k").order_by("t")
+    out = df.select(
+        col("k"), col("v"),
+        rank().over(w).alias("rk"),
+        dense_rank().over(w).alias("dr"),
+    ).sort(["k", "v"]).to_pydict()
+    assert out["rk"] == [1, 2, 2, 1, 2]
+    assert out["dr"] == [1, 2, 2, 1, 2]
+
+
+def test_rank_with_gaps():
+    d = dt.from_pydict({"g": ["x"] * 4, "s": [1, 1, 2, 3]})
+    w = Window().partition_by("g").order_by("s")
+    out = d.select(col("s"), rank().over(w).alias("rk"), dense_rank().over(w).alias("dr")).sort("s").to_pydict()
+    assert out["rk"] == [1, 1, 3, 4]
+    assert out["dr"] == [1, 1, 2, 3]
+
+
+def test_percent_rank_cume_dist():
+    d = dt.from_pydict({"g": ["x"] * 4, "s": [1, 2, 2, 3]})
+    w = Window().partition_by("g").order_by("s")
+    out = d.select(col("s"), percent_rank().over(w).alias("pr"), cume_dist().over(w).alias("cd")).sort("s").to_pydict()
+    assert out["pr"] == [0.0, 1 / 3, 1 / 3, 1.0]
+    assert out["cd"] == [0.25, 0.75, 0.75, 1.0]
+
+
+def test_ntile():
+    d = dt.from_pydict({"g": ["x"] * 5, "s": [1, 2, 3, 4, 5]})
+    w = Window().partition_by("g").order_by("s")
+    out = d.select(col("s"), ntile(2).over(w).alias("nt")).sort("s").to_pydict()
+    assert out["nt"] == [1, 1, 1, 2, 2]
+
+
+def test_running_sum_includes_peers(df):
+    w = Window().partition_by("k").order_by("t")
+    out = df.select(col("k"), col("v"), col("v").sum().over(w).alias("rs")).sort(["k", "v"]).to_pydict()
+    assert out["rs"] == [10.0, 60.0, 60.0, 5.0, 20.0]
+
+
+def test_partition_only_agg(df):
+    w = Window().partition_by("k")
+    out = df.select(col("k"), col("v"), col("v").mean().over(w).alias("m")).sort(["k", "v"]).to_pydict()
+    assert out["m"] == [20.0, 20.0, 20.0, 10.0, 10.0]
+
+
+def test_rows_between(df):
+    w = Window().partition_by("k").order_by("t", desc=False).rows_between(-1, 0)
+    out = df.select(col("k"), col("t"), col("v"), col("v").sum().over(w).alias("s")).sort(["k", "t", "v"]).to_pydict()
+    assert out["s"] == [10.0, 30.0, 50.0, 5.0, 20.0]
+
+
+def test_rows_between_unbounded():
+    d = dt.from_pydict({"g": ["x"] * 3, "s": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    w = Window().partition_by("g").order_by("s").rows_between(Window.unbounded_preceding, Window.unbounded_following)
+    out = d.select(col("s"), col("v").sum().over(w).alias("tot")).sort("s").to_pydict()
+    assert out["tot"] == [6.0, 6.0, 6.0]
+
+
+def test_lag_lead(df):
+    w = Window().partition_by("k").order_by("t")
+    out = df.select(
+        col("k"), col("v"),
+        col("v").lag(1).over(w).alias("prev"),
+        col("v").lead(1).over(w).alias("next"),
+        col("v").lag(1, default=-1.0).over(w).alias("prev_d"),
+    ).sort(["k", "v"]).to_pydict()
+    assert out["prev"] == [None, 10.0, 20.0, None, 5.0]
+    assert out["next"] == [20.0, 30.0, None, 15.0, None]
+    assert out["prev_d"] == [-1.0, 10.0, 20.0, -1.0, 5.0]
+
+
+def test_first_last_value(df):
+    w = Window().partition_by("k").order_by("t")
+    out = df.select(
+        col("k"), col("v"),
+        col("v").first_value().over(w).alias("f"),
+        col("v").last_value().over(w).alias("l"),
+    ).sort(["k", "v"]).to_pydict()
+    assert out["f"] == [10.0, 10.0, 10.0, 5.0, 5.0]
+    # last_value default frame ends at current peer group
+    assert out["l"] == [10.0, 30.0, 30.0, 5.0, 15.0]
+
+
+def test_window_min_max():
+    d = dt.from_pydict({"g": ["x"] * 4, "s": [1, 2, 3, 4], "v": [3.0, 1.0, 4.0, 2.0]})
+    w = Window().partition_by("g").order_by("s").rows_between(-1, 1)
+    out = d.select(
+        col("s"),
+        col("v").min().over(w).alias("mn"),
+        col("v").max().over(w).alias("mx"),
+    ).sort("s").to_pydict()
+    assert out["mn"] == [1.0, 1.0, 1.0, 2.0]
+    assert out["mx"] == [3.0, 4.0, 4.0, 4.0]
+
+
+def test_window_count_with_nulls():
+    d = dt.from_pydict({"g": ["x", "x", "y"], "v": [1.0, None, 2.0]})
+    w = Window().partition_by("g")
+    out = d.select(col("g"), col("v").count().over(w).alias("c")).sort(["g"]).to_pydict()
+    assert out["c"] == [1, 1, 1]
+
+
+def test_window_no_partition():
+    d = dt.from_pydict({"s": [3, 1, 2]})
+    w = Window().order_by("s")
+    out = d.select(col("s"), row_number().over(w).alias("rn")).sort("s").to_pydict()
+    assert out["rn"] == [1, 2, 3]
+
+
+def test_window_stddev():
+    d = dt.from_pydict({"g": ["x", "x", "x"], "v": [1.0, 2.0, 3.0]})
+    w = Window().partition_by("g")
+    out = d.select(col("v").stddev().over(w).alias("sd")).to_pydict()
+    assert all(abs(x - 0.816496580927726) < 1e-12 for x in out["sd"])
+
+
+def test_empty_frames_are_null():
+    d = dt.from_pydict({"g": ["x"] * 3, "s": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    w = Window().partition_by("g").order_by("s").rows_between(-3, -2)
+    out = d.select(col("s"), col("v").sum().over(w).alias("sm")).sort("s").to_pydict()
+    assert out["sm"] == [None, None, 1.0]
+    w2 = Window().partition_by("g").order_by("s").rows_between(2, 4)
+    out2 = d.select(col("s"), col("v").sum().over(w2).alias("sm")).sort("s").to_pydict()
+    assert out2["sm"] == [3.0, None, None]
+
+
+def test_int64_precision_preserved():
+    big = 2**60
+    d = dt.from_pydict({"g": ["x", "x"], "v": [big, big + 1]})
+    w = Window().partition_by("g")
+    out = d.select(col("v").max().over(w).alias("m"), col("v").sum().over(w).alias("s")).to_pydict()
+    assert out["m"] == [big + 1] * 2
+    assert out["s"] == [2 * big + 1] * 2
+
+
+def test_first_value_respects_frame():
+    d = dt.from_pydict({"g": ["x"] * 3, "v": [1.0, 2.0, 3.0]})
+    w = Window().partition_by("g").order_by("v").rows_between(-1, 0)
+    out = d.select(col("v"), col("v").first_value().over(w).alias("f")).sort("v").to_pydict()
+    assert out["f"] == [1.0, 1.0, 2.0]
+
+
+def test_min_periods():
+    d = dt.from_pydict({"g": ["x"] * 3, "v": [1.0, 2.0, 3.0]})
+    w = Window().partition_by("g").order_by("v").rows_between(-2, 0, min_periods=3)
+    out = d.select(col("v"), col("v").sum().over(w).alias("s")).sort("v").to_pydict()
+    assert out["s"] == [None, None, 6.0]
+
+
+def test_null_dtype_window_agg():
+    d = dt.from_pydict({"k": ["a", "a"], "v": [None, None]})
+    out = d.select(col("v").mean().over(Window().partition_by("k")).alias("m")).to_pydict()
+    assert out["m"] == [None, None]
