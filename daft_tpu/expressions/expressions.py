@@ -728,11 +728,25 @@ class WindowExpr(Expression):
         return WindowExpr(self.func, self.child, self.spec, self.params, name)
 
     def children(self):
-        return [self.child] if self.child is not None else []
+        """Includes the spec's partition/order expressions so column-reference
+        analysis (pruning, SQL qualified-name resolution) sees them."""
+        out = [self.child] if self.child is not None else []
+        out.extend(self.spec.partition_by_exprs)
+        out.extend(self.spec.order_by_exprs)
+        return out
 
     def with_children(self, children):
-        return WindowExpr(self.func, children[0] if children else None, self.spec,
-                          self.params, self._out_name)
+        i = 0
+        child = None
+        if self.child is not None:
+            child = children[0]
+            i = 1
+        np_ = len(self.spec.partition_by_exprs)
+        no = len(self.spec.order_by_exprs)
+        spec = self.spec._copy()
+        spec.partition_by_exprs = list(children[i:i + np_])
+        spec.order_by_exprs = list(children[i + np_:i + np_ + no])
+        return WindowExpr(self.func, child, spec, self.params, self._out_name)
 
     def to_field(self, schema: Schema) -> Field:
         name = self.name()

@@ -419,6 +419,22 @@ def _utf8_repeat(args, kwargs):
 register("utf8_repeat", _rt_const(DataType.string()), _utf8_repeat)
 
 
+def _utf8_concat(args, kwargs):
+    a, b = args[0], args[1]
+
+    def k(x, y):
+        # null || anything = null (SQL concat semantics)
+        sx = pc.cast(x, pa.large_string()) if not pa.types.is_large_string(x.type) else x
+        sy = pc.cast(y, pa.large_string()) if not pa.types.is_large_string(y.type) else y
+        sep = pa.scalar("", type=pa.large_string())
+        return pc.binary_join_element_wise(sx, sy, sep)
+
+    return a._binary(b, k, out_dtype=DataType.string())
+
+
+register("utf8_concat", _rt_const(DataType.string()), _utf8_concat)
+
+
 def _like_to_regex(pattern: str, case_insensitive: bool) -> re.Pattern:
     out = []
     for ch in pattern:

@@ -1,0 +1,64 @@
+"""Session: attached catalogs, temp tables, and SQL bindings.
+
+Reference parity: daft/session.py:84 + src/daft-session/src/session.rs:24. The
+session is the namespace `daft_tpu.sql()` resolves tables from; catalogs attach
+name → DataFrame/table providers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Session:
+    def __init__(self):
+        self._tables: Dict[str, Any] = {}
+        self._catalogs: Dict[str, Any] = {}
+
+    # ---- temp tables --------------------------------------------------------------
+    def create_temp_table(self, name: str, df: Any, replace: bool = True) -> None:
+        key = name.lower()
+        if not replace and key in self._tables:
+            raise ValueError(f"table {name!r} already exists")
+        self._tables[key] = df
+
+    def drop_temp_table(self, name: str) -> None:
+        self._tables.pop(name.lower(), None)
+
+    def get_table(self, name: str) -> Optional[Any]:
+        t = self._tables.get(name.lower())
+        if t is not None:
+            return t
+        if "." in name:
+            cat_name, rest = name.split(".", 1)
+            cat = self._catalogs.get(cat_name.lower())
+            if cat is not None:
+                return cat.load_table(rest)
+        return None
+
+    def list_tables(self) -> List[str]:
+        return sorted(self._tables)
+
+    # ---- catalogs -----------------------------------------------------------------
+    def attach_catalog(self, catalog: Any, alias: Optional[str] = None) -> None:
+        name = alias or getattr(catalog, "name", None) or "default"
+        self._catalogs[name.lower()] = catalog
+
+    def detach_catalog(self, alias: str) -> None:
+        self._catalogs.pop(alias.lower(), None)
+
+    # ---- sql ----------------------------------------------------------------------
+    def sql(self, query: str, **bindings):
+        from .sql import sql as _sql
+
+        return _sql(query, **bindings)
+
+
+_SESSION: Optional[Session] = None
+
+
+def current_session() -> Session:
+    global _SESSION
+    if _SESSION is None:
+        _SESSION = Session()
+    return _SESSION

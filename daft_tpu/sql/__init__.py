@@ -1,6 +1,14 @@
-"""SQL frontend (reference parity: src/daft-sql SQLPlanner + daft/sql/sql.py)."""
+"""SQL frontend (reference parity: src/daft-sql SQLPlanner + daft/sql/sql.py).
+
+The package module is itself callable — `daft_tpu.sql("SELECT ...")` works even
+though `daft_tpu.sql` is also the subpackage (import machinery binds the package
+as an attribute of daft_tpu, shadowing the api-level function).
+"""
 
 from __future__ import annotations
+
+import sys
+import types
 
 
 def sql(query: str, **bindings):
@@ -17,3 +25,11 @@ def sql_expr(text: str):
     except ImportError as e:
         raise NotImplementedError("SQL expression parser not built yet (see SQL milestone)") from e
     return parse_expression(text)
+
+
+class _CallableModule(types.ModuleType):
+    def __call__(self, query: str, **bindings):
+        return sql(query, **bindings)
+
+
+sys.modules[__name__].__class__ = _CallableModule

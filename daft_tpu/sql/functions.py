@@ -1,0 +1,163 @@
+"""SQL function name → Expression mapping.
+
+Reference parity: src/daft-sql/src/modules/* (per-domain SQL function modules
+binding SQL names onto the engine's ScalarUDF registry).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..expressions import Expression, col, lit
+from ..expressions.expressions import Cast, IfElse, Literal
+
+
+def _lit_val(e: Expression):
+    if isinstance(e, Literal):
+        return e.value
+    raise ValueError("expected a literal argument")
+
+
+def build_sql_function(fname: str, args: List[Expression]) -> Expression:
+    f = _SQL_FUNCS.get(fname)
+    if f is not None:
+        return f(args)
+    # fall through to the engine registry under the lowercase name
+    from ..functions.registry import has_function
+
+    lname = fname.lower()
+    if has_function(lname):
+        return args[0]._fn(lname, *args[1:])
+    raise ValueError(f"unknown SQL function {fname!r}")
+
+
+def _coalesce(args):
+    out = args[-1]
+    for a in reversed(args[:-1]):
+        out = IfElse(a.not_null(), a, out)
+    return out.alias(args[0].name())
+
+
+def _concat(args):
+    out = args[0]
+    for a in args[1:]:
+        out = out._fn("utf8_concat", a)
+    return out
+
+
+def _substr(args):
+    # SQL SUBSTR is 1-based; engine substr is 0-based
+    start = args[1] - lit(1)
+    length = args[2] if len(args) > 2 else None
+    if length is None:
+        return args[0]._fn("utf8_substr", start)
+    return args[0]._fn("utf8_substr", start, length)
+
+
+def _nullif(args):
+    return IfElse(args[0] == args[1], lit(None), args[0]).alias(args[0].name())
+
+
+def _ifnull(args):
+    return args[0].fill_null(args[1])
+
+
+def _if(args):
+    return IfElse(args[0], args[1], args[2])
+
+
+def _round(args):
+    decimals = int(_lit_val(args[1])) if len(args) > 1 else 0
+    return args[0].round(decimals)
+
+
+def _log(args):
+    if len(args) > 1:
+        # SQL LOG(base, x)
+        return args[1].log(float(_lit_val(args[0])))
+    return args[0].log()
+
+
+_SQL_FUNCS = {
+    "ABS": lambda a: a[0].abs(),
+    "CEIL": lambda a: a[0].ceil(),
+    "CEILING": lambda a: a[0].ceil(),
+    "FLOOR": lambda a: a[0].floor(),
+    "ROUND": _round,
+    "SQRT": lambda a: a[0].sqrt(),
+    "EXP": lambda a: a[0].exp(),
+    "LN": lambda a: a[0].log(),
+    "LOG": _log,
+    "LOG2": lambda a: a[0].log2(),
+    "LOG10": lambda a: a[0].log10(),
+    "POW": lambda a: a[0] ** a[1],
+    "POWER": lambda a: a[0] ** a[1],
+    "MOD": lambda a: a[0] % a[1],
+    "SIGN": lambda a: a[0].sign(),
+    "SIN": lambda a: a[0].sin(),
+    "COS": lambda a: a[0].cos(),
+    "TAN": lambda a: a[0].tan(),
+    "ATAN": lambda a: a[0].arctan(),
+    "ASIN": lambda a: a[0].arcsin(),
+    "ACOS": lambda a: a[0].arccos(),
+    "GREATEST": lambda a: _fold(a, lambda x, y: IfElse(x >= y, x, y)),
+    "LEAST": lambda a: _fold(a, lambda x, y: IfElse(x <= y, x, y)),
+    # strings
+    "LOWER": lambda a: a[0].str.lower(),
+    "UPPER": lambda a: a[0].str.upper(),
+    "LENGTH": lambda a: a[0].str.length(),
+    "CHAR_LENGTH": lambda a: a[0].str.length(),
+    "TRIM": lambda a: a[0]._fn("utf8_strip"),
+    "LTRIM": lambda a: a[0]._fn("utf8_lstrip"),
+    "RTRIM": lambda a: a[0]._fn("utf8_rstrip"),
+    "REVERSE": lambda a: a[0]._fn("utf8_reverse"),
+    "REPLACE": lambda a: a[0]._fn("utf8_replace", _lit_val(a[1]), _lit_val(a[2])),
+    "SUBSTR": _substr,
+    "SUBSTRING": _substr,
+    "LEFT": lambda a: a[0]._fn("utf8_left", a[1]),
+    "RIGHT": lambda a: a[0]._fn("utf8_right", a[1]),
+    "REPEAT": lambda a: a[0]._fn("utf8_repeat", a[1]),
+    "LPAD": lambda a: a[0]._fn("utf8_lpad", _lit_val(a[1]), _lit_val(a[2]) if len(a) > 2 else " "),
+    "RPAD": lambda a: a[0]._fn("utf8_rpad", _lit_val(a[1]), _lit_val(a[2]) if len(a) > 2 else " "),
+    "CONTAINS": lambda a: a[0]._fn("utf8_contains", _lit_val(a[1])),
+    "STARTS_WITH": lambda a: a[0]._fn("utf8_startswith", _lit_val(a[1])),
+    "ENDS_WITH": lambda a: a[0]._fn("utf8_endswith", _lit_val(a[1])),
+    "REGEXP_MATCH": lambda a: a[0]._fn("utf8_match", _lit_val(a[1])),
+    "SPLIT": lambda a: a[0]._fn("utf8_split", _lit_val(a[1])),
+    "CONCAT": _concat,
+    "CONCAT_WS": lambda a: _fold(a[1:], lambda x, y: x._fn("utf8_concat", a[0])._fn("utf8_concat", y)),
+    # conditionals
+    "COALESCE": _coalesce,
+    "NULLIF": _nullif,
+    "IFNULL": _ifnull,
+    "NVL": _ifnull,
+    "IF": _if,
+    "IIF": _if,
+    # temporal
+    "YEAR": lambda a: a[0]._fn("dt_year"),
+    "MONTH": lambda a: a[0]._fn("dt_month"),
+    "DAY": lambda a: a[0]._fn("dt_day"),
+    "HOUR": lambda a: a[0]._fn("dt_hour"),
+    "MINUTE": lambda a: a[0]._fn("dt_minute"),
+    "SECOND": lambda a: a[0]._fn("dt_second"),
+    "DAYOFWEEK": lambda a: a[0]._fn("dt_day_of_week"),
+    "DAYOFYEAR": lambda a: a[0]._fn("dt_day_of_year"),
+    "WEEKOFYEAR": lambda a: a[0]._fn("dt_week_of_year"),
+    "DATE_TRUNC": lambda a: a[1]._fn("dt_truncate", interval=f"1 {_lit_val(a[0])}"),
+    "TO_DATE": lambda a: a[0]._fn("utf8_to_date", _lit_val(a[1]) if len(a) > 1 else "%Y-%m-%d"),
+    "DATE": lambda a: Cast(a[0], __import__("daft_tpu.datatype", fromlist=["DataType"]).DataType.date()),
+    # list
+    "ARRAY_LENGTH": lambda a: a[0]._fn("list_length"),
+    "LIST_CONTAINS": lambda a: a[0]._fn("list_contains", a[1]),
+    "ARRAY_CONTAINS": lambda a: a[0]._fn("list_contains", a[1]),
+    # misc
+    "HASH": lambda a: a[0].hash(),
+    "MINHASH": lambda a: a[0].minhash(),
+}
+
+
+def _fold(args, f):
+    out = args[0]
+    for a in args[1:]:
+        out = f(out, a)
+    return out

@@ -1,0 +1,686 @@
+"""SQL parser: tokens → Expression IR + a small SELECT-statement AST.
+
+Reference parity: src/daft-sql/src/planner.rs (expression/statement planning over
+the sqlparser AST); here parsing builds our Expression nodes directly via a Pratt
+parser, and SELECT structure lands in Select/TableRef dataclasses for the planner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..datatype import DataType
+from ..expressions import Expression, col, lit
+from ..expressions.expressions import AggExpr, Alias, Between, BinaryOp, Cast, IfElse, IsIn, UnaryOp, _UnboundWindowFn
+from .tokenizer import Token, tokenize
+
+_KEYWORDS_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION", "ALL",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON", "AS", "BY",
+    "ASC", "DESC", "NULLS", "FIRST", "LAST", "AND", "OR", "NOT", "THEN", "ELSE",
+    "END", "WHEN", "SELECT", "DISTINCT", "WITH", "USING", "SEMI", "ANTI", "INTERSECT", "EXCEPT",
+}
+
+# binding powers for binary operators (Pratt)
+_BP = {
+    "OR": 10,
+    "AND": 20,
+    "=": 40, "==": 40, "<>": 40, "!=": 40, "<": 40, "<=": 40, ">": 40, ">=": 40,
+    "LIKE": 40, "ILIKE": 40, "IN": 40, "BETWEEN": 40, "IS": 40,
+    "||": 50,
+    "+": 60, "-": 60,
+    "*": 70, "/": 70, "%": 70,
+    "^": 80,
+    "::": 90,
+}
+
+_AGG_FUNCS = {
+    "SUM": "sum", "AVG": "mean", "MEAN": "mean", "MIN": "min", "MAX": "max",
+    "COUNT": "count", "STDDEV": "stddev", "STDDEV_SAMP": "stddev", "VAR": "var",
+    "VARIANCE": "var", "ANY_VALUE": "any_value", "SKEW": "skew",
+    "BOOL_AND": "bool_and", "BOOL_OR": "bool_or",
+    "APPROX_COUNT_DISTINCT": "approx_count_distinct",
+    "LIST_AGG": "list", "ARRAY_AGG": "list",
+}
+
+_WINDOW_RANK_FUNCS = {"ROW_NUMBER", "RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST", "NTILE"}
+
+_TYPE_NAMES = {
+    "INT": DataType.int32, "INTEGER": DataType.int32, "INT4": DataType.int32,
+    "BIGINT": DataType.int64, "INT8": DataType.int64, "SMALLINT": DataType.int16,
+    "TINYINT": DataType.int8, "FLOAT": DataType.float32, "REAL": DataType.float32,
+    "DOUBLE": DataType.float64, "FLOAT8": DataType.float64, "FLOAT4": DataType.float32,
+    "TEXT": DataType.string, "STRING": DataType.string, "VARCHAR": DataType.string,
+    "BOOL": DataType.bool, "BOOLEAN": DataType.bool, "DATE": DataType.date,
+    "BINARY": DataType.binary, "BYTES": DataType.binary,
+}
+
+
+@dataclasses.dataclass
+class SelectItem:
+    expr: Optional[Expression]   # None for wildcard
+    alias: Optional[str]
+    wildcard: bool = False
+    qualifier: Optional[str] = None  # t.* wildcard
+
+
+@dataclasses.dataclass
+class TableFactor:
+    name: Optional[str] = None          # table name
+    subquery: Optional["Select"] = None
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class JoinClause:
+    factor: TableFactor
+    kind: str                    # inner/left/right/outer/cross/semi/anti
+    on: Optional[Expression]
+    using: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class OrderItem:
+    expr: Expression
+    desc: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class Select:
+    items: List[SelectItem] = dataclasses.field(default_factory=list)
+    distinct: bool = False
+    from_table: Optional[TableFactor] = None
+    joins: List[JoinClause] = dataclasses.field(default_factory=list)
+    where: Optional[Expression] = None
+    group_by: List[Any] = dataclasses.field(default_factory=list)  # Expression | int position
+    having: Optional[Expression] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: Dict[str, "Select"] = dataclasses.field(default_factory=dict)
+    set_ops: List[Tuple[str, "Select"]] = dataclasses.field(default_factory=list)  # (op, rhs)
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.tokens = tokenize(text)
+        self.i = 0
+
+    # ---- token helpers -----------------------------------------------------------
+    def peek(self, off: int = 0) -> Token:
+        j = min(self.i + off, len(self.tokens) - 1)
+        return self.tokens[j]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper() in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            raise ValueError(f"expected {kw} at position {self.peek().pos}, got {self.peek().value!r}")
+
+    def at(self, kind: str, value: Optional[str] = None) -> bool:
+        t = self.peek()
+        return t.kind == kind and (value is None or t.value == value)
+
+    def eat(self, kind: str, value: Optional[str] = None) -> bool:
+        if self.at(kind, value):
+            self.next()
+            return True
+        return False
+
+    def expect(self, kind: str, value: Optional[str] = None) -> Token:
+        if not self.at(kind, value):
+            t = self.peek()
+            raise ValueError(f"expected {value or kind} at position {t.pos}, got {t.value!r}")
+        return self.next()
+
+    # ---- expressions --------------------------------------------------------------
+    def parse_expr(self, min_bp: int = 0) -> Expression:
+        lhs = self._prefix()
+        while True:
+            t = self.peek()
+            opname = None
+            if t.kind == "op" and t.value in _BP:
+                opname = t.value
+            elif t.kind == "ident" and t.upper() in ("AND", "OR", "LIKE", "ILIKE", "IN", "BETWEEN", "IS", "NOT"):
+                opname = t.upper()
+            if opname is None:
+                return lhs
+            if opname == "NOT":
+                # NOT IN / NOT LIKE / NOT BETWEEN
+                nxt = self.peek(1)
+                if not (nxt.kind == "ident" and nxt.upper() in ("IN", "LIKE", "ILIKE", "BETWEEN")):
+                    return lhs
+                if _BP[nxt.upper()] < min_bp:
+                    return lhs
+                self.next()  # NOT
+                inner_op = self.next().upper()
+                lhs = ~self._postfix_op(lhs, inner_op)
+                continue
+            bp = _BP[opname]
+            if bp < min_bp:
+                return lhs
+            self.next()
+            if opname in ("LIKE", "ILIKE", "IN", "BETWEEN", "IS"):
+                lhs = self._postfix_op(lhs, opname)
+                continue
+            if opname == "::":
+                lhs = Cast(lhs, self._parse_type())
+                continue
+            rhs = self.parse_expr(bp + 1)
+            lhs = self._binary(opname, lhs, rhs)
+
+    def _binary(self, op: str, l: Expression, r: Expression) -> Expression:
+        if op == "OR":
+            return l | r
+        if op == "AND":
+            return l & r
+        if op in ("=", "=="):
+            return l == r
+        if op in ("<>", "!="):
+            return l != r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        if op == "%":
+            return l % r
+        if op == "^":
+            return l ** r
+        if op == "||":
+            return l._fn("utf8_concat", r)
+        raise ValueError(f"unhandled operator {op}")
+
+    def _postfix_op(self, lhs: Expression, op: str) -> Expression:
+        if op in ("LIKE", "ILIKE"):
+            pattern = self.parse_expr(_BP["LIKE"] + 1)
+            fname = "utf8_like" if op == "LIKE" else "utf8_ilike"
+            from ..expressions.expressions import Literal
+
+            if not isinstance(pattern, Literal):
+                raise ValueError("LIKE pattern must be a string literal")
+            return lhs._fn(fname, pattern.value)
+        if op == "IN":
+            self.expect("punct", "(")
+            if self.at_kw("SELECT"):
+                raise NotImplementedError("IN (subquery) not supported yet")
+            items = [self.parse_expr()]
+            while self.eat("punct", ","):
+                items.append(self.parse_expr())
+            self.expect("punct", ")")
+            return IsIn(lhs, items)
+        if op == "BETWEEN":
+            lo = self.parse_expr(_BP["BETWEEN"] + 1)
+            self.expect_kw("AND")
+            hi = self.parse_expr(_BP["BETWEEN"] + 1)
+            return Between(lhs, lo, hi)
+        if op == "IS":
+            negate = self.eat_kw("NOT")
+            if self.eat_kw("NULL"):
+                return lhs.not_null() if negate else lhs.is_null()
+            if self.eat_kw("TRUE"):
+                e = lhs == lit(True)
+                return ~e if negate else e
+            if self.eat_kw("FALSE"):
+                e = lhs == lit(False)
+                return ~e if negate else e
+            raise ValueError("expected NULL/TRUE/FALSE after IS")
+        raise ValueError(op)
+
+    def _prefix(self) -> Expression:
+        t = self.peek()
+        if t.kind == "op" and t.value == "-":
+            self.next()
+            return -self.parse_expr(65)
+        if t.kind == "op" and t.value == "+":
+            self.next()
+            return self.parse_expr(65)
+        if t.kind == "ident" and t.upper() == "NOT":
+            self.next()
+            return ~self.parse_expr(25)
+        if t.kind == "punct" and t.value == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect("punct", ")")
+            return e
+        if t.kind == "number":
+            self.next()
+            txt = t.value
+            if "." in txt or "e" in txt or "E" in txt:
+                return lit(float(txt))
+            return lit(int(txt))
+        if t.kind == "string":
+            self.next()
+            return lit(t.value)
+        if t.kind == "ident":
+            up = t.upper()
+            if up == "NULL":
+                self.next()
+                return lit(None)
+            if up == "TRUE":
+                self.next()
+                return lit(True)
+            if up == "FALSE":
+                self.next()
+                return lit(False)
+            if up == "CASE":
+                return self._parse_case()
+            if up == "CAST":
+                self.next()
+                self.expect("punct", "(")
+                e = self.parse_expr()
+                self.expect_kw("AS")
+                dt = self._parse_type()
+                self.expect("punct", ")")
+                return Cast(e, dt)
+            if up == "INTERVAL":
+                raise NotImplementedError("INTERVAL literals not supported yet")
+            # function call?
+            if self.peek(1).kind == "punct" and self.peek(1).value == "(":
+                return self._parse_function_call()
+            # qualified / bare column
+            self.next()
+            name = t.value
+            if self.eat("punct", "."):
+                if self.at("op", "*"):
+                    raise ValueError("qualified wildcard only allowed in SELECT list")
+                sub = self.expect("ident").value
+                return col(f"{name}.{sub}")
+            return col(name)
+        raise ValueError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def _parse_case(self) -> Expression:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        branches = []
+        while self.eat_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            val = self.parse_expr()
+            if operand is not None:
+                cond = operand == cond
+            branches.append((cond, val))
+        default = lit(None)
+        if self.eat_kw("ELSE"):
+            default = self.parse_expr()
+        self.expect_kw("END")
+        out = default
+        for cond, val in reversed(branches):
+            out = IfElse(cond, val, out)
+        return out
+
+    def _parse_type(self) -> DataType:
+        t = self.expect("ident")
+        up = t.upper()
+        if up in _TYPE_NAMES:
+            # swallow optional (n) length params
+            if self.eat("punct", "("):
+                while not self.eat("punct", ")"):
+                    self.next()
+            return _TYPE_NAMES[up]()
+        if up == "DECIMAL" or up == "NUMERIC":
+            prec, scale = 38, 10
+            if self.eat("punct", "("):
+                prec = int(self.expect("number").value)
+                if self.eat("punct", ","):
+                    scale = int(self.expect("number").value)
+                self.expect("punct", ")")
+            return DataType.decimal128(prec, scale)
+        if up == "TIMESTAMP":
+            return DataType.timestamp("us")
+        raise ValueError(f"unknown type {t.value!r}")
+
+    def _parse_function_call(self) -> Expression:
+        name_tok = self.next()
+        fname = name_tok.upper()
+        self.expect("punct", "(")
+
+        distinct = False
+        star = False
+        args: List[Expression] = []
+        if self.at("op", "*"):
+            self.next()
+            star = True
+        elif not self.at("punct", ")"):
+            if self.eat_kw("DISTINCT"):
+                distinct = True
+            args.append(self.parse_expr())
+            while self.eat("punct", ","):
+                args.append(self.parse_expr())
+        self.expect("punct", ")")
+
+        expr = self._build_function(fname, args, star, distinct)
+
+        # OVER clause → window expression
+        if self.at_kw("OVER"):
+            self.next()
+            spec = self._parse_window_spec()
+            from ..expressions.expressions import Alias
+
+            inner, out_name = expr, None
+            if isinstance(inner, Alias):
+                out_name = inner._alias
+                inner = inner.child
+            if isinstance(inner, (AggExpr, _UnboundWindowFn)):
+                w = inner.over(spec)
+                return w.alias(out_name) if out_name else w
+            raise ValueError(f"{fname} cannot be used as a window function")
+        if isinstance(expr, _UnboundWindowFn):
+            raise ValueError(f"{fname}() requires an OVER clause")
+        return expr
+
+    def _parse_window_spec(self):
+        from ..window import Window
+
+        self.expect("punct", "(")
+        w = Window()
+        if self.eat_kw("PARTITION"):
+            self.expect_kw("BY")
+            parts = [self.parse_expr()]
+            while self.eat("punct", ","):
+                parts.append(self.parse_expr())
+            w = w.partition_by(*parts)
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            exprs, descs, nfs = [], [], []
+            while True:
+                e = self.parse_expr()
+                d = False
+                if self.eat_kw("DESC"):
+                    d = True
+                elif self.eat_kw("ASC"):
+                    d = False
+                nf = None
+                if self.eat_kw("NULLS"):
+                    if self.eat_kw("FIRST"):
+                        nf = True
+                    else:
+                        self.expect_kw("LAST")
+                        nf = False
+                exprs.append(e)
+                descs.append(d)
+                nfs.append(nf if nf is not None else d)
+                if not self.eat("punct", ","):
+                    break
+            w = w.order_by(*exprs, desc=descs, nulls_first=nfs)
+        if self.at_kw("ROWS", "RANGE"):
+            kind = self.next().upper()
+            lo, hi = self._parse_frame_bounds()
+            from ..window import Window as W
+
+            if kind == "ROWS":
+                w = w.rows_between(lo, hi)
+            else:
+                w = w.range_between(lo, hi)
+        self.expect("punct", ")")
+        return w
+
+    def _parse_frame_bounds(self):
+        from ..window import Window
+
+        def bound():
+            if self.eat_kw("UNBOUNDED"):
+                if self.eat_kw("PRECEDING"):
+                    return Window.unbounded_preceding
+                self.expect_kw("FOLLOWING")
+                return Window.unbounded_following
+            if self.eat_kw("CURRENT"):
+                self.expect_kw("ROW")
+                return 0
+            n = int(self.expect("number").value)
+            if self.eat_kw("PRECEDING"):
+                return -n
+            self.expect_kw("FOLLOWING")
+            return n
+
+        self.expect_kw("BETWEEN")
+        lo = bound()
+        self.expect_kw("AND")
+        hi = bound()
+        return lo, hi
+
+    def _build_function(self, fname: str, args: List[Expression], star: bool, distinct: bool) -> Expression:
+        from ..functions.registry import has_function
+        from .functions import build_sql_function
+
+        if fname in _AGG_FUNCS:
+            if fname == "COUNT":
+                if star:
+                    return AggExpr("count", lit(1), {"mode": "all"}).alias("count")
+                if distinct:
+                    return AggExpr("count_distinct", args[0])
+                return AggExpr("count", args[0], {"mode": "valid"})
+            if distinct:
+                raise ValueError(f"DISTINCT not supported for {fname}")
+            return AggExpr(_AGG_FUNCS[fname], args[0])
+        if fname in _WINDOW_RANK_FUNCS:
+            params = {"n": int(args[0].value)} if fname == "NTILE" and args else {}
+            return _UnboundWindowFn(fname.lower(), None, params)
+        if fname in ("LAG", "LEAD"):
+            from ..expressions.expressions import Literal
+
+            offset = 1
+            default = None
+            if len(args) > 1:
+                if not isinstance(args[1], Literal):
+                    raise ValueError(f"{fname} offset must be a literal integer")
+                offset = int(args[1].value)
+            if len(args) > 2:
+                if not isinstance(args[2], Literal):
+                    raise ValueError(f"{fname} default must be a literal")
+                default = args[2].value
+            return _UnboundWindowFn(fname.lower(), args[0], {"offset": offset, "default": default})
+        if fname in ("FIRST_VALUE", "LAST_VALUE"):
+            return _UnboundWindowFn(fname.lower(), args[0], {})
+        return build_sql_function(fname, args)
+
+    # ---- statements ---------------------------------------------------------------
+    def parse_statement(self) -> Select:
+        sel = self._parse_select()
+        if not self.at("eof") and not self.at("punct", ";"):
+            t = self.peek()
+            raise ValueError(f"unexpected trailing token {t.value!r} at {t.pos}")
+        return sel
+
+    def _parse_select(self) -> Select:
+        ctes: Dict[str, Select] = {}
+        if self.eat_kw("WITH"):
+            while True:
+                name = self.expect("ident").value
+                self.expect_kw("AS")
+                self.expect("punct", "(")
+                ctes[name.lower()] = self._parse_select()
+                self.expect("punct", ")")
+                if not self.eat("punct", ","):
+                    break
+        sel = self._parse_select_core()
+        sel.ctes = ctes
+        # set operations
+        while True:
+            if self.eat_kw("UNION"):
+                op = "union_all" if self.eat_kw("ALL") else "union"
+                sel.set_ops.append((op, self._parse_select_core()))
+            elif self.eat_kw("INTERSECT"):
+                sel.set_ops.append(("intersect", self._parse_select_core()))
+            elif self.eat_kw("EXCEPT"):
+                sel.set_ops.append(("except", self._parse_select_core()))
+            else:
+                break
+        # trailing order/limit apply to the whole compound
+        self._parse_order_limit(sel)
+        return sel
+
+    def _parse_select_core(self) -> Select:
+        self.expect_kw("SELECT")
+        sel = Select()
+        sel.distinct = self.eat_kw("DISTINCT")
+        while True:
+            sel.items.append(self._parse_select_item())
+            if not self.eat("punct", ","):
+                break
+        if self.eat_kw("FROM"):
+            sel.from_table = self._parse_table_factor()
+            while True:
+                j = self._try_parse_join()
+                if j is None:
+                    break
+                sel.joins.append(j)
+        if self.eat_kw("WHERE"):
+            sel.where = self.parse_expr()
+        if self.eat_kw("GROUP"):
+            self.expect_kw("BY")
+            while True:
+                if self.at("number"):
+                    sel.group_by.append(int(self.next().value))
+                else:
+                    sel.group_by.append(self.parse_expr())
+                if not self.eat("punct", ","):
+                    break
+        if self.eat_kw("HAVING"):
+            sel.having = self.parse_expr()
+        return sel
+
+    def _parse_order_limit(self, sel: Select) -> None:
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                if self.at("number"):
+                    e = int(self.next().value)
+                    item = OrderItem(e)  # position resolved by planner
+                else:
+                    item = OrderItem(self.parse_expr())
+                if self.eat_kw("DESC"):
+                    item.desc = True
+                else:
+                    self.eat_kw("ASC")
+                if self.eat_kw("NULLS"):
+                    if self.eat_kw("FIRST"):
+                        item.nulls_first = True
+                    else:
+                        self.expect_kw("LAST")
+                        item.nulls_first = False
+                sel.order_by.append(item)
+                if not self.eat("punct", ","):
+                    break
+        if self.eat_kw("LIMIT"):
+            sel.limit = int(self.expect("number").value)
+        if self.eat_kw("OFFSET"):
+            sel.offset = int(self.expect("number").value)
+
+    def _parse_select_item(self) -> SelectItem:
+        if self.at("op", "*"):
+            self.next()
+            return SelectItem(None, None, wildcard=True)
+        # t.* wildcard
+        if (self.peek().kind == "ident" and self.peek(1).kind == "punct" and self.peek(1).value == "."
+                and self.peek(2).kind == "op" and self.peek(2).value == "*"):
+            q = self.next().value
+            self.next()
+            self.next()
+            return SelectItem(None, None, wildcard=True, qualifier=q)
+        e = self.parse_expr()
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self.next().value
+        elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP and not self.at("eof"):
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    def _parse_table_factor(self) -> TableFactor:
+        if self.eat("punct", "("):
+            sub = self._parse_select()
+            self.expect("punct", ")")
+            alias = None
+            if self.eat_kw("AS"):
+                alias = self.next().value
+            elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP:
+                alias = self.next().value
+            return TableFactor(subquery=sub, alias=alias)
+        name = self.expect("ident").value
+        # dotted table names (catalog.schema.table)
+        while self.eat("punct", "."):
+            name += "." + self.expect("ident").value
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self.next().value
+        elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP:
+            alias = self.next().value
+        return TableFactor(name=name, alias=alias)
+
+    def _try_parse_join(self) -> Optional[JoinClause]:
+        kind = None
+        if self.eat_kw("CROSS"):
+            self.expect_kw("JOIN")
+            kind = "cross"
+        elif self.eat_kw("INNER"):
+            self.expect_kw("JOIN")
+            kind = "inner"
+        elif self.at_kw("LEFT", "RIGHT", "FULL"):
+            k = self.next().upper()
+            self.eat_kw("OUTER")
+            if self.eat_kw("SEMI"):
+                kind = "semi" if k == "LEFT" else "right_semi"
+            elif self.eat_kw("ANTI"):
+                kind = "anti" if k == "LEFT" else "right_anti"
+            else:
+                kind = {"LEFT": "left", "RIGHT": "right", "FULL": "outer"}[k]
+            self.expect_kw("JOIN")
+        elif self.eat_kw("JOIN"):
+            kind = "inner"
+        else:
+            return None
+        factor = self._parse_table_factor()
+        on = None
+        using = None
+        if kind != "cross":
+            if self.eat_kw("ON"):
+                on = self.parse_expr()
+            elif self.eat_kw("USING"):
+                self.expect("punct", "(")
+                using = [self.expect("ident").value]
+                while self.eat("punct", ","):
+                    using.append(self.expect("ident").value)
+                self.expect("punct", ")")
+        return JoinClause(factor, kind, on, using)
+
+
+def parse_expression(text: str) -> Expression:
+    p = Parser(text)
+    e = p.parse_expr()
+    if not p.at("eof"):
+        t = p.peek()
+        raise ValueError(f"unexpected trailing token {t.value!r} at {t.pos}")
+    return e
+
+
+def parse_select(text: str) -> Select:
+    return Parser(text).parse_statement()

@@ -1,0 +1,417 @@
+"""SQL planner: Select AST → DataFrame (LogicalPlanBuilder).
+
+Reference parity: src/daft-sql/src/planner.rs:113 (SQLPlanner::plan_sql) — table
+resolution from bindings/session, scope-based qualified-column resolution,
+equi-join key extraction from ON conjunctions, aggregate extraction with HAVING/
+ORDER BY rewriting, set operations, CTEs and subqueries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..expressions import Expression, col, lit
+from ..expressions.expressions import AggExpr, Alias, BinaryOp, ColumnRef, WindowExpr
+from .parser import JoinClause, OrderItem, Select, SelectItem, TableFactor, parse_select
+
+
+def plan_sql(query: str, bindings: Dict[str, Any]):
+    sel = parse_select(query)
+    return SQLPlanner(bindings).plan(sel)
+
+
+class Scope:
+    """Maps table aliases → {source column → current column name in the DataFrame}."""
+
+    def __init__(self):
+        self.tables: Dict[str, Dict[str, str]] = {}
+
+    def add(self, alias: Optional[str], columns: List[str], rename: Optional[Dict[str, str]] = None):
+        rename = rename or {}
+        m = {c: rename.get(c, c) for c in columns}
+        if alias:
+            self.tables[alias.lower()] = m
+
+    def resolve(self, name: str) -> str:
+        if "." in name:
+            t, c = name.split(".", 1)
+            tbl = self.tables.get(t.lower())
+            if tbl is None:
+                raise ValueError(f"unknown table alias {t!r}")
+            if c not in tbl:
+                raise ValueError(f"column {c!r} not found in table {t!r}")
+            return tbl[c]
+        return name
+
+    def columns_of(self, alias: str) -> List[str]:
+        tbl = self.tables.get(alias.lower())
+        if tbl is None:
+            raise ValueError(f"unknown table alias {alias!r}")
+        return list(tbl.values())
+
+
+class SQLPlanner:
+    def __init__(self, bindings: Dict[str, Any], ctes: Optional[Dict[str, Any]] = None):
+        self.bindings = bindings
+        self.cte_frames: Dict[str, Any] = dict(ctes or {})
+
+    # ---- table resolution ---------------------------------------------------------
+    def _resolve_table(self, name: str):
+        key = name.lower()
+        if key in self.cte_frames:
+            return self.cte_frames[key]
+        if name in self.bindings:
+            return self.bindings[name]
+        if key in self.bindings:
+            return self.bindings[key]
+        from ..session import current_session
+
+        t = current_session().get_table(name)
+        if t is not None:
+            return t
+        raise ValueError(f"unknown table {name!r}")
+
+    def _plan_factor(self, f: TableFactor, scope: Scope):
+        if f.subquery is not None:
+            df = SQLPlanner(self.bindings, self.cte_frames).plan(f.subquery)
+            scope.add(f.alias, df.column_names)
+            return df
+        df = self._resolve_table(f.name)
+        scope.add(f.alias or f.name, df.column_names)
+        return df
+
+    # ---- expression resolution ----------------------------------------------------
+    def _resolve_expr(self, e: Expression, scope: Scope) -> Expression:
+        def rewrite(node):
+            if isinstance(node, ColumnRef) and "." in node._name:
+                return ColumnRef(scope.resolve(node._name))
+            return None
+
+        return e.transform(rewrite)
+
+    # ---- main ---------------------------------------------------------------------
+    def plan(self, sel: Select):
+        from ..dataframe import DataFrame
+
+        # CTEs visible to this select and nested ones
+        planner = self
+        if sel.ctes:
+            planner = SQLPlanner(self.bindings, self.cte_frames)
+            for name, sub in sel.ctes.items():
+                planner.cte_frames[name] = SQLPlanner(self.bindings, planner.cte_frames).plan(sub)
+
+        df = planner._plan_core(sel)
+
+        for op, rhs in sel.set_ops:
+            rdf = planner._plan_core(rhs)
+            if op == "union_all":
+                df = df.concat(rdf)
+            elif op == "union":
+                df = df.concat(rdf).distinct()
+            elif op == "intersect":
+                df = df.intersect(rdf)
+            else:
+                df = df.except_distinct(rdf)
+
+        df = planner._apply_order_limit(df, sel)
+        return df
+
+    def _plan_core(self, sel: Select):
+        import daft_tpu as dt
+
+        scope = Scope()
+        if sel.from_table is None:
+            # SELECT без FROM: single-row literal table
+            df = dt.from_pydict({"__dummy__": [1]})
+        else:
+            df = self._plan_factor(sel.from_table, scope)
+
+        for j in sel.joins:
+            df = self._plan_join(df, j, scope)
+
+        if sel.where is not None:
+            df = df.where(self._resolve_expr(sel.where, scope))
+
+        # expand wildcards
+        items: List[SelectItem] = []
+        for it in sel.items:
+            if it.wildcard:
+                cols = scope.columns_of(it.qualifier) if it.qualifier else df.column_names
+                if not cols and sel.from_table is None:
+                    raise ValueError("SELECT * with no FROM")
+                for c in cols:
+                    items.append(SelectItem(col(c), None))
+            else:
+                items.append(SelectItem(self._resolve_expr(it.expr, scope), it.alias))
+
+        has_agg = any(self._contains_agg(it.expr) for it in items)
+        if sel.group_by or has_agg or (sel.having is not None):
+            df = self._plan_aggregate(df, sel, items, scope)
+        else:
+            # ORDER BY may reference source columns dropped by the projection:
+            # SQL scoping allows it, so sort before projecting in that case
+            if sel.order_by and not sel.set_ops:
+                out_names = {it.alias or it.expr.name() for it in items}
+                in_names = set(df.column_names)
+                needs_presort = any(
+                    not isinstance(o.expr, int)
+                    and any(c not in out_names for c in self._resolve_expr(o.expr, scope).referenced_columns())
+                    for o in sel.order_by
+                )
+                if needs_presort:
+                    alias_map = {it.alias: it.expr for it in items if it.alias}
+                    keys, descs, nfs = [], [], []
+                    for o in sel.order_by:
+                        if isinstance(o.expr, int):
+                            e = items[o.expr - 1].expr
+                        else:
+                            e = self._substitute_aliases(
+                                self._resolve_expr(o.expr, scope), alias_map, in_names
+                            )
+                        keys.append(e)
+                        descs.append(o.desc)
+                        nfs.append(o.nulls_first if o.nulls_first is not None else o.desc)
+                    df = df.sort(keys, descs, nfs)
+                    sel.order_by = []
+            df = df.select(*[self._item_expr(it) for it in items])
+
+        if sel.distinct:
+            df = df.distinct()
+        return df
+
+    def _substitute_aliases(self, e: Expression, alias_map: Dict[str, Expression], in_names) -> Expression:
+        def rw(node):
+            if isinstance(node, ColumnRef) and node._name not in in_names and node._name in alias_map:
+                return alias_map[node._name]
+            return None
+
+        return e.transform(rw)
+
+    def _item_expr(self, it: SelectItem) -> Expression:
+        e = it.expr
+        if it.alias:
+            e = e.alias(it.alias)
+        return e
+
+    def _contains_agg(self, e: Expression) -> bool:
+        if isinstance(e, WindowExpr):
+            return False  # windowed aggs are not grouping aggs; skip the subtree
+        if isinstance(e, AggExpr):
+            return True
+        return any(self._contains_agg(c) for c in e.children())
+
+    # ---- joins --------------------------------------------------------------------
+    def _plan_join(self, left_df, j: JoinClause, scope: Scope):
+        right_scope = Scope()
+        right_df = self._plan_factor(j.factor, right_scope)
+        right_alias = j.factor.alias or j.factor.name
+
+        if j.kind == "cross":
+            out = left_df.join(right_df, how="cross")
+            self._merge_scope_after_join(scope, right_scope, left_df, right_df, set())
+            return out
+
+        residual: Optional[Expression] = None
+        if j.using:
+            left_on = [col(c) for c in j.using]
+            right_on = [col(c) for c in j.using]
+        elif j.on is not None:
+            left_on, right_on, residual = self._extract_equi_keys(j.on, scope, right_scope, left_df, right_df)
+            if not left_on:
+                if j.kind != "inner":
+                    raise ValueError("non-equi join conditions only supported for INNER JOIN")
+                out = left_df.join(right_df, how="cross")
+                self._merge_scope_after_join(scope, right_scope, left_df, right_df, set())
+                joined_scope_expr = self._resolve_expr_joined(j.on, scope)
+                return out.where(joined_scope_expr)
+            if residual is not None and j.kind != "inner":
+                raise ValueError("residual join predicates only supported for INNER JOIN")
+        else:
+            raise ValueError("JOIN requires ON or USING")
+
+        how = {"right_semi": "semi", "right_anti": "anti"}.get(j.kind, j.kind)
+        if j.kind in ("right_semi", "right_anti"):
+            out = right_df.join(left_df, left_on=right_on, right_on=left_on, how=how)
+            scope.tables = right_scope.tables
+            return out
+        out = left_df.join(right_df, left_on=left_on, right_on=right_on, how=how)
+        merged = {r.name() for l, r in zip(left_on, right_on) if l.name() == r.name()}
+        if how in ("semi", "anti"):
+            return out
+        self._merge_scope_after_join(scope, right_scope, left_df, right_df, merged)
+        if residual is not None:
+            out = out.where(self._resolve_expr_joined(residual, scope))
+        return out
+
+    def _merge_scope_after_join(self, scope: Scope, right_scope: Scope, left_df, right_df, merged_keys):
+        left_names = set(left_df.column_names)
+        for alias, m in right_scope.tables.items():
+            out_m = {}
+            for src, cur in m.items():
+                if cur in merged_keys:
+                    out_m[src] = cur
+                elif cur in left_names:
+                    out_m[src] = f"right.{cur}"
+                else:
+                    out_m[src] = cur
+            scope.tables[alias] = out_m
+
+    def _resolve_expr_joined(self, e: Expression, scope: Scope) -> Expression:
+        return self._resolve_expr(e, scope)
+
+    def _extract_equi_keys(self, on: Expression, lscope: Scope, rscope: Scope, left_df, right_df):
+        """Split an ON condition into equi-join keys + residual predicate."""
+        left_cols = set(left_df.column_names)
+        right_cols = set(right_df.column_names)
+
+        conjuncts = self._split_and(on)
+        left_on: List[Expression] = []
+        right_on: List[Expression] = []
+        residual: Optional[Expression] = None
+
+        def side_of(name: str) -> Optional[str]:
+            if "." in name:
+                t = name.split(".", 1)[0].lower()
+                if t in lscope.tables:
+                    return "l"
+                if t in rscope.tables:
+                    return "r"
+                return None
+            inl = name in left_cols
+            inr = name in right_cols
+            if inl and not inr:
+                return "l"
+            if inr and not inl:
+                return "r"
+            return None
+
+        for c in conjuncts:
+            matched = False
+            if isinstance(c, BinaryOp) and c.op == "eq":
+                l, r = c.left, c.right
+                if isinstance(l, ColumnRef) and isinstance(r, ColumnRef):
+                    ls, rs = side_of(l._name), side_of(r._name)
+                    if ls == "l" and rs == "r":
+                        left_on.append(ColumnRef(lscope.resolve(l._name)))
+                        right_on.append(ColumnRef(rscope.resolve(r._name)))
+                        matched = True
+                    elif ls == "r" and rs == "l":
+                        left_on.append(ColumnRef(lscope.resolve(r._name)))
+                        right_on.append(ColumnRef(rscope.resolve(l._name)))
+                        matched = True
+            if not matched:
+                residual = c if residual is None else (residual & c)
+        return left_on, right_on, residual
+
+    def _split_and(self, e: Expression) -> List[Expression]:
+        if isinstance(e, BinaryOp) and e.op == "and":
+            return self._split_and(e.left) + self._split_and(e.right)
+        return [e]
+
+    # ---- aggregation --------------------------------------------------------------
+    def _plan_aggregate(self, df, sel: Select, items: List[SelectItem], scope: Scope):
+        # resolve group-by entries (positions refer to select items)
+        group_exprs: List[Expression] = []
+        for g in sel.group_by:
+            if isinstance(g, int):
+                group_exprs.append(items[g - 1].expr)
+            else:
+                group_exprs.append(self._resolve_expr(g, scope))
+
+        # give grouping expressions stable output names
+        named_groups: List[Tuple[str, Expression]] = [(g.name(), g) for g in group_exprs]
+
+        # collect distinct aggregations from select items + having + order by
+        agg_map: Dict[str, Tuple[str, AggExpr]] = {}
+
+        def collect(e: Expression):
+            for sub in e.walk():
+                if isinstance(sub, AggExpr):
+                    key = repr(sub)
+                    if key not in agg_map:
+                        agg_map[key] = (f"__agg_{len(agg_map)}", sub)
+
+        for it in items:
+            collect(it.expr)
+        if sel.having is not None:
+            collect(self._resolve_expr(sel.having, scope))
+        for o in sel.order_by:
+            if not isinstance(o.expr, int):
+                collect(self._resolve_expr(o.expr, scope))
+
+        aggs = [a.alias(internal) for internal, a in agg_map.values()]
+        gb = [g.alias(n) for n, g in named_groups]
+        df = df.groupby(*gb).agg(*aggs) if gb else df.agg(*aggs)
+
+        group_names = {repr(g): n for n, g in named_groups}
+
+        def replace(e: Expression) -> Expression:
+            def rw(node):
+                if isinstance(node, AggExpr):
+                    internal, _ = agg_map[repr(node)]
+                    return ColumnRef(internal)
+                r = group_names.get(repr(node))
+                if r is not None and not isinstance(node, ColumnRef):
+                    return ColumnRef(r)
+                return None
+
+            return e.transform(rw)
+
+        if sel.having is not None:
+            df = df.where(replace(self._resolve_expr(sel.having, scope)))
+
+        # rewrite ORDER BY in place so _apply_order_limit sees plain columns
+        for o in sel.order_by:
+            if not isinstance(o.expr, int):
+                o.expr = replace(self._resolve_expr(o.expr, scope))
+
+        final = []
+        for it in items:
+            e = replace(it.expr)
+            if it.alias:
+                e = e.alias(it.alias)
+            final.append(e)
+        out = df.select(*final)
+
+        # ORDER BY may reference internal agg columns not in the final projection;
+        # sort before dropping them when needed
+        order_needs_internal = any(
+            not isinstance(o.expr, int) and any(
+                isinstance(s, ColumnRef) and s._name.startswith("__agg_") for s in o.expr.walk()
+            )
+            for o in sel.order_by
+        )
+        if order_needs_internal:
+            keys = []
+            descs = []
+            nfs = []
+            for o in sel.order_by:
+                e = o.expr if not isinstance(o.expr, int) else final[o.expr - 1]
+                keys.append(e)
+                descs.append(o.desc)
+                nfs.append(o.nulls_first if o.nulls_first is not None else o.desc)
+            df = df.sort([k if isinstance(k, Expression) else col(k) for k in keys], descs, nfs)
+            out = df.select(*final)
+            sel.order_by = []
+        return out
+
+    # ---- order/limit ---------------------------------------------------------------
+    def _apply_order_limit(self, df, sel: Select):
+        if sel.order_by:
+            keys: List[Expression] = []
+            descs: List[bool] = []
+            nfs: List[bool] = []
+            out_names = df.column_names
+            for o in sel.order_by:
+                if isinstance(o.expr, int):
+                    keys.append(col(out_names[o.expr - 1]))
+                else:
+                    keys.append(o.expr)
+                descs.append(o.desc)
+                nfs.append(o.nulls_first if o.nulls_first is not None else o.desc)
+            df = df.sort(keys, descs, nfs)
+        if sel.offset is not None:
+            df = df.offset(sel.offset)
+        if sel.limit is not None:
+            df = df.limit(sel.limit)
+        return df

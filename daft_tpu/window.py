@@ -83,11 +83,23 @@ class Window:
         return w
 
     def __repr__(self) -> str:
+        # must capture EVERY semantic field: the optimizer merges specs by repr()
         parts = []
         if self.partition_by_exprs:
-            parts.append(f"partition_by={[e.name() for e in self.partition_by_exprs]}")
+            parts.append(f"partition_by={[repr(e) for e in self.partition_by_exprs]}")
         if self.order_by_exprs:
-            parts.append(f"order_by={[e.name() for e in self.order_by_exprs]}")
+            parts.append(
+                f"order_by={[repr(e) for e in self.order_by_exprs]}"
+                f" desc={self.descending} nulls_first={self.nulls_first}"
+            )
         if self.frame_type:
-            parts.append(f"{self.frame_type}=[{self.frame_start},{self.frame_end}]")
+            def b(x):
+                if x is Window.unbounded_preceding:
+                    return "unbounded_preceding"
+                if x is Window.unbounded_following:
+                    return "unbounded_following"
+                return str(x)
+
+            parts.append(f"{self.frame_type}=[{b(self.frame_start)},{b(self.frame_end)}]"
+                         f" min_periods={self.min_periods}")
         return "Window(" + ", ".join(parts) + ")"

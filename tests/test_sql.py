@@ -1,0 +1,191 @@
+"""SQL frontend tests (reference test model: tests/sql/*)."""
+
+import pytest
+
+import daft_tpu as dt
+
+
+@pytest.fixture
+def df():
+    return dt.from_pydict({
+        "k": ["x", "y", "x", "y", "x"],
+        "a": [1, 2, 3, 4, 5],
+        "b": [10.0, 20.0, 30.0, 40.0, 50.0],
+    })
+
+
+@pytest.fixture
+def d2():
+    return dt.from_pydict({"k": ["x", "z"], "v": [100, 200]})
+
+
+def test_select_project_filter(df):
+    out = dt.sql("SELECT a, b * 2 AS b2 FROM df WHERE a > 2", df=df).to_pydict()
+    assert out == {"a": [3, 4, 5], "b2": [60.0, 80.0, 100.0]}
+
+
+def test_group_by(df):
+    out = dt.sql("SELECT k, SUM(b) AS s, COUNT(*) AS n FROM df GROUP BY k ORDER BY k", df=df).to_pydict()
+    assert out == {"k": ["x", "y"], "s": [90.0, 60.0], "n": [3, 2]}
+
+
+def test_group_by_position_having(df):
+    out = dt.sql("SELECT k, SUM(b) AS s FROM df GROUP BY 1 HAVING SUM(b) > 70", df=df).to_pydict()
+    assert out == {"k": ["x"], "s": [90.0]}
+
+
+def test_join(df, d2):
+    out = dt.sql("SELECT df.k, a, v FROM df JOIN d2 ON df.k = d2.k ORDER BY a", df=df, d2=d2).to_pydict()
+    assert out == {"k": ["x", "x", "x"], "a": [1, 3, 5], "v": [100, 100, 100]}
+
+
+def test_left_join(df, d2):
+    out = dt.sql("SELECT df.k, v FROM df LEFT JOIN d2 ON df.k = d2.k ORDER BY a", df=df, d2=d2).to_pydict()
+    assert out["v"] == [100, None, 100, None, 100]
+
+
+def test_order_limit(df):
+    out = dt.sql("SELECT * FROM df ORDER BY a DESC LIMIT 2", df=df).to_pydict()
+    assert out["a"] == [5, 4]
+
+
+def test_order_by_source_column(df):
+    out = dt.sql("SELECT UPPER(k) AS ku FROM df WHERE k LIKE 'x%' ORDER BY a", df=df).to_pydict()
+    assert out == {"ku": ["X", "X", "X"]}
+
+
+def test_case_when(df):
+    out = dt.sql("SELECT CASE WHEN a > 3 THEN 'big' ELSE 'small' END AS size FROM df ORDER BY a", df=df).to_pydict()
+    assert out["size"] == ["small", "small", "small", "big", "big"]
+
+
+def test_cte(df):
+    out = dt.sql("WITH big AS (SELECT * FROM df WHERE a >= 3) SELECT COUNT(*) AS n FROM big", df=df).to_pydict()
+    assert out == {"n": [3]}
+
+
+def test_subquery(df):
+    out = dt.sql("SELECT a*a AS sq FROM (SELECT a FROM df WHERE a <= 3) t ORDER BY sq", df=df).to_pydict()
+    assert out["sq"] == [1, 4, 9]
+
+
+def test_window_in_sql(df):
+    out = dt.sql("SELECT a, ROW_NUMBER() OVER (PARTITION BY k ORDER BY a) AS rn FROM df ORDER BY a", df=df).to_pydict()
+    assert out["rn"] == [1, 1, 2, 2, 3]
+    out2 = dt.sql("SELECT a, SUM(b) OVER (ORDER BY a) AS rs FROM df ORDER BY a", df=df).to_pydict()
+    assert out2["rs"] == [10.0, 30.0, 60.0, 100.0, 150.0]
+
+
+def test_union(df):
+    out = dt.sql("SELECT a FROM df UNION ALL SELECT a FROM df ORDER BY a LIMIT 3", df=df).to_pydict()
+    assert out["a"] == [1, 1, 2]
+    out2 = dt.sql("SELECT k FROM df UNION SELECT k FROM df ORDER BY k", df=df).to_pydict()
+    assert out2["k"] == ["x", "y"]
+
+
+def test_in_between_not(df):
+    assert dt.sql("SELECT a FROM df WHERE a IN (1, 3, 9) ORDER BY a", df=df).to_pydict()["a"] == [1, 3]
+    assert dt.sql("SELECT a FROM df WHERE a NOT IN (1, 3, 9) ORDER BY a", df=df).to_pydict()["a"] == [2, 4, 5]
+    assert dt.sql("SELECT a FROM df WHERE a BETWEEN 2 AND 4 AND NOT k = 'y'", df=df).to_pydict()["a"] == [3]
+
+
+def test_string_ops(df):
+    out = dt.sql("SELECT k || '_s' AS kk FROM df LIMIT 1", df=df).to_pydict()
+    assert out == {"kk": ["x_s"]}
+    out2 = dt.sql("SELECT SUBSTR('hello', 2, 3) AS s").to_pydict()
+    assert out2 == {"s": ["ell"]}
+
+
+def test_scalar_functions():
+    out = dt.sql("SELECT ABS(-3) AS x, ROUND(2.567, 1) AS y, COALESCE(NULL, 7) AS z").to_pydict()
+    assert out["x"] == [3] and abs(out["y"][0] - 2.6) < 1e-9 and out["z"] == [7]
+
+
+def test_cast(df):
+    out = dt.sql("SELECT CAST(a AS DOUBLE) AS ad, a::BIGINT AS ab FROM df LIMIT 1", df=df).to_pydict()
+    assert out == {"ad": [1.0], "ab": [1]}
+
+
+def test_literal_select():
+    assert dt.sql("SELECT 1 + 2 AS three").to_pydict() == {"three": [3]}
+
+
+def test_is_null(df):
+    d = dt.from_pydict({"x": [1, None, 3]})
+    assert dt.sql("SELECT COUNT(*) AS n FROM d WHERE x IS NULL", d=d).to_pydict() == {"n": [1]}
+    assert dt.sql("SELECT COUNT(*) AS n FROM d WHERE x IS NOT NULL", d=d).to_pydict() == {"n": [2]}
+
+
+def test_count_distinct(df):
+    out = dt.sql("SELECT COUNT(DISTINCT k) AS n FROM df", df=df).to_pydict()
+    assert out == {"n": [2]}
+
+
+def test_agg_expression_arithmetic(df):
+    out = dt.sql("SELECT MAX(a) - MIN(a) AS spread FROM df", df=df).to_pydict()
+    assert out == {"spread": [4]}
+
+
+def test_session_temp_table(df):
+    from daft_tpu.session import current_session
+
+    current_session().create_temp_table("t_sql_test", df)
+    out = dt.sql("SELECT COUNT(*) AS n FROM t_sql_test").to_pydict()
+    assert out == {"n": [5]}
+    current_session().drop_temp_table("t_sql_test")
+
+
+def test_sql_expr():
+    e = dt.sql_expr("a + 1 > 2")
+    d = dt.from_pydict({"a": [0, 2, 5]})
+    assert d.where(e).to_pydict()["a"] == [2, 5]
+
+
+def test_using_join(df, d2):
+    out = dt.sql("SELECT k, v FROM df JOIN d2 USING (k) ORDER BY a", df=df, d2=d2).to_pydict()
+    assert out["v"] == [100, 100, 100]
+
+
+def test_cross_join():
+    a = dt.from_pydict({"x": [1, 2]})
+    b = dt.from_pydict({"y": ["p", "q"]})
+    out = dt.sql("SELECT x, y FROM a CROSS JOIN b ORDER BY x, y", a=a, b=b).to_pydict()
+    assert out == {"x": [1, 1, 2, 2], "y": ["p", "q", "p", "q"]}
+
+
+def test_count_star_over():
+    df = dt.from_pydict({"k": ["x", "y", "x"], "a": [1, 2, 3]})
+    out = dt.sql("SELECT COUNT(*) OVER (PARTITION BY t.k) AS c FROM df t", df=df).to_pydict()
+    assert sorted(out["c"]) == [1, 2, 2]
+
+
+def test_lag_non_literal_offset_rejected():
+    df = dt.from_pydict({"k": ["x"], "a": [1], "o": [2]})
+    with pytest.raises(ValueError, match="literal"):
+        dt.sql("SELECT LAG(a, o) OVER (PARTITION BY k ORDER BY a) AS l FROM df", df=df)
+
+
+def test_distinct_window_specs_not_merged():
+    from daft_tpu import Window, col
+
+    d = dt.from_pydict({"g": ["x"] * 4, "s": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]})
+    out = d.select(
+        col("s"),
+        col("v").sum().over(Window().partition_by("g").order_by("s")).alias("up"),
+        col("v").sum().over(Window().partition_by("g").order_by("s", desc=True)).alias("dn"),
+    ).sort("s").to_pydict()
+    assert out["up"] == [1.0, 3.0, 6.0, 10.0]
+    assert out["dn"] == [10.0, 9.0, 7.0, 4.0]
+
+
+def test_window_partition_col_survives_pruning(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from daft_tpu import Window, col
+
+    pq.write_table(pa.table({"g": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]}), tmp_path / "x.parquet")
+    out = dt.read_parquet(str(tmp_path)).select(
+        col("v").sum().over(Window().partition_by("g")).alias("s")
+    ).to_pydict()
+    assert sorted(out["s"]) == [3.0, 3.0, 3.0]
