@@ -1,9 +1,12 @@
-"""Benchmark harness: TPC-H Q1+Q6 on generated lineitem data.
+"""Benchmark: TPC-H wall-clock on generated lineitem data.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
-Baseline anchor (BASELINE.md): reference NativeRunner TPC-H; we report rows/sec
-through the full engine path (plan → optimize → translate → execute) for a
-Q1-shape grouped aggregation + Q6-shape filter-agg over SF~0.1-scale data.
+Metric = engine rows/sec through the full path (plan → optimize → translate →
+execute) over the BENCH_QUERIES subset (default: the 9 scan/join/agg-heavy
+queries 1,3,4,5,6,10,12,14,19 — the shape of the reference's Q1-Q10 benchmark;
+set BENCH_QUERIES=1,...,22 for the full suite): total lineitem rows touched per
+query run divided by total wall-clock. Baseline anchor: reference NativeRunner
+TPC-H throughput on server CPU (BASELINE.md §6), scaled to one chip.
 """
 
 from __future__ import annotations
@@ -13,87 +16,36 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 6_000_000))
-# reference anchor: Daft native runner sustains O(100M) rows/sec/core-group on
-# this shape on server CPU; per-chip target from BASELINE.json
+SF = float(os.environ.get("BENCH_SF", 0.1))
 BASELINE_ROWS_PER_SEC = 50e6
-
-
-def gen_lineitem(n: int):
-    rng = np.random.default_rng(42)
-    return {
-        "l_quantity": rng.uniform(1, 50, n).round(0),
-        "l_extendedprice": rng.uniform(900, 105000, n).round(2),
-        "l_discount": rng.uniform(0.0, 0.1, n).round(2),
-        "l_tax": rng.uniform(0.0, 0.08, n).round(2),
-        "l_returnflag": rng.choice(np.array(["A", "N", "R"]), n),
-        "l_linestatus": rng.choice(np.array(["F", "O"]), n),
-        "l_shipdate_days": rng.integers(8000, 10600, n),
-    }
+QUERIES = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,4,5,6,10,12,14,19").split(",")]
 
 
 def main() -> None:
-    import daft_tpu as dt
-    from daft_tpu import col
+    from benchmarking.tpch.datagen import load_dataframes
+    from benchmarking.tpch.queries import ALL_QUERIES
 
-    data = gen_lineitem(N_ROWS)
-    df = dt.from_pydict(data).collect()
+    tables = {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
+    n_lineitem = tables["lineitem"].count_rows()
 
-    # warmup (compile caches, etc.)
-    _ = run_q6(df, col)
-    _ = run_q1(df, col)
-
-    t0 = time.perf_counter()
-    run_q6(df, col)
-    t_q6 = time.perf_counter() - t0
+    # warmup (compile caches, group encoders)
+    for q in QUERIES:
+        ALL_QUERIES[q](tables).to_pydict()
 
     t0 = time.perf_counter()
-    run_q1(df, col)
-    t_q1 = time.perf_counter() - t0
+    for q in QUERIES:
+        ALL_QUERIES[q](tables).to_pydict()
+    elapsed = time.perf_counter() - t0
 
-    total_rows = 2 * N_ROWS
-    rows_per_sec = total_rows / (t_q1 + t_q6)
+    rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
-        "metric": "tpch_q1q6_rows_per_sec",
+        "metric": f"tpch_sf{SF}_{len(QUERIES)}q_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
     }))
-
-
-def run_q6(df, col):
-    return (
-        df.where(
-            (col("l_shipdate_days") >= 8766) & (col("l_shipdate_days") < 9131)
-            & (col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
-            & (col("l_quantity") < 24)
-        )
-        .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue"))
-        .to_pydict()
-    )
-
-
-def run_q1(df, col):
-    return (
-        df.where(col("l_shipdate_days") <= 10471)
-        .groupby("l_returnflag", "l_linestatus")
-        .agg(
-            col("l_quantity").sum().alias("sum_qty"),
-            col("l_extendedprice").sum().alias("sum_base_price"),
-            (col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("sum_disc_price"),
-            (col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax"))).sum().alias("sum_charge"),
-            col("l_quantity").mean().alias("avg_qty"),
-            col("l_extendedprice").mean().alias("avg_price"),
-            col("l_discount").mean().alias("avg_disc"),
-            col("l_quantity").count().alias("count_order"),
-        )
-        .sort(["l_returnflag", "l_linestatus"])
-        .to_pydict()
-    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,188 @@
+"""TPC-H data generation (synthetic, dbgen-free).
+
+Reference parity: benchmarking/tpch/ (which shells out to dbgen). Here tables are
+synthesized with deterministic numpy RNG following the public TPC-H schema and
+value domains (row counts scale with SF: lineitem ~= 6M * SF). Not bit-identical
+to dbgen output, but schema- and distribution-faithful enough for correctness
+cross-checks (vs pandas) and throughput benchmarks.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+EPOCH = datetime.date(1970, 1, 1)
+D_1992 = (datetime.date(1992, 1, 1) - EPOCH).days
+D_1998 = (datetime.date(1998, 12, 1) - EPOCH).days
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTIONS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+TYPES_P1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPES_P2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPES_P3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINERS_P1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINERS_P2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+
+
+def _dates(rng, n, lo=D_1992, hi=D_1998):
+    return rng.integers(lo, hi, n).astype("int32")
+
+
+def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
+    """Generate all 8 TPC-H tables as arrow tables."""
+    rng = np.random.default_rng(seed)
+
+    n_part = max(int(200_000 * sf), 20)
+    n_supp = max(int(10_000 * sf), 5)
+    n_cust = max(int(150_000 * sf), 15)
+    n_ord = max(int(1_500_000 * sf), 150)
+
+    region = pa.table({
+        "r_regionkey": pa.array(range(5), pa.int64()),
+        "r_name": REGIONS,
+        "r_comment": [f"region {r}" for r in REGIONS],
+    })
+
+    nation = pa.table({
+        "n_nationkey": pa.array(range(25), pa.int64()),
+        "n_name": [n for n, _ in NATIONS],
+        "n_regionkey": pa.array([r for _, r in NATIONS], pa.int64()),
+        "n_comment": [f"nation {n}" for n, _ in NATIONS],
+    })
+
+    p_types = [
+        f"{rng.choice(TYPES_P1)} {rng.choice(TYPES_P2)} {rng.choice(TYPES_P3)}"
+        for _ in range(n_part)
+    ]
+    part = pa.table({
+        "p_partkey": pa.array(range(1, n_part + 1), pa.int64()),
+        "p_name": [
+            f"{rng.choice(['green', 'blue', 'red', 'ivory', 'forest', 'lime', 'navy'])} "
+            f"{rng.choice(['green', 'blue', 'red', 'ivory', 'forest', 'lime', 'navy'])} part {i}"
+            for i in range(1, n_part + 1)
+        ],
+        "p_mfgr": [f"Manufacturer#{rng.integers(1, 6)}" for _ in range(n_part)],
+        "p_brand": [f"Brand#{rng.integers(1, 6)}{rng.integers(1, 6)}" for _ in range(n_part)],
+        "p_type": p_types,
+        "p_size": pa.array(rng.integers(1, 51, n_part), pa.int32()),
+        "p_container": [f"{rng.choice(CONTAINERS_P1)} {rng.choice(CONTAINERS_P2)}" for _ in range(n_part)],
+        "p_retailprice": pa.array(np.round(rng.uniform(900, 2000, n_part), 2)),
+        "p_comment": [f"part comment {i}" for i in range(n_part)],
+    })
+
+    supplier = pa.table({
+        "s_suppkey": pa.array(range(1, n_supp + 1), pa.int64()),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_address": [f"addr {i}" for i in range(n_supp)],
+        "s_nationkey": pa.array(rng.integers(0, 25, n_supp), pa.int64()),
+        "s_phone": [f"{rng.integers(10,35)}-{rng.integers(100,1000)}-{rng.integers(100,1000)}-{rng.integers(1000,10000)}" for _ in range(n_supp)],
+        "s_acctbal": pa.array(np.round(rng.uniform(-999.99, 9999.99, n_supp), 2)),
+        "s_comment": [
+            ("Customer Complaints " if rng.random() < 0.01 else "") + f"supplier comment {i}"
+            for i in range(n_supp)
+        ],
+    })
+
+    n_psupp = n_part * 4
+    ps_partkey = np.repeat(np.arange(1, n_part + 1), 4)
+    ps_suppkey = ((ps_partkey + np.tile(np.arange(4), n_part) * (n_supp // 4 + 1)) % n_supp) + 1
+    partsupp = pa.table({
+        "ps_partkey": pa.array(ps_partkey, pa.int64()),
+        "ps_suppkey": pa.array(ps_suppkey, pa.int64()),
+        "ps_availqty": pa.array(rng.integers(1, 10_000, n_psupp), pa.int32()),
+        "ps_supplycost": pa.array(np.round(rng.uniform(1.0, 1000.0, n_psupp), 2)),
+        "ps_comment": [f"ps comment {i}" for i in range(n_psupp)],
+    })
+
+    customer = pa.table({
+        "c_custkey": pa.array(range(1, n_cust + 1), pa.int64()),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_address": [f"caddr {i}" for i in range(n_cust)],
+        "c_nationkey": pa.array(rng.integers(0, 25, n_cust), pa.int64()),
+        "c_phone": [f"{rng.integers(10,35)}-{rng.integers(100,1000)}-{rng.integers(100,1000)}-{rng.integers(1000,10000)}" for _ in range(n_cust)],
+        "c_acctbal": pa.array(np.round(rng.uniform(-999.99, 9999.99, n_cust), 2)),
+        "c_mktsegment": [str(rng.choice(SEGMENTS)) for _ in range(n_cust)],
+        "c_comment": [f"customer comment {i}" for i in range(n_cust)],
+    })
+
+    o_orderdate = _dates(rng, n_ord, D_1992, D_1998 - 151)
+    orders = pa.table({
+        "o_orderkey": pa.array(range(1, n_ord + 1), pa.int64()),
+        "o_custkey": pa.array(rng.integers(1, n_cust + 1, n_ord), pa.int64()),
+        "o_orderstatus": [str(s) for s in rng.choice(np.array(["O", "F", "P"]), n_ord, p=[0.49, 0.49, 0.02])],
+        "o_totalprice": pa.array(np.round(rng.uniform(800, 500_000, n_ord), 2)),
+        "o_orderdate": pa.array(o_orderdate, pa.date32()),
+        "o_orderpriority": [str(rng.choice(PRIORITIES)) for _ in range(n_ord)],
+        "o_clerk": [f"Clerk#{rng.integers(1, 1001):09d}" for _ in range(n_ord)],
+        "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int32)),
+        "o_comment": [
+            ("special requests " if rng.random() < 0.02 else "") + f"order comment {i}"
+            for i in range(n_ord)
+        ],
+    })
+
+    lines_per_order = rng.integers(1, 8, n_ord)
+    n_line = int(lines_per_order.sum())
+    l_orderkey = np.repeat(np.arange(1, n_ord + 1), lines_per_order)
+    l_orderdate = np.repeat(o_orderdate, lines_per_order)
+    l_shipdate = l_orderdate + rng.integers(1, 122, n_line)
+    l_commitdate = l_orderdate + rng.integers(30, 91, n_line)
+    l_receiptdate = l_shipdate + rng.integers(1, 31, n_line)
+    l_quantity = rng.integers(1, 51, n_line).astype(np.float64)
+    l_extendedprice = np.round(l_quantity * rng.uniform(900, 2000, n_line) / 10, 2)
+    linenumber = np.concatenate([np.arange(1, c + 1) for c in lines_per_order]) if n_ord else np.empty(0, np.int64)
+
+    lineitem = pa.table({
+        "l_orderkey": pa.array(l_orderkey, pa.int64()),
+        "l_partkey": pa.array(rng.integers(1, n_part + 1, n_line), pa.int64()),
+        "l_suppkey": pa.array(rng.integers(1, n_supp + 1, n_line), pa.int64()),
+        "l_linenumber": pa.array(linenumber, pa.int32()),
+        "l_quantity": pa.array(l_quantity),
+        "l_extendedprice": pa.array(l_extendedprice),
+        "l_discount": pa.array(np.round(rng.uniform(0.0, 0.10, n_line), 2)),
+        "l_tax": pa.array(np.round(rng.uniform(0.0, 0.08, n_line), 2)),
+        "l_returnflag": [str(s) for s in rng.choice(np.array(["R", "A", "N"]), n_line)],
+        "l_linestatus": [str(s) for s in rng.choice(np.array(["O", "F"]), n_line)],
+        "l_shipdate": pa.array(l_shipdate.astype("int32"), pa.date32()),
+        "l_commitdate": pa.array(l_commitdate.astype("int32"), pa.date32()),
+        "l_receiptdate": pa.array(l_receiptdate.astype("int32"), pa.date32()),
+        "l_shipinstruct": [str(rng.choice(INSTRUCTIONS)) for _ in range(n_line)],
+        "l_shipmode": [str(rng.choice(SHIPMODES)) for _ in range(n_line)],
+        "l_comment": [f"line comment {i}" for i in range(n_line)],
+    })
+
+    return {
+        "region": region, "nation": nation, "part": part, "supplier": supplier,
+        "partsupp": partsupp, "customer": customer, "orders": orders, "lineitem": lineitem,
+    }
+
+
+def write_parquet(tables: Dict[str, pa.Table], root: str) -> None:
+    import pyarrow.parquet as pq
+
+    os.makedirs(root, exist_ok=True)
+    for name, t in tables.items():
+        pq.write_table(t, os.path.join(root, f"{name}.parquet"))
+
+
+def load_dataframes(sf: float = 0.01, seed: int = 0):
+    """Tables as in-memory daft_tpu DataFrames."""
+    import daft_tpu as dt
+
+    return {name: dt.from_arrow(t) for name, t in generate(sf, seed).items()}

@@ -1,0 +1,426 @@
+"""TPC-H Q1-Q22 as daft_tpu DataFrame programs.
+
+Reference parity: benchmarking/tpch/answers.py (dataframe-form queries). Queries
+follow the public TPC-H specification; correlated subqueries are expressed as
+join rewrites (the standard dataframe formulation).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+from daft_tpu import col, lit
+
+
+def _d(y, m, d):
+    return lit(datetime.date(y, m, d))
+
+
+def q1(t):
+    L = t["lineitem"]
+    return (
+        L.where(col("l_shipdate") <= _d(1998, 9, 2))
+        .groupby("l_returnflag", "l_linestatus")
+        .agg(
+            col("l_quantity").sum().alias("sum_qty"),
+            col("l_extendedprice").sum().alias("sum_base_price"),
+            (col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("sum_disc_price"),
+            (col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax"))).sum().alias("sum_charge"),
+            col("l_quantity").mean().alias("avg_qty"),
+            col("l_extendedprice").mean().alias("avg_price"),
+            col("l_discount").mean().alias("avg_disc"),
+            col("l_quantity").count().alias("count_order"),
+        )
+        .sort(["l_returnflag", "l_linestatus"])
+    )
+
+
+def q2(t):
+    P, S, PS, N, R = t["part"], t["supplier"], t["partsupp"], t["nation"], t["region"]
+    europe = (
+        R.where(col("r_name") == "EUROPE")
+        .join(N, left_on="r_regionkey", right_on="n_regionkey")
+        .join(S, left_on="n_nationkey", right_on="s_nationkey")
+        .join(PS, left_on="s_suppkey", right_on="ps_suppkey")
+    )
+    brass = P.where((col("p_size") == 15) & col("p_type").str.endswith("BRASS"))
+    merged = europe.join(brass, left_on="ps_partkey", right_on="p_partkey")
+    min_cost = merged.groupby("ps_partkey").agg(col("ps_supplycost").min().alias("min_cost"))
+    return (
+        merged.join(min_cost, on="ps_partkey")
+        .where(col("ps_supplycost") == col("min_cost"))
+        .select("s_acctbal", "s_name", "n_name", col("ps_partkey").alias("p_partkey"),
+                "p_mfgr", "s_address", "s_phone", "s_comment")
+        .sort(["s_acctbal", "n_name", "s_name", "p_partkey"], desc=[True, False, False, False])
+        .limit(100)
+    )
+
+
+def q3(t):
+    C, O, L = t["customer"], t["orders"], t["lineitem"]
+    return (
+        C.where(col("c_mktsegment") == "BUILDING")
+        .join(O, left_on="c_custkey", right_on="o_custkey")
+        .where(col("o_orderdate") < _d(1995, 3, 15))
+        .join(L, left_on="o_orderkey", right_on="l_orderkey")
+        .where(col("l_shipdate") > _d(1995, 3, 15))
+        .groupby(col("o_orderkey").alias("l_orderkey"), "o_orderdate", "o_shippriority")
+        .agg((col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("revenue"))
+        .select("l_orderkey", "revenue", "o_orderdate", "o_shippriority")
+        .sort(["revenue", "o_orderdate"], desc=[True, False])
+        .limit(10)
+    )
+
+
+def q4(t):
+    O, L = t["orders"], t["lineitem"]
+    late = L.where(col("l_commitdate") < col("l_receiptdate"))
+    return (
+        O.where((col("o_orderdate") >= _d(1993, 7, 1)) & (col("o_orderdate") < _d(1993, 10, 1)))
+        .join(late, left_on="o_orderkey", right_on="l_orderkey", how="semi")
+        .groupby("o_orderpriority")
+        .agg(col("o_orderkey").count().alias("order_count"))
+        .sort("o_orderpriority")
+    )
+
+
+def q5(t):
+    C, O, L, S, N, R = t["customer"], t["orders"], t["lineitem"], t["supplier"], t["nation"], t["region"]
+    return (
+        R.where(col("r_name") == "ASIA")
+        .join(N, left_on="r_regionkey", right_on="n_regionkey")
+        .join(C, left_on="n_nationkey", right_on="c_nationkey")
+        .join(O, left_on="c_custkey", right_on="o_custkey")
+        .where((col("o_orderdate") >= _d(1994, 1, 1)) & (col("o_orderdate") < _d(1995, 1, 1)))
+        .join(L, left_on="o_orderkey", right_on="l_orderkey")
+        # supplier must be in the same nation as the customer
+        .join(S, left_on=["l_suppkey", "n_nationkey"], right_on=["s_suppkey", "s_nationkey"])
+        .groupby("n_name")
+        .agg((col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("revenue"))
+        .sort("revenue", desc=True)
+    )
+
+
+def q6(t):
+    L = t["lineitem"]
+    return (
+        L.where(
+            (col("l_shipdate") >= _d(1994, 1, 1)) & (col("l_shipdate") < _d(1995, 1, 1))
+            & (col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
+            & (col("l_quantity") < 24)
+        )
+        .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue"))
+    )
+
+
+def q7(t):
+    C, O, L, S, N = t["customer"], t["orders"], t["lineitem"], t["supplier"], t["nation"]
+    n1 = N.select(col("n_nationkey").alias("supp_nationkey"), col("n_name").alias("supp_nation"))
+    n2 = N.select(col("n_nationkey").alias("cust_nationkey"), col("n_name").alias("cust_nation"))
+    return (
+        L.where((col("l_shipdate") >= _d(1995, 1, 1)) & (col("l_shipdate") <= _d(1996, 12, 31)))
+        .join(S, left_on="l_suppkey", right_on="s_suppkey")
+        .join(n1, left_on="s_nationkey", right_on="supp_nationkey")
+        .join(O, left_on="l_orderkey", right_on="o_orderkey")
+        .join(C, left_on="o_custkey", right_on="c_custkey")
+        .join(n2, left_on="c_nationkey", right_on="cust_nationkey")
+        .where(
+            ((col("supp_nation") == "FRANCE") & (col("cust_nation") == "GERMANY"))
+            | ((col("supp_nation") == "GERMANY") & (col("cust_nation") == "FRANCE"))
+        )
+        .with_column("l_year", col("l_shipdate").dt.year())
+        .with_column("volume", col("l_extendedprice") * (1 - col("l_discount")))
+        .groupby("supp_nation", "cust_nation", "l_year")
+        .agg(col("volume").sum().alias("revenue"))
+        .sort(["supp_nation", "cust_nation", "l_year"])
+    )
+
+
+def q8(t):
+    P, S, L, O, C, N, R = (t["part"], t["supplier"], t["lineitem"], t["orders"],
+                           t["customer"], t["nation"], t["region"])
+    n1 = N.select(col("n_nationkey").alias("cust_nationkey"), col("n_regionkey").alias("cust_regionkey"))
+    n2 = N.select(col("n_nationkey").alias("supp_nationkey"), col("n_name").alias("supp_nation"))
+    return (
+        P.where(col("p_type") == "ECONOMY ANODIZED STEEL")
+        .join(L, left_on="p_partkey", right_on="l_partkey")
+        .join(S, left_on="l_suppkey", right_on="s_suppkey")
+        .join(O, left_on="l_orderkey", right_on="o_orderkey")
+        .where((col("o_orderdate") >= _d(1995, 1, 1)) & (col("o_orderdate") <= _d(1996, 12, 31)))
+        .join(C, left_on="o_custkey", right_on="c_custkey")
+        .join(n1, left_on="c_nationkey", right_on="cust_nationkey")
+        .join(R.where(col("r_name") == "AMERICA"), left_on="cust_regionkey", right_on="r_regionkey")
+        .join(n2, left_on="s_nationkey", right_on="supp_nationkey")
+        .with_column("o_year", col("o_orderdate").dt.year())
+        .with_column("volume", col("l_extendedprice") * (1 - col("l_discount")))
+        .with_column("brazil_volume",
+                     (col("supp_nation") == "BRAZIL").if_else(col("volume"), lit(0.0)))
+        .groupby("o_year")
+        .agg(col("brazil_volume").sum().alias("brazil"), col("volume").sum().alias("total"))
+        .select(col("o_year"), (col("brazil") / col("total")).alias("mkt_share"))
+        .sort("o_year")
+    )
+
+
+def q9(t):
+    P, S, L, PS, O, N = (t["part"], t["supplier"], t["lineitem"], t["partsupp"],
+                         t["orders"], t["nation"])
+    return (
+        P.where(col("p_name").str.contains("green"))
+        .join(L, left_on="p_partkey", right_on="l_partkey")
+        .join(S, left_on="l_suppkey", right_on="s_suppkey")
+        .join(PS, left_on=["l_suppkey", "p_partkey"], right_on=["ps_suppkey", "ps_partkey"])
+        .join(O, left_on="l_orderkey", right_on="o_orderkey")
+        .join(N, left_on="s_nationkey", right_on="n_nationkey")
+        .with_column("o_year", col("o_orderdate").dt.year())
+        .with_column("amount",
+                     col("l_extendedprice") * (1 - col("l_discount"))
+                     - col("ps_supplycost") * col("l_quantity"))
+        .groupby(col("n_name").alias("nation"), "o_year")
+        .agg(col("amount").sum().alias("sum_profit"))
+        .sort(["nation", "o_year"], desc=[False, True])
+    )
+
+
+def q10(t):
+    C, O, L, N = t["customer"], t["orders"], t["lineitem"], t["nation"]
+    return (
+        O.where((col("o_orderdate") >= _d(1993, 10, 1)) & (col("o_orderdate") < _d(1994, 1, 1)))
+        .join(L.where(col("l_returnflag") == "R"), left_on="o_orderkey", right_on="l_orderkey")
+        .join(C, left_on="o_custkey", right_on="c_custkey")
+        .join(N, left_on="c_nationkey", right_on="n_nationkey")
+        .groupby(col("o_custkey").alias("c_custkey"), "c_name", "c_acctbal", "c_phone",
+                 "n_name", "c_address", "c_comment")
+        .agg((col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("revenue"))
+        .select("c_custkey", "c_name", "revenue", "c_acctbal", "n_name", "c_address",
+                "c_phone", "c_comment")
+        .sort(["revenue", "c_custkey"], desc=[True, False])
+        .limit(20)
+    )
+
+
+def q11(t):
+    PS, S, N = t["partsupp"], t["supplier"], t["nation"]
+    germany = (
+        N.where(col("n_name") == "GERMANY")
+        .join(S, left_on="n_nationkey", right_on="s_nationkey")
+        .join(PS, left_on="s_suppkey", right_on="ps_suppkey")
+        .with_column("value", col("ps_supplycost") * col("ps_availqty"))
+    )
+    total = germany.agg(col("value").sum().alias("total"))
+    by_part = germany.groupby("ps_partkey").agg(col("value").sum().alias("value"))
+    return (
+        by_part.join(total, how="cross")
+        .where(col("value") > col("total") * 0.0001)
+        .select("ps_partkey", "value")
+        .sort(["value", "ps_partkey"], desc=[True, False])
+    )
+
+
+def q12(t):
+    O, L = t["orders"], t["lineitem"]
+    high = col("o_orderpriority").is_in(["1-URGENT", "2-HIGH"])
+    return (
+        L.where(
+            col("l_shipmode").is_in(["MAIL", "SHIP"])
+            & (col("l_commitdate") < col("l_receiptdate"))
+            & (col("l_shipdate") < col("l_commitdate"))
+            & (col("l_receiptdate") >= _d(1994, 1, 1)) & (col("l_receiptdate") < _d(1995, 1, 1))
+        )
+        .join(O, left_on="l_orderkey", right_on="o_orderkey")
+        .with_column("high_line", high.if_else(lit(1), lit(0)))
+        .with_column("low_line", (~high).if_else(lit(1), lit(0)))
+        .groupby("l_shipmode")
+        .agg(col("high_line").sum().alias("high_line_count"),
+             col("low_line").sum().alias("low_line_count"))
+        .sort("l_shipmode")
+    )
+
+
+def q13(t):
+    C, O = t["customer"], t["orders"]
+    filtered = O.where(~col("o_comment").str.contains("special requests"))
+    per_cust = (
+        C.join(filtered, left_on="c_custkey", right_on="o_custkey", how="left")
+        .groupby("c_custkey")
+        .agg(col("o_orderkey").count().alias("c_count"))
+    )
+    return (
+        per_cust.groupby("c_count")
+        .agg(col("c_custkey").count().alias("custdist"))
+        .sort(["custdist", "c_count"], desc=[True, True])
+    )
+
+
+def q14(t):
+    L, P = t["lineitem"], t["part"]
+    return (
+        L.where((col("l_shipdate") >= _d(1995, 9, 1)) & (col("l_shipdate") < _d(1995, 10, 1)))
+        .join(P, left_on="l_partkey", right_on="p_partkey")
+        .with_column("revenue", col("l_extendedprice") * (1 - col("l_discount")))
+        .with_column("promo", col("p_type").str.startswith("PROMO").if_else(col("revenue"), lit(0.0)))
+        .agg(col("promo").sum().alias("promo_sum"), col("revenue").sum().alias("total_sum"))
+        .select((lit(100.0) * col("promo_sum") / col("total_sum")).alias("promo_revenue"))
+    )
+
+
+def q15(t):
+    L, S = t["lineitem"], t["supplier"]
+    revenue = (
+        L.where((col("l_shipdate") >= _d(1996, 1, 1)) & (col("l_shipdate") < _d(1996, 4, 1)))
+        .groupby(col("l_suppkey").alias("supplier_no"))
+        .agg((col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("total_revenue"))
+    )
+    max_rev = revenue.agg(col("total_revenue").max().alias("max_revenue"))
+    return (
+        revenue.join(max_rev, how="cross")
+        .where(col("total_revenue") == col("max_revenue"))
+        .join(S, left_on="supplier_no", right_on="s_suppkey")
+        .select(col("supplier_no").alias("s_suppkey"), "s_name", "s_address", "s_phone", "total_revenue")
+        .sort("s_suppkey")
+    )
+
+
+def q16(t):
+    PS, P, S = t["partsupp"], t["part"], t["supplier"]
+    complainers = S.where(col("s_comment").str.contains("Customer Complaints"))
+    return (
+        P.where(
+            (col("p_brand") != "Brand#45")
+            & ~col("p_type").str.startswith("MEDIUM POLISHED")
+            & col("p_size").is_in([49, 14, 23, 45, 19, 3, 36, 9])
+        )
+        .join(PS, left_on="p_partkey", right_on="ps_partkey")
+        .join(complainers, left_on="ps_suppkey", right_on="s_suppkey", how="anti")
+        .distinct("p_brand", "p_type", "p_size", "ps_suppkey")
+        .groupby("p_brand", "p_type", "p_size")
+        .agg(col("ps_suppkey").count().alias("supplier_cnt"))
+        .sort(["supplier_cnt", "p_brand", "p_type", "p_size"], desc=[True, False, False, False])
+    )
+
+
+def q17(t):
+    L, P = t["lineitem"], t["part"]
+    brand = P.where((col("p_brand") == "Brand#23") & (col("p_container") == "MED BOX"))
+    joined = L.join(brand, left_on="l_partkey", right_on="p_partkey")
+    avg_qty = (
+        joined.groupby(col("l_partkey").alias("avg_partkey"))
+        .agg(col("l_quantity").mean().alias("avg_quantity"))
+    )
+    return (
+        joined.join(avg_qty, left_on="l_partkey", right_on="avg_partkey")
+        .where(col("l_quantity") < 0.2 * col("avg_quantity"))
+        .agg(col("l_extendedprice").sum().alias("sum_extendedprice"))
+        .select((col("sum_extendedprice") / 7.0).alias("avg_yearly"))
+    )
+
+
+def q18(t):
+    C, O, L = t["customer"], t["orders"], t["lineitem"]
+    big = (
+        L.groupby("l_orderkey")
+        .agg(col("l_quantity").sum().alias("sum_qty"))
+        .where(col("sum_qty") > 300)
+    )
+    return (
+        O.join(big, left_on="o_orderkey", right_on="l_orderkey", how="semi")
+        .join(C, left_on="o_custkey", right_on="c_custkey")
+        .join(L, left_on="o_orderkey", right_on="l_orderkey")
+        .groupby("c_name", col("o_custkey").alias("c_custkey"), "o_orderkey",
+                 "o_orderdate", "o_totalprice")
+        .agg(col("l_quantity").sum().alias("col6"))
+        .sort(["o_totalprice", "o_orderdate"], desc=[True, False])
+        .limit(100)
+    )
+
+
+def q19(t):
+    L, P = t["lineitem"], t["part"]
+    joined = L.where(
+        col("l_shipmode").is_in(["AIR", "REG AIR"])
+        & (col("l_shipinstruct") == "DELIVER IN PERSON")
+    ).join(P, left_on="l_partkey", right_on="p_partkey")
+    sm = (col("p_brand") == "Brand#12") & col("p_container").is_in(
+        ["SM CASE", "SM BOX", "SM PACK", "SM PKG"]
+    ) & (col("l_quantity") >= 1) & (col("l_quantity") <= 11) & (col("p_size") <= 5)
+    med = (col("p_brand") == "Brand#23") & col("p_container").is_in(
+        ["MED BAG", "MED BOX", "MED PKG", "MED PACK"]
+    ) & (col("l_quantity") >= 10) & (col("l_quantity") <= 20) & (col("p_size") <= 10)
+    lg = (col("p_brand") == "Brand#34") & col("p_container").is_in(
+        ["LG CASE", "LG BOX", "LG PACK", "LG PKG"]
+    ) & (col("l_quantity") >= 20) & (col("l_quantity") <= 30) & (col("p_size") <= 15)
+    return (
+        joined.where((col("p_size") >= 1) & (sm | med | lg))
+        .agg((col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("revenue"))
+    )
+
+
+def q20(t):
+    S, N, PS, P, L = t["supplier"], t["nation"], t["partsupp"], t["part"], t["lineitem"]
+    forest_parts = P.where(col("p_name").str.startswith("forest"))
+    shipped = (
+        L.where((col("l_shipdate") >= _d(1994, 1, 1)) & (col("l_shipdate") < _d(1995, 1, 1)))
+        .groupby(col("l_partkey").alias("spk"), col("l_suppkey").alias("ssk"))
+        .agg(col("l_quantity").sum().alias("total_shipped"))
+    )
+    qualified = (
+        PS.join(forest_parts, left_on="ps_partkey", right_on="p_partkey", how="semi")
+        .join(shipped, left_on=["ps_partkey", "ps_suppkey"], right_on=["spk", "ssk"])
+        .where(col("ps_availqty") > 0.5 * col("total_shipped"))
+    )
+    return (
+        S.join(qualified, left_on="s_suppkey", right_on="ps_suppkey", how="semi")
+        .join(N.where(col("n_name") == "CANADA"), left_on="s_nationkey", right_on="n_nationkey", how="semi")
+        .select("s_name", "s_address")
+        .sort("s_name")
+    )
+
+
+def q21(t):
+    S, L, O, N = t["supplier"], t["lineitem"], t["orders"], t["nation"]
+    late = L.where(col("l_receiptdate") > col("l_commitdate"))
+    # orders with >1 distinct supplier
+    multi_supp = (
+        L.groupby("l_orderkey").agg(col("l_suppkey").count_distinct().alias("nsupp"))
+        .where(col("nsupp") > 1)
+    )
+    # orders where ONLY one supplier was late
+    single_late = (
+        late.groupby("l_orderkey").agg(col("l_suppkey").count_distinct().alias("nlate"))
+        .where(col("nlate") == 1)
+    )
+    return (
+        late.join(O.where(col("o_orderstatus") == "F"), left_on="l_orderkey", right_on="o_orderkey", how="semi")
+        .join(multi_supp, on="l_orderkey", how="semi")
+        .join(single_late, on="l_orderkey", how="semi")
+        .join(S, left_on="l_suppkey", right_on="s_suppkey")
+        .join(N.where(col("n_name") == "SAUDI ARABIA"), left_on="s_nationkey",
+              right_on="n_nationkey", how="semi")
+        .groupby("s_name")
+        .agg(col("l_orderkey").count().alias("numwait"))
+        .sort(["numwait", "s_name"], desc=[True, False])
+        .limit(100)
+    )
+
+
+def q22(t):
+    C, O = t["customer"], t["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    with_code = C.with_column("cntrycode", col("c_phone").str.left(2))
+    eligible = with_code.where(col("cntrycode").is_in(codes))
+    avg_bal = (
+        eligible.where(col("c_acctbal") > 0.0)
+        .agg(col("c_acctbal").mean().alias("avg_acctbal"))
+    )
+    return (
+        eligible.join(O, left_on="c_custkey", right_on="o_custkey", how="anti")
+        .join(avg_bal, how="cross")
+        .where(col("c_acctbal") > col("avg_acctbal"))
+        .groupby("cntrycode")
+        .agg(col("c_acctbal").count().alias("numcust"),
+             col("c_acctbal").sum().alias("totacctbal"))
+        .sort("cntrycode")
+    )
+
+
+ALL_QUERIES = {i: globals()[f"q{i}"] for i in range(1, 23)}

@@ -49,9 +49,10 @@ class Session:
 
     # ---- sql ----------------------------------------------------------------------
     def sql(self, query: str, **bindings):
-        from .sql import sql as _sql
+        """Plan SQL against THIS session's tables/catalogs (not the global one)."""
+        from .sql.planner import plan_sql
 
-        return _sql(query, **bindings)
+        return plan_sql(query, bindings, session=self)
 
 
 _SESSION: Optional[Session] = None

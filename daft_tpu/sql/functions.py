@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import List
 
+from ..datatype import DataType
 from ..expressions import Expression, col, lit
 from ..expressions.expressions import Cast, IfElse, Literal
 
@@ -145,7 +146,7 @@ _SQL_FUNCS = {
     "WEEKOFYEAR": lambda a: a[0]._fn("dt_week_of_year"),
     "DATE_TRUNC": lambda a: a[1]._fn("dt_truncate", interval=f"1 {_lit_val(a[0])}"),
     "TO_DATE": lambda a: a[0]._fn("utf8_to_date", _lit_val(a[1]) if len(a) > 1 else "%Y-%m-%d"),
-    "DATE": lambda a: Cast(a[0], __import__("daft_tpu.datatype", fromlist=["DataType"]).DataType.date()),
+    "DATE": lambda a: Cast(a[0], DataType.date()),
     # list
     "ARRAY_LENGTH": lambda a: a[0]._fn("list_length"),
     "LIST_CONTAINS": lambda a: a[0]._fn("list_contains", a[1]),

@@ -245,11 +245,12 @@ class Parser:
             negate = self.eat_kw("NOT")
             if self.eat_kw("NULL"):
                 return lhs.not_null() if negate else lhs.is_null()
+            # IS [NOT] TRUE/FALSE: three-valued — NULL IS TRUE = false (never null)
             if self.eat_kw("TRUE"):
-                e = lhs == lit(True)
+                e = lhs.eq_null_safe(lit(True))
                 return ~e if negate else e
             if self.eat_kw("FALSE"):
-                e = lhs == lit(False)
+                e = lhs.eq_null_safe(lit(False))
                 return ~e if negate else e
             raise ValueError("expected NULL/TRUE/FALSE after IS")
         raise ValueError(op)
@@ -300,6 +301,18 @@ class Parser:
                 dt = self._parse_type()
                 self.expect("punct", ")")
                 return Cast(e, dt)
+            if up == "DATE" and self.peek(1).kind == "string":
+                self.next()
+                import datetime as _dt
+
+                s = self.next().value
+                return lit(_dt.date.fromisoformat(s))
+            if up == "TIMESTAMP" and self.peek(1).kind == "string":
+                self.next()
+                import datetime as _dt
+
+                s = self.next().value
+                return lit(_dt.datetime.fromisoformat(s))
             if up == "INTERVAL":
                 raise NotImplementedError("INTERVAL literals not supported yet")
             # function call?

@@ -15,9 +15,9 @@ from ..expressions.expressions import AggExpr, Alias, BinaryOp, ColumnRef, Windo
 from .parser import JoinClause, OrderItem, Select, SelectItem, TableFactor, parse_select
 
 
-def plan_sql(query: str, bindings: Dict[str, Any]):
+def plan_sql(query: str, bindings: Dict[str, Any], session: Any = None):
     sel = parse_select(query)
-    return SQLPlanner(bindings).plan(sel)
+    return SQLPlanner(bindings, session=session).plan(sel)
 
 
 class Scope:
@@ -51,9 +51,11 @@ class Scope:
 
 
 class SQLPlanner:
-    def __init__(self, bindings: Dict[str, Any], ctes: Optional[Dict[str, Any]] = None):
+    def __init__(self, bindings: Dict[str, Any], ctes: Optional[Dict[str, Any]] = None,
+                 session: Any = None):
         self.bindings = bindings
         self.cte_frames: Dict[str, Any] = dict(ctes or {})
+        self.session = session
 
     # ---- table resolution ---------------------------------------------------------
     def _resolve_table(self, name: str):
@@ -66,14 +68,15 @@ class SQLPlanner:
             return self.bindings[key]
         from ..session import current_session
 
-        t = current_session().get_table(name)
+        sess = self.session if self.session is not None else current_session()
+        t = sess.get_table(name)
         if t is not None:
             return t
         raise ValueError(f"unknown table {name!r}")
 
     def _plan_factor(self, f: TableFactor, scope: Scope):
         if f.subquery is not None:
-            df = SQLPlanner(self.bindings, self.cte_frames).plan(f.subquery)
+            df = SQLPlanner(self.bindings, self.cte_frames, session=self.session).plan(f.subquery)
             scope.add(f.alias, df.column_names)
             return df
         df = self._resolve_table(f.name)
@@ -96,9 +99,9 @@ class SQLPlanner:
         # CTEs visible to this select and nested ones
         planner = self
         if sel.ctes:
-            planner = SQLPlanner(self.bindings, self.cte_frames)
+            planner = SQLPlanner(self.bindings, self.cte_frames, session=self.session)
             for name, sub in sel.ctes.items():
-                planner.cte_frames[name] = SQLPlanner(self.bindings, planner.cte_frames).plan(sub)
+                planner.cte_frames[name] = SQLPlanner(self.bindings, planner.cte_frames, session=self.session).plan(sub)
 
         df = planner._plan_core(sel)
 
@@ -121,7 +124,9 @@ class SQLPlanner:
 
         scope = Scope()
         if sel.from_table is None:
-            # SELECT без FROM: single-row literal table
+            if any(it.wildcard for it in sel.items):
+                raise ValueError("SELECT * requires a FROM clause")
+            # SELECT without FROM: single-row literal table
             df = dt.from_pydict({"__dummy__": [1]})
         else:
             df = self._plan_factor(sel.from_table, scope)
@@ -318,8 +323,20 @@ class SQLPlanner:
             else:
                 group_exprs.append(self._resolve_expr(g, scope))
 
-        # give grouping expressions stable output names
-        named_groups: List[Tuple[str, Expression]] = [(g.name(), g) for g in group_exprs]
+        # give grouping expressions stable output names: prefer the alias of a
+        # matching select item, and disambiguate colliding derived names
+        item_alias_by_repr = {repr(it.expr): it.alias for it in items if it.alias}
+        named_groups: List[Tuple[str, Expression]] = []
+        used_names: set = set()
+        for g in group_exprs:
+            name = item_alias_by_repr.get(repr(g)) or g.name()
+            if name in used_names:
+                i = 1
+                while f"{name}_{i}" in used_names:
+                    i += 1
+                name = f"{name}_{i}"
+            used_names.add(name)
+            named_groups.append((name, g))
 
         # collect distinct aggregations from select items + having + order by
         agg_map: Dict[str, Tuple[str, AggExpr]] = {}

@@ -1,0 +1,265 @@
+"""TPC-H correctness: cross-validate queries against independent pandas
+implementations on deterministic generated data (reference test model:
+tests/benchmarks/test_local_tpch.py vs golden answers)."""
+
+import datetime
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+from benchmarking.tpch.datagen import load_dataframes
+from benchmarking.tpch.queries import ALL_QUERIES
+
+
+@pytest.fixture(scope="module")
+def tables():
+    t = load_dataframes(sf=0.01, seed=0)
+    return {k: v.collect() for k, v in t.items()}
+
+
+@pytest.fixture(scope="module")
+def pdf(tables):
+    return {k: v.to_pandas() for k, v in tables.items()}
+
+
+def _close(a, b, tol=1e-6):
+    if a is None and b is None:
+        return True
+    if isinstance(a, float) or isinstance(b, float):
+        return abs(float(a) - float(b)) <= tol * max(1.0, abs(float(b)))
+    return a == b
+
+
+def assert_frame_matches(out: dict, expected: pd.DataFrame):
+    assert list(out.keys()) == list(expected.columns), (list(out.keys()), list(expected.columns))
+    n = len(next(iter(out.values()))) if out else 0
+    assert n == len(expected), f"row count {n} != {len(expected)}"
+    for c in expected.columns:
+        got = out[c]
+        exp = expected[c].tolist()
+        for i, (g, e) in enumerate(zip(got, exp)):
+            e = None if (isinstance(e, float) and np.isnan(e)) else e
+            assert _close(g, e), f"col {c} row {i}: {g} != {e}"
+
+
+def test_q1(tables, pdf):
+    out = ALL_QUERIES[1](tables).to_pydict()
+    L = pdf["lineitem"]
+    f = L[L.l_shipdate <= datetime.date(1998, 9, 2)].copy()
+    f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
+    f["charge"] = f.disc_price * (1 + f.l_tax)
+    g = f.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"),
+    ).sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert_frame_matches(out, g)
+
+
+def test_q3(tables, pdf):
+    out = ALL_QUERIES[3](tables).to_pydict()
+    C, O, L = pdf["customer"], pdf["orders"], pdf["lineitem"]
+    m = (
+        C[C.c_mktsegment == "BUILDING"]
+        .merge(O[O.o_orderdate < datetime.date(1995, 3, 15)], left_on="c_custkey", right_on="o_custkey")
+        .merge(L[L.l_shipdate > datetime.date(1995, 3, 15)], left_on="o_orderkey", right_on="l_orderkey")
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["o_orderkey", "o_orderdate", "o_shippriority"], as_index=False)
+        .agg(revenue=("revenue", "sum"))
+        .rename(columns={"o_orderkey": "l_orderkey"})
+        [["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10).reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q4(tables, pdf):
+    out = ALL_QUERIES[4](tables).to_pydict()
+    O, L = pdf["orders"], pdf["lineitem"]
+    late_orders = set(L[L.l_commitdate < L.l_receiptdate].l_orderkey)
+    f = O[
+        (O.o_orderdate >= datetime.date(1993, 7, 1))
+        & (O.o_orderdate < datetime.date(1993, 10, 1))
+        & O.o_orderkey.isin(late_orders)
+    ]
+    g = (
+        f.groupby("o_orderpriority", as_index=False)
+        .agg(order_count=("o_orderkey", "count"))
+        .sort_values("o_orderpriority").reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q5(tables, pdf):
+    out = ALL_QUERIES[5](tables).to_pydict()
+    C, O, L, S, N, R = (pdf["customer"], pdf["orders"], pdf["lineitem"],
+                        pdf["supplier"], pdf["nation"], pdf["region"])
+    m = (
+        R[R.r_name == "ASIA"]
+        .merge(N, left_on="r_regionkey", right_on="n_regionkey")
+        .merge(C, left_on="n_nationkey", right_on="c_nationkey")
+        .merge(O[(O.o_orderdate >= datetime.date(1994, 1, 1)) & (O.o_orderdate < datetime.date(1995, 1, 1))],
+               left_on="c_custkey", right_on="o_custkey")
+        .merge(L, left_on="o_orderkey", right_on="l_orderkey")
+        .merge(S, left_on=["l_suppkey", "n_nationkey"], right_on=["s_suppkey", "s_nationkey"])
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby("n_name", as_index=False).agg(revenue=("revenue", "sum"))
+        .sort_values("revenue", ascending=False).reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q6(tables, pdf):
+    out = ALL_QUERIES[6](tables).to_pydict()
+    L = pdf["lineitem"]
+    f = L[
+        (L.l_shipdate >= datetime.date(1994, 1, 1)) & (L.l_shipdate < datetime.date(1995, 1, 1))
+        & (L.l_discount >= 0.05) & (L.l_discount <= 0.07) & (L.l_quantity < 24)
+    ]
+    expected = (f.l_extendedprice * f.l_discount).sum()
+    assert _close(out["revenue"][0], expected)
+
+
+def test_q7(tables, pdf):
+    out = ALL_QUERIES[7](tables).to_pydict()
+    L, S, O, C, N = pdf["lineitem"], pdf["supplier"], pdf["orders"], pdf["customer"], pdf["nation"]
+    m = (
+        L[(L.l_shipdate >= datetime.date(1995, 1, 1)) & (L.l_shipdate <= datetime.date(1996, 12, 31))]
+        .merge(S, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(N.rename(columns={"n_nationkey": "snk", "n_name": "supp_nation"})[["snk", "supp_nation"]],
+               left_on="s_nationkey", right_on="snk")
+        .merge(O, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(C, left_on="o_custkey", right_on="c_custkey")
+        .merge(N.rename(columns={"n_nationkey": "cnk", "n_name": "cust_nation"})[["cnk", "cust_nation"]],
+               left_on="c_nationkey", right_on="cnk")
+    )
+    m = m[
+        ((m.supp_nation == "FRANCE") & (m.cust_nation == "GERMANY"))
+        | ((m.supp_nation == "GERMANY") & (m.cust_nation == "FRANCE"))
+    ].copy()
+    m["l_year"] = pd.to_datetime(m.l_shipdate).dt.year
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["supp_nation", "cust_nation", "l_year"], as_index=False)
+        .agg(revenue=("volume", "sum"))
+        .sort_values(["supp_nation", "cust_nation", "l_year"]).reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q10(tables, pdf):
+    out = ALL_QUERIES[10](tables).to_pydict()
+    C, O, L, N = pdf["customer"], pdf["orders"], pdf["lineitem"], pdf["nation"]
+    m = (
+        O[(O.o_orderdate >= datetime.date(1993, 10, 1)) & (O.o_orderdate < datetime.date(1994, 1, 1))]
+        .merge(L[L.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+        .merge(C, left_on="o_custkey", right_on="c_custkey")
+        .merge(N, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["o_custkey", "c_name", "c_acctbal", "c_phone", "n_name", "c_address", "c_comment"],
+                  as_index=False)
+        .agg(revenue=("revenue", "sum"))
+        .rename(columns={"o_custkey": "c_custkey"})
+        [["c_custkey", "c_name", "revenue", "c_acctbal", "n_name", "c_address", "c_phone", "c_comment"]]
+        .sort_values(["revenue", "c_custkey"], ascending=[False, True])
+        .head(20).reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q12(tables, pdf):
+    out = ALL_QUERIES[12](tables).to_pydict()
+    O, L = pdf["orders"], pdf["lineitem"]
+    f = L[
+        L.l_shipmode.isin(["MAIL", "SHIP"])
+        & (L.l_commitdate < L.l_receiptdate)
+        & (L.l_shipdate < L.l_commitdate)
+        & (L.l_receiptdate >= datetime.date(1994, 1, 1))
+        & (L.l_receiptdate < datetime.date(1995, 1, 1))
+    ].merge(O, left_on="l_orderkey", right_on="o_orderkey")
+    f["high"] = f.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    f["low"] = 1 - f.high
+    g = (
+        f.groupby("l_shipmode", as_index=False)
+        .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+        .sort_values("l_shipmode").reset_index(drop=True)
+    )
+    assert_frame_matches(out, g)
+
+
+def test_q14(tables, pdf):
+    out = ALL_QUERIES[14](tables).to_pydict()
+    L, P = pdf["lineitem"], pdf["part"]
+    m = L[
+        (L.l_shipdate >= datetime.date(1995, 9, 1)) & (L.l_shipdate < datetime.date(1995, 10, 1))
+    ].merge(P, left_on="l_partkey", right_on="p_partkey")
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    promo = m[m.p_type.str.startswith("PROMO")].revenue.sum()
+    expected = 100.0 * promo / m.revenue.sum()
+    assert _close(out["promo_revenue"][0], expected)
+
+
+def test_q17(tables, pdf):
+    out = ALL_QUERIES[17](tables).to_pydict()
+    L, P = pdf["lineitem"], pdf["part"]
+    brand = P[(P.p_brand == "Brand#23") & (P.p_container == "MED BOX")]
+    m = L.merge(brand, left_on="l_partkey", right_on="p_partkey")
+    avg = m.groupby("l_partkey").l_quantity.transform("mean")
+    expected = m[m.l_quantity < 0.2 * avg].l_extendedprice.sum() / 7.0
+    got = out["avg_yearly"][0]
+    if expected == 0:
+        assert got is None or got == 0
+    else:
+        assert _close(got, expected)
+
+
+def test_q18(tables, pdf):
+    out = ALL_QUERIES[18](tables).to_pydict()
+    L = pdf["lineitem"]
+    sums = L.groupby("l_orderkey").l_quantity.sum()
+    big = set(sums[sums > 300].index)
+    total_rows = len(out["o_orderkey"])
+    assert set(out["o_orderkey"]) <= big or total_rows == 0
+
+
+def test_q19(tables, pdf):
+    out = ALL_QUERIES[19](tables).to_pydict()
+    L, P = pdf["lineitem"], pdf["part"]
+    m = L[
+        L.l_shipmode.isin(["AIR", "REG AIR"]) & (L.l_shipinstruct == "DELIVER IN PERSON")
+    ].merge(P, left_on="l_partkey", right_on="p_partkey")
+    sm = (m.p_brand == "Brand#12") & m.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"]) \
+        & (m.l_quantity >= 1) & (m.l_quantity <= 11) & (m.p_size <= 5)
+    med = (m.p_brand == "Brand#23") & m.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"]) \
+        & (m.l_quantity >= 10) & (m.l_quantity <= 20) & (m.p_size <= 10)
+    lg = (m.p_brand == "Brand#34") & m.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"]) \
+        & (m.l_quantity >= 20) & (m.l_quantity <= 30) & (m.p_size <= 15)
+    f = m[(m.p_size >= 1) & (sm | med | lg)]
+    expected = (f.l_extendedprice * (1 - f.l_discount)).sum()
+    got = out["revenue"][0]
+    if len(f) == 0:
+        assert got is None
+    else:
+        assert _close(got, expected)
+
+
+def test_all_queries_run(tables):
+    for i, q in ALL_QUERIES.items():
+        out = q(tables).to_pydict()
+        assert isinstance(out, dict), f"Q{i}"
