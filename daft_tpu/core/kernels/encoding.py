@@ -57,6 +57,99 @@ def encode_column(series, domain_extra=None) -> np.ndarray:
     return codes
 
 
+# ======================================================================================
+# Equality-only fast path (hash-based, NOT order preserving)
+# ======================================================================================
+
+
+def equality_codes(series) -> np.ndarray:
+    """Compact int64 equality codes for one column, first-occurrence ordered;
+    null -> -1. Hash-based (arrow dictionary-encode / pandas factorize — both
+    C++), so no O(n log n) sort: this is the groupby/join/distinct fast path.
+    Floats: NaNs group together, -0.0 == 0.0 (bit-canonicalized)."""
+    import pandas as pd
+
+    dt = series.dtype
+    n = len(series)
+    valid = series.validity_numpy()
+    if dt.is_null():
+        return np.full(n, -1, dtype=np.int64)
+    if dt.is_numeric() and not dt.is_decimal() or dt.is_boolean() or dt.is_temporal():
+        vals = series.to_numpy()
+        if vals.dtype.kind == "f":
+            vals = (vals + 0.0).view(np.int64 if vals.dtype.itemsize == 8
+                                     else np.int32).astype(np.int64, copy=False)
+        elif vals.dtype == bool:
+            vals = vals.astype(np.int64)
+        codes = pd.factorize(vals)[0].astype(np.int64, copy=False)
+    elif dt.is_string() or dt.is_binary() or dt.is_decimal():
+        arr = series.to_arrow()
+        if hasattr(arr, "combine_chunks"):
+            arr = arr.combine_chunks()
+        de = arr.dictionary_encode()
+        codes = np.asarray(
+            de.indices.fill_null(-1).to_numpy(zero_copy_only=False)
+        ).astype(np.int64, copy=False)
+    else:
+        codes = pd.factorize(series.hash().to_numpy())[0].astype(np.int64, copy=False)
+    codes = codes.copy() if not codes.flags.writeable else codes
+    codes[~valid] = -1
+    return codes
+
+
+def combine_equality_codes(code_cols: List[np.ndarray]) -> np.ndarray:
+    """Combine per-column compact equality codes into one compact int64 code per
+    row, first-occurrence ordered. Pairwise (codes * domain + next) with a
+    re-factorize each step keeps values < n² (no overflow)."""
+    import pandas as pd
+
+    codes = code_cols[0]
+    if len(code_cols) == 1:
+        return codes.astype(np.int64, copy=False)
+    for c in code_cols[1:]:
+        g = int(c.max()) + 2 if len(c) else 2  # +2: shift both by 1 for the -1 null code
+        pair = (codes + 1) * g + (c + 1)
+        codes = pd.factorize(pair)[0].astype(np.int64, copy=False)
+    return codes
+
+
+def encode_keys_equality(key_series: list, other_side: Optional[list] = None):
+    """Like encode_keys but hash-based (equality semantics only).
+
+    Returns (codes, other_codes, any_null_mask, other_null_mask); combined codes
+    are compact and non-negative EXCEPT single-column all-null (-1) rows, which
+    keep their per-column -1 marker only in the null masks.
+    """
+    from ..series import Series
+
+    if other_side is None:
+        cols = [equality_codes(s) for s in key_series]
+        codes = combine_equality_codes(cols)
+        null_mask = np.zeros(len(codes), dtype=bool)
+        for c in cols:
+            null_mask |= c == -1
+        return codes, None, null_mask, None
+
+    lcols, rcols = [], []
+    for ls, rs in zip(key_series, other_side):
+        if ls.dtype != rs.dtype:
+            target = _common_key_dtype(ls.dtype, rs.dtype)
+            ls, rs = ls.cast(target), rs.cast(target)
+        both = Series.concat([ls.rename("k"), rs.rename("k")])
+        c = equality_codes(both)
+        lcols.append(c[: len(ls)])
+        rcols.append(c[len(ls):])
+    n_l = len(lcols[0])
+    joint = combine_equality_codes([np.concatenate([lc, rc]) for lc, rc in zip(lcols, rcols)])
+    lcodes, rcodes = joint[:n_l], joint[n_l:]
+    lnull = np.zeros(n_l, dtype=bool)
+    rnull = np.zeros(len(rcodes), dtype=bool)
+    for lc, rc in zip(lcols, rcols):
+        lnull |= lc == -1
+        rnull |= rc == -1
+    return lcodes, rcodes, lnull, rnull
+
+
 def combine_codes(code_cols: List[np.ndarray]) -> np.ndarray:
     """Combine per-column codes into one int64 code per row (order-preserving)."""
     if len(code_cols) == 1:

@@ -11,7 +11,7 @@ from typing import List, Tuple
 
 import numpy as np
 
-from .encoding import encode_keys
+from .encoding import encode_keys_equality
 
 
 def make_groups(key_series: list) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -21,18 +21,21 @@ def make_groups(key_series: list) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
       - first_occurrence_indices[g] = row index of the first row of group g
       - group_ids[i] = group of row i (0..G-1, ordered by first occurrence)
       - group_counts[g] = rows in group g
+
+    Hash-based (factorize), O(n): no sort anywhere on the group path.
     """
-    codes, _, _, _ = encode_keys(key_series)
+    import pandas as pd
+
+    codes, _, _, _ = encode_keys_equality(key_series)
     n = len(codes)
     if n == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64)
-    uniq, first_idx, inverse, counts = np.unique(codes, return_index=True, return_inverse=True, return_counts=True)
-    # reorder groups by first occurrence so output order is deterministic & stream-friendly
-    order = np.argsort(first_idx, kind="stable")
-    remap = np.empty_like(order)
-    remap[order] = np.arange(len(order))
-    group_ids = remap[inverse]
-    return first_idx[order].astype(np.int64), group_ids.astype(np.int64), counts[order].astype(np.int64)
+    # factorize assigns ids in first-occurrence order (null code -1 is a value here)
+    group_ids = pd.factorize(codes)[0].astype(np.int64, copy=False)
+    first_mask = ~pd.Series(group_ids).duplicated().to_numpy()
+    first_idx = np.flatnonzero(first_mask).astype(np.int64)
+    counts = np.bincount(group_ids).astype(np.int64)
+    return first_idx, group_ids, counts
 
 
 def group_row_indices(group_ids: np.ndarray, num_groups: int) -> List[np.ndarray]:

@@ -15,7 +15,7 @@ from typing import Tuple
 
 import numpy as np
 
-from .encoding import encode_keys
+from .encoding import encode_keys_equality
 
 
 def _expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
@@ -33,7 +33,7 @@ def join_indices(
     how: str = "inner",
     null_equals_null: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    lcodes, rcodes, lnull, rnull = encode_keys(left_keys, right_keys)
+    lcodes, rcodes, lnull, rnull = encode_keys_equality(left_keys, right_keys)
     assert rcodes is not None
 
     if not null_equals_null:
@@ -43,6 +43,7 @@ def join_indices(
         lcodes[lnull] = -2
         rcodes[rnull] = -3
 
+    # int64 stable argsort = numpy radix sort, O(n) on compact codes
     r_order = np.argsort(rcodes, kind="stable").astype(np.int64)
     r_sorted = rcodes[r_order]
     starts = np.searchsorted(r_sorted, lcodes, side="left")

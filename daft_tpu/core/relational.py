@@ -20,7 +20,7 @@ from ..datatype import DataType, Field
 from ..expressions import AggExpr, Alias, Expression
 from ..expressions.eval import eval_expression, eval_projection
 from ..schema import Schema
-from .kernels.encoding import encode_column, encode_keys
+from .kernels.encoding import equality_codes
 from .kernels.groupby import make_groups
 from .kernels.join import cross_join_indices, join_indices
 from .recordbatch import RecordBatch
@@ -164,7 +164,7 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
         return Series.from_numpy(data.astype(np.uint64), s.name, DataType.uint64())
 
     if op in ("count_distinct", "approx_count_distinct"):
-        codes = encode_column(s)[order]
+        codes = equality_codes(s)[order]
         gid_for_rows = seg_gid[np.searchsorted(starts, np.arange(len(codes)), side="right") - 1] if len(codes) else np.empty(0, np.int64)
         keep = valid
         pairs = np.stack([gid_for_rows[keep], codes[keep]], axis=1) if len(codes) else np.empty((0, 2), np.int64)

@@ -35,7 +35,31 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.TaskScan):
+        from ..utils.pool import compute_pool
+
         remaining = node.post_limit
+
+        def read_task(task):
+            out = []
+            for part in task.read():
+                if node.post_filter is not None and not task.filters_applied:
+                    part = _filter_part(part, node.post_filter)
+                out.append(part)
+            return out
+
+        if len(node.tasks) > 1 and remaining is None:
+            # IO-parallel scan with a bounded in-flight window: parallelism without
+            # buffering the whole dataset ahead of the consumer
+            window = compute_pool()._max_workers
+            futures = []
+            ti = 0
+            while ti < len(node.tasks) or futures:
+                while ti < len(node.tasks) and len(futures) < window:
+                    futures.append(compute_pool().submit(read_task, node.tasks[ti]))
+                    ti += 1
+                f = futures.pop(0)
+                yield from f.result()
+            return
         for task in node.tasks:
             for part in task.read():
                 if node.post_filter is not None and not task.filters_applied:
@@ -154,14 +178,12 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.UngroupedAggregate):
-        batch = _gather(node.input, node.input.schema)
-        out = rel.ungrouped_agg(batch, node.aggregations)
+        out = _two_phase_agg(node.input, [], node.aggregations, ungrouped=True)
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
     if isinstance(node, pp.HashAggregate):
-        batch = _gather(node.input, node.input.schema)
-        out = rel.grouped_agg(batch, node.groupby, node.aggregations)
+        out = _two_phase_agg(node.input, node.groupby, node.aggregations, ungrouped=False)
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
@@ -253,6 +275,45 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")
+
+
+_MORSEL_ROWS = 256 * 1024
+
+
+def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool) -> RecordBatch:
+    """Partial aggregation per morsel on the compute pool, then a final combine
+    (reference: two-stage aggregation in translate.rs + partial-agg thresholds)."""
+    from ..plan.agg_split import split_aggs
+    from ..utils.pool import pool_map
+
+    batches = [b for p in _exec(child) for b in p.batches if b.num_rows > 0]
+    if not batches:
+        big = _concat_parts([], child.schema)
+        return rel.ungrouped_agg(big, aggs) if ungrouped else rel.grouped_agg(big, groupby, aggs)
+
+    split = split_aggs(aggs)
+    # small total input or unsplittable aggs: one-phase
+    total_rows = sum(b.num_rows for b in batches)
+    if split is None or total_rows <= _MORSEL_ROWS:
+        big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
+        return rel.ungrouped_agg(big, aggs) if ungrouped else rel.grouped_agg(big, groupby, aggs)
+
+    # re-chunk into morsels so partials parallelize even for one big batch
+    if len(batches) == 1:
+        b = batches[0]
+        batches = [b.slice(s, s + _MORSEL_ROWS) for s in range(0, b.num_rows, _MORSEL_ROWS)]
+
+    from ..expressions import col as _col
+
+    if ungrouped:
+        partials = pool_map(lambda b: rel.ungrouped_agg(b, split.partial), batches)
+        final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)
+        return eval_projection(final, split.projection)
+
+    partials = pool_map(lambda b: rel.grouped_agg(b, groupby, split.partial), batches)
+    key_names = [e.name() for e in groupby]
+    final = rel.grouped_agg(RecordBatch.concat(partials), [_col(k) for k in key_names], split.final)
+    return eval_projection(final, [_col(k) for k in key_names] + split.projection)
 
 
 def _filter_part(part: MicroPartition, predicate: Expression) -> MicroPartition:

@@ -64,11 +64,11 @@ def eval_window(batch: RecordBatch, window_exprs, spec, schema: Schema) -> Recor
 
     # ---- peer groups (rows equal on all order keys within a partition) --------------
     if order_series:
-        from ..core.kernels.encoding import encode_column
+        from ..core.kernels.encoding import equality_codes
 
         peer_new = seg_start_flag.copy()
         for s in order_series:
-            codes = encode_column(s.take(sorted_idx))  # nulls get their own code
+            codes = equality_codes(s.take(sorted_idx))  # nulls get their own code
             peer_new[1:] |= codes[1:] != codes[:-1]
     else:
         peer_new = seg_start_flag.copy()

@@ -1,0 +1,116 @@
+"""Aggregation splitting: partial (per-morsel/per-shard) + final (combine) phases.
+
+Reference parity: src/daft-local-plan/src/translate.rs agg splitting and
+src/daft-physical-plan two-stage aggregation. The same decomposition drives
+thread-parallel partial aggregation on host, psum-combined shard aggregation on
+the TPU mesh (parallel/distributed.py), and distributed partition aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..expressions import AggExpr, Alias, ColumnRef, Expression, col
+from ..expressions.expressions import Literal
+
+
+def _unalias(e: Expression) -> Tuple[Expression, str]:
+    name = e.name()
+    while isinstance(e, Alias):
+        e = e.child
+    return e, name
+
+
+class AggSplit:
+    """partial: AggExprs evaluated per input chunk; final: AggExprs over the
+    concatenated partials; projection: final output expressions (one per input
+    agg, aliased to the original output name)."""
+
+    def __init__(self, partial: List[Expression], final: List[Expression],
+                 projection: List[Expression]):
+        self.partial = partial
+        self.final = final
+        self.projection = projection
+
+
+def split_aggs(aggs: List[Expression]) -> Optional[AggSplit]:
+    """Decompose aggregations into partial+final, or None if any agg can't split
+    (count_distinct/approx_count_distinct need full value sets)."""
+    partial: List[Expression] = []
+    final: List[Expression] = []
+    projection: List[Expression] = []
+    counter = [0]
+    seen: dict = {}  # (repr(partial agg), final op) -> column name — dedupe shared partials
+
+    def fresh(base: str) -> str:
+        counter[0] += 1
+        return f"__p{counter[0]}_{base}"
+
+    def add(p_expr: Expression, f_op: str, f_params=None) -> str:
+        """Register a partial agg + its final combine; returns the final column name."""
+        key = (repr(p_expr), f_op, repr(sorted((f_params or {}).items())))
+        if key in seen:
+            return seen[key]
+        name = fresh(p_expr.name() if not isinstance(p_expr, Literal) else "lit")
+        partial.append(p_expr.alias(name))
+        final.append(AggExpr(f_op, col(name), f_params or {}).alias(name))
+        seen[key] = name
+        return name
+
+    for e in aggs:
+        inner, out_name = _unalias(e)
+        if not isinstance(inner, AggExpr):
+            return None
+        op = inner.op
+        child = inner.child
+        if op == "sum":
+            n = add(AggExpr("sum", child), "sum")
+            projection.append(col(n).alias(out_name))
+        elif op == "count":
+            n = add(AggExpr("count", child, dict(inner.params)), "sum")
+            from ..datatype import DataType
+
+            projection.append(col(n).cast(DataType.uint64()).alias(out_name))
+        elif op in ("min", "max", "any_value", "bool_and", "bool_or"):
+            n = add(AggExpr(op, child, dict(inner.params)), op, dict(inner.params))
+            projection.append(col(n).alias(out_name))
+        elif op == "mean":
+            s = add(AggExpr("sum", child), "sum")
+            c = add(AggExpr("count", child), "sum")
+            projection.append((col(s) / col(c)).alias(out_name))
+        elif op in ("stddev", "var"):
+            ddof = inner.params.get("ddof", 0)
+            s = add(AggExpr("sum", child), "sum")
+            q = add(AggExpr("sum", child * child), "sum")
+            c = add(AggExpr("count", child), "sum")
+            mean = col(s) / col(c)
+            # clamp: float error can push E[x²]−E[x]² slightly negative (must match
+            # the one-phase kernel's np.maximum(var, 0.0))
+            var = ((col(q) / col(c)) - mean * mean).clip(min=0.0)
+            if ddof:
+                var = var * col(c) / (col(c) - ddof).clip(min=0)
+            expr = var.sqrt() if op == "stddev" else var
+            projection.append(expr.alias(out_name))
+        elif op == "skew":
+            from ..expressions import lit
+
+            s = add(AggExpr("sum", child), "sum")
+            q = add(AggExpr("sum", child * child), "sum")
+            cu = add(AggExpr("sum", child * child * child), "sum")
+            c = add(AggExpr("count", child), "sum")
+            m = col(s) / col(c)
+            var = ((col(q) / col(c)) - m * m).clip(min=0.0)
+            sd = var.sqrt()
+            m3 = (col(cu) / col(c)) - 3 * m * (col(q) / col(c)) + 2 * m * m * m
+            # zero variance → undefined skew (one-phase kernel nulls it)
+            projection.append((sd > 0).if_else(m3 / (sd ** 3), lit(None)).alias(out_name))
+        elif op == "list":
+            n = add(AggExpr("list", child), "concat")
+            projection.append(col(n).alias(out_name))
+        elif op == "concat":
+            n = add(AggExpr("concat", child), "concat")
+            projection.append(col(n).alias(out_name))
+        else:
+            # count_distinct / approx_count_distinct: need full sets
+            return None
+    return AggSplit(partial, final, projection)

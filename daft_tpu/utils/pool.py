@@ -1,0 +1,26 @@
+"""Shared compute thread pool (reference: common/runtime compute runtime —
+numpy/arrow kernels release the GIL, so morsel parallelism works on threads)."""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def compute_pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        workers = int(os.environ.get("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4))
+        _POOL = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="daft-compute")
+    return _POOL
+
+
+def pool_map(fn, items):
+    """Map over items in the pool; falls back to serial for 0/1 items."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    return list(compute_pool().map(fn, items))
