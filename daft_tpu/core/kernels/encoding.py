@@ -101,14 +101,20 @@ def combine_equality_codes(code_cols: List[np.ndarray]) -> np.ndarray:
     """Combine per-column compact equality codes into one compact int64 code per
     row, first-occurrence ordered. Pairwise (codes * domain + next) with a
     re-factorize each step keeps values < n² (no overflow)."""
-    import pandas as pd
-
     codes = code_cols[0]
     if len(code_cols) == 1:
         return codes.astype(np.int64, copy=False)
+    from ...native import native_combine_factorize
+
     for c in code_cols[1:]:
-        g = int(c.max()) + 2 if len(c) else 2  # +2: shift both by 1 for the -1 null code
-        pair = (codes + 1) * g + (c + 1)
+        g = int(c.max()) + 1 if len(c) else 1
+        nf = native_combine_factorize(codes, c, g)
+        if nf is not None:
+            codes = nf[0]
+            continue
+        import pandas as pd
+
+        pair = (codes + 1) * (g + 2) + (c + 1)
         codes = pd.factorize(pair)[0].astype(np.int64, copy=False)
     return codes
 

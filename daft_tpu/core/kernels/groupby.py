@@ -31,6 +31,18 @@ def make_groups(key_series: list) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     if n == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64)
     # factorize assigns ids in first-occurrence order (null code -1 is a value here)
+    from ...native import native_factorize
+
+    nf = native_factorize(codes)
+    if nf is not None:
+        group_ids, num_groups = nf
+        # group ids are first-occurrence ordered, so first indices are where the
+        # running max increases
+        first_idx = np.flatnonzero(
+            np.concatenate([[True], group_ids[1:] > np.maximum.accumulate(group_ids)[:-1]])
+        ).astype(np.int64)
+        counts = np.bincount(group_ids, minlength=num_groups).astype(np.int64)
+        return first_idx, group_ids, counts
     group_ids = pd.factorize(codes)[0].astype(np.int64, copy=False)
     first_mask = ~pd.Series(group_ids).duplicated().to_numpy()
     first_idx = np.flatnonzero(first_mask).astype(np.int64)

@@ -33,55 +33,69 @@ def join_indices(
     how: str = "inner",
     null_equals_null: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
+    if how == "right":
+        ridx2, lidx2 = join_indices(right_keys, left_keys, "left", null_equals_null)
+        return lidx2, ridx2
+    if how not in ("inner", "left", "outer", "semi", "anti"):
+        raise ValueError(f"unsupported join type: {how}")
+
     lcodes, rcodes, lnull, rnull = encode_keys_equality(left_keys, right_keys)
     assert rcodes is not None
 
-    if not null_equals_null:
-        # null keys never match: give them unmatchable codes
-        lcodes = lcodes.copy()
-        rcodes = rcodes.copy()
+    lcodes = lcodes.copy()
+    rcodes = rcodes.copy()
+    if null_equals_null:
+        # nulls match nulls: shift so the -1 null code becomes a real bucket
+        lcodes += 1
+        rcodes += 1
+    else:
+        # null keys never match: give them distinct unmatchable codes
         lcodes[lnull] = -2
         rcodes[rnull] = -3
 
-    # int64 stable argsort = numpy radix sort, O(n) on compact codes
-    r_order = np.argsort(rcodes, kind="stable").astype(np.int64)
-    r_sorted = rcodes[r_order]
-    starts = np.searchsorted(r_sorted, lcodes, side="left")
-    ends = np.searchsorted(r_sorted, lcodes, side="right")
-    counts = (ends - starts).astype(np.int64)
+    from ...native import native_join_counts, native_join_indices
 
-    if how == "semi":
-        lidx = np.nonzero(counts > 0)[0].astype(np.int64)
-        return lidx, np.full(len(lidx), -1, dtype=np.int64)
-    if how == "anti":
-        lidx = np.nonzero(counts == 0)[0].astype(np.int64)
+    num_codes = int(max(lcodes.max(initial=-1), rcodes.max(initial=-1))) + 1
+
+    if how in ("semi", "anti"):
+        counts = native_join_counts(lcodes, rcodes, num_codes)
+        if counts is None:
+            r_sorted = np.sort(rcodes, kind="stable")
+            counts = (np.searchsorted(r_sorted, lcodes, side="right")
+                      - np.searchsorted(r_sorted, lcodes, side="left")).astype(np.int64)
+        keep = counts > 0 if how == "semi" else counts == 0
+        lidx = np.nonzero(keep)[0].astype(np.int64)
         return lidx, np.full(len(lidx), -1, dtype=np.int64)
 
-    matched_l = np.repeat(np.arange(len(lcodes), dtype=np.int64), counts)
-    pos = _expand_ranges(starts.astype(np.int64), counts)
-    matched_r = r_order[pos] if len(pos) else np.empty(0, dtype=np.int64)
+    native = native_join_indices(lcodes, rcodes, num_codes)
+    if native is not None:
+        matched_l, matched_r, counts = native
+    else:
+        # int64 stable argsort = numpy radix sort, O(n) on compact codes
+        r_order = np.argsort(rcodes, kind="stable").astype(np.int64)
+        r_sorted = rcodes[r_order]
+        starts = np.searchsorted(r_sorted, lcodes, side="left")
+        ends = np.searchsorted(r_sorted, lcodes, side="right")
+        counts = (ends - starts).astype(np.int64)
+        matched_l = np.repeat(np.arange(len(lcodes), dtype=np.int64), counts)
+        pos = _expand_ranges(starts.astype(np.int64), counts)
+        matched_r = r_order[pos] if len(pos) else np.empty(0, dtype=np.int64)
 
     if how == "inner":
         return matched_l, matched_r
 
-    if how in ("left", "outer"):
-        unmatched_l = np.nonzero(counts == 0)[0].astype(np.int64)
-        lidx = np.concatenate([matched_l, unmatched_l])
-        ridx = np.concatenate([matched_r, np.full(len(unmatched_l), -1, dtype=np.int64)])
-        if how == "left":
-            return lidx, ridx
-        r_matched_mask = np.zeros(len(rcodes), dtype=bool)
-        r_matched_mask[matched_r] = True
-        unmatched_r = np.nonzero(~r_matched_mask)[0].astype(np.int64)
-        lidx = np.concatenate([lidx, np.full(len(unmatched_r), -1, dtype=np.int64)])
-        ridx = np.concatenate([ridx, unmatched_r])
+    # left / outer
+    unmatched_l = np.nonzero(counts == 0)[0].astype(np.int64)
+    lidx = np.concatenate([matched_l, unmatched_l])
+    ridx = np.concatenate([matched_r, np.full(len(unmatched_l), -1, dtype=np.int64)])
+    if how == "left":
         return lidx, ridx
-
-    if how == "right":
-        ridx2, lidx2 = join_indices(right_keys, left_keys, "left", null_equals_null)
-        return lidx2, ridx2
-
-    raise ValueError(f"unsupported join type: {how}")
+    r_matched_mask = np.zeros(len(rcodes), dtype=bool)
+    r_matched_mask[matched_r] = True
+    unmatched_r = np.nonzero(~r_matched_mask)[0].astype(np.int64)
+    lidx = np.concatenate([lidx, np.full(len(unmatched_r), -1, dtype=np.int64)])
+    ridx = np.concatenate([ridx, unmatched_r])
+    return lidx, ridx
 
 
 def cross_join_indices(n_left: int, n_right: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -99,6 +99,26 @@ def _group_starts(sorted_gids: np.ndarray) -> np.ndarray:
     return np.concatenate([[0], change]).astype(np.int64)
 
 
+class _GroupCtx:
+    """Shared grouping state; the sorted-segment view (order/starts/seg_gid) is
+    computed lazily — the native single-pass kernels don't need it."""
+
+    def __init__(self, group_ids: np.ndarray, counts: np.ndarray, num_groups: int):
+        self.group_ids = group_ids
+        self.counts = counts
+        self.num_groups = num_groups
+        self._sorted = None
+
+    def sorted_view(self):
+        if self._sorted is None:
+            order = np.argsort(self.group_ids, kind="stable")
+            sorted_gids = self.group_ids[order]
+            starts = _group_starts(sorted_gids)
+            seg_gid = sorted_gids[starts] if self.num_groups else np.empty(0, np.int64)
+            self._sorted = (order, starts, seg_gid)
+        return self._sorted
+
+
 def grouped_agg(batch: RecordBatch, groupby: Sequence[Expression],
                 aggs: Sequence[Expression]) -> RecordBatch:
     """Hash-group rows by the groupby keys and aggregate each group.
@@ -108,13 +128,7 @@ def grouped_agg(batch: RecordBatch, groupby: Sequence[Expression],
     key_series = _eval_keys(batch, groupby)
     first_idx, group_ids, counts = make_groups(key_series)
     num_groups = len(first_idx)
-
-    # sort rows by group id so each group is one contiguous segment
-    order = np.argsort(group_ids, kind="stable")
-    sorted_gids = group_ids[order]
-    starts = _group_starts(sorted_gids)
-    # map segment s -> group id (first occurrence order)
-    seg_gid = sorted_gids[starts] if num_groups else np.empty(0, np.int64)
+    ctx = _GroupCtx(group_ids, counts, num_groups)
 
     out_cols: List[Series] = [s.take(first_idx) for s in key_series]
 
@@ -127,22 +141,102 @@ def grouped_agg(batch: RecordBatch, groupby: Sequence[Expression],
             from ..expressions.eval import _broadcast
 
             s = _broadcast(s, batch.num_rows)
-        res = _grouped_agg_one(s, inner, order, starts, seg_gid, counts, num_groups)
+        res = _grouped_agg_native(s, inner, ctx)
+        if res is None:
+            order, starts, seg_gid = ctx.sorted_view()
+            res = _grouped_agg_one(s, inner, order, starts, seg_gid, counts, num_groups)
         out_cols.append(res.rename(name))
 
     n = num_groups
     return RecordBatch(Schema([c.field() for c in out_cols]), out_cols, n)
 
 
+def _agg_out_dtype(s: Series, agg: AggExpr) -> DataType:
+    from ..expressions import ColumnRef
+
+    synth = AggExpr(agg.op, ColumnRef(s.name), agg.params)
+    return synth.to_field(Schema([s.field()])).dtype
+
+
+def _grouped_agg_native(s: Series, agg: AggExpr, ctx: _GroupCtx) -> Optional[Series]:
+    """Single-pass C++ grouped aggregation for numeric sum/count/mean/min/max/
+    var/stddev; returns None to fall back to the sorted-segment kernels."""
+    from ..native import get_lib, native_grouped_minmax, native_grouped_sum
+
+    op = agg.op
+    if op not in ("sum", "count", "mean", "min", "max", "stddev", "var") or get_lib() is None:
+        return None
+    dt = s.dtype
+    if op != "count" and not (
+        (dt.is_numeric() and not dt.is_decimal()) or dt.is_boolean()
+    ):
+        return None
+    n, G = len(ctx.group_ids), ctx.num_groups
+    valid = s.validity_numpy()
+
+    if op == "count":
+        mode = agg.params.get("mode", "valid")
+        if mode == "all":
+            data = ctx.counts
+        else:
+            vc = np.bincount(ctx.group_ids[valid], minlength=G)
+            data = vc if mode == "valid" else ctx.counts - vc
+        return Series.from_numpy(data.astype(np.uint64), s.name, DataType.uint64())
+
+    vals = s.to_numpy()
+    if vals.dtype == object:
+        return None
+    if vals.dtype == np.uint64 and op in ("sum", "min", "max"):
+        return None  # would wrap at 2^63 through the int64 kernel; fallback is exact
+    is_int = np.issubdtype(vals.dtype, np.integer) or vals.dtype == bool
+    work = vals.astype(np.int64) if is_int and op in ("sum", "min", "max") \
+        else vals.astype(np.float64)
+    out_dtype = _agg_out_dtype(s, agg)
+
+    def null_where_zero(data: np.ndarray, cnt: np.ndarray, dtype: DataType) -> Series:
+        arr = pa.array(data)
+        arr = pc.if_else(pa.array(cnt > 0), arr, pa.nulls(G, arr.type))
+        ser = Series.from_arrow(arr, s.name)
+        return ser.cast(dtype) if ser.dtype != dtype else ser
+
+    if op in ("sum", "mean"):
+        res = native_grouped_sum(ctx.group_ids, work, valid, G)
+        if res is None:
+            return None
+        sums, cnt = res
+        if op == "sum":
+            return null_where_zero(sums, cnt, out_dtype)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            m = sums.astype(np.float64) / cnt
+        return null_where_zero(m, cnt, DataType.float64())
+    if op in ("min", "max"):
+        res = native_grouped_minmax(ctx.group_ids, work, valid, G)
+        if res is None:
+            return None
+        mn, mx = res
+        cnt = np.bincount(ctx.group_ids[valid], minlength=G)
+        return null_where_zero(mn if op == "min" else mx, cnt, out_dtype)
+    # stddev / var: two fused native passes (sum + sum of squares)
+    r1 = native_grouped_sum(ctx.group_ids, work, valid, G)
+    r2 = native_grouped_sum(ctx.group_ids, work * work, valid, G)
+    if r1 is None or r2 is None:
+        return None
+    sums, cnt = r1
+    sq, _ = r2
+    ddof = agg.params.get("ddof", 0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        m = sums / cnt
+        var = np.maximum(sq / cnt - m * m, 0.0)
+        if ddof:
+            var = var * cnt / np.maximum(cnt - ddof, 0)
+        data = np.sqrt(var) if op == "stddev" else var
+    return null_where_zero(data, cnt, DataType.float64())
+
+
 def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndarray,
                      seg_gid: np.ndarray, counts: np.ndarray, num_groups: int) -> Series:
     op = agg.op
-    # derive output dtype from the already-evaluated child series
-    from ..expressions import ColumnRef
-
-    synth = AggExpr(op, ColumnRef(s.name), agg.params)
-    out_field = synth.to_field(Schema([s.field()]))
-    out_dtype = out_field.dtype
+    out_dtype = _agg_out_dtype(s, agg)
 
     valid = s.validity_numpy()[order]
     valid_counts = np.add.reduceat(valid.astype(np.int64), starts) if num_groups else np.empty(0, np.int64)

@@ -1,0 +1,211 @@
+"""ctypes loader for the C++ host-kernel library (native/src/kernels.cpp).
+
+Reference parity: the reference compiles its Rust core into the daft.daft
+extension module; here the hot host kernels live in a C ABI shared library with
+a graceful numpy fallback when the library hasn't been built. Build:
+
+    cmake -S native -B native/build && cmake --build native/build
+
+The build drops libdaft_native.so into daft_tpu/_native/.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO_PATH = os.path.join(_REPO_ROOT, "daft_tpu", "_native", "libdaft_native.so")
+
+
+def _try_build() -> None:
+    """Best-effort one-shot build if a toolchain is available (dev convenience)."""
+    src_dir = os.path.join(_REPO_ROOT, "native")
+    if not os.path.isdir(src_dir):
+        return
+    try:
+        os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             os.path.join(src_dir, "src", "kernels.cpp"), "-o", _SO_PATH],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DAFT_TPU_DISABLE_NATIVE"):
+        return None
+    src = os.path.join(_REPO_ROOT, "native", "src", "kernels.cpp")
+    stale = (
+        os.path.exists(_SO_PATH) and os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+    )
+    if not os.path.exists(_SO_PATH) or stale:
+        _try_build()
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.xxhash64.restype = ctypes.c_uint64
+    lib.xxhash64.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.hash_binary_column.restype = None
+    lib.hash_binary_column.argtypes = [u8p, i64p, ctypes.c_int64, ctypes.c_uint64, u64p]
+    lib.hash_u64_column.restype = None
+    lib.hash_u64_column.argtypes = [u64p, ctypes.c_int64, ctypes.c_uint64, u64p]
+    lib.factorize_i64.restype = ctypes.c_int64
+    lib.factorize_i64.argtypes = [i64p, ctypes.c_int64, i64p]
+    lib.combine_factorize_i64.restype = ctypes.c_int64
+    lib.combine_factorize_i64.argtypes = [i64p, i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.grouped_sum_f64.restype = None
+    lib.grouped_sum_f64.argtypes = [i64p, f64p, u8p, ctypes.c_int64, ctypes.c_int64, f64p, i64p]
+    lib.grouped_sum_i64.restype = None
+    lib.grouped_sum_i64.argtypes = [i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.grouped_minmax_f64.restype = None
+    lib.grouped_minmax_f64.argtypes = [i64p, f64p, u8p, ctypes.c_int64, ctypes.c_int64, f64p, f64p]
+    lib.grouped_minmax_i64.restype = None
+    lib.grouped_minmax_i64.argtypes = [i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.join_count.restype = ctypes.c_int64
+    lib.join_count.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.join_fill.restype = None
+    lib.join_fill.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
+                              i64p, i64p, i64p, i64p]
+    _LIB = lib
+    return _LIB
+
+
+def _p(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def native_factorize(keys: np.ndarray) -> Optional[tuple]:
+    """(codes, num_groups) in first-occurrence order, or None if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    out = np.empty(len(keys), dtype=np.int64)
+    g = lib.factorize_i64(_p(keys, ctypes.c_int64), len(keys), _p(out, ctypes.c_int64))
+    return out, int(g)
+
+
+def native_combine_factorize(a: np.ndarray, b: np.ndarray, b_domain: int) -> Optional[tuple]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    b = np.ascontiguousarray(b, dtype=np.int64)
+    out = np.empty(len(a), dtype=np.int64)
+    g = lib.combine_factorize_i64(_p(a, ctypes.c_int64), _p(b, ctypes.c_int64),
+                                  len(a), int(b_domain), _p(out, ctypes.c_int64))
+    return out, int(g)
+
+
+def native_join_counts(lcodes: np.ndarray, rcodes: np.ndarray, num_codes: int) -> Optional[np.ndarray]:
+    """Per-left-row match counts only (semi/anti joins skip pair materialization)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lcodes = np.ascontiguousarray(lcodes, dtype=np.int64)
+    rcodes = np.ascontiguousarray(rcodes, dtype=np.int64)
+    nl, nr = len(lcodes), len(rcodes)
+    bucket_counts = np.empty(max(num_codes, 1), dtype=np.int64)
+    l_match = np.empty(max(nl, 1), dtype=np.int64)
+    lib.join_count(_p(lcodes, ctypes.c_int64), nl, _p(rcodes, ctypes.c_int64), nr,
+                   num_codes, _p(bucket_counts, ctypes.c_int64), _p(l_match, ctypes.c_int64))
+    return l_match[:nl]
+
+
+def native_join_indices(lcodes: np.ndarray, rcodes: np.ndarray, num_codes: int) -> Optional[tuple]:
+    """Inner-match pairs for compact codes: (l_idx, r_idx, l_match_counts)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lcodes = np.ascontiguousarray(lcodes, dtype=np.int64)
+    rcodes = np.ascontiguousarray(rcodes, dtype=np.int64)
+    nl, nr = len(lcodes), len(rcodes)
+    bucket_counts = np.empty(max(num_codes, 1), dtype=np.int64)
+    l_match = np.empty(max(nl, 1), dtype=np.int64)
+    total = lib.join_count(_p(lcodes, ctypes.c_int64), nl, _p(rcodes, ctypes.c_int64), nr,
+                           num_codes, _p(bucket_counts, ctypes.c_int64), _p(l_match, ctypes.c_int64))
+    offsets = np.concatenate([[0], np.cumsum(bucket_counts[:num_codes])[:-1]]).astype(np.int64) \
+        if num_codes else np.zeros(1, np.int64)
+    bucket_rows = np.empty(max(nr, 1), dtype=np.int64)
+    out_l = np.empty(max(total, 1), dtype=np.int64)
+    out_r = np.empty(max(total, 1), dtype=np.int64)
+    lib.join_fill(_p(lcodes, ctypes.c_int64), nl, _p(rcodes, ctypes.c_int64), nr, num_codes,
+                  _p(offsets, ctypes.c_int64), _p(bucket_rows, ctypes.c_int64),
+                  _p(out_l, ctypes.c_int64), _p(out_r, ctypes.c_int64))
+    return out_l[:total], out_r[:total], l_match[:nl]
+
+
+def native_grouped_sum(gids: np.ndarray, vals: np.ndarray, valid: np.ndarray,
+                       num_groups: int) -> Optional[tuple]:
+    """(sums, counts) or None. vals must be float64 or int64."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    gids = np.ascontiguousarray(gids, dtype=np.int64)
+    valid8 = np.ascontiguousarray(valid, dtype=np.uint8)
+    if vals.dtype == np.float64:
+        vals = np.ascontiguousarray(vals)
+        out = np.empty(num_groups, dtype=np.float64)
+        cnt = np.empty(num_groups, dtype=np.int64)
+        lib.grouped_sum_f64(_p(gids, ctypes.c_int64), _p(vals, ctypes.c_double),
+                            _p(valid8, ctypes.c_uint8), len(gids), num_groups,
+                            _p(out, ctypes.c_double), _p(cnt, ctypes.c_int64))
+        return out, cnt
+    if vals.dtype == np.int64:
+        vals = np.ascontiguousarray(vals)
+        out = np.empty(num_groups, dtype=np.int64)
+        cnt = np.empty(num_groups, dtype=np.int64)
+        lib.grouped_sum_i64(_p(gids, ctypes.c_int64), _p(vals, ctypes.c_int64),
+                            _p(valid8, ctypes.c_uint8), len(gids), num_groups,
+                            _p(out, ctypes.c_int64), _p(cnt, ctypes.c_int64))
+        return out, cnt
+    return None
+
+
+def native_grouped_minmax(gids: np.ndarray, vals: np.ndarray, valid: np.ndarray,
+                          num_groups: int) -> Optional[tuple]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    gids = np.ascontiguousarray(gids, dtype=np.int64)
+    valid8 = np.ascontiguousarray(valid, dtype=np.uint8)
+    if vals.dtype == np.float64:
+        vals = np.ascontiguousarray(vals)
+        mn = np.empty(num_groups, dtype=np.float64)
+        mx = np.empty(num_groups, dtype=np.float64)
+        lib.grouped_minmax_f64(_p(gids, ctypes.c_int64), _p(vals, ctypes.c_double),
+                               _p(valid8, ctypes.c_uint8), len(gids), num_groups,
+                               _p(mn, ctypes.c_double), _p(mx, ctypes.c_double))
+        return mn, mx
+    if vals.dtype == np.int64:
+        vals = np.ascontiguousarray(vals)
+        mn = np.empty(num_groups, dtype=np.int64)
+        mx = np.empty(num_groups, dtype=np.int64)
+        lib.grouped_minmax_i64(_p(gids, ctypes.c_int64), _p(vals, ctypes.c_int64),
+                               _p(valid8, ctypes.c_uint8), len(gids), num_groups,
+                               _p(mn, ctypes.c_int64), _p(mx, ctypes.c_int64))
+        return mn, mx
+    return None
