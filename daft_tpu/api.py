@@ -13,7 +13,7 @@ from .dataframe import DataFrame, GroupedDataFrame
 from .expressions import Expression, col, lit
 from .plan.builder import LogicalPlanBuilder
 from .schema import Schema
-from .udf import func
+from .udf import Func, cls, func, method, udf
 from .window import Window
 from . import functions
 
@@ -21,6 +21,7 @@ __all__ = [
     "DataFrame", "GroupedDataFrame", "Expression", "col", "lit", "element", "func",
     "from_pydict", "from_pylist", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "from_glob_path", "sql", "sql_expr",
+    "cls", "method", "udf", "Func",
 ]
 
 

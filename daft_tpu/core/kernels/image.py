@@ -1,0 +1,212 @@
+"""Image kernels: decode/encode/resize/crop/to_mode over image columns.
+
+Reference parity: src/daft-image/src/ops.rs:31-63 (decode/encode/resize/crop/
+to_mode over ImageArrays) + common/image CowImage. Host codecs via PIL; the
+decoded representation is a struct column {data, mode, height, width, channels}
+holding raw uint8/uint16/float32 pixels, so fixed-shape batches can move to the
+TPU as dense arrays without re-decoding.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ...datatype import DataType, ImageMode
+from ..series import Series
+
+_MODE_INDEX = {m: i for i, m in enumerate(
+    ["L", "LA", "RGB", "RGBA", "L16", "LA16", "RGB16", "RGBA16", "RGB32F", "RGBA32F"]
+)}
+_INDEX_MODE = {i: m for m, i in _MODE_INDEX.items()}
+
+
+def _image_struct_type() -> pa.DataType:
+    return DataType.image().to_arrow()
+
+
+def build_image_series(name: str, images: List[Optional[np.ndarray]],
+                       modes: List[Optional[str]]) -> Series:
+    """Pack decoded numpy images (H, W, C) into an image struct column."""
+    data, mode_idx, heights, widths, channels = [], [], [], [], []
+    for img, mode in zip(images, modes):
+        if img is None:
+            data.append(None)
+            mode_idx.append(None)
+            heights.append(None)
+            widths.append(None)
+            channels.append(None)
+        else:
+            if img.ndim == 2:
+                img = img[:, :, None]
+            data.append(img.tobytes())
+            mode_idx.append(_MODE_INDEX[mode])
+            heights.append(img.shape[0])
+            widths.append(img.shape[1])
+            channels.append(img.shape[2])
+    arr = pa.StructArray.from_arrays(
+        [
+            pa.array(data, pa.large_binary()),
+            pa.array(mode_idx, pa.uint8()),
+            pa.array(heights, pa.uint32()),
+            pa.array(widths, pa.uint32()),
+            pa.array(channels, pa.uint8()),
+        ],
+        fields=list(_image_struct_type()),
+        mask=pa.array([d is None for d in data]),
+    )
+    return Series(name, DataType.image(), arr)
+
+
+def unpack_images(series: Series):
+    """Yield (np image (H,W,C) | None, mode | None) per row."""
+    arr = series.to_arrow()
+    data = arr.field("data")
+    modes = arr.field("mode")
+    hs, ws, cs = arr.field("height"), arr.field("width"), arr.field("channels")
+    row_valid = np.asarray(pa.compute.is_valid(arr).to_numpy(zero_copy_only=False))
+    data_valid = np.asarray(pa.compute.is_valid(data).to_numpy(zero_copy_only=False))
+    for i in range(len(arr)):
+        if not (row_valid[i] and data_valid[i]):
+            yield None, None
+            continue
+        mode = _INDEX_MODE[modes[i].as_py()]
+        h, w, c = hs[i].as_py(), ws[i].as_py(), cs[i].as_py()
+        buf = np.frombuffer(data[i].as_py(), dtype=ImageMode.np_dtype(mode))
+        yield buf.reshape(h, w, c), mode
+
+
+def decode(series: Series, mode: Optional[str] = None,
+           on_error: str = "raise") -> Series:
+    """Decode encoded image bytes (png/jpeg/...) into an image column."""
+    from PIL import Image
+
+    imgs, modes = [], []
+    for v in series.to_pylist():
+        if v is None:
+            imgs.append(None)
+            modes.append(None)
+            continue
+        try:
+            with Image.open(io.BytesIO(v)) as im:
+                target = mode or ("RGB" if im.mode not in _MODE_INDEX else im.mode)
+                if im.mode != target:
+                    im = im.convert(target)
+                imgs.append(np.asarray(im))
+                modes.append(target)
+        except Exception:
+            if on_error == "raise":
+                raise
+            imgs.append(None)
+            modes.append(None)
+    return build_image_series(series.name, imgs, modes)
+
+
+def encode(series: Series, image_format: str = "PNG") -> Series:
+    """Encode an image column back to bytes."""
+    from PIL import Image
+
+    out = []
+    for img, mode in unpack_images(series):
+        if img is None:
+            out.append(None)
+            continue
+        pil_mode = mode if mode in ("L", "LA", "RGB", "RGBA") else "RGB"
+        im = Image.fromarray(img.squeeze() if img.shape[2] == 1 else img, mode=pil_mode)
+        buf = io.BytesIO()
+        im.save(buf, format=image_format.upper().replace("JPG", "JPEG"))
+        out.append(buf.getvalue())
+    return Series(series.name, DataType.binary(), pa.array(out, pa.large_binary()))
+
+
+def resize(series: Series, w: int, h: int) -> Series:
+    import cv2
+
+    imgs, modes = [], []
+    for img, mode in unpack_images(series):
+        if img is None:
+            imgs.append(None)
+            modes.append(None)
+            continue
+        resized = cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
+        if resized.ndim == 2:
+            resized = resized[:, :, None]
+        imgs.append(resized)
+        modes.append(mode)
+    return build_image_series(series.name, imgs, modes)
+
+
+def crop(series: Series, bbox) -> Series:
+    """bbox = (x, y, w, h)."""
+    x, y, w, h = bbox
+    imgs, modes = [], []
+    for img, mode in unpack_images(series):
+        if img is None:
+            imgs.append(None)
+            modes.append(None)
+            continue
+        imgs.append(img[y:y + h, x:x + w])
+        modes.append(mode)
+    return build_image_series(series.name, imgs, modes)
+
+
+def to_mode(series: Series, mode: str) -> Series:
+    from PIL import Image
+
+    imgs, modes = [], []
+    for img, m in unpack_images(series):
+        if img is None:
+            imgs.append(None)
+            modes.append(None)
+            continue
+        if m == mode:
+            imgs.append(img)
+            modes.append(m)
+            continue
+        im = Image.fromarray(img.squeeze() if img.shape[2] == 1 else img,
+                             mode=m if m in ("L", "LA", "RGB", "RGBA") else "RGB")
+        conv = im.convert(mode)
+        arr = np.asarray(conv)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        imgs.append(arr)
+        modes.append(mode)
+    return build_image_series(series.name, imgs, modes)
+
+
+def to_fixed_shape(series: Series, mode: str, h: int, w: int) -> Series:
+    """Resize+convert to a FixedShapeImage column — a dense (n, h*w*c) buffer
+    ready for zero-copy device transfer (the TPU preprocessing entry point)."""
+    import cv2
+    from PIL import Image
+
+    c = ImageMode.num_channels(mode)
+    npdt = ImageMode.np_dtype(mode)
+    n = len(series)
+    flat = np.zeros((n, h * w * c), dtype=npdt)
+    validity = np.zeros(n, dtype=bool)
+    for i, (img, m) in enumerate(unpack_images(series)):
+        if img is None:
+            continue
+        if m != mode:
+            im = Image.fromarray(img.squeeze() if img.shape[2] == 1 else img,
+                                 mode=m if m in ("L", "LA", "RGB", "RGBA") else "RGB")
+            img = np.asarray(im.convert(mode))
+            if img.ndim == 2:
+                img = img[:, :, None]
+        resized = cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
+        if resized.ndim == 2:
+            resized = resized[:, :, None]
+        flat[i] = resized.astype(npdt).reshape(-1)
+        validity[i] = True
+    values = pa.array(flat.reshape(-1))
+    # keep the child buffer dense (zeros under null slots) so device transfer
+    # stays a single contiguous reshape; nullness lives in the validity bitmap
+    fsl = pa.FixedSizeListArray.from_arrays(
+        values, h * w * c,
+        mask=pa.array(~validity) if not validity.all() else None,
+    )
+    return Series(series.name, DataType.fixed_shape_image(mode, h, w), fsl)

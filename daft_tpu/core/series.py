@@ -159,8 +159,24 @@ class Series:
                 inner_np, shape = dt.inner.to_numpy(), dt.shape
             else:
                 inner_np, shape = dt.inner.to_numpy(), (dt.size,)
-            flat = arr.flatten()
+            # .values keeps child slots under null rows (dense); .flatten() drops them
+            flat = arr.values if hasattr(arr, "values") else arr.flatten()
             values = np.asarray(flat.to_numpy(zero_copy_only=False), dtype=inner_np)
+            if flat.null_count:
+                values = np.nan_to_num(values) if values.dtype.kind == "f" else values
+            n_expect = len(arr) * int(np.prod(shape))
+            if len(values) != n_expect:
+                # ragged child (some arrow paths drop null slots): rebuild dense
+                dense = np.zeros(n_expect, dtype=inner_np)
+                valid = self.validity_numpy()
+                per = int(np.prod(shape))
+                flat_vals = np.asarray(arr.flatten().to_numpy(zero_copy_only=False), dtype=inner_np)
+                pos = 0
+                for i, v in enumerate(valid):
+                    if v:
+                        dense[i * per:(i + 1) * per] = flat_vals[pos:pos + per]
+                        pos += per
+                values = dense
             return values.reshape((len(arr),) + tuple(shape))
         if dt.is_boolean():
             return np.asarray(arr.to_numpy(zero_copy_only=False), dtype=bool)

@@ -366,6 +366,14 @@ class Expression:
     def struct(self) -> "StructNamespace":
         return StructNamespace(self)
 
+    @property
+    def image(self) -> "ImageNamespace":
+        return ImageNamespace(self)
+
+    @property
+    def url(self) -> "UrlNamespace":
+        return UrlNamespace(self)
+
 
 class ColumnRef(Expression):
     def __init__(self, name: str):
@@ -1002,6 +1010,39 @@ class EmbeddingNamespace(_Namespace):
 
     def norm(self):
         return self._e._fn("embedding_norm")
+
+
+class ImageNamespace(_Namespace):
+    """Image ops (reference: daft Expression.image namespace / daft-image ops.rs)."""
+
+    def decode(self, mode: Optional[str] = None, on_error: str = "raise"):
+        return self._e._fn("image_decode", mode=mode, on_error=on_error)
+
+    def encode(self, image_format: str = "PNG"):
+        return self._e._fn("image_encode", image_format=image_format)
+
+    def resize(self, w: int, h: int):
+        return self._e._fn("image_resize", w=w, h=h)
+
+    def crop(self, bbox):
+        return self._e._fn("image_crop", bbox=tuple(bbox))
+
+    def to_mode(self, mode: str):
+        return self._e._fn("image_to_mode", mode=mode)
+
+    def to_fixed_shape(self, mode: str, h: int, w: int):
+        """Dense (h, w, c) batch layout — the TPU preprocessing entry point."""
+        return self._e._fn("image_to_fixed_shape", mode=mode, h=h, w=w)
+
+
+class UrlNamespace(_Namespace):
+    """URL fetch ops (reference: daft-functions-uri url download/upload)."""
+
+    def download(self, on_error: str = "raise", timeout: int = 30):
+        return self._e._fn("url_download", on_error=on_error, timeout=timeout)
+
+    def upload(self, location: str):
+        return self._e._fn("url_upload", location=location)
 
 
 class StructNamespace(_Namespace):

@@ -1,0 +1,76 @@
+"""AI expression functions (reference parity: daft/functions/ai/__init__.py:72-453
+embed_text/classify_text/prompt over the provider protocol layer)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..datatype import DataType
+from ..expressions import Expression
+from ..udf.udf import Func
+
+
+def _batch_func(fn, name: str, return_dtype: DataType, max_concurrency=None,
+                use_process: bool = False) -> Func:
+    return Func(fn=fn, return_dtype=return_dtype, is_batch=True, name=name,
+                max_concurrency=max_concurrency, use_process=use_process)
+
+
+def embed_text(expr: Expression, provider: str = "transformers",
+               model: Optional[str] = None, **options) -> Expression:
+    """Embed a text column via the named provider; model loads lazily per worker."""
+    from ..ai.provider import get_provider
+    from ..core.series import Series
+
+    state = {}
+
+    def run(s: Series) -> Series:
+        if "embedder" not in state:
+            state["embedder"] = get_provider(provider).get_text_embedder(model, **options)
+        texts = s.to_pylist()
+        mask = [t is not None for t in texts]
+        vecs = state["embedder"].embed_text([t for t in texts if t is not None])
+        it = iter(vecs)
+        out = [list(map(float, next(it))) if m else None for m in mask]
+        return Series.from_pylist(out, s.name, DataType.list(DataType.float32()))
+
+    return _batch_func(run, "embed_text", DataType.list(DataType.float32()))(expr)
+
+
+def classify_text(expr: Expression, labels: List[str], provider: str = "dummy",
+                  model: Optional[str] = None, **options) -> Expression:
+    from ..ai.provider import get_provider
+    from ..core.series import Series
+
+    state = {}
+
+    def run(s: Series) -> Series:
+        if "clf" not in state:
+            state["clf"] = get_provider(provider).get_text_classifier(model, **options)
+        texts = s.to_pylist()
+        mask = [t is not None for t in texts]
+        res = state["clf"].classify_text([t for t in texts if t is not None], labels)
+        it = iter(res)
+        out = [next(it) if m else None for m in mask]
+        return Series.from_pylist(out, s.name, DataType.string())
+
+    return _batch_func(run, "classify_text", DataType.string())(expr)
+
+
+def prompt(expr: Expression, provider: str, model: Optional[str] = None, **options) -> Expression:
+    from ..ai.provider import get_provider
+    from ..core.series import Series
+
+    state = {}
+
+    def run(s: Series) -> Series:
+        if "p" not in state:
+            state["p"] = get_provider(provider).get_prompter(model, **options)
+        texts = s.to_pylist()
+        mask = [t is not None for t in texts]
+        res = state["p"].prompt([t for t in texts if t is not None])
+        it = iter(res)
+        out = [next(it) if m else None for m in mask]
+        return Series.from_pylist(out, s.name, DataType.string())
+
+    return _batch_func(run, "prompt", DataType.string())(expr)

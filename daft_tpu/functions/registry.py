@@ -869,11 +869,26 @@ register("struct_get", _rt_struct_get, _struct_get)
 # ===================================================================================
 
 
+def _vec_2d(s) -> np.ndarray:
+    """(n, d) float64 view of an embedding/fixed-size-list OR variable list column
+    (variable lists must be rectangular)."""
+    v = s.to_numpy()
+    if v.dtype == object or v.ndim == 1:
+        rows = s.to_pylist()
+        d = next((len(r) for r in rows if r is not None), 0)
+        out = np.zeros((len(rows), d), dtype=np.float64)
+        for i, r in enumerate(rows):
+            if r is not None:
+                out[i] = np.asarray(r, dtype=np.float64)
+        return out
+    return v.astype(np.float64)
+
+
 def _vec_pair(args):
     a, b = args[0], args[1]
-    av, bv = a.to_numpy().astype(np.float64), b.to_numpy().astype(np.float64)
-    if bv.ndim == 1:
-        bv = bv[None, :]
+    av, bv = _vec_2d(a), _vec_2d(b)
+    if len(b) == 1 and len(a) != 1:
+        bv = np.broadcast_to(bv, (len(a), bv.shape[1]))
     valid = a.validity_numpy() & (b.validity_numpy() if len(b) == len(a) else np.ones(len(a), bool))
     return a, av, bv, valid
 
@@ -960,3 +975,93 @@ def _uuid_host(args, kwargs):
 
 
 register("uuid", _rt_const(DataType.string()), _uuid_host)
+
+
+# ===================================================================================
+# image (reference: src/daft-image/src/ops.rs via daft-functions image module)
+# ===================================================================================
+
+
+def _img(args):
+    return args[0]
+
+
+register("image_decode", _rt_const(DataType.image()),
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["decode"]).decode(
+             a[0], k.get("mode"), k.get("on_error", "raise")))
+register("image_encode", _rt_const(DataType.binary()),
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["encode"]).encode(
+             a[0], k.get("image_format", "PNG")))
+register("image_resize", _rt_const(DataType.image()),
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["resize"]).resize(
+             a[0], k["w"], k["h"]))
+register("image_crop", _rt_const(DataType.image()),
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["crop"]).crop(
+             a[0], k["bbox"]))
+register("image_to_mode", _rt_const(DataType.image()),
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["to_mode"]).to_mode(
+             a[0], k["mode"]))
+
+
+def _image_fixed_rt(fields, kwargs):
+    return DataType.fixed_shape_image(kwargs["mode"], kwargs["h"], kwargs["w"])
+
+
+register("image_to_fixed_shape", _image_fixed_rt,
+         lambda a, k: __import__("daft_tpu.core.kernels.image", fromlist=["to_fixed_shape"]).to_fixed_shape(
+             a[0], k["mode"], k["h"], k["w"]))
+
+
+# ===================================================================================
+# url (reference: daft-functions-uri url download/upload — multimodal fetch)
+# ===================================================================================
+
+
+def _url_download(args, kwargs):
+    s = args[0]
+    on_error = kwargs.get("on_error", "raise")
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            if v.startswith("http://") or v.startswith("https://"):
+                import urllib.request
+
+                with urllib.request.urlopen(v, timeout=kwargs.get("timeout", 30)) as r:
+                    out.append(r.read())
+            else:
+                path = v[len("file://"):] if v.startswith("file://") else v
+                with open(path, "rb") as f:
+                    out.append(f.read())
+        except Exception:
+            if on_error == "raise":
+                raise
+            out.append(None)
+    return Series(s.name, DataType.binary(), pa.array(out, pa.large_binary()))
+
+
+register("url_download", _rt_const(DataType.binary()), _url_download)
+
+
+def _url_upload(args, kwargs):
+    import os as _os
+    import uuid as _uuid
+
+    s = args[0]
+    location = kwargs["location"]
+    _os.makedirs(location, exist_ok=True)
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        path = _os.path.join(location, _uuid.uuid4().hex)
+        with open(path, "wb") as f:
+            f.write(v)
+        out.append(path)
+    return Series(s.name, DataType.string(), pa.array(out, pa.large_string()))
+
+
+register("url_upload", _rt_const(DataType.string()), _url_upload)

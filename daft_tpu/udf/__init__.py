@@ -1,4 +1,18 @@
-from .udf import Func, func, method
+import sys
+import types
+
+from .udf import Func, cls, func, method, udf
 from .expr import UdfCall
 
-__all__ = ["Func", "func", "method", "UdfCall"]
+__all__ = ["Func", "cls", "func", "method", "udf", "UdfCall"]
+
+
+class _CallableModule(types.ModuleType):
+    """`daft_tpu.udf(...)` works even though `daft_tpu.udf` is also this package
+    (import machinery shadows the api-level function with the module)."""
+
+    def __call__(self, *args, **kwargs):
+        return udf(*args, **kwargs)
+
+
+sys.modules[__name__].__class__ = _CallableModule

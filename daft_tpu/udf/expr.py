@@ -3,6 +3,12 @@
 Reference parity: src/daft-dsl/src/functions/python (ScalarFn python UDF exprs);
 the SplitUDFs optimizer rule isolates these into their own UDFProject plan nodes so
 device-stage fusion is never broken by opaque Python (SURVEY.md §7 'hard parts').
+
+Execution tiers (reference: intermediate_ops/udf.rs:384 thread-vs-process pick +
+streaming_sink/async_udf.rs):
+- in-thread (default): row loop / batch call under the GIL
+- process pool (use_process=True): forked workers via execution/udf_process.py
+- async: coroutine fan-out with a max_concurrency semaphore
 """
 
 from __future__ import annotations
@@ -11,7 +17,7 @@ import asyncio
 from typing import Any, Dict, List
 
 from ..core.series import Series
-from ..datatype import Field
+from ..datatype import DataType, Field
 from ..expressions.expressions import Expression
 from ..schema import Schema
 
@@ -32,7 +38,10 @@ class UdfCall(Expression):
         return UdfCall(self.func, children, self.kwargs)
 
     def to_field(self, schema: Schema) -> Field:
-        return Field(self.name(), self.func.return_dtype)
+        dt = self.func.return_dtype
+        if getattr(self.func, "is_generator", False):
+            dt = DataType.list(dt)
+        return Field(self.name(), dt)
 
     def __repr__(self):
         inner = ", ".join(repr(a) for a in self.args)
@@ -41,20 +50,47 @@ class UdfCall(Expression):
     # ---- execution ------------------------------------------------------------------
     def eval_host(self, arg_series: List[Series], num_rows: int) -> Series:
         f = self.func
+        out_name = self.name()
+
+        if f.use_process:
+            from ..execution.udf_process import get_pool
+
+            payload = get_pool(f).run_batch(arg_series, self.kwargs, num_rows)
+            if f.is_batch:
+                out = Series.from_arrow(payload, out_name)
+                if out.dtype != f.return_dtype:
+                    out = out.cast(f.return_dtype)
+                return out
+            dt = DataType.list(f.return_dtype) if f.is_generator else f.return_dtype
+            return Series.from_pylist(payload, out_name, dt)
+
         if f.is_batch:
             out = f.fn(*arg_series, **self.kwargs)
             if not isinstance(out, Series):
                 out = Series.from_pylist(list(out), f.name, f.return_dtype)
-            return out.rename(self.name())
+            return out.rename(out_name)
 
         cols = [s.to_pylist() for s in arg_series]
         # broadcast length-1 args
         cols = [c * num_rows if len(c) == 1 and num_rows != 1 else c for c in cols]
+
+        if getattr(f, "is_generator", False):
+            results = [list(f.fn(*vals, **self.kwargs)) for vals in zip(*cols)]
+            return Series.from_pylist(results, out_name, DataType.list(f.return_dtype))
+
         if f.is_async:
+            limit = f.max_concurrency or 256
+
             async def run_all():
-                return await asyncio.gather(*(f.fn(*vals, **self.kwargs) for vals in zip(*cols)))
+                sem = asyncio.Semaphore(limit)
+
+                async def one(vals):
+                    async with sem:
+                        return await f.fn(*vals, **self.kwargs)
+
+                return await asyncio.gather(*(one(vals) for vals in zip(*cols)))
 
             results = asyncio.run(run_all())
         else:
             results = [f.fn(*vals, **self.kwargs) for vals in zip(*cols)]
-        return Series.from_pylist(results, self.name(), f.return_dtype)
+        return Series.from_pylist(results, out_name, f.return_dtype)

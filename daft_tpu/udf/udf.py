@@ -21,6 +21,7 @@ class Func:
     return_dtype: DataType
     is_batch: bool = False
     is_async: bool = False
+    is_generator: bool = False
     batch_size: Optional[int] = None
     max_concurrency: Optional[int] = None
     use_process: bool = False
@@ -59,6 +60,8 @@ def func(
             return_dtype=rdt,
             is_batch=is_batch,
             is_async=inspect.iscoroutinefunction(f),
+            # batch fns return whole Series — generator semantics apply row-wise only
+            is_generator=inspect.isgeneratorfunction(f) and not is_batch,
             batch_size=batch_size,
             max_concurrency=max_concurrency,
             use_process=use_process,
@@ -97,36 +100,124 @@ def _dtype_from_hint(hint) -> DataType:
     return DataType.python()
 
 
-class cls:  # noqa: N801 — mirrors the reference's @daft.cls decorator name
-    """``@daft_tpu.cls`` — stateful UDF class; instantiated once per worker.
+class _ClsWrapper:
+    """Wraps a user class; calling it captures __init__ args and returns a
+    lazy instance handle whose methods build UDF expressions."""
 
-    Reference parity: daft/udf/udf_v2.py ClsBase. The wrapped class's __init__ runs
-    lazily on first call (per process), so expensive setup (model load) happens on
-    the executor, not the driver.
-    """
-
-    def __init__(self, klass=None, *, max_concurrency: Optional[int] = None, use_process: bool = False):
+    def __init__(self, klass, max_concurrency: Optional[int], use_process: bool):
         self._klass = klass
         self._max_concurrency = max_concurrency
         self._use_process = use_process
-        self._instance = None
 
-    def __call__(self, *args, **kwargs):
-        if self._klass is None:
-            # used as @cls(...) with arguments
-            self._klass = args[0]
-            return self
-        raise TypeError("instantiate via .method(...) expressions")
+    def __call__(self, *args, **kwargs) -> "_ClsInstance":
+        return _ClsInstance(self, args, kwargs)
 
 
-def method(fn: Optional[Callable] = None, *, return_dtype: Optional[DataType] = None):
-    """Mark a method of a ``@cls`` class as a UDF entrypoint."""
+class _ClsInstance:
+    """Deferred instance: the real object is constructed once per worker process
+    on first use (expensive model loads happen on the executor, not the driver)."""
+
+    def __init__(self, wrapper: _ClsWrapper, init_args, init_kwargs):
+        object.__setattr__(self, "_wrapper", wrapper)
+        object.__setattr__(self, "_init_args", init_args)
+        object.__setattr__(self, "_init_kwargs", init_kwargs)
+        object.__setattr__(self, "_obj", None)
+        object.__setattr__(self, "_method_funcs", {})
+
+    def _materialize(self):
+        if self._obj is None:
+            obj = self._wrapper._klass(*self._init_args, **self._init_kwargs)
+            object.__setattr__(self, "_obj", obj)
+        return self._obj
+
+    def __getattr__(self, name: str):
+        cached = self._method_funcs.get(name)
+        if cached is not None:
+            return cached
+        target = getattr(self._wrapper._klass, name, None)
+        if target is None:
+            raise AttributeError(name)
+        if not callable(target):
+            # plain attribute / property: read it off the materialized instance
+            return getattr(self._materialize(), name)
+        rdt = getattr(target, "__udf_return_dtype__", None)
+        if rdt is None:
+            hint = inspect.signature(target).return_annotation
+            try:
+                rdt = _dtype_from_hint(hint)
+            except ValueError:
+                rdt = DataType.python()
+        inst = self
+
+        def bound(*vals, **kw):
+            return getattr(inst._materialize(), name)(*vals, **kw)
+
+        f = Func(
+            fn=bound,
+            return_dtype=rdt,
+            is_batch=bool(getattr(target, "__udf_is_batch__", False)),
+            is_async=inspect.iscoroutinefunction(target),
+            is_generator=inspect.isgeneratorfunction(target),
+            max_concurrency=self._wrapper._max_concurrency,
+            use_process=self._wrapper._use_process,
+            name=f"{self._wrapper._klass.__name__}.{name}",
+        )
+        self._method_funcs[name] = f
+        return f
+
+
+def cls(klass=None, *, max_concurrency: Optional[int] = None, use_process: bool = False):
+    """``@daft_tpu.cls`` — stateful UDF class; instantiated once per worker.
+
+    Reference parity: daft/udf/udf_v2.py ClsBase::
+
+        @daft_tpu.cls
+        class Embedder:
+            def __init__(self, model): self.m = load(model)
+            def embed(self, text: str) -> float: ...
+
+        e = Embedder("small")                 # lazy — nothing loads here
+        df.select(e.embed(col("text")))       # loads once per worker
+    """
+    if klass is not None:
+        return _ClsWrapper(klass, max_concurrency, use_process)
+
+    def wrap(k):
+        return _ClsWrapper(k, max_concurrency, use_process)
+
+    return wrap
+
+
+def method(fn: Optional[Callable] = None, *, return_dtype: Optional[DataType] = None,
+           is_batch: bool = False):
+    """Mark a method of a ``@cls`` class as a UDF entrypoint with an explicit
+    return dtype (otherwise inferred from the annotation)."""
 
     def wrap(f):
         f.__udf_method__ = True
         f.__udf_return_dtype__ = return_dtype
+        f.__udf_is_batch__ = is_batch
         return f
 
     if fn is not None:
         return wrap(fn)
+    return wrap
+
+
+def udf(*, return_dtype: DataType, batch_size: Optional[int] = None,
+        max_concurrency: Optional[int] = None, use_process: bool = False):
+    """Legacy ``@daft.udf`` decorator (reference: daft/udf/legacy.py) — batch
+    UDFs receiving Series arguments."""
+
+    def wrap(f: Callable) -> Func:
+        return Func(
+            fn=f,
+            return_dtype=return_dtype,
+            is_batch=True,
+            batch_size=batch_size,
+            max_concurrency=max_concurrency,
+            use_process=use_process,
+            name=getattr(f, "__name__", "udf"),
+        )
+
     return wrap

@@ -1,0 +1,131 @@
+"""Multimodal tests: image kernels, url fetch, embeddings, AI functions, minhash
+(reference test model: tests/io multimodal + daft-image tests + tests/ai)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.datatype import DataType
+
+
+def _png(w, h, color):
+    from PIL import Image
+
+    im = Image.new("RGB", (w, h), color)
+    b = io.BytesIO()
+    im.save(b, format="PNG")
+    return b.getvalue()
+
+
+@pytest.fixture
+def img_df():
+    return dt.from_pydict({
+        "bytes": [_png(8, 6, (255, 0, 0)), _png(10, 4, (0, 255, 0)), None],
+    })
+
+
+def test_image_decode(img_df):
+    out = img_df.with_column("img", col("bytes").image.decode())
+    assert out.schema["img"].dtype.kind == "image"
+    d = out.to_pydict()
+    assert d["img"][0]["height"] == 6 and d["img"][0]["width"] == 8
+    assert d["img"][2] is None
+
+
+def test_image_resize_encode_roundtrip(img_df):
+    from PIL import Image
+
+    out = (img_df.with_column("img", col("bytes").image.decode())
+           .with_column("small", col("img").image.resize(4, 3))
+           .with_column("re", col("small").image.encode("PNG"))).to_pydict()
+    im = Image.open(io.BytesIO(out["re"][0]))
+    assert im.size == (4, 3)
+    assert out["re"][2] is None
+
+
+def test_image_crop_and_mode(img_df):
+    out = (img_df.with_column("img", col("bytes").image.decode())
+           .with_column("c", col("img").image.crop((0, 0, 2, 2)))
+           .with_column("g", col("img").image.to_mode("L"))).to_pydict()
+    assert out["c"][0]["height"] == 2 and out["c"][0]["width"] == 2
+    assert out["g"][0]["channels"] == 1
+
+
+def test_image_to_fixed_shape(img_df):
+    out = (img_df.with_column("img", col("bytes").image.decode())
+           .with_column("t", col("img").image.to_fixed_shape("RGB", 4, 4))).to_pydict()
+    assert out["t"][0].shape == (4, 4, 3)
+    assert out["t"][2] is None
+    # red image stays red after resize
+    assert out["t"][0][0, 0, 0] == 255
+
+
+def test_image_decode_on_error_null():
+    d = dt.from_pydict({"b": [b"notanimage", _png(2, 2, (1, 2, 3))]})
+    out = d.with_column("img", col("b").image.decode(on_error="null")).to_pydict()
+    assert out["img"][0] is None and out["img"][1] is not None
+
+
+def test_url_roundtrip(tmp_path, img_df):
+    up = (img_df.where(col("bytes").not_null())
+          .with_column("p", col("bytes").url.upload(str(tmp_path)))).to_pydict()
+    assert all(os.path.exists(p) for p in up["p"])
+    dl = dt.from_pydict({"p": up["p"]}).with_column("d", col("p").url.download()).to_pydict()
+    assert dl["d"] == up["bytes"]
+
+
+def test_url_download_missing_null():
+    d = dt.from_pydict({"p": ["/nonexistent/file.bin"]})
+    out = d.with_column("d", col("p").url.download(on_error="null")).to_pydict()
+    assert out["d"] == [None]
+    with pytest.raises(Exception):
+        d.with_column("d", col("p").url.download()).to_pydict()
+
+
+def test_embedding_distances():
+    e = dt.from_pydict({"a": [[1.0, 0.0], [0.0, 1.0]], "b": [[1.0, 0.0], [1.0, 0.0]]})
+    out = e.select(
+        col("a").embedding.cosine_distance(col("b")).alias("cos"),
+        col("a").embedding.dot(col("b")).alias("dot"),
+        col("a").embedding.euclidean_distance(col("b")).alias("l2"),
+    ).to_pydict()
+    assert abs(out["cos"][0]) < 1e-9 and abs(out["cos"][1] - 1.0) < 1e-9
+    assert out["dot"] == [1.0, 0.0]
+    assert abs(out["l2"][1] - np.sqrt(2)) < 1e-9
+
+
+def test_ai_embed_classify_dummy():
+    from daft_tpu.functions import classify_text, embed_text
+
+    df = dt.from_pydict({"t": ["hello", "world", None]})
+    out = df.with_column("e", embed_text(col("t"), provider="dummy")).to_pydict()
+    assert len(out["e"][0]) == 16 and out["e"][2] is None
+    # deterministic
+    out2 = df.with_column("e", embed_text(col("t"), provider="dummy")).to_pydict()
+    assert out["e"][0] == out2["e"][0]
+    c = df.with_column("c", classify_text(col("t"), ["x", "y"], provider="dummy")).to_pydict()
+    assert c["c"][0] in ("x", "y") and c["c"][2] is None
+
+
+def test_minhash_dedup_shape():
+    d = dt.from_pydict({"s": ["the quick brown fox", "the quick brown fox!", "zzz totally different"]})
+    out = d.with_column("m", col("s").minhash(num_hashes=16, ngram_size=2)).to_pydict()
+    assert all(len(m) == 16 for m in out["m"])
+    sim_close = sum(a == b for a, b in zip(out["m"][0], out["m"][1])) / 16
+    sim_far = sum(a == b for a, b in zip(out["m"][0], out["m"][2])) / 16
+    assert sim_close > sim_far
+
+
+def test_approx_count_distinct():
+    import random
+
+    random.seed(0)
+    vals = [f"v{random.randrange(500)}" for _ in range(5000)]
+    d = dt.from_pydict({"x": vals})
+    approx = d.agg(col("x").approx_count_distinct().alias("a")).to_pydict()["a"][0]
+    exact = d.agg(col("x").count_distinct().alias("e")).to_pydict()["e"][0]
+    assert abs(approx - exact) / exact < 0.15

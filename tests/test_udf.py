@@ -1,0 +1,148 @@
+"""UDF tests (reference test model: tests/udf/* + tests/actor_pool/*)."""
+
+import asyncio
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.datatype import DataType
+
+
+@pytest.fixture
+def df():
+    return dt.from_pydict({"x": [1, 2, 3], "s": ["a", "b", "c"]})
+
+
+def test_row_udf(df):
+    @dt.func
+    def double(x: int) -> int:
+        return x * 2
+
+    assert df.select(double(col("x"))).to_pydict() == {"x": [2, 4, 6]}
+
+
+def test_udf_return_dtype_inference(df):
+    @dt.func
+    def as_str(x: int) -> str:
+        return f"v{x}"
+
+    out = df.select(as_str(col("x")).alias("y"))
+    assert out.schema["y"].dtype == DataType.string()
+    assert out.to_pydict() == {"y": ["v1", "v2", "v3"]}
+
+
+def test_batch_udf(df):
+    @dt.func(is_batch=True, return_dtype=DataType.float64())
+    def scaled(s):
+        return dt.Series.from_numpy(s.to_numpy() * 1.5, "x")
+
+    assert df.select(scaled(col("x"))).to_pydict() == {"x": [1.5, 3.0, 4.5]}
+
+
+def test_multi_arg_udf_with_literal(df):
+    @dt.func
+    def combine(x: int, s: str, suffix: str) -> str:
+        return f"{s}{x}{suffix}"
+
+    out = df.select(combine(col("x"), col("s"), "!").alias("c")).to_pydict()
+    assert out == {"c": ["a1!", "b2!", "c3!"]}
+
+
+def test_process_udf(df):
+    @dt.func(use_process=True, max_concurrency=2)
+    def sq(x: int) -> int:
+        return x * x
+
+    assert df.select(sq(col("x")).alias("y")).to_pydict() == {"y": [1, 4, 9]}
+
+
+def test_process_udf_error_propagates(df):
+    @dt.func(use_process=True)
+    def boom(x: int) -> int:
+        raise RuntimeError("kapow")
+
+    with pytest.raises(RuntimeError, match="kapow"):
+        df.select(boom(col("x"))).to_pydict()
+
+
+def test_async_udf(df):
+    @dt.func
+    async def aplus(x: int) -> int:
+        await asyncio.sleep(0)
+        return x + 10
+
+    assert df.select(aplus(col("x"))).to_pydict() == {"x": [11, 12, 13]}
+
+
+def test_generator_udf(df):
+    @dt.func(return_dtype=DataType.int64())
+    def expand(x: int):
+        for i in range(x):
+            yield i
+
+    out = df.select(col("x"), expand(col("x")).alias("e"))
+    assert out.to_pydict()["e"] == [[0], [0, 1], [0, 1, 2]]
+    # explode to one row per yielded item
+    assert out.explode("e").to_pydict()["e"] == [0, 0, 1, 0, 1, 2]
+
+
+def test_stateful_cls(df):
+    init_count = {"n": 0}
+
+    @dt.cls
+    class Adder:
+        def __init__(self, base):
+            init_count["n"] += 1
+            self.base = base
+
+        def add(self, x: int) -> int:
+            return self.base + x
+
+    a = Adder(100)
+    assert init_count["n"] == 0  # lazy: not constructed at wrap time
+    assert df.select(a.add(col("x"))).to_pydict() == {"x": [101, 102, 103]}
+    assert init_count["n"] == 1
+    df.select(a.add(col("x"))).to_pydict()
+    assert init_count["n"] == 1  # instance reused
+
+
+def test_stateful_cls_in_process(df):
+    @dt.cls(use_process=True)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def tick(self, x: int) -> int:
+            self.n += 1
+            return self.n
+
+    c = Counter()
+    assert df.select(c.tick(col("x")).alias("t")).to_pydict() == {"t": [1, 2, 3]}
+
+
+def test_legacy_udf_decorator(df):
+    @dt.udf(return_dtype=DataType.int64())
+    def plus1(s):
+        return dt.Series.from_numpy(s.to_numpy() + 1, "x")
+
+    assert df.select(plus1(col("x"))).to_pydict() == {"x": [2, 3, 4]}
+
+
+def test_udf_split_into_udfproject(df):
+    @dt.func
+    def double(x: int) -> int:
+        return x * 2
+
+    q = df.select(col("s"), double(col("x")).alias("d"), (col("x") + 1).alias("p"))
+    from daft_tpu.plan.logical import UDFProject
+
+    opt = q._builder.optimize().plan
+    assert any(isinstance(n, UDFProject) for n in opt.walk())
+    out = q.to_pydict()
+    assert out == {"s": ["a", "b", "c"], "d": [2, 4, 6], "p": [2, 3, 4]}
+
+
+def test_udf_apply_method(df):
+    out = df.select(col("x").apply(lambda v: v * 7, return_dtype=DataType.int64()))
+    assert out.to_pydict() == {"x": [7, 14, 21]}
