@@ -100,13 +100,12 @@ class FilterAggStage:
             count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
             agg_specs.append((name, agg.op, count_all, child_fn))
 
-        def stage(cols: Dict[str, dev.DCol]):
+        def stage(cols: Dict[str, dev.DCol], row_mask):
             if pred_fn is not None:
                 pv, pm = pred_fn(cols)
-                keep = pv.astype(bool) & pm
+                keep = pv.astype(bool) & pm & row_mask
             else:
-                any_col = next(iter(cols.values()))
-                keep = jnp.ones(jnp.shape(any_col[0]), dtype=bool)
+                keep = row_mask
             out = {}
             for name, op, count_all, child_fn in agg_specs:
                 v, m = child_fn(cols)
@@ -132,7 +131,9 @@ class FilterAggStage:
                 vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
                 valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
             dcols[name] = (jnp.asarray(vals), jnp.asarray(valid))
-        res = self._jitted[bucket](dcols)
+        row_mask = np.zeros(bucket, dtype=bool)
+        row_mask[:n] = True
+        res = self._jitted[bucket](dcols, jnp.asarray(row_mask))
         self._partials.append({k: (np.asarray(v[0]).item(), bool(np.asarray(v[1]))) for k, v in res.items()})
 
     def feed_batch(self, batch) -> None:

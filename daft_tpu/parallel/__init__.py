@@ -1,13 +1,15 @@
 from .distributed import (
     default_mesh,
+    groupby_host,
     sharded_filter_agg_step,
-    sharded_grouped_agg_step,
+    sharded_groupby_step,
     shard_columns,
 )
 
 __all__ = [
     "default_mesh",
+    "groupby_host",
     "sharded_filter_agg_step",
-    "sharded_grouped_agg_step",
+    "sharded_groupby_step",
     "shard_columns",
 ]

@@ -1,16 +1,19 @@
 """Mesh-sharded relational compute: the multi-chip execution path.
 
 TPU-native replacement for the reference's distributed data movement
-(src/daft-distributed "Flotilla" + src/daft-shuffles Arrow-Flight shuffle):
-within a mesh, repartition/aggregation exchange rides ICI via XLA collectives
-(psum / all_to_all) inside ONE jit program instead of host-side shuffle services;
-cross-host DCN exchange reuses the same primitives through jax.distributed.
+(reference: src/daft-distributed "Flotilla" + src/daft-shuffles Arrow-Flight
+shuffle): within a mesh, repartition/aggregation exchange rides ICI via XLA
+collectives (psum / all_gather) inside ONE jit program instead of host-side
+shuffle services; cross-host DCN exchange reuses the same primitives through
+jax.distributed.
 
 Layout: rows are data-parallel sharded along the 'dp' mesh axis (each device
 owns a contiguous row shard, padded with validity=False rows). Ungrouped
 aggregation = local masked reduce + psum. Grouped aggregation = local
-segment-reduce into a fixed-width group-hash table + psum — the device
-equivalent of partial→final two-phase aggregation.
+sort/unique + segment-reduce into a fixed-capacity group table, then an
+all_gather table merge — an EXACT two-phase groupby whose 'shuffle' is one ICI
+collective. Capacity is static (XLA needs static shapes); exceeding it is
+reported via an overflow flag so the host can re-run with a larger table.
 """
 
 from __future__ import annotations
@@ -28,6 +31,9 @@ from ..expressions.expressions import AggExpr, Expression
 from ..ops import device_eval as dev
 from ..ops.stage import _decompose_agg, pad_bucket
 from ..schema import Schema
+
+# Sentinel key for invalid / padding rows: sorts after every real key.
+_KEY_SENTINEL = np.iinfo(np.int64).max
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
@@ -53,11 +59,26 @@ def shard_columns(mesh: Mesh, columns: Dict[str, Tuple[np.ndarray, np.ndarray]],
     return out
 
 
+def shard_row_mask(mesh: Mesh, n: int, axis: str = "dp") -> jax.Array:
+    """Row-sharded bool mask marking real rows (False on shard padding).
+
+    Needed by count(mode=all): null values count, padding rows must not.
+    """
+    n_dev = mesh.shape[axis]
+    per = pad_bucket(max((n + n_dev - 1) // n_dev, 1))
+    total = per * n_dev
+    mask = np.zeros(total, dtype=bool)
+    mask[:n] = True
+    return jax.device_put(mask, NamedSharding(mesh, P(axis)))
+
+
 def sharded_filter_agg_step(mesh: Mesh, schema: Schema, predicate: Optional[Expression],
                             aggs: Sequence[Tuple[str, AggExpr]], axis: str = "dp") -> Callable:
     """Build a pjit'd distributed filter+ungrouped-agg step.
 
-    Returns fn(cols) -> {(name, partial_op): (value, valid)} with replicated outputs.
+    Returns fn(cols, row_mask) -> {(name, partial_op): (value, valid)} with
+    replicated outputs; row_mask (see shard_row_mask) marks real rows so shard
+    padding never reaches an aggregate — count(mode=all) counts nulls, not padding.
     With row-sharded inputs, XLA lowers the reductions to per-shard partials plus a
     psum over ICI — no explicit collective code needed beyond the sharding contract.
     """
@@ -68,13 +89,12 @@ def sharded_filter_agg_step(mesh: Mesh, schema: Schema, predicate: Optional[Expr
         count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
         agg_specs.append((name, agg.op, count_all, child_fn))
 
-    def step(cols):
+    def step(cols, row_mask):
         if pred_fn is not None:
             pv, pm = pred_fn(cols)
-            keep = pv.astype(bool) & pm
+            keep = pv.astype(bool) & pm & row_mask
         else:
-            any_col = next(iter(cols.values()))
-            keep = jnp.ones(jnp.shape(any_col[0]), dtype=bool)
+            keep = row_mask
         out = {}
         for name, op, count_all, child_fn in agg_specs:
             v, m = child_fn(cols)
@@ -90,31 +110,180 @@ def sharded_filter_agg_step(mesh: Mesh, schema: Schema, predicate: Optional[Expr
     return jax.jit(step, out_shardings=replicated)
 
 
-def sharded_grouped_agg_step(mesh: Mesh, schema: Schema, key_col: str,
-                             agg_col: str, agg_op: str, num_buckets: int,
-                             axis: str = "dp") -> Callable:
-    """Distributed groupby-aggregate over integer group keys via shard_map.
+def _segment_reduce(op: str, values: jnp.ndarray, mask: jnp.ndarray,
+                    seg: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Masked segment reduce. Invalid rows contribute the op's identity.
 
-    Each device segment-reduces its row shard into a fixed-width bucket table
-    (key hashed to [0, num_buckets)), then a psum over the mesh axis combines
-    partial tables — two-phase aggregation where the 'shuffle' is one ICI
-    collective. Returns fn(keys, values, valid) -> (bucket_sums, bucket_counts),
-    both replicated [num_buckets] arrays.
+    Integer inputs accumulate in int64 (exact, matching the single-node
+    device_agg); floats in float64.
     """
-    from jax.experimental.shard_map import shard_map
+    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
+    if op == "count":
+        return jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=num_segments)
+    if op == "sum":
+        acc = jnp.int64 if is_int else jnp.float64
+        v = jnp.where(mask, values.astype(acc), jnp.zeros((), acc))
+        return jax.ops.segment_sum(v, seg, num_segments=num_segments)
+    if op in ("min", "max"):
+        acc = jnp.int64 if is_int else jnp.float64
+        if is_int:
+            ident = jnp.iinfo(jnp.int64).max if op == "min" else jnp.iinfo(jnp.int64).min
+        else:
+            ident = jnp.inf if op == "min" else -jnp.inf
+        v = jnp.where(mask, values.astype(acc), jnp.asarray(ident, acc))
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        return fn(v, seg, num_segments=num_segments)
+    raise ValueError(f"no segment reduce for {op!r}")
 
-    def local(keys, values, valid):
-        b = (keys % num_buckets).astype(jnp.int32)
-        vals = jnp.where(valid, values.astype(jnp.float64), 0.0)
-        sums = jax.ops.segment_sum(vals, b, num_segments=num_buckets)
-        counts = jax.ops.segment_sum(valid.astype(jnp.int64), b, num_segments=num_buckets)
-        sums = jax.lax.psum(sums, axis)
-        counts = jax.lax.psum(counts, axis)
-        return sums, counts
 
-    mapped = shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=(P(), P()),
-    )
+def _merge_op(op: str) -> str:
+    """Reduce op used when merging per-shard partial tables."""
+    return {"count": "sum", "sum": "sum", "min": "min", "max": "max"}[op]
+
+
+def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
+                         axis: str = "dp") -> Callable:
+    """EXACT distributed groupby-aggregate over int64 group keys.
+
+    Each device: sort/unique its row shard's keys into a fixed-capacity group
+    table (jnp.unique with static size) and segment-reduce values per group.
+    Merge: all_gather the per-shard tables over the mesh axis and re-reduce —
+    two-phase aggregation where the shuffle is one ICI collective. No hashing,
+    no collisions: real keys are carried through both phases.
+
+    agg_ops: per value-column ops from {sum, count, min, max, mean}.
+    capacity: max distinct keys (static; XLA shape). Exceeding it sets the
+    returned overflow flag (host should retry with a larger capacity).
+
+    Returns fn(keys, key_valid, *[(values, valid) flattened]) ->
+      (group_keys[capacity], group_valid[capacity], overflow_scalar,
+       results: tuple of per-column (values[capacity], valid[capacity])).
+    Rows with invalid keys (nulls / shard padding) are excluded.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    ops = list(agg_ops)
+    cap1 = capacity + 1  # one extra slot so the sentinel never evicts a real key
+
+    def _true_unique_count(sorted_keys: jnp.ndarray) -> jnp.ndarray:
+        """Number of distinct non-sentinel keys in an ascending-sorted array."""
+        real = sorted_keys != _KEY_SENTINEL
+        first = jnp.concatenate([
+            jnp.ones((1,), dtype=bool),
+            sorted_keys[1:] != sorted_keys[:-1],
+        ])
+        return jnp.sum(first & real)
+
+    def local(keys, key_valid, *flat):
+        cols = [(flat[2 * i], flat[2 * i + 1]) for i in range(len(ops))]
+        k = jnp.where(key_valid, keys.astype(jnp.int64), _KEY_SENTINEL)
+        sorted_k = jnp.sort(k)
+        local_nu = _true_unique_count(sorted_k)
+        uk = jnp.unique(k, size=cap1, fill_value=_KEY_SENTINEL)
+        seg = jnp.searchsorted(uk, k)
+
+        # per-column partial tables; a "count" partial is always included so the
+        # merge phase can null out groups whose values are all-null
+        col_partials: List[List[str]] = []
+        partial_tables = []
+        for (v, m), op in zip(cols, ops):
+            mask = dev._broadcast_valid(k, m) & key_valid
+            partials = list(_decompose_agg(op))
+            if "count" not in partials:
+                partials.append("count")
+            col_partials.append(partials)
+            for partial in partials:
+                partial_tables.append(_segment_reduce(partial, v, mask, seg, cap1))
+
+        # merge phase: gather every shard's table, re-group by real key
+        all_k = jax.lax.all_gather(uk, axis).reshape(-1)
+        gathered = [jax.lax.all_gather(t, axis).reshape(-1) for t in partial_tables]
+        fuk = jnp.unique(all_k, size=cap1, fill_value=_KEY_SENTINEL)
+        fseg = jnp.searchsorted(fuk, all_k)
+
+        idx = 0
+        results = []
+        src_valid = all_k != _KEY_SENTINEL
+        for op, partials in zip(ops, col_partials):
+            merged = {}
+            for partial in partials:
+                t = gathered[idx]
+                idx += 1
+                merged[partial] = _segment_reduce(
+                    _merge_op(partial), t, src_valid, fseg, cap1
+                )
+            cnt = merged["count"]
+            if op == "mean":
+                val = merged["sum"] / jnp.maximum(cnt, 1)
+                ok = cnt > 0
+            elif op == "count":
+                val = cnt
+                ok = jnp.ones_like(cnt, dtype=bool)
+            else:
+                val = merged[op]
+                ok = cnt > 0
+            results.append((val[:capacity], ok[:capacity]))
+
+        total_nu = _true_unique_count(jnp.sort(all_k))
+        overflow = (
+            jax.lax.pmax(local_nu, axis) > capacity
+        ) | (total_nu > capacity)
+        group_keys = fuk[:capacity]
+        group_valid = group_keys != _KEY_SENTINEL
+        return group_keys, group_valid, overflow, tuple(results)
+
+    in_specs = tuple([P(axis), P(axis)] + [P(axis)] * (2 * len(ops)))
+    out_specs = (P(), P(), P(), tuple((P(), P()) for _ in ops))
+    try:
+        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    except TypeError:  # pre-0.8 jax spells it check_rep
+        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
     return jax.jit(mapped)
+
+
+def groupby_host(mesh: Mesh, keys: np.ndarray, key_valid: np.ndarray,
+                 value_cols: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 agg_ops: Sequence[str], axis: str = "dp",
+                 capacity: Optional[int] = None):
+    """Host driver for sharded_groupby_step: shards inputs, retries on overflow.
+
+    Returns (group_keys np.int64[g], per-col list of (values np, valid np)) with
+    only real groups (overflow resolved by doubling capacity).
+    """
+    n = len(keys)
+    keys = keys.astype(np.int64)
+    if key_valid.any() and keys[key_valid].max() == _KEY_SENTINEL:
+        raise ValueError(
+            f"group key {_KEY_SENTINEL} (int64 max) is reserved as the null/padding "
+            "sentinel on the device groupby path"
+        )
+    if capacity is None:
+        capacity = max(int(2 ** np.ceil(np.log2(max(16, min(n, 4096))))), 16)
+    cols = {"__key__": (keys, key_valid)}
+    for i, (v, m) in enumerate(value_cols):
+        cols[f"__v{i}__"] = (v, m)
+    sharded = shard_columns(mesh, cols, n, axis=axis)
+    flat = []
+    for i in range(len(value_cols)):
+        dv, dm = sharded[f"__v{i}__"]
+        flat += [dv, dm]
+    while True:
+        step = sharded_groupby_step(mesh, agg_ops, capacity, axis=axis)
+        gk, gv, overflow, results = step(
+            sharded["__key__"][0], sharded["__key__"][1], *flat
+        )
+        if bool(np.asarray(overflow)):
+            capacity *= 2
+            continue
+        gk = np.asarray(gk)
+        gv = np.asarray(gv)
+        keep = gv
+        out_cols = [
+            (np.asarray(v)[keep], np.asarray(ok)[keep]) for v, ok in results
+        ]
+        return gk[keep], out_cols
