@@ -1,0 +1,86 @@
+"""Execution configuration (reference parity: src/common/daft-config/src/lib.rs:109-145
+DaftPlanningConfig/DaftExecutionConfig + daft/context.py set_execution_config).
+
+Frozen dataclass snapshot + env-var defaults; set_execution_config mutates the
+process default, execution_config_ctx scopes an override.
+
+Device (TPU) knobs: the engine's agg stages can run on the JAX device. Mode:
+  - "on": always use the device for qualifying stages
+  - "off": never
+  - "auto" (default): use the device when the backend is a real accelerator and
+    the first morsel has >= device_min_rows rows (amortizes transfer/dispatch
+    latency; below that the host kernels win)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass, field, replace
+from typing import Iterator, Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class ExecutionConfig:
+    # device (TPU) stage selection
+    device_mode: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_DEVICE", "auto")
+    )
+    # Default calibrated for a tunneled/remote device (measured ~0.1-2s per
+    # dispatch+fetch round trip): only very large morsels amortize it. On
+    # co-located TPU hardware set this to ~1M (or device_mode="on").
+    device_min_rows: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_DEVICE_MIN_ROWS", 32_000_000)
+    )
+    # morsel sizing (reference default_morsel_size, common/daft-config/src/lib.rs:131)
+    morsel_size_rows: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_MORSEL_SIZE", 128 * 1024)
+    )
+    # broadcast-join threshold (reference: 10MiB)
+    broadcast_join_size_bytes: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_BROADCAST_JOIN_BYTES", 10 * 1024 * 1024)
+    )
+    # memory budget for blocking sinks (0 = unbounded)
+    memory_limit_bytes: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_MEMORY_LIMIT", 0)
+    )
+    # pipeline executor knobs
+    num_threads: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4)
+    )
+
+
+_default: Optional[ExecutionConfig] = None
+
+
+def execution_config() -> ExecutionConfig:
+    global _default
+    if _default is None:
+        _default = ExecutionConfig()
+    return _default
+
+
+def set_execution_config(**kwargs) -> ExecutionConfig:
+    """Update the process-default execution config; returns the new snapshot."""
+    global _default
+    _default = replace(execution_config(), **kwargs)
+    return _default
+
+
+@contextlib.contextmanager
+def execution_config_ctx(**kwargs) -> Iterator[ExecutionConfig]:
+    """Scoped execution-config override."""
+    global _default
+    prev = execution_config()
+    _default = replace(prev, **kwargs)
+    try:
+        yield _default
+    finally:
+        _default = prev

@@ -18,7 +18,7 @@ from .series import Series
 
 
 class RecordBatch:
-    __slots__ = ("_schema", "_columns", "_num_rows")
+    __slots__ = ("_schema", "_columns", "_num_rows", "_stage_cache")
 
     def __init__(self, schema: Schema, columns: List[Series], num_rows: Optional[int] = None):
         if num_rows is None:

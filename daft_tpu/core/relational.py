@@ -2,10 +2,10 @@
 distinct, explode, unpivot, pivot, sample.
 
 Reference parity: src/daft-micropartition/src/ops/*.rs and
-src/daft-recordbatch/src/ops/ (agg, joins, groups). Host implementations are
-vectorized numpy/arrow; the device (TPU) fast path for numeric grouped aggregation
-lives in ops/device_eval.py (segment-reduce after sort) and is selected by the
-executor when dtypes allow.
+src/daft-recordbatch/src/ops/ (agg, joins, groups). These are the HOST
+implementations (vectorized numpy/arrow/C++). The device (TPU) aggregation path
+is separate: plan/physical.py lowers qualifying agg chains to Device*Agg nodes
+executed via ops/stage.py and ops/grouped_stage.py.
 """
 
 from __future__ import annotations
@@ -86,6 +86,8 @@ def ungrouped_agg(batch: RecordBatch, aggs: Sequence[Expression]) -> RecordBatch
             res = s.count(mode)
         elif op == "any_value":
             res = s.any_value(inner.params.get("ignore_nulls", False))
+        elif op in ("stddev", "var"):
+            res = getattr(s, op)(ddof=inner.params.get("ddof", 0))
         else:
             res = _SERIES_AGG[op](s)
         out.append(res.rename(name))
@@ -228,7 +230,9 @@ def _grouped_agg_native(s: Series, agg: AggExpr, ctx: _GroupCtx) -> Optional[Ser
         m = sums / cnt
         var = np.maximum(sq / cnt - m * m, 0.0)
         if ddof:
-            var = var * cnt / np.maximum(cnt - ddof, 0)
+            var = var * cnt / np.where(cnt > ddof, cnt - ddof, 1)
+            # count <= ddof: sample variance undefined -> NULL (not inf/NaN)
+            cnt = np.where(cnt > ddof, cnt, 0)
         data = np.sqrt(var) if op == "stddev" else var
     return null_where_zero(data, cnt, DataType.float64())
 
@@ -381,7 +385,9 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
                 var = s2 / vc - m * m
                 var = np.maximum(var, 0.0)
                 if ddof:
-                    var = var * vc / np.maximum(vc - ddof, 0)
+                    var = var * vc / np.where(vc > ddof, vc - ddof, 1)
+                    # count <= ddof: sample variance undefined -> NULL
+                    var = np.where(vc > ddof, var, np.nan)
                 if op == "var":
                     data = var
                 elif op == "stddev":
@@ -392,7 +398,7 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
                     sd = np.sqrt(var)
                     data = np.where(sd > 0, m3 / sd**3, np.nan)
             res = null_where_empty(data, DataType.float64())
-            if op == "skew":
+            if op == "skew" or ddof:
                 arr = res.to_arrow()
                 arr = pc.if_else(pc.is_nan(arr), pa.nulls(len(arr), arr.type), arr)
                 res = Series.from_arrow(arr, s.name)

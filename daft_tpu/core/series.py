@@ -28,7 +28,7 @@ def _combine(arr) -> pa.Array:
 
 
 class Series:
-    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs")
+    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "_device_cache")
 
     def __init__(self, name: str, dtype: DataType, arrow: Optional[pa.Array], pyobjs: Optional[list] = None):
         self._name = name
@@ -213,6 +213,22 @@ class Series:
             values = np.concatenate([values, np.zeros(pad_shape, dtype=values.dtype)])
             validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
         return jnp.asarray(values), jnp.asarray(validity)
+
+    def to_device_cached(self, pad_to: Optional[int] = None):
+        """to_device with a device-residency cache on this Series.
+
+        Collected tables queried repeatedly keep their columns resident in HBM
+        (GPU-database-style column cache), so only the first query pays the
+        host->device transfer. Series is immutable, so the cache never stales.
+        """
+        cache = getattr(self, "_device_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_device_cache", cache)
+        key = pad_to
+        if key not in cache:
+            cache[key] = self.to_device(pad_to)
+        return cache[key]
 
     # ---- selection kernels --------------------------------------------------------
     def slice(self, start: int, end: int) -> "Series":
@@ -614,6 +630,8 @@ def _pa_validity(x, n: int) -> pa.Array:
 def _null_fill_scalar(t: pa.DataType, fill):
     if pa.types.is_floating(t):
         return pa.scalar(float("nan"), type=t)
+    if pa.types.is_date32(t):
+        return pa.scalar(0, type=pa.int32()).cast(t)
     if pa.types.is_temporal(t):
         return pa.scalar(0, type=pa.int64()).cast(t)
     return pa.scalar(fill, type=t)

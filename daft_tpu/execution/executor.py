@@ -5,9 +5,12 @@ This is the pull-based core: each physical node is interpreted as a generator of
 MicroPartitions, so streaming ops (project/filter/limit) never materialize the
 whole input, while blocking ops (sort/agg/join build side) gather what they need.
 
-Morsel/thread parallelism and bounded-queue pipelining are layered on in
-pipeline.py (M5); device (TPU) stage fusion is selected in stage compilation
-(ops/device_eval.py) when a Project/Filter chain is device-evaluable.
+Device (TPU) execution: the planner lowers qualifying (filter+)aggregate chains
+to DeviceFilterAgg / DeviceGroupedAgg nodes (plan/physical.py translate); this
+executor runs them on the JAX device via ops/stage.py / ops/grouped_stage.py when
+the config allows (device_mode on, or auto with a large-enough first morsel and a
+real accelerator backend), with a semantics-identical host fallback otherwise.
+ops/counters.py records which path actually ran.
 """
 
 from __future__ import annotations
@@ -187,6 +190,10 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
+    if isinstance(node, (pp.DeviceFilterAgg, pp.DeviceGroupedAgg)):
+        yield _exec_device_agg(node)
+        return
+
     if isinstance(node, pp.Dedup):
         # streaming dedup: keep first occurrence across the stream
         seen: Optional[RecordBatch] = None
@@ -280,13 +287,89 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
 _MORSEL_ROWS = 256 * 1024
 
 
-def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool) -> RecordBatch:
+def _exec_device_agg(node) -> MicroPartition:
+    """Run a DeviceFilterAgg/DeviceGroupedAgg node: device stage or host fallback.
+
+    Device when device_mode == "on", or "auto" with a real accelerator backend
+    and a first morsel of >= device_min_rows rows (amortizes transfer latency).
+    """
+    import itertools
+
+    from ..config import execution_config
+
+    cfg = execution_config()
+    grouped = isinstance(node, pp.DeviceGroupedAgg)
+    stream = _exec(node.input)
+
+    use_device = cfg.device_mode == "on"
+    if cfg.device_mode == "auto":
+        first = next(stream, None)
+        if first is not None:
+            stream = itertools.chain([first], stream)
+            if first.num_rows >= cfg.device_min_rows:
+                import jax
+
+                use_device = jax.default_backend() not in ("cpu",)
+
+    if not use_device:
+        if node.predicate is not None:
+            stream = (_filter_part(p, node.predicate) for p in stream)
+        out = _two_phase_agg(node.input, node.groupby if grouped else [],
+                             node.aggregations, ungrouped=not grouped, stream=stream)
+        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+    from ..core.series import Series
+
+    in_schema = node.input.schema
+    if grouped:
+        from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+        stage = try_build_grouped_agg_stage(
+            in_schema, node.predicate, node.groupby, node.aggregations)
+        assert stage is not None, "planner emitted DeviceGroupedAgg for a non-qualifying plan"
+        for part in stream:
+            for b in part.batches:
+                stage.feed_batch(b)
+        key_rows, results = stage.finalize()
+        cols = []
+        for i, g in enumerate(node.groupby):
+            f = node.schema[g.name()]
+            cols.append(Series.from_pylist([k[i] for k in key_rows], f.name, dtype=f.dtype))
+        for (name, _), (vals, valid) in zip(stage.aggs, results):
+            f = node.schema[name]
+            data = [v if ok else None for v, ok in zip(vals, valid)]
+            cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
+        out = RecordBatch(node.schema, cols, len(key_rows))
+        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+    from ..ops.stage import try_build_filter_agg_stage
+
+    stage = try_build_filter_agg_stage(in_schema, node.predicate, node.aggregations)
+    assert stage is not None, "planner emitted DeviceFilterAgg for a non-qualifying plan"
+    for part in stream:
+        for b in part.batches:
+            stage.feed_batch(b)
+    final = stage.finalize()
+    cols = []
+    for name, _agg in stage.aggs:
+        f = node.schema[name]
+        cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
+    out = RecordBatch(node.schema, cols, 1)
+    return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+
+
+def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
+                   stream=None) -> RecordBatch:
     """Partial aggregation per morsel on the compute pool, then a final combine
     (reference: two-stage aggregation in translate.rs + partial-agg thresholds)."""
     from ..plan.agg_split import split_aggs
     from ..utils.pool import pool_map
 
-    batches = [b for p in _exec(child) for b in p.batches if b.num_rows > 0]
+    if stream is None:
+        stream = _exec(child)
+    batches = [b for p in stream for b in p.batches if b.num_rows > 0]
     if not batches:
         big = _concat_parts([], child.schema)
         return rel.ungrouped_agg(big, aggs) if ungrouped else rel.grouped_agg(big, groupby, aggs)

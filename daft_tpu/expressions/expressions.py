@@ -295,11 +295,11 @@ class Expression:
     def any_value(self, ignore_nulls: bool = False):
         return AggExpr("any_value", self, {"ignore_nulls": ignore_nulls})
 
-    def stddev(self):
-        return AggExpr("stddev", self)
+    def stddev(self, ddof: int = 0):
+        return AggExpr("stddev", self, {"ddof": ddof} if ddof else {})
 
-    def var(self):
-        return AggExpr("var", self)
+    def var(self, ddof: int = 0):
+        return AggExpr("var", self, {"ddof": ddof} if ddof else {})
 
     def skew(self):
         return AggExpr("skew", self)

@@ -81,9 +81,14 @@ def is_device_evaluable(expr: Expression, schema: Schema) -> bool:
             if not _dtype_on_device(schema[node._name].dtype):
                 return False
         elif isinstance(node, Literal):
-            if not (node.dtype.is_numeric() or node.dtype.is_boolean() or node.dtype.is_null()) or node.dtype.is_decimal():
+            ok = (node.dtype.is_numeric() or node.dtype.is_boolean()
+                  or node.dtype.is_null() or node.dtype.is_temporal())
+            if not ok or node.dtype.is_decimal():
                 return False
-        elif isinstance(node, (Alias, Between, IfElse, IsIn)):
+        elif isinstance(node, Between):
+            if not _temporal_operands_aligned([node.child, node.lower, node.upper], schema):
+                return False
+        elif isinstance(node, (Alias, IfElse, IsIn)):
             pass
         elif isinstance(node, Cast):
             if not _dtype_on_device(node.dtype):
@@ -94,6 +99,8 @@ def is_device_evaluable(expr: Expression, schema: Schema) -> bool:
                 "eq", "neq", "lt", "le", "gt", "ge", "and", "or", "xor",
                 "fill_null", "eq_null_safe",
             ):
+                return False
+            if not _temporal_operands_aligned([node.left, node.right], schema):
                 return False
         elif isinstance(node, UnaryOp):
             if node.op not in ("not", "neg", "abs", "is_null", "not_null"):
@@ -113,6 +120,22 @@ def _dtype_on_device(dt: DataType) -> bool:
     return (dt.is_numeric() and not dt.is_decimal()) or dt.is_boolean() or dt.is_temporal()
 
 
+def _temporal_operands_aligned(exprs, schema: Schema) -> bool:
+    """Temporal values live on device as raw storage ints (days / epoch-in-unit),
+    so mixed-unit or mixed-kind temporal operands would compare wrong numbers.
+    Require every temporal operand in an operation to have the identical dtype."""
+    dts = []
+    for e in exprs:
+        try:
+            dts.append(e.to_field(schema).dtype)
+        except Exception:
+            return False
+    temporal = [dt for dt in dts if dt.is_temporal()]
+    if not temporal:
+        return True
+    return all(dt == temporal[0] for dt in temporal)
+
+
 def build_device_expr(expr: Expression, schema: Schema) -> Callable[[Dict[str, DCol]], DCol]:
     """Return fn(cols) -> (values, validity); traceable under jit."""
 
@@ -123,7 +146,15 @@ def build_device_expr(expr: Expression, schema: Schema) -> Callable[[Dict[str, D
             if node.value is None:
                 return jnp.zeros((), dtype=jnp.float64), jnp.zeros((), dtype=bool)
             dt = node.dtype.to_jax()
-            return jnp.asarray(node.value, dtype=dt), jnp.ones((), dtype=bool)
+            value = node.value
+            if node.dtype.is_temporal():
+                # temporal columns live on device as their arrow storage ints
+                # (date32 -> days, timestamp -> epoch in the column's unit)
+                import pyarrow as pa
+
+                storage = pa.int32() if node.dtype.kind == "date" else pa.int64()
+                value = pa.scalar(value, type=node.dtype.to_arrow()).cast(storage).as_py()
+            return jnp.asarray(value, dtype=dt), jnp.ones((), dtype=bool)
         if isinstance(node, Alias):
             return ev(node.child, cols)
         if isinstance(node, Cast):

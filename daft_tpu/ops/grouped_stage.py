@@ -1,0 +1,295 @@
+"""Device grouped-aggregation stage: host key factorization + device segment-reduce.
+
+The TPU answer to hash-table grouped aggregation (reference:
+src/daft-local-execution/src/sinks/grouped_aggregate.rs): group keys (any host
+dtype, including strings) are factorized to dense codes on the host (C++
+open-addressing factorize), the value expressions + predicate + segment
+reductions run fused on the device, and tiny per-batch group tables are merged
+on the host keyed by the real key values — two-phase aggregation where phase 1
+is one XLA program per morsel.
+
+Static shapes: rows pad to power-of-two buckets, the group table pads to a
+power-of-two capacity, with one trash segment for filtered/padding rows. The
+jit cache is bounded by O(log rows · log groups) per stage structure.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from ..expressions.expressions import AggExpr, Alias, Expression
+from ..schema import Schema
+from . import counters
+from . import device_eval as dev
+from .stage import _decompose_agg, pad_bucket
+
+_MIN_GROUP_CAP = 8
+
+
+def _pad_groups(g: int) -> int:
+    c = _MIN_GROUP_CAP
+    while c < g:
+        c <<= 1
+    return c
+
+
+class GroupedAggStage:
+    """Compiled filter→grouped-agg stage.
+
+    aggs: list of (output_name, AggExpr). Feed RecordBatches; finalize returns
+    (key_rows, agg_tables): key_rows = list of per-group key tuples in first-seen
+    order; agg_tables = per agg a list of (value, valid) aligned with key_rows.
+    """
+
+    def __init__(self, schema: Schema, predicate: Optional[Expression],
+                 groupby: Sequence[Expression], aggs: Sequence[Tuple[str, AggExpr]]):
+        self.schema = schema
+        self.predicate = predicate
+        self.groupby = list(groupby)
+        self.aggs = list(aggs)
+        self._jitted: Dict[Tuple[int, int], Callable] = {}
+        # key tuple -> group slot; partial tables accumulate per slot
+        self._key_order: List[tuple] = []
+        self._key_slot: Dict[tuple, int] = {}
+        self._acc: List[Dict[str, List[float]]] = [
+            {p: [] for p in self._partials(a.op)} for _, a in self.aggs
+        ]
+        self._input_cols = self._referenced_columns()
+
+    @staticmethod
+    def _partials(op: str) -> List[str]:
+        parts = list(_decompose_agg(op))
+        if "count" not in parts:
+            parts.append("count")
+        return parts
+
+    def _referenced_columns(self) -> List[str]:
+        cols: List[str] = []
+        exprs: List[Expression] = [a.child for _, a in self.aggs]
+        if self.predicate is not None:
+            exprs.append(self.predicate)
+        for e in exprs:
+            for c in e.referenced_columns():
+                if c not in cols:
+                    cols.append(c)
+        return cols
+
+    def _build(self, cap: int) -> Callable:
+        schema = self.schema
+        pred_fn = dev.build_device_expr(self.predicate, schema) if self.predicate is not None else None
+        agg_specs = []
+        for name, agg in self.aggs:
+            child_fn = dev.build_device_expr(agg.child, schema)
+            count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
+            agg_specs.append((agg.op, count_all, child_fn))
+
+        def stage(cols: Dict[str, dev.DCol], codes: jnp.ndarray, row_mask: jnp.ndarray):
+            if pred_fn is not None:
+                pv, pm = pred_fn(cols)
+                keep = pv.astype(bool) & pm & row_mask
+            else:
+                keep = row_mask
+            seg = jnp.where(keep, codes, cap).astype(jnp.int32)
+            out = []
+            for op, count_all, child_fn in agg_specs:
+                v, m = child_fn(cols)
+                v = v + jnp.zeros(jnp.shape(seg), dtype=v.dtype) if jnp.shape(v) != jnp.shape(seg) else v
+                mask = dev._broadcast_valid(v, m) & keep
+                if count_all:
+                    mask = keep
+                tables = {}
+                for partial in self._partials(op):
+                    tables[partial] = _segment_table(partial, v, mask, seg, cap)
+                out.append(tables)
+            return out
+
+        return jax.jit(stage)
+
+    def feed_batch(self, batch) -> None:
+        from ..core.kernels.groupby import make_groups
+        from ..expressions.eval import eval_expression, _broadcast
+
+        n = batch.num_rows
+        if n == 0:
+            return
+        # group codes are a pure function of (batch, groupby exprs): cache them on
+        # the batch so repeated queries over resident tables skip re-factorization
+        gb_key = ("__group_codes__",) + tuple(str(e) for e in self.groupby)
+        cache = getattr(batch, "_stage_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(batch, "_stage_cache", cache)
+        if gb_key in cache:
+            group_ids, num_groups, key_rows = cache[gb_key]
+        else:
+            key_series = []
+            for e in self.groupby:
+                s = eval_expression(batch, e)
+                if len(s) == 1 and n != 1:
+                    s = _broadcast(s, n)
+                key_series.append(s)
+            first_idx, group_ids, _ = make_groups(key_series)
+            num_groups = len(first_idx)
+            key_rows = list(zip(*[s.take(first_idx).to_pylist() for s in key_series])) \
+                if num_groups else []
+            cache[gb_key] = (group_ids, num_groups, key_rows)
+
+        bucket = pad_bucket(n)
+        cap = _pad_groups(max(num_groups, 1))
+        if (bucket, cap) not in self._jitted:
+            self._jitted[(bucket, cap)] = self._build(cap)
+
+        codes_key = (gb_key, bucket, cap)
+        if codes_key in cache:
+            dcodes = cache[codes_key]
+        else:
+            codes = np.full(bucket, cap, dtype=np.int32)
+            codes[:n] = group_ids
+            dcodes = jnp.asarray(codes)
+            cache[codes_key] = dcodes
+        row_mask = np.zeros(bucket, dtype=bool)
+        row_mask[:n] = True
+        dcols = {name: batch.get_column(name).to_device_cached(bucket)
+                 for name in self._input_cols}
+
+        out = self._jitted[(bucket, cap)](dcols, dcodes, jnp.asarray(row_mask))
+        out = jax.device_get(out)  # ONE device->host round trip for all tables
+        counters.bump("device_grouped_batches")
+
+        # host merge: one small fetch per partial table
+        slots = []
+        for key in key_rows:
+            slot = self._key_slot.get(key)
+            if slot is None:
+                slot = len(self._key_order)
+                self._key_slot[key] = slot
+                self._key_order.append(key)
+                for acc in self._acc:
+                    for p, lst in acc.items():
+                        lst.append(_identity(p))
+            slots.append(slot)
+
+        for acc, tables in zip(self._acc, out):
+            for p, table in tables.items():
+                host = np.asarray(table)[:num_groups]
+                lst = acc[p]
+                for g, slot in enumerate(slots):
+                    # Python-scalar arithmetic: exact for int64 sums (no float64
+                    # demotion, no silent int overflow)
+                    lst[slot] = _merge(p, lst[slot], host[g].item())
+
+    def finalize(self):
+        """Returns (key_rows, agg_results); agg_results[i] = (values list, valid list).
+
+        Resets accumulation state so a cached stage can serve the next run.
+        """
+        results = []
+        for (name, agg), acc in zip(self.aggs, self._acc):
+            op = agg.op
+            vals: List = []
+            valid: List[bool] = []
+            for slot in range(len(self._key_order)):
+                cnt = acc["count"][slot]
+                if op == "count":
+                    vals.append(int(cnt))
+                    valid.append(True)
+                elif op == "mean":
+                    vals.append(acc["sum"][slot] / cnt if cnt else None)
+                    valid.append(cnt > 0)
+                else:
+                    vals.append(acc[op][slot] if cnt else None)
+                    valid.append(cnt > 0)
+            results.append((vals, valid))
+        key_rows = list(self._key_order)
+        self._key_order = []
+        self._key_slot = {}
+        self._acc = [{p: [] for p in self._partials(a.op)} for _, a in self.aggs]
+        counters.bump("device_stage_runs")
+        return key_rows, results
+
+
+def _identity(partial: str):
+    if partial in ("count", "sum"):
+        return 0  # int identity: promoted to float by float inputs, exact for ints
+    if partial == "min":
+        return np.inf
+    if partial == "max":
+        return -np.inf
+    raise ValueError(partial)
+
+
+def _merge(partial: str, a, b):
+    if partial in ("count", "sum"):
+        return a + b
+    return min(a, b) if partial == "min" else max(a, b)
+
+
+def _segment_table(op: str, values: jnp.ndarray, mask: jnp.ndarray,
+                   seg: jnp.ndarray, cap: int) -> jnp.ndarray:
+    """Masked segment reduce into cap real slots (+1 trash, sliced off)."""
+    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
+    if op == "count":
+        t = jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=cap + 1)
+        return t[:cap]
+    if op == "sum":
+        acc = jnp.int64 if is_int else jnp.float64
+        v = jnp.where(mask, values.astype(acc), jnp.zeros((), acc))
+        return jax.ops.segment_sum(v, seg, num_segments=cap + 1)[:cap]
+    if op in ("min", "max"):
+        acc = jnp.float64
+        ident = jnp.inf if op == "min" else -jnp.inf
+        v = jnp.where(mask, values.astype(acc), jnp.asarray(ident, acc))
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        return fn(v, seg, num_segments=cap + 1)[:cap]
+    raise ValueError(f"no segment table op {op!r}")
+
+
+_STAGE_CACHE: Dict[tuple, GroupedAggStage] = {}
+
+
+def try_build_grouped_agg_stage(schema: Schema, predicate: Optional[Expression],
+                                groupby: Sequence[Expression],
+                                agg_exprs: Sequence[Expression]) -> Optional[GroupedAggStage]:
+    """Build a device grouped-agg stage if predicate + agg value exprs qualify.
+
+    Group keys run host-side (factorize handles any dtype), so they are
+    unconstrained beyond being non-aggregate expressions. Stages are cached by
+    structure so repeated runs reuse jitted programs (finalize resets state).
+    """
+    from .stage import stage_cache_key
+
+    key = stage_cache_key(schema, predicate, list(groupby) + list(agg_exprs))
+    if key in _STAGE_CACHE:
+        return _STAGE_CACHE[key]
+    if not groupby:
+        return None
+    if predicate is not None and not dev.is_device_evaluable(predicate, schema):
+        return None
+    aggs: List[Tuple[str, AggExpr]] = []
+    for e in agg_exprs:
+        name = e.name()
+        inner = e
+        while isinstance(inner, Alias):
+            inner = inner.child
+        if not isinstance(inner, AggExpr):
+            return None
+        if inner.op not in ("sum", "mean", "min", "max", "count"):
+            return None
+        if inner.op == "count" and inner.params.get("mode", "valid") == "null":
+            return None
+        if not dev.is_device_evaluable(inner.child, schema):
+            return None
+        aggs.append((name, inner))
+    for g in groupby:
+        for node in g.walk():
+            if isinstance(node, AggExpr):
+                return None
+    stage = GroupedAggStage(schema, predicate, groupby, aggs)
+    _STAGE_CACHE[key] = stage
+    return stage

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..datatype import DataType
 from ..expressions.expressions import AggExpr, Alias, Expression
 from ..schema import Schema
+from . import counters
 from . import device_eval as dev
 
 _MIN_BUCKET = 512
@@ -119,10 +120,18 @@ class FilterAggStage:
 
         return jax.jit(stage)
 
-    def feed(self, columns: Dict[str, Tuple[np.ndarray, np.ndarray]], n: int) -> None:
-        bucket = pad_bucket(n)
+    def _run(self, dcols: Dict[str, dev.DCol], n: int, bucket: int) -> None:
         if bucket not in self._jitted:
             self._jitted[bucket] = self._build(bucket)
+        row_mask = np.zeros(bucket, dtype=bool)
+        row_mask[:n] = True
+        res = self._jitted[bucket](dcols, jnp.asarray(row_mask))
+        counters.bump("device_stage_batches")
+        res = jax.device_get(res)  # ONE device->host round trip for all partials
+        self._partials.append({k: (v[0].item(), bool(v[1])) for k, v in res.items()})
+
+    def feed(self, columns: Dict[str, Tuple[np.ndarray, np.ndarray]], n: int) -> None:
+        bucket = pad_bucket(n)
         dcols = {}
         for name in self._input_cols:
             vals, valid = columns[name]
@@ -131,18 +140,15 @@ class FilterAggStage:
                 vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
                 valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
             dcols[name] = (jnp.asarray(vals), jnp.asarray(valid))
-        row_mask = np.zeros(bucket, dtype=bool)
-        row_mask[:n] = True
-        res = self._jitted[bucket](dcols, jnp.asarray(row_mask))
-        self._partials.append({k: (np.asarray(v[0]).item(), bool(np.asarray(v[1]))) for k, v in res.items()})
+        self._run(dcols, n, bucket)
 
     def feed_batch(self, batch) -> None:
-        """Feed a host RecordBatch (converts referenced columns to device arrays)."""
-        cols = {}
-        for name in self._input_cols:
-            s = batch.get_column(name)
-            cols[name] = (s.to_numpy(), s.validity_numpy())
-        self.feed(cols, batch.num_rows)
+        """Feed a host RecordBatch (referenced columns go to device, cached)."""
+        n = batch.num_rows
+        bucket = pad_bucket(n)
+        dcols = {name: batch.get_column(name).to_device_cached(bucket)
+                 for name in self._input_cols}
+        self._run(dcols, n, bucket)
 
     def finalize(self) -> Dict[str, Optional[float]]:
         out = {}
@@ -152,12 +158,31 @@ class FilterAggStage:
             else:
                 out[name] = _combine_partials(agg.op, self._partials, name)
         self._partials = []
+        counters.bump("device_stage_runs")
         return out
+
+
+_STAGE_CACHE: Dict[tuple, FilterAggStage] = {}
+
+
+def stage_cache_key(schema: Schema, predicate, exprs) -> tuple:
+    return (
+        tuple((f.name, repr(f.dtype)) for f in schema),
+        repr(predicate),
+        tuple(repr(e) for e in exprs),
+    )
 
 
 def try_build_filter_agg_stage(schema: Schema, predicate: Optional[Expression],
                                agg_exprs: Sequence[Expression]) -> Optional[FilterAggStage]:
-    """Build a device stage for filter+ungrouped-agg if every expression qualifies."""
+    """Build a device stage for filter+ungrouped-agg if every expression qualifies.
+
+    Stages are cached by (schema, predicate, aggs) structure so repeated runs of
+    the same query reuse the jitted programs instead of retracing.
+    """
+    key = stage_cache_key(schema, predicate, agg_exprs)
+    if key in _STAGE_CACHE:
+        return _STAGE_CACHE[key]
     if predicate is not None and not dev.is_device_evaluable(predicate, schema):
         return None
     aggs: List[Tuple[str, AggExpr]] = []
@@ -175,4 +200,6 @@ def try_build_filter_agg_stage(schema: Schema, predicate: Optional[Expression],
         if not dev.is_device_evaluable(inner.child, schema):
             return None
         aggs.append((name, inner))
-    return FilterAggStage(schema, predicate, aggs)
+    stage = FilterAggStage(schema, predicate, aggs)
+    _STAGE_CACHE[key] = stage
+    return stage

@@ -88,7 +88,13 @@ def split_aggs(aggs: List[Expression]) -> Optional[AggSplit]:
             # the one-phase kernel's np.maximum(var, 0.0))
             var = ((col(q) / col(c)) - mean * mean).clip(min=0.0)
             if ddof:
-                var = var * col(c) / (col(c) - ddof).clip(min=0)
+                from ..expressions import lit
+
+                # groups with count <= ddof have no defined sample variance: NULL,
+                # not inf/NaN (matches the one-phase kernel)
+                var = (col(c) > ddof).if_else(
+                    var * col(c) / (col(c) - ddof), lit(None)
+                )
             expr = var.sqrt() if op == "stddev" else var
             projection.append(expr.alias(out_name))
         elif op == "skew":

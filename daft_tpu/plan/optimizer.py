@@ -185,22 +185,33 @@ def rule_column_pruning(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
 
 def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     """Isolate UDF-bearing expressions into their own UDFProject nodes
-    (reference: rules/split_udfs.rs) so host UDFs don't break device stage fusion."""
+    (reference: rules/split_udfs.rs) so host UDFs don't break device stage fusion.
+
+    Extracts EVERY UDF expression in one application (stacked UDFProject nodes),
+    so isolation doesn't depend on the batch's pass budget; each UDF output gets
+    a unique internal name so sibling expressions referencing a same-named input
+    column are unaffected.
+    """
     if not isinstance(node, lp.Project):
         return None
     udf_exprs = [e for e in node.projection if e.has_udf()]
     if not udf_exprs or len(node.projection) == len(udf_exprs) == 1:
         return None
-    if isinstance(node.input, lp.UDFProject):
-        return None
-    # take the first UDF expression out into its own node
-    target = udf_exprs[0]
-    input_cols = node.input.schema.column_names()
-    passthrough = [col(c) for c in input_cols if c != target.name()]
-    udf_node = lp.UDFProject(node.input, target, passthrough)
-    # remaining projection runs on top, referencing the udf output by name
-    new_projection = [col(target.name()) if e is target else e for e in node.projection]
-    return lp.Project(udf_node, new_projection)
+    current = node.input
+    projection = list(node.projection)
+    for target in udf_exprs:
+        out_name = target.name()
+        input_cols = current.schema.column_names()
+        taken = set(input_cols) | {e.name() for e in projection}
+        internal = f"__udf__{out_name}"
+        while internal in taken:
+            internal = "_" + internal
+        passthrough = [col(c) for c in input_cols]
+        current = lp.UDFProject(current, target.alias(internal), passthrough)
+        projection = [
+            col(internal).alias(out_name) if e is target else e for e in projection
+        ]
+    return lp.Project(current, projection)
 
 
 def rule_extract_windows(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:

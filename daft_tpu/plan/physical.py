@@ -158,6 +158,36 @@ class HashAggregate(_Unary):
         self.aggregations = aggregations
 
 
+class DeviceFilterAgg(_Unary):
+    """Fused (optional filter)+ungrouped-agg stage eligible for the JAX device.
+
+    The executor decides device vs host per run (config device_mode/min-rows);
+    host fallback has identical semantics. Reference wiring point:
+    src/daft-local-execution/src/pipeline.rs:358 operator selection.
+    """
+
+    def __init__(self, input: PhysicalPlan, predicate: Optional[Expression],
+                 aggregations: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.predicate = predicate
+        self.aggregations = aggregations
+
+
+class DeviceGroupedAgg(_Unary):
+    """Fused (optional filter)+grouped-agg stage eligible for the JAX device.
+
+    Keys factorize on host (any dtype); value reductions segment-reduce on
+    device. Executor decides device vs host per run.
+    """
+
+    def __init__(self, input: PhysicalPlan, predicate: Optional[Expression],
+                 groupby: List[Expression], aggregations: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.predicate = predicate
+        self.groupby = groupby
+        self.aggregations = aggregations
+
+
 class Dedup(_Unary):
     def __init__(self, input: PhysicalPlan, on: Optional[List[Expression]], schema: Schema):
         super().__init__(input, schema)
@@ -301,6 +331,34 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
                         plan.nulls_first, plan.limit, plan.offset, plan.schema)
 
     if isinstance(plan, lp.Aggregate):
+        # Device-stage fusion: Aggregate(+optional Filter) whose expressions are
+        # device-evaluable lowers to a fused Device*Agg node; the executor picks
+        # device vs host at runtime. An absorbed filter stays in the fused node.
+        from ..config import execution_config
+
+        cfg = config or execution_config()
+        if getattr(cfg, "device_mode", "off") != "off":
+            src = plan.input
+            predicate = None
+            if isinstance(src, lp.Filter):
+                predicate = src.predicate
+                src = src.input
+            if plan.groupby:
+                from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+                if try_build_grouped_agg_stage(
+                    src.schema, predicate, plan.groupby, plan.aggregations
+                ) is not None:
+                    return DeviceGroupedAgg(translate(src, config), predicate,
+                                            plan.groupby, plan.aggregations, plan.schema)
+            else:
+                from ..ops.stage import try_build_filter_agg_stage
+
+                if try_build_filter_agg_stage(
+                    src.schema, predicate, plan.aggregations
+                ) is not None:
+                    return DeviceFilterAgg(translate(src, config), predicate,
+                                           plan.aggregations, plan.schema)
         child = translate(plan.input, config)
         if plan.groupby:
             return HashAggregate(child, plan.groupby, plan.aggregations, plan.schema)

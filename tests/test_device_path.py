@@ -1,0 +1,205 @@
+"""Tests proving the engine actually selects the device (JAX) execution path.
+
+VERDICT r1 item #1: the planner must emit Device*Agg nodes and the executor must
+run them on device; ops/counters.py records real device batches so these tests
+fail if the path silently falls back to host.
+"""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.config import execution_config_ctx
+from daft_tpu.ops import counters
+from daft_tpu.plan import physical as pp
+
+
+def _plan(df):
+    from daft_tpu.plan.physical import translate
+
+    return translate(df._builder.optimize()._plan)
+
+
+def _q6_df():
+    rng = np.random.default_rng(0)
+    n = 10_000
+    return daft_tpu.from_pydict({
+        "l_quantity": rng.uniform(1, 50, n).tolist(),
+        "l_extendedprice": rng.uniform(100, 10000, n).tolist(),
+        "l_discount": rng.uniform(0.0, 0.1, n).tolist(),
+    })
+
+
+def _q6_query(df):
+    return (
+        df.where((col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
+                 & (col("l_quantity") < 24.0))
+        .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue"))
+    )
+
+
+def test_planner_emits_device_filter_agg():
+    with execution_config_ctx(device_mode="on"):
+        plan = _plan(_q6_query(_q6_df()))
+    assert any(isinstance(n, pp.DeviceFilterAgg) for n in plan.walk()), plan.display()
+
+
+def test_planner_emits_device_grouped_agg():
+    df = daft_tpu.from_pydict({"k": ["a", "b", "a"], "v": [1.0, 2.0, 3.0]})
+    q = df.groupby("k").agg(col("v").sum())
+    with execution_config_ctx(device_mode="on"):
+        plan = _plan(q)
+    assert any(isinstance(n, pp.DeviceGroupedAgg) for n in plan.walk()), plan.display()
+
+
+def test_planner_device_off_no_device_nodes():
+    with execution_config_ctx(device_mode="off"):
+        plan = _plan(_q6_query(_q6_df()))
+    assert not any(isinstance(n, (pp.DeviceFilterAgg, pp.DeviceGroupedAgg))
+                   for n in plan.walk())
+
+
+def test_q6_runs_on_device_and_matches_host():
+    df = _q6_df()
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = _q6_query(df).to_pydict()
+    assert counters.device_stage_batches > 0, "device stage never fed"
+    assert counters.device_stage_runs > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = _q6_query(df).to_pydict()
+    np.testing.assert_allclose(dev_out["revenue"], host_out["revenue"], rtol=1e-12)
+
+
+def test_grouped_agg_device_matches_host_string_keys():
+    rng = np.random.default_rng(1)
+    n = 5000
+    df = daft_tpu.from_pydict({
+        "flag": rng.choice(["A", "N", "R"], n).tolist(),
+        "status": rng.choice(["O", "F"], n).tolist(),
+        "qty": rng.uniform(1, 50, n).tolist(),
+        "price": rng.uniform(1, 1000, n).tolist(),
+    })
+
+    def q(d):
+        return (d.groupby("flag", "status")
+                .agg(col("qty").sum().alias("sum_qty"),
+                     col("price").mean().alias("avg_price"),
+                     col("qty").min().alias("min_qty"),
+                     col("qty").max().alias("max_qty"),
+                     col("qty").count().alias("n"))
+                .sort(["flag", "status"]))
+
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    assert counters.device_grouped_batches > 0, "device grouped stage never fed"
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out["flag"] == host_out["flag"]
+    assert dev_out["status"] == host_out["status"]
+    for c in ("sum_qty", "avg_price", "min_qty", "max_qty"):
+        np.testing.assert_allclose(dev_out[c], host_out[c], rtol=1e-12)
+    assert dev_out["n"] == host_out["n"]
+
+
+def test_grouped_agg_device_with_filter_and_nulls():
+    df = daft_tpu.from_pydict({
+        "k": ["x", "y", "x", "y", "x", None],
+        "v": [1.0, 2.0, None, 4.0, 5.0, 6.0],
+        "w": [10.0, 20.0, 30.0, 40.0, 50.0, 60.0],
+    })
+    q = lambda d: (d.where(col("w") > 15.0)
+                   .groupby("k")
+                   .agg(col("v").sum().alias("s"), col("v").count().alias("c"))
+                   .sort("k"))
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    assert counters.device_grouped_batches > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out == host_out
+
+
+def test_device_count_modes_match_host():
+    df = daft_tpu.from_pydict({"v": [1.0, None, 3.0, None, 5.0]})
+    q = lambda d: d.agg(
+        col("v").count().alias("c_valid"),
+        col("v").sum().alias("s"),
+        col("v").mean().alias("m"),
+        col("v").min().alias("lo"),
+        col("v").max().alias("hi"),
+    )
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    assert counters.device_stage_runs > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out == host_out
+
+
+def test_device_auto_small_input_stays_on_host():
+    df = _q6_df()
+    counters.reset()
+    with execution_config_ctx(device_mode="auto", device_min_rows=10**9):
+        out = _q6_query(df).to_pydict()
+    assert counters.device_stage_batches == 0
+    assert len(out["revenue"]) == 1
+
+
+def test_device_int_sums_exact():
+    df = daft_tpu.from_pydict({"k": ["a", "a", "b"], "v": [2**60, 7, 11]})
+    q = lambda d: d.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out == host_out
+
+
+def test_tpch_q1_shape_device_matches_host():
+    rng = np.random.default_rng(2)
+    n = 20_000
+    df = daft_tpu.from_pydict({
+        "l_returnflag": rng.choice(["A", "N", "R"], n).tolist(),
+        "l_linestatus": rng.choice(["O", "F"], n).tolist(),
+        "l_quantity": rng.uniform(1, 50, n).tolist(),
+        "l_extendedprice": rng.uniform(900, 105000, n).tolist(),
+        "l_discount": rng.uniform(0, 0.1, n).tolist(),
+        "l_tax": rng.uniform(0, 0.08, n).tolist(),
+        "l_shipdate_days": rng.integers(8000, 10000, n).tolist(),
+    })
+
+    def q1(d):
+        disc_price = col("l_extendedprice") * (1 - col("l_discount"))
+        charge = disc_price * (1 + col("l_tax"))
+        return (
+            d.where(col("l_shipdate_days") <= 9190)
+            .groupby("l_returnflag", "l_linestatus")
+            .agg(
+                col("l_quantity").sum().alias("sum_qty"),
+                col("l_extendedprice").sum().alias("sum_base_price"),
+                disc_price.sum().alias("sum_disc_price"),
+                charge.sum().alias("sum_charge"),
+                col("l_quantity").mean().alias("avg_qty"),
+                col("l_extendedprice").mean().alias("avg_price"),
+                col("l_discount").mean().alias("avg_disc"),
+                col("l_quantity").count().alias("count_order"),
+            )
+            .sort(["l_returnflag", "l_linestatus"])
+        )
+
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q1(df).to_pydict()
+    assert counters.device_grouped_batches > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = q1(df).to_pydict()
+    for k in host_out:
+        if isinstance(host_out[k][0], float):
+            np.testing.assert_allclose(dev_out[k], host_out[k], rtol=1e-9)
+        else:
+            assert dev_out[k] == host_out[k], k

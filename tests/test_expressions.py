@@ -223,3 +223,22 @@ def test_referenced_columns_and_transform():
 
     e2 = e.transform(lambda n: ColumnRef("z") if isinstance(n, ColumnRef) and n._name == "a" else None)
     assert e2.referenced_columns() == ["z", "b"]
+
+
+def test_stddev_var_ddof_small_groups_null():
+    # count <= ddof must yield NULL, not inf/NaN (one-phase and two-phase kernels)
+    import daft_tpu
+    from daft_tpu import col
+    df = daft_tpu.from_pydict({"k": ["a", "a", "b"], "v": [1.0, 3.0, 5.0]})
+    out = (
+        df.groupby("k")
+        .agg(
+            col("v").var(ddof=1).alias("v1"),
+            col("v").stddev(ddof=1).alias("s1"),
+        )
+        .sort("k")
+        .to_pydict()
+    )
+    assert out["v1"][0] == 2.0
+    assert out["v1"][1] is None
+    assert out["s1"][1] is None

@@ -146,3 +146,41 @@ def test_udf_split_into_udfproject(df):
 def test_udf_apply_method(df):
     out = df.select(col("x").apply(lambda v: v * 7, return_dtype=DataType.int64()))
     assert out.to_pydict() == {"x": [7, 14, 21]}
+
+
+def test_multiple_udfs_in_one_projection_all_isolated():
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.udf import func
+    from daft_tpu.plan import logical as lp
+
+    @func
+    def f1(x: int) -> int:
+        return x + 1
+
+    @func
+    def f2(x: int) -> int:
+        return x * 2
+
+    df = daft_tpu.from_pydict({"a": [1, 2, 3], "b": [10, 20, 30]})
+    q = df.select(f1(col("a")).alias("u1"), f2(col("b")).alias("u2"),
+                  (col("a") + col("b")).alias("c"))
+    plan = q._builder.optimize()._plan
+    n_udf_nodes = sum(1 for n in plan.walk() if isinstance(n, lp.UDFProject))
+    assert n_udf_nodes == 2, plan.describe_tree() if hasattr(plan, "describe_tree") else n_udf_nodes
+    out = q.to_pydict()
+    assert out == {"u1": [2, 3, 4], "u2": [20, 40, 60], "c": [11, 22, 33]}
+
+
+def test_udf_output_shadowing_input_column_name():
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.udf import func
+
+    @func
+    def f1(x: int) -> int:
+        return x + 1
+
+    df = daft_tpu.from_pydict({"x": [1, 2], "y": [5, 6]})
+    out = df.select(f1(col("y")).alias("x"), (col("x") + 100).alias("keep")).to_pydict()
+    assert out == {"x": [6, 7], "keep": [101, 102]}
