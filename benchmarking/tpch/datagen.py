@@ -181,8 +181,23 @@ def write_parquet(tables: Dict[str, pa.Table], root: str) -> None:
         pq.write_table(t, os.path.join(root, f"{name}.parquet"))
 
 
+def cached_tables(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
+    """generate() with a parquet disk cache keyed by (sf, seed)."""
+    import pyarrow.parquet as pq
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_cache", f"sf{sf}_s{seed}")
+    names = ["region", "nation", "supplier", "customer", "part", "partsupp",
+             "orders", "lineitem"]
+    if all(os.path.exists(os.path.join(root, f"{n}.parquet")) for n in names):
+        return {n: pq.read_table(os.path.join(root, f"{n}.parquet")) for n in names}
+    tables = generate(sf, seed)
+    write_parquet(tables, root)
+    return tables
+
+
 def load_dataframes(sf: float = 0.01, seed: int = 0):
     """Tables as in-memory daft_tpu DataFrames."""
     import daft_tpu as dt
 
-    return {name: dt.from_arrow(t) for name, t in generate(sf, seed).items()}
+    return {name: dt.from_arrow(t) for name, t in cached_tables(sf, seed).items()}

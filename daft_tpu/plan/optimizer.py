@@ -38,6 +38,9 @@ class Optimizer:
                         changed = True
                 if not changed:
                     break
+            if batch.name == "pushdowns":
+                # global projection pushdown after filters have settled
+                plan = prune_columns(plan)
         return plan
 
 
@@ -181,6 +184,151 @@ def rule_column_pruning(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     pd = scan.pushdowns
     new_scan = lp.ScanSource(scan.scan_op, Pushdowns(needed, pd.filters, pd.limit))
     return lp.Project(new_scan, node.projection)
+
+
+def _ordered_union(*col_lists) -> List[str]:
+    out: List[str] = []
+    for cols in col_lists:
+        for c in cols:
+            if c not in out:
+                out.append(c)
+    return out
+
+
+def _refs(exprs) -> List[str]:
+    out: List[str] = []
+    for e in exprs:
+        for c in e.referenced_columns():
+            if c not in out:
+                out.append(c)
+    return out
+
+
+def prune_columns(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Global projection pushdown (reference: rules/push_down_projection.rs).
+
+    Walks top-down computing the column set each operator actually needs and
+    narrows sources: ScanSource gets a columns pushdown, InMemorySource gets a
+    Project wrapper, joins prune both sides (accounting for right-side renames).
+    Shrinks every downstream batch — filters, joins and shuffles stop carrying
+    dead columns.
+    """
+    return _prune(plan, None)
+
+
+def _restrict(needed: Optional[List[str]], schema) -> Optional[List[str]]:
+    """Intersect needed with a schema, in schema order; None passes through."""
+    if needed is None:
+        return None
+    names = schema.column_names()
+    keep = [c for c in names if c in set(needed)]
+    if not keep:  # never prune to zero columns (row counts must survive)
+        keep = names[:1]
+    return keep
+
+
+def _prune(node: lp.LogicalPlan, needed: Optional[List[str]]) -> lp.LogicalPlan:
+    if isinstance(node, lp.InMemorySource):
+        keep = _restrict(needed, node.schema)
+        if keep is not None and len(keep) < len(node.schema.column_names()):
+            return lp.Project(node, [col(c) for c in keep])
+        return node
+
+    if isinstance(node, lp.ScanSource):
+        base_cols = node.schema.column_names()
+        want = _restrict(
+            _ordered_union(
+                needed if needed is not None else base_cols,
+                _refs([node.pushdowns.filters]) if node.pushdowns.filters is not None else [],
+            ),
+            node.schema,
+        )
+        if needed is not None and want is not None and len(want) < len(base_cols):
+            from ..io.scan import Pushdowns
+
+            pd = node.pushdowns
+            return lp.ScanSource(node.scan_op, Pushdowns(want, pd.filters, pd.limit))
+        return node
+
+    if isinstance(node, lp.Project):
+        proj = node.projection
+        if needed is not None:
+            proj = [e for e in proj if e.name() in set(needed)]
+            if not proj:
+                proj = node.projection[:1]
+        child = _prune(node.input, _refs(proj))
+        return lp.Project(child, proj)
+
+    if isinstance(node, lp.UDFProject):
+        passthrough = node.passthrough
+        if needed is not None:
+            keep = set(needed)
+            passthrough = [e for e in passthrough if e.name() in keep]
+        child = _prune(node.input, _ordered_union(_refs([node.udf_expr]), _refs(passthrough)))
+        return lp.UDFProject(child, node.udf_expr, passthrough)
+
+    if isinstance(node, lp.Filter):
+        child = _prune(node.input, None if needed is None
+                       else _ordered_union(needed, _refs([node.predicate])))
+        return lp.Filter(child, node.predicate)
+
+    if isinstance(node, (lp.Limit, lp.Offset, lp.Sample, lp.IntoBatches, lp.IntoPartitions)):
+        return node.with_children([_prune(node.input, needed)])
+
+    if isinstance(node, lp.Repartition):
+        child_needed = None if needed is None else _ordered_union(needed, _refs(node.by))
+        return node.with_children([_prune(node.input, child_needed)])
+
+    if isinstance(node, lp.MonotonicallyIncreasingId):
+        child_needed = None if needed is None else [c for c in needed if c != node.column_name]
+        return node.with_children([_prune(node.input, child_needed)])
+
+    if isinstance(node, lp.Distinct):
+        if node.on is None:
+            child_needed = None
+        else:
+            child_needed = None if needed is None else _ordered_union(needed, _refs(node.on))
+        return node.with_children([_prune(node.input, child_needed)])
+
+    if isinstance(node, (lp.Sort, lp.TopN)):
+        child_needed = None if needed is None else _ordered_union(needed, _refs(node.sort_by))
+        return node.with_children([_prune(node.input, child_needed)])
+
+    if isinstance(node, lp.Aggregate):
+        child = _prune(node.input, _ordered_union(_refs(node.groupby), _refs(node.aggregations)))
+        return lp.Aggregate(child, node.groupby, node.aggregations)
+
+    if isinstance(node, lp.Explode):
+        child_needed = None if needed is None else _ordered_union(needed, _refs(node.to_explode))
+        return node.with_children([_prune(node.input, child_needed)])
+
+    if isinstance(node, lp.Concat):
+        return node.with_children([_prune(c, needed) for c in node.inputs])
+
+    if isinstance(node, lp.Join):
+        left_names = node.left.schema.column_names()
+        right_names = node.right.schema.column_names()
+        merged_keys, right_rename = node.output_naming()
+        if needed is None:
+            left_needed = None
+            right_needed = None
+        else:
+            left_needed = _ordered_union(
+                [c for c in needed if c in set(left_names)], _refs(node.left_on))
+            if node.how in ("anti", "semi"):
+                right_needed = _refs(node.right_on)
+            else:
+                out_to_right = {right_rename.get(c, c): c for c in right_names
+                                if c not in merged_keys}
+                right_needed = _ordered_union(
+                    [out_to_right[c] for c in needed if c in out_to_right],
+                    _refs(node.right_on))
+        return lp.Join(_prune(node.left, left_needed), _prune(node.right, right_needed),
+                       node.left_on, node.right_on, node.how,
+                       node.prefix, node.suffix, node.strategy)
+
+    # Window / Pivot / Unpivot / Sink / anything else: conservatively need all
+    return node.with_children([_prune(c, None) for c in node.children()])
 
 
 def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
