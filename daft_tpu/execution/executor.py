@@ -327,17 +327,18 @@ def _exec_device_agg(node) -> MicroPartition:
         stage = try_build_grouped_agg_stage(
             in_schema, node.predicate, node.groupby, node.aggregations)
         assert stage is not None, "planner emitted DeviceGroupedAgg for a non-qualifying plan"
+        run = stage.start_run()
         for part in stream:
             for b in part.batches:
-                stage.feed_batch(b)
-        key_rows, results = stage.finalize()
+                run.feed_batch(b)
+        key_rows, results = run.finalize()
         cols = []
         for i, g in enumerate(node.groupby):
             f = node.schema[g.name()]
             cols.append(Series.from_pylist([k[i] for k in key_rows], f.name, dtype=f.dtype))
         for (name, _), (vals, valid) in zip(stage.aggs, results):
             f = node.schema[name]
-            data = [v if ok else None for v, ok in zip(vals, valid)]
+            data = [v.item() if ok else None for v, ok in zip(vals, valid)]
             cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
         out = RecordBatch(node.schema, cols, len(key_rows))
         return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
@@ -346,10 +347,11 @@ def _exec_device_agg(node) -> MicroPartition:
 
     stage = try_build_filter_agg_stage(in_schema, node.predicate, node.aggregations)
     assert stage is not None, "planner emitted DeviceFilterAgg for a non-qualifying plan"
+    run = stage.start_run()
     for part in stream:
         for b in part.batches:
-            stage.feed_batch(b)
-    final = stage.finalize()
+            run.feed_batch(b)
+    final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
         f = node.schema[name]

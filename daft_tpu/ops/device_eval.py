@@ -322,6 +322,39 @@ def _fn_node(node: Function, ev, cols) -> DCol:
     raise ValueError(f"function {name!r} has no device kernel")
 
 
+# ---- segment (grouped) reduction on device ---------------------------------------
+
+
+def segment_reduce(op: str, values: jnp.ndarray, mask: jnp.ndarray,
+                   seg: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Masked segment reduce. Invalid rows contribute the op's identity.
+
+    Integer/bool inputs accumulate in int64 (exact for the full int64 domain,
+    including min/max identities via iinfo); floats in float64. Shared by the
+    single-chip grouped stage (ops/grouped_stage.py) and the mesh-sharded
+    groupby (parallel/distributed.py) so both paths agree bit-for-bit.
+    """
+    import jax
+
+    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
+    if op == "count":
+        return jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=num_segments)
+    if op == "sum":
+        acc = jnp.int64 if is_int else jnp.float64
+        v = jnp.where(mask, values.astype(acc), jnp.zeros((), acc))
+        return jax.ops.segment_sum(v, seg, num_segments=num_segments)
+    if op in ("min", "max"):
+        acc = jnp.int64 if is_int else jnp.float64
+        if is_int:
+            ident = jnp.iinfo(jnp.int64).max if op == "min" else jnp.iinfo(jnp.int64).min
+        else:
+            ident = jnp.inf if op == "min" else -jnp.inf
+        v = jnp.where(mask, values.astype(acc), jnp.asarray(ident, acc))
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        return fn(v, seg, num_segments=num_segments)
+    raise ValueError(f"no segment reduce for {op!r}")
+
+
 # ---- whole-column (ungrouped) aggregation on device -------------------------------
 
 

@@ -4,13 +4,22 @@ The TPU answer to hash-table grouped aggregation (reference:
 src/daft-local-execution/src/sinks/grouped_aggregate.rs): group keys (any host
 dtype, including strings) are factorized to dense codes on the host (C++
 open-addressing factorize), the value expressions + predicate + segment
-reductions run fused on the device, and tiny per-batch group tables are merged
-on the host keyed by the real key values — two-phase aggregation where phase 1
-is one XLA program per morsel.
+reductions run fused on the device, and per-batch group tables are merged on
+the host with vectorized numpy scatter ops keyed by the real key values —
+two-phase aggregation where phase 1 is one XLA program per morsel.
 
 Static shapes: rows pad to power-of-two buckets, the group table pads to a
 power-of-two capacity, with one trash segment for filtered/padding rows. The
 jit cache is bounded by O(log rows · log groups) per stage structure.
+
+Like ops/stage.py, the compiled program (GroupedAggStage, cached process-wide)
+is separated from per-run accumulator state (GroupedAggRun via start_run()), so
+failed or interrupted runs can never corrupt subsequent runs of the same query.
+
+Integer columns accumulate in int64 end-to-end (device segment tables AND the
+host merge) — exact for the full int64 domain, mirroring
+parallel/distributed.py's _segment_reduce and the reference's dtype-preserving
+aggregation.
 """
 
 from __future__ import annotations
@@ -40,12 +49,7 @@ def _pad_groups(g: int) -> int:
 
 
 class GroupedAggStage:
-    """Compiled filter→grouped-agg stage.
-
-    aggs: list of (output_name, AggExpr). Feed RecordBatches; finalize returns
-    (key_rows, agg_tables): key_rows = list of per-group key tuples in first-seen
-    order; agg_tables = per agg a list of (value, valid) aligned with key_rows.
-    """
+    """Compiled filter→grouped-agg program (immutable; see start_run())."""
 
     def __init__(self, schema: Schema, predicate: Optional[Expression],
                  groupby: Sequence[Expression], aggs: Sequence[Tuple[str, AggExpr]]):
@@ -53,13 +57,7 @@ class GroupedAggStage:
         self.predicate = predicate
         self.groupby = list(groupby)
         self.aggs = list(aggs)
-        self._jitted: Dict[Tuple[int, int], Callable] = {}
-        # key tuple -> group slot; partial tables accumulate per slot
-        self._key_order: List[tuple] = []
-        self._key_slot: Dict[tuple, int] = {}
-        self._acc: List[Dict[str, List[float]]] = [
-            {p: [] for p in self._partials(a.op)} for _, a in self.aggs
-        ]
+        self._jitted: Dict[int, Callable] = {}
         self._input_cols = self._referenced_columns()
 
     @staticmethod
@@ -79,6 +77,9 @@ class GroupedAggStage:
                 if c not in cols:
                     cols.append(c)
         return cols
+
+    def start_run(self) -> "GroupedAggRun":
+        return GroupedAggRun(self)
 
     def _build(self, cap: int) -> Callable:
         schema = self.schema
@@ -105,22 +106,57 @@ class GroupedAggStage:
                     mask = keep
                 tables = {}
                 for partial in self._partials(op):
-                    tables[partial] = _segment_table(partial, v, mask, seg, cap)
+                    tables[partial] = dev.segment_reduce(partial, v, mask, seg, cap + 1)[:cap]
                 out.append(tables)
             return out
 
         return jax.jit(stage)
 
+    def _jit_for(self, cap: int) -> Callable:
+        if cap not in self._jitted:
+            self._jitted[cap] = self._build(cap)
+        return self._jitted[cap]
+
+
+class GroupedAggRun:
+    """Per-run accumulator: key→slot map + numpy partial arrays (scatter-merged)."""
+
+    def __init__(self, stage: GroupedAggStage):
+        self.stage = stage
+        self._key_order: List[tuple] = []
+        self._key_slot: Dict[tuple, int] = {}
+        # per agg: partial name -> np accumulator array (grown by doubling)
+        self._acc: List[Dict[str, np.ndarray]] = [
+            {p: None for p in stage._partials(a.op)} for _, a in stage.aggs
+        ]
+        self._cap = 0  # allocated accumulator length
+
+    def _grow(self, need: int) -> None:
+        if need <= self._cap:
+            return
+        new_cap = max(64, self._cap * 2)
+        while new_cap < need:
+            new_cap *= 2
+        for acc in self._acc:
+            for p, arr in acc.items():
+                if arr is None:
+                    continue
+                grown = np.full(new_cap, _identity_np(p, arr.dtype), dtype=arr.dtype)
+                grown[: len(arr)] = arr
+                acc[p] = grown
+        self._cap = new_cap
+
     def feed_batch(self, batch) -> None:
         from ..core.kernels.groupby import make_groups
         from ..expressions.eval import eval_expression, _broadcast
 
+        stage = self.stage
         n = batch.num_rows
         if n == 0:
             return
         # group codes are a pure function of (batch, groupby exprs): cache them on
         # the batch so repeated queries over resident tables skip re-factorization
-        gb_key = ("__group_codes__",) + tuple(str(e) for e in self.groupby)
+        gb_key = ("__group_codes__",) + tuple(str(e) for e in stage.groupby)
         cache = getattr(batch, "_stage_cache", None)
         if cache is None:
             cache = {}
@@ -129,7 +165,7 @@ class GroupedAggStage:
             group_ids, num_groups, key_rows = cache[gb_key]
         else:
             key_series = []
-            for e in self.groupby:
+            for e in stage.groupby:
                 s = eval_expression(batch, e)
                 if len(s) == 1 and n != 1:
                     s = _broadcast(s, n)
@@ -142,8 +178,7 @@ class GroupedAggStage:
 
         bucket = pad_bucket(n)
         cap = _pad_groups(max(num_groups, 1))
-        if (bucket, cap) not in self._jitted:
-            self._jitted[(bucket, cap)] = self._build(cap)
+        prog = stage._jit_for(cap)
 
         codes_key = (gb_key, bucket, cap)
         if codes_key in cache:
@@ -156,98 +191,78 @@ class GroupedAggStage:
         row_mask = np.zeros(bucket, dtype=bool)
         row_mask[:n] = True
         dcols = {name: batch.get_column(name).to_device_cached(bucket)
-                 for name in self._input_cols}
+                 for name in stage._input_cols}
 
-        out = self._jitted[(bucket, cap)](dcols, dcodes, jnp.asarray(row_mask))
+        out = prog(dcols, dcodes, jnp.asarray(row_mask))
         out = jax.device_get(out)  # ONE device->host round trip for all tables
         counters.bump("device_grouped_batches")
 
-        # host merge: one small fetch per partial table
-        slots = []
-        for key in key_rows:
-            slot = self._key_slot.get(key)
+        # map this batch's groups to global slots (dict probe per distinct group,
+        # not per row); new keys extend the accumulators
+        slots = np.empty(num_groups, dtype=np.int64)
+        key_slot = self._key_slot
+        for g, key in enumerate(key_rows):
+            slot = key_slot.get(key)
             if slot is None:
                 slot = len(self._key_order)
-                self._key_slot[key] = slot
+                key_slot[key] = slot
                 self._key_order.append(key)
-                for acc in self._acc:
-                    for p, lst in acc.items():
-                        lst.append(_identity(p))
-            slots.append(slot)
+            slots[g] = slot
+        self._grow(len(self._key_order))
 
+        # vectorized merge: numpy scatter per partial table
         for acc, tables in zip(self._acc, out):
             for p, table in tables.items():
                 host = np.asarray(table)[:num_groups]
-                lst = acc[p]
-                for g, slot in enumerate(slots):
-                    # Python-scalar arithmetic: exact for int64 sums (no float64
-                    # demotion, no silent int overflow)
-                    lst[slot] = _merge(p, lst[slot], host[g].item())
+                arr = acc[p]
+                if arr is None:
+                    dt = host.dtype if host.dtype.kind in "iuf" else np.float64
+                    arr = np.full(self._cap, _identity_np(p, dt), dtype=dt)
+                    acc[p] = arr
+                if p in ("count", "sum"):
+                    np.add.at(arr, slots, host)
+                elif p == "min":
+                    np.minimum.at(arr, slots, host)
+                else:
+                    np.maximum.at(arr, slots, host)
 
     def finalize(self):
-        """Returns (key_rows, agg_results); agg_results[i] = (values list, valid list).
-
-        Resets accumulation state so a cached stage can serve the next run.
-        """
+        """Returns (key_rows, agg_results); agg_results[i] = (values array, valid array)."""
+        g = len(self._key_order)
         results = []
-        for (name, agg), acc in zip(self.aggs, self._acc):
+        for (name, agg), acc in zip(self.stage.aggs, self._acc):
             op = agg.op
-            vals: List = []
-            valid: List[bool] = []
-            for slot in range(len(self._key_order)):
-                cnt = acc["count"][slot]
-                if op == "count":
-                    vals.append(int(cnt))
-                    valid.append(True)
-                elif op == "mean":
-                    vals.append(acc["sum"][slot] / cnt if cnt else None)
-                    valid.append(cnt > 0)
-                else:
-                    vals.append(acc[op][slot] if cnt else None)
-                    valid.append(cnt > 0)
+            cnt = acc["count"][:g] if acc["count"] is not None else np.zeros(g, dtype=np.int64)
+            if op == "count":
+                vals = cnt.astype(np.int64)
+                valid = np.ones(g, dtype=bool)
+            elif op == "mean":
+                s = acc["sum"][:g] if acc["sum"] is not None else np.zeros(g)
+                valid = cnt > 0
+                vals = s / np.maximum(cnt, 1)
+            else:
+                arr = acc[op][:g] if acc[op] is not None else np.zeros(g)
+                valid = cnt > 0
+                vals = arr
             results.append((vals, valid))
         key_rows = list(self._key_order)
         self._key_order = []
         self._key_slot = {}
-        self._acc = [{p: [] for p in self._partials(a.op)} for _, a in self.aggs]
+        self._acc = [{p: None for p in self.stage._partials(a.op)} for _, a in self.stage.aggs]
+        self._cap = 0
         counters.bump("device_stage_runs")
         return key_rows, results
 
 
-def _identity(partial: str):
+def _identity_np(partial: str, dtype) -> object:
+    """Merge identity for a host accumulator of this dtype (exact for ints)."""
+    dt = np.dtype(dtype)
     if partial in ("count", "sum"):
-        return 0  # int identity: promoted to float by float inputs, exact for ints
-    if partial == "min":
-        return np.inf
-    if partial == "max":
-        return -np.inf
-    raise ValueError(partial)
-
-
-def _merge(partial: str, a, b):
-    if partial in ("count", "sum"):
-        return a + b
-    return min(a, b) if partial == "min" else max(a, b)
-
-
-def _segment_table(op: str, values: jnp.ndarray, mask: jnp.ndarray,
-                   seg: jnp.ndarray, cap: int) -> jnp.ndarray:
-    """Masked segment reduce into cap real slots (+1 trash, sliced off)."""
-    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
-    if op == "count":
-        t = jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=cap + 1)
-        return t[:cap]
-    if op == "sum":
-        acc = jnp.int64 if is_int else jnp.float64
-        v = jnp.where(mask, values.astype(acc), jnp.zeros((), acc))
-        return jax.ops.segment_sum(v, seg, num_segments=cap + 1)[:cap]
-    if op in ("min", "max"):
-        acc = jnp.float64
-        ident = jnp.inf if op == "min" else -jnp.inf
-        v = jnp.where(mask, values.astype(acc), jnp.asarray(ident, acc))
-        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        return fn(v, seg, num_segments=cap + 1)[:cap]
-    raise ValueError(f"no segment table op {op!r}")
+        return dt.type(0)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return info.max if partial == "min" else info.min
+    return np.inf if partial == "min" else -np.inf
 
 
 _STAGE_CACHE: Dict[tuple, GroupedAggStage] = {}
@@ -259,8 +274,9 @@ def try_build_grouped_agg_stage(schema: Schema, predicate: Optional[Expression],
     """Build a device grouped-agg stage if predicate + agg value exprs qualify.
 
     Group keys run host-side (factorize handles any dtype), so they are
-    unconstrained beyond being non-aggregate expressions. Stages are cached by
-    structure so repeated runs reuse jitted programs (finalize resets state).
+    unconstrained beyond being non-aggregate expressions. Stages (compiled
+    programs only) are cached by structure so repeated runs reuse jitted
+    executables; run state lives in GroupedAggRun.
     """
     from .stage import stage_cache_key
 

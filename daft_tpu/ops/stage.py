@@ -10,6 +10,12 @@ Dynamic shapes: XLA requires static shapes, so batches are padded to power-of-tw
 length buckets (padding rows ride along with validity=False) — SURVEY.md §7's
 "quantized batching" answer to data-dependent row counts. The jit cache is then
 bounded by O(log max_rows) compilations per stage structure.
+
+Stages are split into an immutable compiled *program* (cached process-wide, so
+repeated queries reuse jitted XLA executables) and a per-run accumulator object
+(`FilterAggRun`) created via `start_run()` — an interrupted or failed run can
+never leak partial state into the next run of the same query, and concurrent
+identical queries never share accumulators.
 """
 
 from __future__ import annotations
@@ -22,7 +28,6 @@ from ..utils import jax_setup  # noqa: F401
 import jax
 import jax.numpy as jnp
 
-from ..datatype import DataType
 from ..expressions.expressions import AggExpr, Alias, Expression
 from ..schema import Schema
 from . import counters
@@ -66,10 +71,11 @@ def _combine_partials(op: str, parts: List[Dict[str, Tuple[float, bool]]], name:
 
 
 class FilterAggStage:
-    """Compiled scan→filter→ungrouped-agg stage (the TPC-H Q6 shape).
+    """Compiled scan→filter→ungrouped-agg program (the TPC-H Q6 shape).
 
-    aggs: list of (output_name, AggExpr). Call feed(columns, n) per batch
-    (columns: name → (np values, np validity)); finalize() returns final scalars.
+    Immutable + shareable: holds only the expression structure and the jit
+    cache. Call start_run() for a fresh accumulator, feed it batches, then
+    finalize().
     """
 
     def __init__(self, schema: Schema, predicate: Optional[Expression],
@@ -78,7 +84,6 @@ class FilterAggStage:
         self.predicate = predicate
         self.aggs = list(aggs)
         self._jitted: Dict[int, Callable] = {}
-        self._partials: List[Dict] = []
         self._input_cols = self._referenced_columns()
 
     def _referenced_columns(self) -> List[str]:
@@ -92,7 +97,10 @@ class FilterAggStage:
                     cols.append(c)
         return cols
 
-    def _build(self, bucket: int) -> Callable:
+    def start_run(self) -> "FilterAggRun":
+        return FilterAggRun(self)
+
+    def _build(self) -> Callable:
         schema = self.schema
         pred_fn = dev.build_device_expr(self.predicate, schema) if self.predicate is not None else None
         agg_specs = []
@@ -120,12 +128,26 @@ class FilterAggStage:
 
         return jax.jit(stage)
 
-    def _run(self, dcols: Dict[str, dev.DCol], n: int, bucket: int) -> None:
+    def _jit_for(self, bucket: int) -> Callable:
+        # one program serves every bucket (shapes differ per call; jit retraces
+        # per shape internally) — keyed anyway so future bucket-specialized
+        # programs stay cheap to add
         if bucket not in self._jitted:
-            self._jitted[bucket] = self._build(bucket)
+            self._jitted[bucket] = self._build()
+        return self._jitted[bucket]
+
+
+class FilterAggRun:
+    """Per-run accumulator for a FilterAggStage (fresh per query execution)."""
+
+    def __init__(self, stage: FilterAggStage):
+        self.stage = stage
+        self._partials: List[Dict] = []
+
+    def _run(self, dcols: Dict[str, dev.DCol], n: int, bucket: int) -> None:
         row_mask = np.zeros(bucket, dtype=bool)
         row_mask[:n] = True
-        res = self._jitted[bucket](dcols, jnp.asarray(row_mask))
+        res = self.stage._jit_for(bucket)(dcols, jnp.asarray(row_mask))
         counters.bump("device_stage_batches")
         res = jax.device_get(res)  # ONE device->host round trip for all partials
         self._partials.append({k: (v[0].item(), bool(v[1])) for k, v in res.items()})
@@ -133,7 +155,7 @@ class FilterAggStage:
     def feed(self, columns: Dict[str, Tuple[np.ndarray, np.ndarray]], n: int) -> None:
         bucket = pad_bucket(n)
         dcols = {}
-        for name in self._input_cols:
+        for name in self.stage._input_cols:
             vals, valid = columns[name]
             if len(vals) < bucket:
                 pad = bucket - len(vals)
@@ -147,12 +169,12 @@ class FilterAggStage:
         n = batch.num_rows
         bucket = pad_bucket(n)
         dcols = {name: batch.get_column(name).to_device_cached(bucket)
-                 for name in self._input_cols}
+                 for name in self.stage._input_cols}
         self._run(dcols, n, bucket)
 
     def finalize(self) -> Dict[str, Optional[float]]:
         out = {}
-        for name, agg in self.aggs:
+        for name, agg in self.stage.aggs:
             if not self._partials:
                 out[name] = 0 if agg.op == "count" else None
             else:
@@ -177,8 +199,9 @@ def try_build_filter_agg_stage(schema: Schema, predicate: Optional[Expression],
                                agg_exprs: Sequence[Expression]) -> Optional[FilterAggStage]:
     """Build a device stage for filter+ungrouped-agg if every expression qualifies.
 
-    Stages are cached by (schema, predicate, aggs) structure so repeated runs of
-    the same query reuse the jitted programs instead of retracing.
+    Stages (compiled programs only — no run state) are cached by
+    (schema, predicate, aggs) structure so repeated runs of the same query reuse
+    the jitted executables instead of retracing.
     """
     key = stage_cache_key(schema, predicate, agg_exprs)
     if key in _STAGE_CACHE:

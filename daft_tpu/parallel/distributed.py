@@ -110,35 +110,16 @@ def sharded_filter_agg_step(mesh: Mesh, schema: Schema, predicate: Optional[Expr
     return jax.jit(step, out_shardings=replicated)
 
 
-def _segment_reduce(op: str, values: jnp.ndarray, mask: jnp.ndarray,
-                    seg: jnp.ndarray, num_segments: int) -> jnp.ndarray:
-    """Masked segment reduce. Invalid rows contribute the op's identity.
-
-    Integer inputs accumulate in int64 (exact, matching the single-node
-    device_agg); floats in float64.
-    """
-    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
-    if op == "count":
-        return jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=num_segments)
-    if op == "sum":
-        acc = jnp.int64 if is_int else jnp.float64
-        v = jnp.where(mask, values.astype(acc), jnp.zeros((), acc))
-        return jax.ops.segment_sum(v, seg, num_segments=num_segments)
-    if op in ("min", "max"):
-        acc = jnp.int64 if is_int else jnp.float64
-        if is_int:
-            ident = jnp.iinfo(jnp.int64).max if op == "min" else jnp.iinfo(jnp.int64).min
-        else:
-            ident = jnp.inf if op == "min" else -jnp.inf
-        v = jnp.where(mask, values.astype(acc), jnp.asarray(ident, acc))
-        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        return fn(v, seg, num_segments=num_segments)
-    raise ValueError(f"no segment reduce for {op!r}")
+# canonical masked segment reduce shared with the single-chip grouped stage
+_segment_reduce = dev.segment_reduce
 
 
 def _merge_op(op: str) -> str:
     """Reduce op used when merging per-shard partial tables."""
     return {"count": "sum", "sum": "sum", "min": "min", "max": "max"}[op]
+
+
+_STEP_CACHE: Dict[tuple, Callable] = {}
 
 
 def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
@@ -166,6 +147,13 @@ def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
         from jax.experimental.shard_map import shard_map
 
     ops = list(agg_ops)
+    # memoize the compiled step: repeated groupbys at the same (mesh, ops,
+    # capacity) reuse one jitted multi-device program instead of rebuilding a
+    # fresh closure that can never cache-hit (Mesh is hashable by value)
+    cache_key = (mesh, tuple(ops), capacity, axis)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
     cap1 = capacity + 1  # one extra slot so the sentinel never evicts a real key
 
     def _true_unique_count(sorted_keys: jnp.ndarray) -> jnp.ndarray:
@@ -243,7 +231,9 @@ def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
     except TypeError:  # pre-0.8 jax spells it check_rep
         mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False)
-    return jax.jit(mapped)
+    step = jax.jit(mapped)
+    _STEP_CACHE[cache_key] = step
+    return step
 
 
 def groupby_host(mesh: Mesh, keys: np.ndarray, key_valid: np.ndarray,

@@ -160,6 +160,63 @@ def test_device_int_sums_exact():
     assert dev_out == host_out
 
 
+def test_abandoned_run_does_not_corrupt_next_run():
+    """ADVICE r2 (high): cached stages must not carry accumulator state across
+    runs — an interrupted run (exception between feed and finalize) previously
+    leaked partials into the next run of the same query (106.0 instead of 6.0)."""
+    df = daft_tpu.from_pydict({"v": [1.0, 2.0, 3.0]})
+    q = lambda d: d.agg(col("v").sum().alias("s"))
+    with execution_config_ctx(device_mode="on"):
+        # simulate a run that fed batches then died before finalize
+        from daft_tpu.ops.stage import try_build_filter_agg_stage
+
+        plan = _plan(q(df))
+        node = next(n for n in plan.walk() if isinstance(n, pp.DeviceFilterAgg))
+        stage = try_build_filter_agg_stage(node.input.schema, node.predicate,
+                                           node.aggregations)
+        run = stage.start_run()
+        for part in node.input.partitions:
+            for b in part.batches:
+                run.feed_batch(b)
+        # (no finalize — abandoned)
+        out = q(df).to_pydict()
+    assert out["s"] == [6.0]
+
+
+def test_abandoned_grouped_run_does_not_corrupt_next_run():
+    df = daft_tpu.from_pydict({"k": ["a", "b", "a"], "v": [1.0, 2.0, 3.0]})
+    q = lambda d: d.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+    with execution_config_ctx(device_mode="on"):
+        from daft_tpu.ops.grouped_stage import try_build_grouped_agg_stage
+
+        plan = _plan(q(df))
+        node = next(n for n in plan.walk() if isinstance(n, pp.DeviceGroupedAgg))
+        stage = try_build_grouped_agg_stage(node.input.schema, node.predicate,
+                                            node.groupby, node.aggregations)
+        run = stage.start_run()
+        for part in node.input.partitions:
+            for b in part.batches:
+                run.feed_batch(b)
+        out = q(df).to_pydict()
+    assert out["k"] == ["a", "b"]
+    assert out["s"] == [4.0, 2.0]
+
+
+def test_grouped_device_int_min_max_exact():
+    """ADVICE r2: int min/max must accumulate in int64, not float64 (2^53 cliff)."""
+    big = 2**53 + 1
+    df = daft_tpu.from_pydict({"k": ["a", "a", "b"], "v": [big, big + 2, 5]})
+    q = lambda d: (d.groupby("k")
+                   .agg(col("v").min().alias("lo"), col("v").max().alias("hi"))
+                   .sort("k"))
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out == host_out
+    assert dev_out["hi"][0] == big + 2
+
+
 def test_tpch_q1_shape_device_matches_host():
     rng = np.random.default_rng(2)
     n = 20_000
