@@ -119,6 +119,47 @@ def combine_equality_codes(code_cols: List[np.ndarray]) -> np.ndarray:
     return codes
 
 
+def _dense_int_pair_codes(ls, rs) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Join fast path: integer key columns with a dense value domain skip
+    factorization entirely — codes are (value - min), computed in one pass.
+    Only equality matters for join codes (no first-occurrence-order contract),
+    so direct value codes are valid. Returns (lcodes, rcodes) with null -> -1,
+    or None when not applicable (non-int keys, sparse domain)."""
+    for s in (ls, rs):
+        dt = s.dtype
+        if not (dt.is_numeric() and not dt.is_decimal()) and not dt.is_temporal():
+            return None
+    lv, rv = ls.to_numpy(), rs.to_numpy()
+    if lv.dtype.kind not in "iu" or rv.dtype.kind not in "iu":
+        return None
+    n = len(lv) + len(rv)
+    lvalid, rvalid = ls.validity_numpy(), rs.validity_numpy()
+    lall, rall = bool(len(lv) and lvalid.all()), bool(len(rv) and rvalid.all())
+    bounds = []
+    for v, va, al in ((lv, lvalid, lall), (rv, rvalid, rall)):
+        if al:
+            bounds.append((int(v.min()), int(v.max())))
+        elif va.any():
+            vv = v[va]
+            bounds.append((int(vv.min()), int(vv.max())))
+    if not bounds:
+        return None
+    lo = min(b[0] for b in bounds)
+    hi = max(b[1] for b in bounds)
+    if lo < np.iinfo(np.int64).min or hi > np.iinfo(np.int64).max:
+        return None  # uint64 beyond int64: let the factorize path handle it
+    domain = hi - lo + 1
+    if domain > max(1024, 4 * n):
+        return None
+    lc = (lv.astype(np.int64) - int(lo))
+    rc = (rv.astype(np.int64) - int(lo))
+    if not lall:
+        lc[~lvalid] = -1
+    if not rall:
+        rc[~rvalid] = -1
+    return lc, rc
+
+
 def encode_keys_equality(key_series: list, other_side: Optional[list] = None):
     """Like encode_keys but hash-based (equality semantics only).
 
@@ -141,6 +182,11 @@ def encode_keys_equality(key_series: list, other_side: Optional[list] = None):
         if ls.dtype != rs.dtype:
             target = _common_key_dtype(ls.dtype, rs.dtype)
             ls, rs = ls.cast(target), rs.cast(target)
+        dense = _dense_int_pair_codes(ls, rs)
+        if dense is not None:
+            lcols.append(dense[0])
+            rcols.append(dense[1])
+            continue
         both = Series.concat([ls.rename("k"), rs.rename("k")])
         c = equality_codes(both)
         lcols.append(c[: len(ls)])

@@ -65,9 +65,18 @@ def rule_drop_trivial_filter(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
 
 
 def rule_merge_filters(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
-    """Filter(Filter(x, a), b) → Filter(x, a & b)."""
+    """Filter(Filter(x, a), b) → Filter(x, a & b), deduping repr-identical
+    conjuncts (derived OR-pushdown filters can otherwise stack copies)."""
     if isinstance(node, lp.Filter) and isinstance(node.input, lp.Filter):
-        return lp.Filter(node.input.input, node.input.predicate & node.predicate)
+        merged = _split_conjuncts(node.input.predicate) + _split_conjuncts(node.predicate)
+        seen = set()
+        uniq = []
+        for c in merged:
+            r = repr(c)
+            if r not in seen:
+                seen.add(r)
+                uniq.append(c)
+        return lp.Filter(node.input.input, _and_all(uniq))
     return None
 
 
@@ -102,6 +111,184 @@ def rule_push_filter_into_scan(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]
         new_filters = node.predicate
     new_scan = lp.ScanSource(scan.scan_op, Pushdowns(pd.columns, new_filters, pd.limit))
     return lp.Filter(new_scan, new_filters)
+
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    from ..expressions.expressions import BinaryOp
+
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _split_disjuncts(e: Expression) -> List[Expression]:
+    from ..expressions.expressions import BinaryOp
+
+    if isinstance(e, BinaryOp) and e.op == "or":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _and_all(exprs: List[Expression]) -> Expression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = out & e
+    return out
+
+
+def _or_all(exprs: List[Expression]) -> Expression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = out | e
+    return out
+
+
+def _rename_refs(e: Expression, mapping) -> Expression:
+    def rewrite(x: Expression) -> Optional[Expression]:
+        if isinstance(x, ColumnRef) and x._name in mapping:
+            return col(mapping[x._name])
+        return None
+
+    return e.transform(rewrite)
+
+
+def _existing_conjunct_reprs(node: lp.LogicalPlan) -> set:
+    """Conjuncts already filtering this subtree (looking through name-preserving
+    Projects, which rule_push_filter_through_project may have inserted between
+    the join and a previously-derived filter)."""
+    out: set = set()
+    while True:
+        if isinstance(node, lp.Filter):
+            out |= {repr(c) for c in _split_conjuncts(node.predicate)}
+            node = node.input
+        elif isinstance(node, lp.Project):
+            node = node.input
+        else:
+            return out
+
+
+def rule_push_filter_through_join(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter(Join) → push side-local conjuncts below the join; derive relaxed
+    OR-predicates for cross-side disjunctions.
+
+    Reference: rules/push_down_filter.rs (+ its extract-or-predicates step).
+    - inner/cross: conjuncts referencing only one side's columns move to that side.
+    - left/right joins: only the preserved side accepts pushes (filters on the
+      null-extended side would change null-extension semantics).
+    - semi/anti: output schema is the left side; all conjuncts push left.
+    - A conjunct (A1&B1)|(A2&B2)|… where every disjunct Ai references only one
+      side pushes (A1|A2|…) to that side as a *derived* filter — the original
+      conjunct stays above the join (classic q19 shape).
+    """
+    if not (isinstance(node, lp.Filter) and isinstance(node.input, lp.Join)):
+        return None
+    join = node.input
+    if join.how == "outer":
+        return None
+    left_cols = set(join.left.schema.column_names())
+    merged_keys, right_rename = join.output_naming()
+    # output-name -> right-side-internal-name, for columns sourced from the right
+    right_names = join.right.schema.column_names()
+    out_to_right = {}
+    for c in right_names:
+        if join.how in ("semi", "anti"):
+            break
+        if c in merged_keys:
+            continue
+        out_to_right[right_rename.get(c, c)] = c
+
+    push_left = join.how in ("inner", "cross", "left", "semi", "anti")
+    push_right = join.how in ("inner", "cross", "right")
+
+    left_push: List[Expression] = []
+    right_push: List[Expression] = []
+    remaining: List[Expression] = []
+    derived_left: List[Expression] = []
+    derived_right: List[Expression] = []
+
+    for conj in _split_conjuncts(node.predicate):
+        if conj.has_udf():
+            remaining.append(conj)
+            continue
+        refs = set(conj.referenced_columns())
+        refs_left = refs <= left_cols
+        refs_right = refs <= set(out_to_right)
+        if refs_left and push_left:
+            left_push.append(conj)
+            continue
+        if refs_right and push_right:
+            right_push.append(_rename_refs(conj, out_to_right))
+            continue
+        remaining.append(conj)
+        # derived OR-predicate extraction (inner/cross only: a derived filter on
+        # one side must not affect null-extension of preserved rows)
+        if join.how not in ("inner", "cross"):
+            continue
+        disjuncts = _split_disjuncts(conj)
+        if len(disjuncts) < 2:
+            continue
+        for side, target in (("l", derived_left), ("r", derived_right)):
+            per_disjunct = []
+            for d in disjuncts:
+                side_parts = []
+                for p in _split_conjuncts(d):
+                    prefs = set(p.referenced_columns())
+                    if side == "l" and prefs <= left_cols and not p.has_udf():
+                        side_parts.append(p)
+                    elif side == "r" and prefs <= set(out_to_right) and not p.has_udf():
+                        side_parts.append(_rename_refs(p, out_to_right))
+                if not side_parts:
+                    per_disjunct = None
+                    break
+                per_disjunct.append(_and_all(side_parts))
+            if per_disjunct:
+                target.append(_or_all(per_disjunct))
+
+    # derived filters stay above too, so guard against re-deriving every pass
+    left_existing = _existing_conjunct_reprs(join.left)
+    right_existing = _existing_conjunct_reprs(join.right)
+    derived_left = [e for e in derived_left if repr(e) not in left_existing]
+    derived_right = [e for e in derived_right if repr(e) not in right_existing]
+
+    if not (left_push or right_push or derived_left or derived_right):
+        return None
+
+    new_left = join.left
+    if left_push or derived_left:
+        new_left = lp.Filter(new_left, _and_all(left_push + derived_left))
+    new_right = join.right
+    if right_push or derived_right:
+        new_right = lp.Filter(new_right, _and_all(right_push + derived_right))
+    new_join = lp.Join(new_left, new_right, join.left_on, join.right_on, join.how,
+                       join.prefix, join.suffix, join.strategy)
+    if remaining:
+        return lp.Filter(new_join, _and_all(remaining))
+    return new_join
+
+
+def rule_push_filter_through_project(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter(Project) → Project(Filter) when every referenced projection output
+    is a plain column passthrough or alias of a column (reference:
+    rules/push_down_filter.rs over Project)."""
+    if not (isinstance(node, lp.Filter) and isinstance(node.input, lp.Project)):
+        return None
+    from ..expressions.expressions import Alias
+
+    proj = node.input
+    mapping = {}
+    for e in proj.projection:
+        inner = e
+        while isinstance(inner, Alias):
+            inner = inner.child
+        if isinstance(inner, ColumnRef):
+            mapping[e.name()] = inner._name
+    if node.predicate.has_udf():
+        return None
+    refs = set(node.predicate.referenced_columns())
+    if not refs <= set(mapping):
+        return None
+    pushed = _rename_refs(node.predicate, mapping)
+    return lp.Project(lp.Filter(proj.input, pushed), proj.projection)
 
 
 def rule_push_limit_into_scan(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
@@ -416,6 +603,9 @@ def default_rule_batches(config) -> List[RuleBatch]:
             rule_drop_noop_project,
         ]),
         RuleBatch("pushdowns", [
+            rule_push_filter_through_join,
+            rule_push_filter_through_project,
+            rule_merge_filters,
             rule_push_filter_into_scan,
             rule_push_limit_through,
             rule_push_limit_into_scan,
