@@ -33,11 +33,19 @@ class ExecutionConfig:
     device_mode: str = field(
         default_factory=lambda: os.environ.get("DAFT_TPU_DEVICE", "auto")
     )
-    # Default calibrated for a tunneled/remote device (measured ~0.1-2s per
-    # dispatch+fetch round trip): only very large morsels amortize it. On
-    # co-located TPU hardware set this to ~1M (or device_mode="on").
+    # Floor below which "auto" never considers the device (skips cost-model
+    # calibration for trivially small inputs). The real host-vs-device decision
+    # above this floor is the measured cost model in ops/costmodel.py.
     device_min_rows: int = field(
-        default_factory=lambda: _env_int("DAFT_TPU_DEVICE_MIN_ROWS", 32_000_000)
+        default_factory=lambda: _env_int("DAFT_TPU_DEVICE_MIN_ROWS", 65_536)
+    )
+    # Amortization horizon for one-time device costs (h2d column upload, group-key
+    # dictionary builds) when the stage reads a resident in-memory table: those
+    # costs are cached across queries (Series.to_device_cached / dict_codes), so
+    # the cost model charges 1/N of them — the GPU-database "resident column
+    # cache" investment policy. Streaming file scans get no amortization.
+    device_amortize_runs: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_DEVICE_AMORTIZE", 16)
     )
     # morsel sizing (reference default_morsel_size, common/daft-config/src/lib.rs:131)
     morsel_size_rows: int = field(

@@ -28,7 +28,7 @@ def _combine(arr) -> pa.Array:
 
 
 class Series:
-    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "_device_cache")
+    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "_device_cache", "_dict_codes")
 
     def __init__(self, name: str, dtype: DataType, arrow: Optional[pa.Array], pyobjs: Optional[list] = None):
         self._name = name
@@ -196,16 +196,24 @@ class Series:
             return np.ones(len(self._arrow), dtype=bool)
         return np.asarray(pc.is_valid(self._arrow).to_numpy(zero_copy_only=False), dtype=bool)
 
-    def to_device(self, pad_to: Optional[int] = None):
+    def to_device(self, pad_to: Optional[int] = None, f32: bool = False):
         """(values, validity) as jax Arrays, optionally padded to ``pad_to`` rows.
 
         Padding rows are marked invalid; this is the padding+masking convention the
         stage compiler uses to keep XLA shapes static (SURVEY.md §7 'hard parts').
+
+        ``f32=True`` downcasts float64 columns to float32 — the engine's device
+        compute dtype. TPU f64 is software-emulated (~5x slower, measured) and
+        halving the column bytes doubles effective HBM residency + h2d bandwidth;
+        aggregations recover accuracy by combining per-chunk partials in f64
+        (see ops/grouped_stage.py).
         """
         from ..utils import jax_setup  # noqa: F401  (enables x64 before device use)
         import jax.numpy as jnp
 
         values = self.to_numpy()
+        if f32 and values.dtype == np.float64:
+            values = values.astype(np.float32)
         validity = self.validity_numpy()
         if pad_to is not None and pad_to > len(self):
             pad = pad_to - len(self)
@@ -214,7 +222,7 @@ class Series:
             validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
         return jnp.asarray(values), jnp.asarray(validity)
 
-    def to_device_cached(self, pad_to: Optional[int] = None):
+    def to_device_cached(self, pad_to: Optional[int] = None, f32: bool = False):
         """to_device with a device-residency cache on this Series.
 
         Collected tables queried repeatedly keep their columns resident in HBM
@@ -225,10 +233,37 @@ class Series:
         if cache is None:
             cache = {}
             object.__setattr__(self, "_device_cache", cache)
-        key = pad_to
+        key = (pad_to, f32)
         if key not in cache:
-            cache[key] = self.to_device(pad_to)
+            cache[key] = self.to_device(pad_to, f32=f32)
         return cache[key]
+
+    def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False) -> bool:
+        """True if this column is already in HBM for the given layout (cost-model hook)."""
+        cache = getattr(self, "_device_cache", None)
+        return bool(cache) and (pad_to, f32) in cache
+
+    def dict_codes(self):
+        """Dictionary-encode this column: (codes int32 ndarray, values list, K).
+
+        codes[i] in [0, K): index of row i's value in ``values`` (first-occurrence
+        order); nulls get their own code. Cached on the Series (immutable), so
+        repeated grouped queries over a resident table factorize each key column
+        exactly once — the device grouped-agg stage combines per-column codes into
+        segment ids ON DEVICE instead of re-factorizing rows per query
+        (reference contrast: daft-groupby make_groups runs per batch).
+        """
+        cached = getattr(self, "_dict_codes", None)
+        if cached is not None:
+            return cached
+        from .kernels.groupby import make_groups
+
+        first_idx, group_ids, _ = make_groups([self])
+        codes = group_ids.astype(np.int32, copy=False)
+        values = self.take(first_idx).to_pylist()
+        out = (codes, values, len(values))
+        object.__setattr__(self, "_dict_codes", out)
+        return out
 
     # ---- selection kernels --------------------------------------------------------
     def slice(self, start: int, end: int) -> "Series":

@@ -290,8 +290,10 @@ _MORSEL_ROWS = 256 * 1024
 def _exec_device_agg(node) -> MicroPartition:
     """Run a DeviceFilterAgg/DeviceGroupedAgg node: device stage or host fallback.
 
-    Device when device_mode == "on", or "auto" with a real accelerator backend
-    and a first morsel of >= device_min_rows rows (amortizes transfer latency).
+    Device when device_mode == "on", or "auto" on a real accelerator backend
+    when the measured cost model (ops/costmodel.py: live-calibrated d2h round
+    trip + h2d bandwidth for non-resident columns + compute-rate terms) says
+    the device beats the host numpy/C++ path for this stage's shape.
     """
     import itertools
 
@@ -309,28 +311,40 @@ def _exec_device_agg(node) -> MicroPartition:
             if first.num_rows >= cfg.device_min_rows:
                 import jax
 
-                use_device = jax.default_backend() not in ("cpu",)
+                if jax.default_backend() not in ("cpu",):
+                    use_device = _device_wins(node, first, grouped)
+
+    def _host_agg(s):
+        if node.predicate is not None:
+            s = (_filter_part(p, node.predicate) for p in s)
+        out = _two_phase_agg(node.input, node.groupby if grouped else [],
+                             node.aggregations, ungrouped=not grouped, stream=s)
+        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
 
     if not use_device:
-        if node.predicate is not None:
-            stream = (_filter_part(p, node.predicate) for p in stream)
-        out = _two_phase_agg(node.input, node.groupby if grouped else [],
-                             node.aggregations, ungrouped=not grouped, stream=stream)
-        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+        return _host_agg(stream)
 
     from ..core.series import Series
 
     in_schema = node.input.schema
     if grouped:
-        from ..ops.grouped_stage import try_build_grouped_agg_stage
+        from ..ops.grouped_stage import DeviceFallback, try_build_grouped_agg_stage
 
         stage = try_build_grouped_agg_stage(
             in_schema, node.predicate, node.groupby, node.aggregations)
         assert stage is not None, "planner emitted DeviceGroupedAgg for a non-qualifying plan"
         run = stage.start_run()
-        for part in stream:
-            for b in part.batches:
-                run.feed_batch(b)
+        buffered: List[MicroPartition] = []
+        try:
+            for part in stream:
+                buffered.append(part)
+                for b in part.batches:
+                    run.feed_batch(b)
+        except DeviceFallback:
+            # runtime shape outside the device kernel envelope (e.g. group count
+            # beyond the matmul segment ceiling, raised before any dispatch for
+            # the offending batch): rerun the whole stage on host
+            return _host_agg(itertools.chain(buffered, stream))
         key_rows, results = run.finalize()
         cols = []
         for i, g in enumerate(node.groupby):
@@ -358,6 +372,87 @@ def _exec_device_agg(node) -> MicroPartition:
         cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
     out = RecordBatch(node.schema, cols, 1)
     return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
+    """Cost-model decision for one device-agg stage based on the first morsel.
+
+    One-time cacheable costs (column upload, key-dictionary builds) amortize
+    over cfg.device_amortize_runs when the source is a resident in-memory table
+    (they persist on the Series across queries); streaming scans pay in full.
+    """
+    from ..config import execution_config
+    from ..ops import costmodel
+    from ..ops.stage import pad_bucket
+
+    batch = next((b for b in first.batches if b.num_rows > 0), None)
+    if batch is None:
+        return False
+    rows = first.num_rows
+    cal = costmodel.calibrate()
+
+    def _resident_source(n) -> bool:
+        while n is not None:
+            if isinstance(n, pp.InMemoryScan):
+                return True
+            n = getattr(n, "input", None)
+        return False
+
+    amort = max(execution_config().device_amortize_runs, 1) \
+        if _resident_source(node.input) else 1
+
+    if grouped:
+        from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+        stage = try_build_grouped_agg_stage(
+            node.input.schema, node.predicate, node.groupby, node.aggregations)
+        if stage is None:
+            return False
+        bucket = pad_bucket(batch.num_rows)
+        nonres = sum(
+            batch.num_rows * 5
+            for c in stage._input_cols
+            if not batch.get_column(c).is_device_resident(bucket, f32=True))
+        from ..ops.grouped_stage import (MAX_MATMUL_SEGMENTS, _pad_groups,
+                                         estimate_key_cardinality,
+                                         resolve_key_series)
+
+        key_series = resolve_key_series(batch, stage.groupby, batch.num_rows)
+        cap_est = _pad_groups(min(max(estimate_key_cardinality(key_series), 1),
+                                  2 * MAX_MATMUL_SEGMENTS))
+        if stage.dict_keys:
+            # dictionary builds are cached per Series -> amortized like uploads
+            dict_rows = sum(
+                batch.num_rows for s in key_series
+                if getattr(s, "_dict_codes", None) is None)
+            factorize_cost_rows = dict_rows // amort
+        else:
+            # host-mode keys re-factorize on every run: full price, no amortization
+            factorize_cost_rows = batch.num_rows
+        dev_cost = costmodel.device_grouped_cost(
+            cal, rows, nonres // amort, n_mm=len(stage._mm_specs), n_ext=len(stage._ext_specs),
+            n_sct=len(stage._sct_specs), cap=cap_est, factorize_rows=factorize_cost_rows)
+        host_cost = costmodel.host_agg_cost(
+            cal, rows, len(node.aggregations), grouped=True,
+            has_predicate=node.predicate is not None)
+        return dev_cost < host_cost
+
+    from ..ops.stage import try_build_filter_agg_stage
+
+    stage = try_build_filter_agg_stage(node.input.schema, node.predicate, node.aggregations)
+    if stage is None:
+        return False
+    bucket = pad_bucket(batch.num_rows)
+    nonres = sum(
+        batch.num_rows * 5
+        for c in stage._input_cols
+        if not batch.get_column(c).is_device_resident(bucket, f32=True))
+    dev_cost = costmodel.device_ungrouped_cost(
+        cal, rows, nonres // amort, n_partials=max(len(stage.aggs), 1))
+    host_cost = costmodel.host_agg_cost(
+        cal, rows, len(node.aggregations), grouped=False,
+        has_predicate=node.predicate is not None)
+    return dev_cost < host_cost
 
 
 

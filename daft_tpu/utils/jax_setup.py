@@ -7,9 +7,24 @@ opt into bf16/f32 explicitly where precision allows (SURVEY.md §7 MXU notes).
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: stage programs (scan-of-matmul groupbys etc.)
+# can take tens of seconds to compile over a tunneled device; caching across
+# processes makes every run after the first start warm.
+_cache_dir = os.environ.get(
+    "DAFT_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/daft_tpu_xla"))
+if _cache_dir:
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 def get_jax():

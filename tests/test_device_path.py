@@ -69,7 +69,8 @@ def test_q6_runs_on_device_and_matches_host():
     assert counters.device_stage_runs > 0
     with execution_config_ctx(device_mode="off"):
         host_out = _q6_query(df).to_pydict()
-    np.testing.assert_allclose(dev_out["revenue"], host_out["revenue"], rtol=1e-12)
+    # device compute dtype is f32 (f64 is TPU-emulated; see ops/stage.py) -> ~1e-7 rel
+    np.testing.assert_allclose(dev_out["revenue"], host_out["revenue"], rtol=1e-5)
 
 
 def test_grouped_agg_device_matches_host_string_keys():
@@ -100,7 +101,7 @@ def test_grouped_agg_device_matches_host_string_keys():
     assert dev_out["flag"] == host_out["flag"]
     assert dev_out["status"] == host_out["status"]
     for c in ("sum_qty", "avg_price", "min_qty", "max_qty"):
-        np.testing.assert_allclose(dev_out[c], host_out[c], rtol=1e-12)
+        np.testing.assert_allclose(dev_out[c], host_out[c], rtol=1e-5)
     assert dev_out["n"] == host_out["n"]
 
 
@@ -257,6 +258,24 @@ def test_tpch_q1_shape_device_matches_host():
         host_out = q1(df).to_pydict()
     for k in host_out:
         if isinstance(host_out[k][0], float):
-            np.testing.assert_allclose(dev_out[k], host_out[k], rtol=1e-9)
+            np.testing.assert_allclose(dev_out[k], host_out[k], rtol=1e-5)
         else:
             assert dev_out[k] == host_out[k], k
+
+
+def test_high_cardinality_groupby_falls_back_to_host():
+    """The one-hot matmul kernel must never see unbounded segment counts: keys
+    beyond MAX_MATMUL_SEGMENTS raise DeviceFallback pre-dispatch and the
+    executor reruns the stage on host with identical results."""
+    n = 20_000  # > MAX_MATMUL_SEGMENTS distinct keys
+    df = daft_tpu.from_pydict({
+        "k": list(range(n)),
+        "v": [float(i % 97) for i in range(n)],
+    })
+    q = lambda d: d.groupby("k").agg(col("v").sum().alias("s"))
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert dev_out == host_out
