@@ -238,6 +238,18 @@ class Series:
             cache[key] = self.to_device(pad_to, f32=f32)
         return cache[key]
 
+    def __getstate__(self):
+        """Pickle for cross-process shipping (distributed tasks/UDF workers):
+        device residency and dictionary caches are process-local — drop them."""
+        return (self._name, self._dtype, self._arrow, self._pyobjs)
+
+    def __setstate__(self, state):
+        name, dtype, arrow, pyobjs = state
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_dtype", dtype)
+        object.__setattr__(self, "_arrow", arrow)
+        object.__setattr__(self, "_pyobjs", pyobjs)
+
     def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False) -> bool:
         """True if this column is already in HBM for the given layout (cost-model hook)."""
         cache = getattr(self, "_device_cache", None)

@@ -1,0 +1,25 @@
+"""Distributed execution engine: partitioned tasks over local worker processes.
+
+The TPU-native counterpart of the reference's Flotilla layer
+(/root/reference/src/daft-distributed): a scheduler assigns serialized
+physical sub-plans ("SubPlanTask" — reference scheduling/task.rs:212
+SwordfishTask) to workers; bulk data moves through a disk-backed Arrow-IPC
+shuffle (reference src/daft-shuffles/src/shuffle_cache.rs). Control transport
+is spawn-based worker processes over pipes (the reference uses Ray actors);
+the scheduler/worker protocol is transport-agnostic so a gRPC/DCN multi-host
+backend slots in behind the same WorkerHandle interface.
+"""
+
+from .runner import DistributedRunner
+from .scheduler import Scheduler, Spread, WorkerAffinity, WorkerSnapshot
+from .task import SubPlanTask, TaskResult
+
+__all__ = [
+    "DistributedRunner",
+    "Scheduler",
+    "Spread",
+    "WorkerAffinity",
+    "WorkerSnapshot",
+    "SubPlanTask",
+    "TaskResult",
+]

@@ -1,0 +1,10 @@
+"""Worker subprocess entry point (kept separate from worker.py so that
+``python -m`` does not re-execute a module already imported by the package).
+"""
+
+import sys
+
+if __name__ == "__main__":
+    from daft_tpu.distributed.worker import main
+
+    main(sys.argv[1:])

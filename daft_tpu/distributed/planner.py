@@ -1,0 +1,306 @@
+"""Distributed planner: partition a physical plan into shuffle-separated stages.
+
+Reference parity: src/daft-distributed/src/pipeline_node/translate.rs:36
+(logical plan -> DistributedPipelineNode DAG) + pipeline_node/join/translate_join.rs
+(co-partitioning decisions). Model:
+
+- ``distribute(ctx, node)`` returns N plan *fragments* (one per partition) plus
+  the hash-partitioning property their outputs satisfy.
+- Map ops (project/filter/...) compose into the fragment sub-plans.
+- Exchange points (join/grouped-agg inputs not already co-partitioned, explicit
+  repartitions) run eagerly as a stage of ShuffleWrite tasks on the worker
+  pool; downstream fragments read via ShuffleRead.
+- ``localize()`` replaces each maximal distributable subtree with an
+  InMemoryScan of its distributed result; the driver executes the remainder
+  (sort/window/writes/...) locally.
+
+Two-phase grouped aggregation reuses plan/agg_split (the same partial/final
+decomposition the local engine uses), so a distributed groupby is:
+partial-agg fragments -> hash shuffle on keys -> final-agg fragments.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..core.micropartition import MicroPartition
+from ..core.recordbatch import RecordBatch
+from ..expressions import ColumnRef
+from ..expressions.expressions import Alias
+from ..plan import physical as pp
+from .task import SubPlanTask
+
+
+@dataclass
+class DistContext:
+    pool: object               # WorkerPool
+    shuffle_dir: str
+    n_partitions: int
+    _task_seq: itertools.count = None  # type: ignore[assignment]
+    _run_tag: str = ""
+    shuffle_ids: List[str] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        self._task_seq = itertools.count()
+        # unique per context: a reused pool must never confuse this run's task
+        # ids with a previous query's (stale-result isolation)
+        self._run_tag = uuid.uuid4().hex[:8]
+        self.shuffle_ids = []
+
+    def task_id(self, prefix: str) -> str:
+        return f"{prefix}-{self._run_tag}-{next(self._task_seq)}"
+
+
+@dataclass
+class Partitioned:
+    fragments: List[pp.PhysicalPlan]
+    # hash-partition property: column names the fragments are co-partitioned on
+    # (None = unknown/none). Only ever set for fragment lists of length
+    # ctx.n_partitions produced by a shuffle (or preserved through map ops).
+    partitioned_by: Optional[Tuple[str, ...]] = None
+
+
+_MAP_NODES = (pp.Project, pp.PhysFilter, pp.UDFProject, pp.PhysExplode,
+              pp.PhysUnpivot, pp.PhysSample)
+_SUPPORTED = _MAP_NODES + (pp.InMemoryScan, pp.TaskScan, pp.HashJoin,
+                           pp.HashAggregate, pp.PhysRepartition, pp.Dedup,
+                           pp.DeviceGroupedAgg)
+
+
+def subtree_distributable(node: pp.PhysicalPlan) -> bool:
+    for n in node.walk():
+        if not isinstance(n, _SUPPORTED):
+            return False
+        if isinstance(n, pp.TaskScan) and n.post_limit is not None:
+            return False
+        if isinstance(n, pp.PhysRepartition) and n.scheme not in ("hash",):
+            return False
+        if isinstance(n, pp.HashJoin) and n.how == "cross":
+            return False
+    return True
+
+
+def worth_distributing(node: pp.PhysicalPlan, min_rows: int = 0) -> bool:
+    """Only ship subtrees containing an exchange-heavy op; pure scans/maps are
+    cheaper executed in-process than serialized across workers."""
+    return any(isinstance(n, (pp.HashJoin, pp.HashAggregate, pp.PhysRepartition,
+                              pp.Dedup))
+               for n in node.walk())
+
+
+def localize(ctx: DistContext, node: pp.PhysicalPlan) -> pp.PhysicalPlan:
+    """Replace maximal distributable subtrees with their distributed results."""
+    if subtree_distributable(node) and worth_distributing(node):
+        parts = run_distributed(ctx, node)
+        return pp.InMemoryScan(parts, node.schema)
+    if isinstance(node, pp.PhysConcat):
+        node.inputs = [localize(ctx, c) for c in node.inputs]
+        return node
+    if isinstance(node, (pp.HashJoin, pp.CrossJoin)):
+        node.left = localize(ctx, node.left)
+        node.right = localize(ctx, node.right)
+        return node
+    if hasattr(node, "input"):
+        node.input = localize(ctx, node.input)
+    return node
+
+
+def run_distributed(ctx: DistContext, node: pp.PhysicalPlan) -> List[MicroPartition]:
+    """Distribute a subtree and run its final fragments as a task stage.
+
+    Shuffle intermediates for this subtree are deleted once the results are
+    gathered (reference: cluster-wide shuffle dir cleanup on plan end,
+    daft/runners/flotilla.py:70-106).
+    """
+    from . import shuffle as shf
+
+    try:
+        dist = distribute(ctx, node)
+        tasks = [SubPlanTask.from_plan(ctx.task_id("final"), frag)
+                 for frag in dist.fragments]
+        results = ctx.pool.run_tasks(tasks)
+        parts: List[MicroPartition] = []
+        for t in tasks:  # preserve fragment order
+            parts.extend(results[t.task_id].partitions)
+        return parts or [MicroPartition.empty(node.schema)]
+    finally:
+        for sid in ctx.shuffle_ids:
+            shf.cleanup(ctx.shuffle_dir, sid)
+        ctx.shuffle_ids.clear()
+
+
+def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
+    N = ctx.n_partitions
+
+    if isinstance(node, pp.InMemoryScan):
+        groups = _split_partitions(node.partitions, N, node.schema)
+        return Partitioned([pp.InMemoryScan(g, node.schema) for g in groups])
+
+    if isinstance(node, pp.TaskScan):
+        if len(node.tasks) <= 1:
+            return Partitioned([node])
+        groups = [node.tasks[i::N] for i in range(min(N, len(node.tasks)))]
+        return Partitioned([
+            pp.TaskScan(g, node.schema, node.post_filter, None) for g in groups if g
+        ])
+
+    if isinstance(node, _MAP_NODES):
+        child = distribute(ctx, node.input)
+        frags = []
+        for f in child.fragments:
+            clone = _clone_unary(node, f)
+            frags.append(clone)
+        keep = child.partitioned_by
+        if keep is not None and not set(keep).issubset(set(node.schema.column_names())):
+            keep = None  # partition keys projected away
+        return Partitioned(frags, keep)
+
+    if isinstance(node, pp.PhysRepartition):
+        child = distribute(ctx, node.input)
+        keys = _key_names(node.by)
+        reads = _shuffle(ctx, child.fragments, node.by, node.schema)
+        return Partitioned(reads, keys)
+
+    if isinstance(node, pp.Dedup):
+        # co-partition on the dedup keys, then dedup each partition independently
+        child = distribute(ctx, node.input)
+        from ..expressions import col as _col
+
+        on = node.on or [_col(c) for c in node.input.schema.column_names()]
+        keys = _key_names(on)
+        if child.partitioned_by is None or child.partitioned_by != keys:
+            reads = _shuffle(ctx, child.fragments, on, node.input.schema)
+        else:
+            reads = child.fragments
+        return Partitioned([pp.Dedup(f, node.on, node.schema) for f in reads], keys)
+
+    if isinstance(node, pp.HashJoin):
+        left = distribute(ctx, node.left)
+        right = distribute(ctx, node.right)
+        lkeys = _key_names(node.left_on)
+        rkeys = _key_names(node.right_on)
+        if left.partitioned_by is None or left.partitioned_by != lkeys:
+            lfrags = _shuffle(ctx, left.fragments, node.left_on, node.left.schema)
+        else:
+            lfrags = left.fragments
+        if right.partitioned_by is None or right.partitioned_by != rkeys:
+            rfrags = _shuffle(ctx, right.fragments, node.right_on, node.right.schema)
+        else:
+            rfrags = right.fragments
+        frags = [
+            pp.HashJoin(lf, rf, node.left_on, node.right_on, node.how,
+                        node.merged_keys, node.right_rename, node.schema)
+            for lf, rf in zip(lfrags, rfrags)
+        ]
+        out_keys = lkeys if lkeys and set(lkeys).issubset(set(node.schema.column_names())) else None
+        return Partitioned(frags, out_keys)
+
+    if isinstance(node, pp.DeviceGroupedAgg):
+        # the device belongs to the driver; shipped sub-plans aggregate on the
+        # workers' host path — rewrite to the equivalent filter + hash agg
+        inner = node.input
+        if node.predicate is not None:
+            inner = pp.PhysFilter(inner, node.predicate, inner.schema)
+        node = pp.HashAggregate(inner, node.groupby, node.aggregations, node.schema)
+
+    if isinstance(node, pp.HashAggregate):
+        from ..expressions import col as _col
+        from ..plan.agg_split import split_aggs
+
+        child = distribute(ctx, node.input)
+        keys = _key_names(node.groupby)
+        if child.partitioned_by is not None and child.partitioned_by == keys:
+            # already co-partitioned on the group keys: aggregate in place
+            frags = [pp.HashAggregate(f, node.groupby, node.aggregations, node.schema)
+                     for f in child.fragments]
+            return Partitioned(frags, keys)
+        split = split_aggs(node.aggregations)
+        if split is not None:
+            # two-phase: partial agg per fragment -> shuffle on keys -> final
+            partial_schema = _agg_schema(node.input.schema, node.groupby, split.partial)
+            partials = [
+                pp.HashAggregate(f, node.groupby, split.partial, partial_schema)
+                for f in child.fragments
+            ]
+            key_names = [e.name() for e in node.groupby]
+            key_cols = [_col(k) for k in key_names]
+            reads = _shuffle(ctx, partials, key_cols, partial_schema)
+            frags = []
+            for r in reads:
+                final = pp.HashAggregate(r, key_cols, split.final,
+                                         _agg_schema(partial_schema, key_cols, split.final))
+                frags.append(pp.Project(final, key_cols + split.projection, node.schema))
+            return Partitioned(frags, keys)
+        # unsplittable aggs (e.g. count_distinct): shuffle raw rows by key
+        reads = _shuffle(ctx, child.fragments, node.groupby, node.input.schema)
+        frags = [pp.HashAggregate(r, node.groupby, node.aggregations, node.schema)
+                 for r in reads]
+        return Partitioned(frags, keys)
+
+    raise NotImplementedError(f"distribute: unhandled node {type(node).__name__}")
+
+
+def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
+             schema) -> List[pp.PhysicalPlan]:
+    """Run a shuffle stage: wrap each fragment in ShuffleWrite, execute on the
+    pool, return per-partition ShuffleRead fragments."""
+    sid = uuid.uuid4().hex[:12]
+    ctx.shuffle_ids.append(sid)
+    tasks = [
+        SubPlanTask.from_plan(
+            ctx.task_id("shuffle"),
+            pp.ShuffleWrite(frag, sid, map_id=i, num_partitions=ctx.n_partitions,
+                            by=list(by), shuffle_dir=ctx.shuffle_dir, schema=schema))
+        for i, frag in enumerate(fragments)
+    ]
+    ctx.pool.run_tasks(tasks)
+    return [pp.ShuffleRead(sid, p, ctx.shuffle_dir, schema)
+            for p in range(ctx.n_partitions)]
+
+
+def _key_names(exprs) -> Optional[Tuple[str, ...]]:
+    names = []
+    for e in exprs:
+        node = e.child if isinstance(e, Alias) else e
+        if not isinstance(node, ColumnRef):
+            return None
+        names.append(e.name())
+    return tuple(names)
+
+
+def _clone_unary(node, new_input):
+    import copy
+
+    clone = copy.copy(node)
+    clone.input = new_input
+    return clone
+
+
+def _agg_schema(in_schema, groupby, aggs):
+    from ..schema import Schema
+
+    fields = [e.to_field(in_schema) for e in list(groupby) + list(aggs)]
+    return Schema(fields)
+
+
+def _split_partitions(partitions, n: int, schema) -> List[List[MicroPartition]]:
+    """Round-robin micropartitions into n groups; a single big partition is
+    sliced by rows so every worker gets real work."""
+    parts = [p for p in partitions if p.num_rows > 0]
+    if not parts:
+        return [[MicroPartition.empty(schema)]]
+    if len(parts) < n:
+        batches = [b for p in parts for b in p.batches if b.num_rows > 0]
+        total = sum(b.num_rows for b in batches)
+        if total == 0:
+            return [[MicroPartition.empty(schema)]]
+        big = RecordBatch.concat(batches) if len(batches) > 1 else batches[0]
+        step = (total + n - 1) // n
+        groups = []
+        for s in range(0, total, step):
+            groups.append([MicroPartition(schema, [big.slice(s, min(s + step, total))])])
+        return groups
+    return [parts[i::n] for i in range(n)]

@@ -1,0 +1,106 @@
+"""Task scheduler: priority heap + worker snapshots + scheduling strategies.
+
+Reference parity: src/daft-distributed/src/scheduling/scheduler/default.rs:9 —
+pending tasks in a priority heap; each scheduling pass snapshots worker
+capacity and assigns: Spread -> worker with most available slots (default.rs:48),
+WorkerAffinity soft -> preferred worker if it has a slot else spread, hard ->
+only that worker. Pure logic, no IO — hermetically unit-tested with mock
+workers exactly like the reference (scheduling/scheduler/mod.rs:257-298).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .task import Spread, SubPlanTask, WorkerAffinity
+
+
+@dataclass
+class WorkerSnapshot:
+    worker_id: str
+    total_slots: int
+    active_tasks: int = 0
+
+    @property
+    def available_slots(self) -> int:
+        return max(self.total_slots - self.active_tasks, 0)
+
+
+class Scheduler:
+    """Assigns pending tasks to workers with capacity.
+
+    Usage: submit() tasks, then schedule() to drain as many as capacity allows
+    (schedule() itself marks assigned slots busy); call task_finished() as
+    results arrive to free slots.
+    """
+
+    def __init__(self, workers: Dict[str, int]):
+        self._workers: Dict[str, WorkerSnapshot] = {
+            wid: WorkerSnapshot(wid, slots) for wid, slots in workers.items()
+        }
+        self._heap: List[Tuple[int, int, SubPlanTask]] = []
+        self._seq = itertools.count()
+
+    # ---- worker lifecycle ----------------------------------------------------
+    def add_worker(self, worker_id: str, slots: int) -> None:
+        self._workers[worker_id] = WorkerSnapshot(worker_id, slots)
+
+    def remove_worker(self, worker_id: str) -> None:
+        self._workers.pop(worker_id, None)
+
+    def task_finished(self, worker_id: str) -> None:
+        w = self._workers.get(worker_id)
+        if w is not None and w.active_tasks > 0:
+            w.active_tasks -= 1
+
+    def snapshots(self) -> List[WorkerSnapshot]:
+        return list(self._workers.values())
+
+    # ---- scheduling ----------------------------------------------------------
+    def submit(self, task: SubPlanTask) -> None:
+        # lower priority value = scheduled first (matches reference heap order)
+        heapq.heappush(self._heap, (task.priority, next(self._seq), task))
+
+    def pending_count(self) -> int:
+        return len(self._heap)
+
+    def schedule(self) -> List[Tuple[SubPlanTask, str]]:
+        """Assign as many pending tasks as current capacity allows.
+
+        Tasks whose strategy cannot be satisfied right now (hard affinity to a
+        busy/absent worker, every eligible worker full) stay pending.
+        """
+        assigned: List[Tuple[SubPlanTask, str]] = []
+        skipped: List[Tuple[int, int, SubPlanTask]] = []
+        while self._heap:
+            prio, seq, task = heapq.heappop(self._heap)
+            wid = self._pick_worker(task)
+            if wid is None:
+                skipped.append((prio, seq, task))
+                continue
+            self._workers[wid].active_tasks += 1
+            assigned.append((task, wid))
+        for item in skipped:
+            heapq.heappush(self._heap, item)
+        return assigned
+
+    def _pick_worker(self, task: SubPlanTask) -> Optional[str]:
+        strategy = task.strategy
+        eligible = [w for w in self._workers.values()
+                    if w.worker_id not in task.excluded_workers]
+        if isinstance(strategy, WorkerAffinity):
+            pref = self._workers.get(strategy.worker_id)
+            pref_ok = (pref is not None and pref.available_slots > 0
+                       and pref.worker_id not in task.excluded_workers)
+            if pref_ok:
+                return pref.worker_id
+            if strategy.hard:
+                return None
+        free = [w for w in eligible if w.available_slots > 0]
+        if not free:
+            return None
+        # Spread: most available slots; stable tiebreak by id for determinism
+        return max(free, key=lambda w: (w.available_slots, w.worker_id)).worker_id

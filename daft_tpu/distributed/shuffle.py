@@ -1,0 +1,94 @@
+"""Disk-backed Arrow-IPC shuffle cache.
+
+Reference parity: src/daft-shuffles/src/shuffle_cache.rs:39 (InProgressShuffleCache
+partitions each MicroPartition and writes Arrow IPC files per partition to local
+disk) + server/flight_server.rs (partition fetch). Layout:
+
+    {base}/{shuffle_id}/p{partition}/m{map_id}.arrow
+
+Each map task appends one file per partition it produced rows for; a reduce
+task for partition p streams every m*.arrow under p{p}/. On one host the
+"fetch" is a file read; the multi-host path serves the same files over a
+socket (see fetch_server) the way the reference serves them over Arrow Flight.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ..core.micropartition import MicroPartition
+from ..core.recordbatch import RecordBatch
+from ..schema import Schema
+
+
+def partition_dir(base: str, shuffle_id: str, partition_idx: int) -> str:
+    return os.path.join(base, shuffle_id, f"p{partition_idx}")
+
+
+class MapOutputWriter:
+    """Streaming writer for one map task: per-partition IPC files opened lazily,
+    appended batch-by-batch as the input streams through (the map task never
+    materializes its whole output — matching the reference's incremental
+    InProgressShuffleCache, shuffle_cache.rs:39)."""
+
+    def __init__(self, base: str, shuffle_id: str, map_id: int, num_partitions: int):
+        self.base = base
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.rows = [0] * num_partitions
+        self._writers: dict = {}
+
+    def append(self, partition_idx: int, batch: RecordBatch) -> None:
+        if batch.num_rows == 0:
+            return
+        self.rows[partition_idx] += batch.num_rows
+        table = batch.to_arrow()
+        w = self._writers.get(partition_idx)
+        if w is None:
+            d = partition_dir(self.base, self.shuffle_id, partition_idx)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"m{self.map_id}.arrow")
+            w = ipc.RecordBatchFileWriter(path, table.schema)
+            self._writers[partition_idx] = w
+        w.write_table(table)
+
+    def close(self) -> List[int]:
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+        return self.rows
+
+
+def write_map_output(base: str, shuffle_id: str, map_id: int,
+                     partitioned: List[List[RecordBatch]]) -> List[int]:
+    """Persist one map task's per-partition batches; returns rows per partition."""
+    out = MapOutputWriter(base, shuffle_id, map_id, len(partitioned))
+    for p, batches in enumerate(partitioned):
+        for b in batches:
+            out.append(p, b)
+    return out.close()
+
+
+def read_partition(base: str, shuffle_id: str, partition_idx: int,
+                   schema: Schema) -> Iterator[MicroPartition]:
+    """Stream every map's output for one shuffle partition."""
+    d = partition_dir(base, shuffle_id, partition_idx)
+    if not os.path.isdir(d):
+        return
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".arrow"):
+            continue
+        with ipc.RecordBatchFileReader(os.path.join(d, name)) as r:
+            table = r.read_all()
+        batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
+        yield MicroPartition(schema, [batch])
+
+
+def cleanup(base: str, shuffle_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(base, shuffle_id), ignore_errors=True)

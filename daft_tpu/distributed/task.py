@@ -1,0 +1,63 @@
+"""Task model for the distributed engine.
+
+Reference parity: src/daft-distributed/src/scheduling/task.rs:212 (SwordfishTask
+= serialized LocalPhysicalPlan sub-DAG + SchedulingStrategy) and task.rs:165
+(Spread / WorkerAffinity scheduling strategies).
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Spread:
+    """Run anywhere; scheduler picks the worker with the most free slots."""
+
+
+@dataclass(frozen=True)
+class WorkerAffinity:
+    """Prefer (soft) or require (hard) a specific worker — used for cached
+    shuffle locality and stateful actor pools."""
+
+    worker_id: str
+    hard: bool = False
+
+
+@dataclass
+class SubPlanTask:
+    """A serialized physical sub-plan to run on one worker.
+
+    The plan's leaves are InMemoryScan (inline data) or ShuffleRead nodes; a
+    plan rooted at ShuffleWrite produces shuffle files instead of inline
+    results.
+    """
+
+    task_id: str
+    plan_blob: bytes
+    strategy: Any = field(default_factory=Spread)
+    priority: int = 0
+    # workers that already failed this task (reference: scheduler re-queues with
+    # the failed worker excluded)
+    excluded_workers: Tuple[str, ...] = ()
+
+    @classmethod
+    def from_plan(cls, task_id: str, plan, strategy=None, priority: int = 0) -> "SubPlanTask":
+        return cls(task_id=task_id, plan_blob=pickle.dumps(plan),
+                   strategy=strategy or Spread(), priority=priority)
+
+    def plan(self):
+        return pickle.loads(self.plan_blob)
+
+
+@dataclass
+class TaskResult:
+    task_id: str
+    worker_id: str
+    # inline result partitions (pickled MicroPartitions); empty for shuffle writes
+    partitions: List[Any] = field(default_factory=list)
+    rows: int = 0
+    error: Optional[str] = None
+    error_tb: Optional[str] = None

@@ -281,6 +281,25 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield from node.info.execute_write(_exec(node.input), node.input.schema)
         return
 
+    if isinstance(node, pp.ShuffleWrite):
+        from ..distributed.shuffle import MapOutputWriter
+
+        out = MapOutputWriter(node.shuffle_dir, node.shuffle_id, node.map_id,
+                              node.num_partitions)
+        try:
+            for j, piece in _hash_buckets(_exec(node.input), node.by, node.num_partitions):
+                out.append(j, piece)
+        finally:
+            out.close()
+        return
+
+    if isinstance(node, pp.ShuffleRead):
+        from ..distributed import shuffle as shf
+
+        yield from shf.read_partition(node.shuffle_dir, node.shuffle_id,
+                                      node.partition_idx, node.schema)
+        return
+
     raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")
 
 
@@ -520,9 +539,20 @@ def _concat_parts(parts: List[MicroPartition], schema) -> RecordBatch:
     return RecordBatch.concat(batches)
 
 
-def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
-    from ..core.series import Series
+def _hash_buckets(stream, by: List[Expression], n: int):
+    """Yield (partition_idx, RecordBatch) pieces hash-partitioned on `by` —
+    shared by in-memory repartition and the disk-backed shuffle writer."""
+    for part in stream:
+        for b in part.batches:
+            if b.num_rows == 0:
+                continue
+            keys = [eval_expression(b, e) for e in by]
+            for j, piece in enumerate(b.partition_by_hash(keys, n)):
+                if piece.num_rows:
+                    yield j, piece
 
+
+def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
     n = node.num_partitions or 1
     if node.scheme == "into":
         batch = _gather(node.input, node.schema)
@@ -535,18 +565,17 @@ def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
         return
 
     buckets: List[List[RecordBatch]] = [[] for _ in range(n)]
-    for i, part in enumerate(_exec(node.input)):
-        for b in part.batches:
-            if node.scheme == "hash":
-                keys = [eval_expression(b, e) for e in node.by]
-                pieces = b.partition_by_hash(keys, n)
-            elif node.scheme == "random":
-                pieces = b.partition_by_random(n, seed=i)
-            else:
-                raise NotImplementedError(f"repartition scheme {node.scheme}")
-            for j, piece in enumerate(pieces):
-                if piece.num_rows:
-                    buckets[j].append(piece)
+    if node.scheme == "hash":
+        for j, piece in _hash_buckets(_exec(node.input), node.by, n):
+            buckets[j].append(piece)
+    elif node.scheme == "random":
+        for i, part in enumerate(_exec(node.input)):
+            for b in part.batches:
+                for j, piece in enumerate(b.partition_by_random(n, seed=i)):
+                    if piece.num_rows:
+                        buckets[j].append(piece)
+    else:
+        raise NotImplementedError(f"repartition scheme {node.scheme}")
     for j in range(n):
         if buckets[j]:
             yield MicroPartition(node.schema, buckets[j])

@@ -270,6 +270,36 @@ class PhysWrite(_Unary):
         self.info = info
 
 
+class ShuffleWrite(_Unary):
+    """Terminal node of a distributed map task: hash-partition the input stream
+    and persist per-partition Arrow IPC files to the shuffle directory
+    (reference: src/daft-shuffles/src/shuffle_cache.rs:39 InProgressShuffleCache).
+    Yields nothing; consumers use ShuffleRead."""
+
+    def __init__(self, input: PhysicalPlan, shuffle_id: str, map_id: int,
+                 num_partitions: int, by: List[Expression], shuffle_dir: str,
+                 schema: Schema):
+        super().__init__(input, schema)
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.num_partitions = num_partitions
+        self.by = by
+        self.shuffle_dir = shuffle_dir
+
+
+class ShuffleRead(PhysicalPlan):
+    """Leaf of a distributed reduce task: stream every map's IPC file for one
+    shuffle partition (reference: daft-shuffles flight client do_get)."""
+
+    def __init__(self, shuffle_id: str, partition_idx: int, shuffle_dir: str,
+                 schema: Schema):
+        super().__init__()
+        self.shuffle_id = shuffle_id
+        self.partition_idx = partition_idx
+        self.shuffle_dir = shuffle_dir
+        self.schema = schema
+
+
 # ======================================================================================
 # Translation
 # ======================================================================================

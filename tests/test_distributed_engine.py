@@ -1,0 +1,260 @@
+"""Distributed engine tests: hermetic scheduler units + multi-process end-to-end.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the scheduler is tested
+against mock worker snapshots with no processes (reference
+scheduling/scheduler/mod.rs:257-298), shuffle and plan execution run on a real
+spawn-based WorkerPool.
+"""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed.scheduler import Scheduler
+from daft_tpu.distributed.task import Spread, SubPlanTask, WorkerAffinity
+
+
+def _task(tid, strategy=None, priority=0, excluded=()):
+    return SubPlanTask(task_id=tid, plan_blob=b"", strategy=strategy or Spread(),
+                       priority=priority, excluded_workers=tuple(excluded))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (hermetic, no processes)
+# ---------------------------------------------------------------------------
+
+def test_spread_picks_most_available_slots():
+    s = Scheduler({"w0": 2, "w1": 4})
+    s.submit(_task("t0"))
+    [(t, wid)] = s.schedule()
+    assert wid == "w1"  # 4 free > 2 free
+
+
+def test_spread_balances_as_slots_fill():
+    s = Scheduler({"w0": 2, "w1": 2})
+    for i in range(4):
+        s.submit(_task(f"t{i}"))
+    assigned = s.schedule()
+    by_worker = {}
+    for t, wid in assigned:
+        by_worker.setdefault(wid, []).append(t.task_id)
+    assert len(assigned) == 4
+    assert len(by_worker["w0"]) == 2 and len(by_worker["w1"]) == 2
+
+
+def test_excess_tasks_stay_pending_until_capacity_frees():
+    s = Scheduler({"w0": 1})
+    s.submit(_task("t0"))
+    s.submit(_task("t1"))
+    assigned = s.schedule()
+    assert [t.task_id for t, _ in assigned] == ["t0"]
+    assert s.pending_count() == 1
+    assert s.schedule() == []  # still full
+    s.task_finished("w0")
+    [(t, wid)] = s.schedule()
+    assert t.task_id == "t1" and wid == "w0"
+
+
+def test_priority_order():
+    s = Scheduler({"w0": 1})
+    s.submit(_task("low", priority=10))
+    s.submit(_task("high", priority=0))
+    [(t, _)] = s.schedule()
+    assert t.task_id == "high"
+
+
+def test_soft_affinity_prefers_worker_but_falls_back():
+    s = Scheduler({"w0": 1, "w1": 1})
+    s.submit(_task("t0", strategy=WorkerAffinity("w0")))
+    [(_, wid)] = s.schedule()
+    assert wid == "w0"
+    # w0 now full: soft affinity falls back to any free worker
+    s.submit(_task("t1", strategy=WorkerAffinity("w0")))
+    [(_, wid2)] = s.schedule()
+    assert wid2 == "w1"
+
+
+def test_hard_affinity_waits_for_its_worker():
+    s = Scheduler({"w0": 1, "w1": 1})
+    s.submit(_task("t0", strategy=WorkerAffinity("w0", hard=True)))
+    [(_, wid)] = s.schedule()
+    assert wid == "w0"
+    s.submit(_task("t1", strategy=WorkerAffinity("w0", hard=True)))
+    assert s.schedule() == []  # w1 free but hard affinity refuses it
+    s.task_finished("w0")
+    [(_, wid2)] = s.schedule()
+    assert wid2 == "w0"
+
+
+def test_excluded_workers_skipped():
+    s = Scheduler({"w0": 4, "w1": 1})
+    s.submit(_task("t0", excluded=["w0"]))
+    [(_, wid)] = s.schedule()
+    assert wid == "w1"  # w0 has more slots but is excluded (failed there before)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end on a real worker pool
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dist_runner():
+    from daft_tpu.distributed import DistributedRunner
+
+    r = DistributedRunner(num_workers=4, n_partitions=4)
+    yield r
+    r.shutdown()
+
+
+def _run_both(df_build, dist_runner):
+    import daft_tpu.runners as runners
+
+    native = runners.NativeRunner()
+    runners.set_runner(native)
+    expect = df_build().to_pydict()
+    runners.set_runner(dist_runner)
+    try:
+        got = df_build().to_pydict()
+    finally:
+        runners.set_runner(native)
+    return got, expect
+
+
+def test_distributed_groupby_matches_native(dist_runner):
+    rng = np.random.default_rng(0)
+    n = 10_000
+    data = daft_tpu.from_pydict({
+        "k": rng.choice(["a", "b", "c", "d", "e"], n).tolist(),
+        "v": rng.uniform(0, 100, n).tolist(),
+    })
+
+    def q():
+        return (data.groupby("k")
+                .agg(col("v").sum().alias("s"), col("v").mean().alias("m"),
+                     col("v").count().alias("c"), col("v").min().alias("lo"),
+                     col("v").max().alias("hi"))
+                .sort("k"))
+
+    got, expect = _run_both(q, dist_runner)
+    assert got["k"] == expect["k"]
+    assert got["c"] == expect["c"]
+    for c in ("s", "m", "lo", "hi"):
+        np.testing.assert_allclose(got[c], expect[c], rtol=1e-12)
+
+
+def test_distributed_join_matches_native(dist_runner):
+    rng = np.random.default_rng(1)
+    n = 5_000
+    left = daft_tpu.from_pydict({
+        "id": rng.integers(0, 1000, n).tolist(),
+        "x": rng.uniform(0, 10, n).tolist(),
+    })
+    right = daft_tpu.from_pydict({
+        "id": list(range(1000)),
+        "name": [f"n{i}" for i in range(1000)],
+    })
+
+    def q():
+        return (left.join(right, on="id")
+                .groupby("name").agg(col("x").sum().alias("sx"))
+                .sort("name"))
+
+    got, expect = _run_both(q, dist_runner)
+    assert got == expect
+
+
+def test_distributed_tpch_q5_shape(dist_runner):
+    """TPC-H Q5 (multi-join + grouped agg) across 4 worker processes with
+    hash-shuffle joins — the VERDICT r2 'done' criterion for the distributed
+    skeleton."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    from benchmarking.tpch.datagen import load_dataframes
+    from benchmarking.tpch.queries import ALL_QUERIES
+
+    tables = {k: v.collect() for k, v in load_dataframes(sf=0.01, seed=0).items()}
+
+    def q():
+        return ALL_QUERIES[5](tables)
+
+    got, expect = _run_both(q, dist_runner)
+    assert got["n_name"] == expect["n_name"]
+    np.testing.assert_allclose(got["revenue"], expect["revenue"], rtol=1e-9)
+
+
+def test_distributed_left_outer_join_matches_native(dist_runner):
+    left = daft_tpu.from_pydict({"id": [1, 2, 3, 4], "x": [1.0, 2.0, 3.0, 4.0]})
+    right = daft_tpu.from_pydict({"id": [2, 4, 6], "y": ["b", "d", "f"]})
+
+    def q():
+        return left.join(right, on="id", how="left").sort("id")
+
+    got, expect = _run_both(q, dist_runner)
+    assert got == expect
+
+
+def test_distributed_dedup_matches_native(dist_runner):
+    data = daft_tpu.from_pydict({
+        "k": ["a", "b", "a", "c", "b", "a"] * 100,
+        "v": list(range(600)),
+    })
+
+    def q():
+        return data.select("k").distinct().sort("k")
+
+    got, expect = _run_both(q, dist_runner)
+    assert got == expect
+
+
+def test_worker_failure_requeues_on_another_worker():
+    """A dead worker's in-flight tasks re-queue with that worker excluded
+    (reference: scheduler snapshot re-queue semantics)."""
+    from daft_tpu.distributed.worker import WorkerPool
+    from daft_tpu.plan import physical as pp
+    from daft_tpu.core.micropartition import MicroPartition
+    from daft_tpu.core.recordbatch import RecordBatch
+    from daft_tpu.schema import Schema
+    from daft_tpu.datatype import DataType
+    from daft_tpu.core.series import Series
+
+    pool = WorkerPool(2)
+    try:
+        s = Series.from_pylist([1, 2, 3], "a", DataType.int64())
+        schema = Schema([s.field()])
+        part = MicroPartition(schema, [RecordBatch(schema, [s], 3)])
+        plan = pp.InMemoryScan([part], schema)
+        # kill one worker pre-submit; pool should notice and run elsewhere
+        w0 = pool.workers["worker-0"]
+        w0._proc.terminate()
+        w0._proc.wait()
+        tasks = [SubPlanTask.from_plan(f"t{i}", plan) for i in range(4)]
+        results = pool.run_tasks(tasks)
+        assert len(results) == 4
+        assert all(r.rows == 3 for r in results.values())
+    finally:
+        pool.shutdown()
+
+
+def test_task_error_propagates_with_traceback():
+    from daft_tpu.core.micropartition import MicroPartition
+    from daft_tpu.core.recordbatch import RecordBatch
+    from daft_tpu.core.series import Series
+    from daft_tpu.datatype import DataType
+    from daft_tpu.distributed.worker import WorkerPool
+    from daft_tpu.plan import physical as pp
+    from daft_tpu.schema import Schema
+
+    s = Series.from_pylist([1, 2, 3], "a", DataType.int64())
+    schema = Schema([s.field()])
+    part = MicroPartition(schema, [RecordBatch(schema, [s], 3)])
+    # predicate references a column that does not exist -> fails in the worker
+    bad = pp.PhysFilter(pp.InMemoryScan([part], schema),
+                        col("missing") > 0, schema)
+    pool = WorkerPool(1)
+    try:
+        with pytest.raises(RuntimeError, match="failed on worker-0"):
+            pool.run_tasks([SubPlanTask.from_plan("boom", bad)])
+    finally:
+        pool.shutdown()
