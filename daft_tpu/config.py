@@ -63,6 +63,13 @@ class ExecutionConfig:
     num_threads: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4)
     )
+    # Multi-chip mesh execution: when >= 2 (and that many JAX devices exist),
+    # qualifying grouped aggregations execute via the mesh-sharded exact groupby
+    # (parallel/distributed.py: per-shard sort/unique + segment-reduce, one
+    # all_gather table merge over ICI). 0 = single-chip only.
+    mesh_devices: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_MESH_DEVICES", 0)
+    )
 
 
 _default: Optional[ExecutionConfig] = None

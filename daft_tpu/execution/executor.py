@@ -346,6 +346,11 @@ def _exec_device_agg(node) -> MicroPartition:
     from ..core.series import Series
 
     in_schema = node.input.schema
+    if grouped and cfg.mesh_devices >= 2:
+        import jax
+
+        if len(jax.devices()) >= cfg.mesh_devices:
+            return _exec_mesh_grouped(node, stream, cfg.mesh_devices)
     if grouped:
         from ..ops.grouped_stage import DeviceFallback, try_build_grouped_agg_stage
 
@@ -365,16 +370,7 @@ def _exec_device_agg(node) -> MicroPartition:
             # the offending batch): rerun the whole stage on host
             return _host_agg(itertools.chain(buffered, stream))
         key_rows, results = run.finalize()
-        cols = []
-        for i, g in enumerate(node.groupby):
-            f = node.schema[g.name()]
-            cols.append(Series.from_pylist([k[i] for k in key_rows], f.name, dtype=f.dtype))
-        for (name, _), (vals, valid) in zip(stage.aggs, results):
-            f = node.schema[name]
-            data = [v.item() if ok else None for v, ok in zip(vals, valid)]
-            cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
-        out = RecordBatch(node.schema, cols, len(key_rows))
-        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+        return _grouped_output(node, key_rows, results)
 
     from ..ops.stage import try_build_filter_agg_stage
 
@@ -391,6 +387,98 @@ def _exec_device_agg(node) -> MicroPartition:
         cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
     out = RecordBatch(node.schema, cols, 1)
     return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+def _grouped_output(node, key_rows, results) -> MicroPartition:
+    """Assemble a grouped-agg result batch from key tuples + per-agg
+    (values, valid) arrays — shared by the single-chip and mesh device paths
+    so null/dtype semantics cannot drift."""
+    from ..core.series import Series
+
+    cols = []
+    for i, g in enumerate(node.groupby):
+        f = node.schema[g.name()]
+        cols.append(Series.from_pylist([k[i] for k in key_rows], f.name, dtype=f.dtype))
+    for e, (vals, valid) in zip(node.aggregations, results):
+        f = node.schema[e.name()]
+        data = [v.item() if ok else None for v, ok in zip(vals, valid)]
+        cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
+    out = RecordBatch(node.schema, cols, len(key_rows))
+    return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
+    """Grouped aggregation over a multi-chip mesh (the engine's scale-out path).
+
+    Group keys are dictionary/factorize-encoded to dense int64 codes on the
+    host (null keys get their own code, preserving host null-group semantics),
+    then the EXACT mesh-sharded groupby runs: each device sort/uniques its row
+    shard and segment-reduces into a fixed-capacity table, merged with one
+    all_gather over the mesh axis (parallel/distributed.py). Counter-asserted
+    via counters.mesh_grouped_runs.
+    """
+    import numpy as np
+
+    from ..expressions.eval import eval_expression
+    from ..ops import counters
+    from ..ops.grouped_stage import resolve_key_series
+    from ..parallel.distributed import default_mesh, groupby_host
+
+    batch = _concat_parts(list(stream), node.input.schema)
+    if node.predicate is not None:
+        filtered = _filter_part(
+            MicroPartition(node.input.schema, [batch]), node.predicate)
+        batch = (filtered.batches[0] if filtered.batches
+                 else RecordBatch.empty(node.input.schema))
+    n = batch.num_rows
+
+    key_series = resolve_key_series(batch, node.groupby, n)
+    if n == 0:
+        key_rows: List[tuple] = []
+        codes = np.empty(0, dtype=np.int64)
+    else:
+        from ..core.kernels.groupby import make_groups
+
+        first_idx, group_ids, _ = make_groups(key_series)
+        key_rows = list(zip(*[s.take(first_idx).to_pylist() for s in key_series])) \
+            if len(first_idx) else []
+        codes = group_ids.astype(np.int64)
+
+    ops = []
+    value_cols = []
+    for e in node.aggregations:
+        from ..expressions.expressions import AggExpr, Alias
+
+        inner = e
+        while isinstance(inner, Alias):
+            inner = inner.child
+        assert isinstance(inner, AggExpr)
+        ops.append(inner.op)
+        count_all = inner.op == "count" and inner.params.get("mode", "valid") == "all"
+        s = eval_expression(batch, inner.child)
+        if len(s) == 1 and n != 1:
+            from ..expressions.eval import _broadcast
+
+            s = _broadcast(s, n)
+        vals = s.to_numpy()
+        valid = np.ones(n, dtype=bool) if count_all else s.validity_numpy()
+        value_cols.append((vals, valid))
+
+    if n == 0:
+        gk = np.empty(0, dtype=np.int64)
+        out_cols = [(np.empty(0), np.empty(0, dtype=bool)) for _ in ops]
+    else:
+        # capacity is known exactly (dense codes from make_groups): no
+        # overflow-retry recompiles
+        cap = max(16, int(2 ** np.ceil(np.log2(max(len(key_rows), 1) + 1))))
+        mesh = default_mesh(n_devices)
+        gk, out_cols = groupby_host(mesh, codes, np.ones(n, dtype=bool),
+                                    value_cols, ops, capacity=cap)
+        counters.bump("mesh_grouped_runs")
+
+    # gk is sorted ascending = dense-code order = first-occurrence order
+    ordered_keys = [key_rows[int(k)] for k in gk]
+    return _grouped_output(node, ordered_keys, out_cols)
 
 
 def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:

@@ -10,6 +10,7 @@ from __future__ import annotations
 device_stage_batches = 0     # batches through FilterAggStage (ungrouped)
 device_grouped_batches = 0   # batches through GroupedAggStage
 device_stage_runs = 0        # completed device agg node executions
+mesh_grouped_runs = 0        # grouped aggs executed via the mesh-sharded path
 
 
 def bump(name: str, n: int = 1) -> None:
@@ -18,6 +19,8 @@ def bump(name: str, n: int = 1) -> None:
 
 def reset() -> None:
     global device_stage_batches, device_grouped_batches, device_stage_runs
+    global mesh_grouped_runs
     device_stage_batches = 0
     device_grouped_batches = 0
     device_stage_runs = 0
+    mesh_grouped_runs = 0

@@ -215,3 +215,56 @@ def test_shard_columns_pads_with_invalid(mesh):
     assert len(vals) % 8 == 0
     assert valid.sum() == n
     assert vals[:n].tolist() == list(range(n))
+
+
+def test_engine_grouped_agg_on_mesh_matches_host():
+    """VERDICT r3 item: df.groupby().agg() on a mesh-enabled session must
+    execute the mesh-sharded groupby (counter-asserted) with host-equal results."""
+    import numpy as np
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.ops import counters
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    df = daft_tpu.from_pydict({
+        "k": rng.choice(["a", "b", "c", None, "d"], n).tolist(),
+        "v": [None if i % 13 == 0 else float(i % 101) for i in range(n)],
+        "w": rng.integers(0, 1000, n).tolist(),
+    })
+
+    def q(d):
+        return (d.where(col("w") < 900)
+                .groupby("k")
+                .agg(col("v").sum().alias("s"), col("v").mean().alias("m"),
+                     col("v").min().alias("lo"), col("v").max().alias("hi"),
+                     col("v").count().alias("c"))
+                .sort("k"))
+
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        mesh_out = q(df).to_pydict()
+    assert counters.mesh_grouped_runs > 0, "mesh path never executed"
+    with execution_config_ctx(device_mode="off", mesh_devices=0):
+        host_out = q(df).to_pydict()
+    assert mesh_out["k"] == host_out["k"]
+    assert mesh_out["c"] == host_out["c"]
+    for c in ("s", "m", "lo", "hi"):
+        np.testing.assert_allclose(
+            np.array(mesh_out[c], dtype=float), np.array(host_out[c], dtype=float),
+            rtol=1e-9)
+
+
+def test_mesh_grouped_agg_empty_after_filter():
+    """Predicate filtering out every row must return an empty result, not crash."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+
+    df = daft_tpu.from_pydict({"k": ["a", "b"], "v": [1.0, 2.0], "w": [1, 2]})
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        out = (df.where(col("w") > 100).groupby("k")
+               .agg(col("v").sum().alias("s")).to_pydict())
+    assert out == {"k": [], "s": []}
