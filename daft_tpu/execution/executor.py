@@ -15,6 +15,7 @@ ops/counters.py records which path actually ran.
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -158,10 +159,7 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.PhysSort):
-        batch = _gather(node.input, node.schema)
-        keys = [eval_expression(batch, e) for e in node.sort_by]
-        out = batch.sort(keys, node.descending, node.nulls_first)
-        yield MicroPartition(node.schema, [out])
+        yield from _sort_exec(node)
         return
 
     if isinstance(node, pp.PhysTopN):
@@ -231,20 +229,7 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.HashJoin):
-        right = _gather(node.right, node.right.schema)  # build side
-        parts = list(_exec(node.left))
-        if node.how in ("right", "outer"):
-            # need full left side to find unmatched build rows exactly once
-            left = _concat_parts(parts, node.left.schema)
-            out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
-                                node.schema, node.merged_keys, node.right_rename)
-            yield MicroPartition(node.schema, [out])
-            return
-        for part in parts:
-            for b in part.batches:
-                out = rel.hash_join(b, right, node.left_on, node.right_on, node.how,
-                                    node.schema, node.merged_keys, node.right_rename)
-                yield MicroPartition(node.schema, [out])
+        yield from _join_exec(node)
         return
 
     if isinstance(node, pp.CrossJoin):
@@ -417,8 +402,6 @@ def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
     all_gather over the mesh axis (parallel/distributed.py). Counter-asserted
     via counters.mesh_grouped_runs.
     """
-    import numpy as np
-
     from ..expressions.eval import eval_expression
     from ..ops import counters
     from ..ops.grouped_stage import resolve_key_series
@@ -564,43 +547,342 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
 
 
 
+def _batch_iter(stream) -> Iterator[RecordBatch]:
+    for p in stream:
+        for b in p.batches:
+            if b.num_rows > 0:
+                yield b
+
+
 def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
                    stream=None) -> RecordBatch:
     """Partial aggregation per morsel on the compute pool, then a final combine
-    (reference: two-stage aggregation in translate.rs + partial-agg thresholds)."""
+    (reference: two-stage aggregation in translate.rs + partial-agg thresholds).
+
+    Out-of-core: input batches are admitted against the operator memory budget
+    (ExecutionConfig.memory_limit_bytes); once over budget the aggregation
+    switches to its spilling strategy — streamed partials for ungrouped aggs,
+    Grace hash-partitioned spill (of shrunken partials when the aggs split,
+    of raw rows otherwise) for grouped aggs (reference: blocking_sink.rs +
+    resource_manager.rs memory gating).
+    """
+    from . import memory as mem
     from ..plan.agg_split import split_aggs
     from ..utils.pool import pool_map
 
     if stream is None:
         stream = _exec(child)
-    batches = [b for p in stream for b in p.batches if b.num_rows > 0]
-    if not batches:
-        big = _concat_parts([], child.schema)
-        return rel.ungrouped_agg(big, aggs) if ungrouped else rel.grouped_agg(big, groupby, aggs)
+    it = _batch_iter(stream)
+    budget = mem.operator_budget()
+    batches: List[RecordBatch] = []
+    over = False
+    for b in it:
+        batches.append(b)
+        if not budget.admit(b.size_bytes()):
+            over = True
+            break
 
     split = split_aggs(aggs)
-    # small total input or unsplittable aggs: one-phase
-    total_rows = sum(b.num_rows for b in batches)
-    if split is None or total_rows <= _MORSEL_ROWS:
-        big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
-        return rel.ungrouped_agg(big, aggs) if ungrouped else rel.grouped_agg(big, groupby, aggs)
-
-    # re-chunk into morsels so partials parallelize even for one big batch
-    if len(batches) == 1:
-        b = batches[0]
-        batches = [b.slice(s, s + _MORSEL_ROWS) for s in range(0, b.num_rows, _MORSEL_ROWS)]
-
     from ..expressions import col as _col
 
+    if not over:
+        if not batches:
+            big = _concat_parts([], child.schema)
+            return rel.ungrouped_agg(big, aggs) if ungrouped \
+                else rel.grouped_agg(big, groupby, aggs)
+        # small total input or unsplittable aggs: one-phase in memory
+        total_rows = sum(b.num_rows for b in batches)
+        if split is None or total_rows <= _MORSEL_ROWS:
+            big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
+            return rel.ungrouped_agg(big, aggs) if ungrouped \
+                else rel.grouped_agg(big, groupby, aggs)
+        # re-chunk into morsels so partials parallelize even for one big batch
+        if len(batches) == 1:
+            b = batches[0]
+            batches = [b.slice(s, s + _MORSEL_ROWS)
+                       for s in range(0, b.num_rows, _MORSEL_ROWS)]
+        if ungrouped:
+            partials = pool_map(lambda b: rel.ungrouped_agg(b, split.partial), batches)
+            final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)
+            return eval_projection(final, split.projection)
+        partials = pool_map(lambda b: rel.grouped_agg(b, groupby, split.partial), batches)
+        key_names = [e.name() for e in groupby]
+        final = rel.grouped_agg(RecordBatch.concat(partials),
+                                [_col(k) for k in key_names], split.final)
+        return eval_projection(final, [_col(k) for k in key_names] + split.projection)
+
+    # ---- over budget: out-of-core paths ------------------------------------------
+    rest = itertools.chain(batches, it)
+
     if ungrouped:
-        partials = pool_map(lambda b: rel.ungrouped_agg(b, split.partial), batches)
+        if split is None:
+            # unsplittable global agg (e.g. count_distinct) needs full value
+            # sets; keep gathering (documented gap — bounded by distinct count
+            # only after dedup, not implemented as spill yet)
+            big = RecordBatch.concat(list(rest))
+            return rel.ungrouped_agg(big, aggs)
+        # streamed partials: memory is one 1-row partial batch per morsel
+        partials = [rel.ungrouped_agg(b, split.partial) for b in rest]
         final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)
         return eval_projection(final, split.projection)
 
-    partials = pool_map(lambda b: rel.grouped_agg(b, groupby, split.partial), batches)
+    K = 32
     key_names = [e.name() for e in groupby]
-    final = rel.grouped_agg(RecordBatch.concat(partials), [_col(k) for k in key_names], split.final)
-    return eval_projection(final, [_col(k) for k in key_names] + split.projection)
+    key_cols = [_col(k) for k in key_names]
+    if split is not None:
+        # Grace over *partials*: each morsel partially aggregates (shrinks),
+        # partials spill hash-partitioned by group key, each spill partition
+        # final-aggregates independently (keys are disjoint across partitions)
+        from ..schema import Schema
+
+        partial_schema = Schema([e.to_field(child.schema)
+                                 for e in list(groupby) + list(split.partial)])
+        sp = mem.SpillPartitions(partial_schema, K)
+        try:
+            for b in rest:
+                pb = rel.grouped_agg(b, groupby, split.partial)
+                sp.append_partitioned(pb, key_cols)
+            outs = []
+            for f in sp.files:
+                bs = list(f.read())
+                if not bs:
+                    continue
+                final = rel.grouped_agg(RecordBatch.concat(bs), key_cols, split.final)
+                outs.append(eval_projection(final, key_cols + split.projection))
+            if not outs:
+                return rel.grouped_agg(RecordBatch.empty(child.schema), groupby, aggs)
+            return RecordBatch.concat(outs)
+        finally:
+            sp.delete()
+    # unsplittable grouped aggs: Grace over raw rows
+    sp = mem.SpillPartitions(child.schema, K)
+    try:
+        for b in rest:
+            sp.append_partitioned(b, groupby)
+        outs = []
+        for f in sp.files:
+            bs = list(f.read())
+            if not bs:
+                continue
+            outs.append(rel.grouped_agg(RecordBatch.concat(bs), groupby, aggs))
+        if not outs:
+            return rel.grouped_agg(RecordBatch.empty(child.schema), groupby, aggs)
+        return RecordBatch.concat(outs)
+    finally:
+        sp.delete()
+
+
+def _sort_exec(node: pp.PhysSort) -> Iterator[MicroPartition]:
+    """Sort with out-of-core fallback: buffer within the memory budget; once
+    over, range-partition the stream into K spill buckets on the first sort
+    key (boundaries sampled from the buffered prefix) and sort each bucket
+    independently — buckets are emitted in key order, so the concatenation is
+    globally sorted (reference approach: sampled range partitioning + per-
+    partition sort, flotilla.py get_boundaries_remote)."""
+    from . import memory as mem
+
+    budget = mem.operator_budget()
+    it = _batch_iter(_exec(node.input))
+    buffered: List[RecordBatch] = []
+    over = False
+    for b in it:
+        buffered.append(b)
+        if not budget.admit(b.size_bytes()):
+            over = True
+            break
+
+    if not over:
+        batch = RecordBatch.concat(buffered) if buffered else RecordBatch.empty(node.schema)
+        keys = [eval_expression(batch, e) for e in node.sort_by]
+        yield MicroPartition(node.schema, [batch.sort(keys, node.descending, node.nulls_first)])
+        return
+
+    # ---- external sort ------------------------------------------------------------
+    K = 32
+    e0 = node.sort_by[0]
+    desc0 = bool(node.descending[0]) if node.descending else False
+    nf = node.nulls_first[0] if node.nulls_first else desc0
+
+    def key0(b: RecordBatch):
+        s = eval_expression(b, e0)
+        return s.to_numpy(), s.validity_numpy()
+
+    # boundaries from the buffered prefix (a large sample by construction)
+    sample_vals = []
+    for b in buffered:
+        v, ok = key0(b)
+        sample_vals.append(v[ok])
+    sample = np.concatenate(sample_vals) if sample_vals else np.empty(0)
+    if sample.dtype.kind == "f":
+        sample = sample[~np.isnan(sample)]  # NaN handled by searchsorted (last bucket)
+    if len(sample):
+        # dtype-agnostic quantile boundaries (strings/dates sort too)
+        srt = np.sort(sample)
+        idx = (np.linspace(0, 1, K + 1)[1:-1] * (len(srt) - 1)).astype(np.int64)
+        boundaries = np.unique(srt[idx])
+    else:
+        boundaries = np.empty(0)
+    nb = len(boundaries) + 1  # value buckets; nulls get their own bucket
+
+    sp = [mem.SpillFile(node.schema) for _ in range(nb + 1)]  # [+1] = null bucket
+    try:
+        for b in itertools.chain(buffered, it):
+            v, ok = key0(b)
+            if len(boundaries):
+                if not ok.all():
+                    # null slots hold None/garbage that would break comparisons;
+                    # park them on a real value, then route to the null bucket
+                    v = np.array(v, copy=True)
+                    v[~ok] = boundaries[0]
+                ids = np.searchsorted(boundaries, v, side="right").astype(np.int64)
+            else:
+                ids = np.zeros(len(v), dtype=np.int64)
+            ids[~ok] = nb  # null bucket
+            for j, piece in enumerate(b._split_by_partition_ids(ids, nb + 1)):
+                if piece.num_rows:
+                    sp[j].append(piece)
+        value_order = list(range(nb))
+        if desc0:
+            value_order.reverse()
+        order = ([nb] + value_order) if nf else (value_order + [nb])
+        for j in order:
+            yield from _sort_bucket(node, sp[j], budget.limit, depth=0,
+                                    allow_split=(j != nb))
+    finally:
+        for f in sp:
+            f.delete()
+
+
+def _sort_bucket(node: pp.PhysSort, f, limit: int, depth: int,
+                 allow_split: bool) -> Iterator[MicroPartition]:
+    """Sort one spill bucket. A bucket bigger than the budget (boundary skew:
+    sorted/clustered input defeats prefix sampling) re-splits recursively with
+    boundaries sampled from its own full contents (streamed — the oversized
+    bucket is never materialized); identical-key buckets can't split, so
+    recursion is bounded and falls back to in-memory sort."""
+    from . import memory as mem
+
+    if f.rows == 0:
+        return
+    e0 = node.sort_by[0]
+
+    if limit > 0 and allow_split and depth < 3:
+        # pass 1 (streaming): total size + a bounded per-batch key sample
+        total = 0
+        sample_parts = []
+        for b in f.read():
+            total += b.size_bytes()
+            s = eval_expression(b, e0)
+            v, ok = s.to_numpy(), s.validity_numpy()
+            sample_parts.append(v[ok][:4096])
+        if total > limit:
+            sample = np.concatenate(sample_parts) if sample_parts else np.empty(0)
+            if sample.dtype.kind == "f":
+                sample = sample[~np.isnan(sample)]
+            srt = np.sort(sample) if len(sample) else sample
+            if len(srt) and srt[0] != srt[-1]:  # splittable: keys not all equal
+                idx = (np.linspace(0, 1, 9)[1:-1] * (len(srt) - 1)).astype(np.int64)
+                bounds = np.unique(srt[idx])
+                subs = [mem.SpillFile(node.schema) for _ in range(len(bounds) + 1)]
+                try:
+                    for b in f.read():  # pass 2 (streaming): re-partition
+                        s = eval_expression(b, e0)
+                        v, ok = s.to_numpy(), s.validity_numpy()
+                        if not ok.all():
+                            v = np.array(v, copy=True)
+                            v[~ok] = bounds[0]
+                        ids = np.searchsorted(bounds, v, side="right").astype(np.int64)
+                        ids[~ok] = 0  # nulls can't reach here (dedicated bucket upstream)
+                        for k, piece in enumerate(
+                                b._split_by_partition_ids(ids, len(bounds) + 1)):
+                            if piece.num_rows:
+                                subs[k].append(piece)
+                    desc0 = bool(node.descending[0]) if node.descending else False
+                    order = reversed(range(len(subs))) if desc0 else range(len(subs))
+                    for k in order:
+                        yield from _sort_bucket(node, subs[k], limit, depth + 1,
+                                                allow_split=True)
+                    return
+                finally:
+                    for sf in subs:
+                        sf.delete()
+
+    bucket = RecordBatch.concat(list(f.read()))
+    keys = [eval_expression(bucket, e) for e in node.sort_by]
+    yield MicroPartition(node.schema,
+                         [bucket.sort(keys, node.descending, node.nulls_first)])
+
+
+def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
+    """Hash join with a spillable build side: the right (build) side is
+    admitted against the memory budget; if it exceeds the budget, both sides
+    Grace-partition into K co-partitioned spill files by join-key hash and the
+    join runs per partition (correct for every join type since equal keys
+    land in the same partition)."""
+    from . import memory as mem
+
+    budget = mem.operator_budget()
+    right_it = _batch_iter(_exec(node.right))
+    right_parts: List[RecordBatch] = []
+    over = False
+    for b in right_it:
+        right_parts.append(b)
+        if not budget.admit(b.size_bytes()):
+            over = True
+            break
+
+    left_prefix: List[RecordBatch] = []
+    left_it = None
+    if not over:
+        right = RecordBatch.concat(right_parts) if right_parts \
+            else RecordBatch.empty(node.right.schema)
+        if node.how not in ("right", "outer"):
+            # probe side streams batch-by-batch: never materialized
+            for b in _batch_iter(_exec(node.left)):
+                out = rel.hash_join(b, right, node.left_on, node.right_on, node.how,
+                                    node.schema, node.merged_keys, node.right_rename)
+                yield MicroPartition(node.schema, [out])
+            return
+        # right/outer need the full left side to find unmatched build rows
+        # exactly once — admit it against the budget too
+        left_it = _batch_iter(_exec(node.left))
+        for b in left_it:
+            left_prefix.append(b)
+            if not budget.admit(b.size_bytes()):
+                over = True
+                break
+        if not over:
+            left = RecordBatch.concat(left_prefix) if left_prefix \
+                else RecordBatch.empty(node.left.schema)
+            out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
+                                node.schema, node.merged_keys, node.right_rename)
+            yield MicroPartition(node.schema, [out])
+            return
+
+    K = 16
+    spr = mem.SpillPartitions(node.right.schema, K)
+    spl = mem.SpillPartitions(node.left.schema, K)
+    try:
+        for b in itertools.chain(right_parts, right_it):
+            spr.append_partitioned(b, node.right_on)
+        if left_it is None:
+            left_it = _batch_iter(_exec(node.left))
+        for b in itertools.chain(left_prefix, left_it):
+            spl.append_partitioned(b, node.left_on)
+        for fl, fr in zip(spl.files, spr.files):
+            lbs = list(fl.read())
+            rbs = list(fr.read())
+            if not lbs and node.how in ("inner", "left", "semi", "anti"):
+                continue
+            left = RecordBatch.concat(lbs) if lbs else RecordBatch.empty(node.left.schema)
+            right = RecordBatch.concat(rbs) if rbs else RecordBatch.empty(node.right.schema)
+            out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
+                                node.schema, node.merged_keys, node.right_rename)
+            if out.num_rows:
+                yield MicroPartition(node.schema, [out])
+    finally:
+        spr.delete()
+        spl.delete()
 
 
 def _filter_part(part: MicroPartition, predicate: Expression) -> MicroPartition:
