@@ -8,9 +8,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Union
 
+from .config import execution_config, execution_config_ctx, set_execution_config
 from .core.micropartition import MicroPartition
 from .dataframe import DataFrame, GroupedDataFrame
 from .expressions import Expression, col, lit
+from .io.io_config import HTTPConfig, IOConfig, S3Config, io_config, set_io_config
 from .plan.builder import LogicalPlanBuilder
 from .schema import Schema
 from .udf import Func, cls, func, method, udf

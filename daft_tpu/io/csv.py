@@ -40,7 +40,8 @@ class CsvScanOperator(ScanOperator):
             ropts_head = pacsv.ReadOptions(
                 autogenerate_column_names=not self._has_headers, block_size=1 << 20
             )
-            with pacsv.open_csv(self._paths[0], read_options=ropts_head, parse_options=popts) as r:
+            from .object_store import open_input
+            with pacsv.open_csv(open_input(self._paths[0]), read_options=ropts_head, parse_options=popts) as r:
                 batch = r.read_next_batch()
             if not self._has_headers:
                 # rename f0.. to column_1.. (reference naming)
@@ -77,7 +78,8 @@ class CsvScanOperator(ScanOperator):
 
         def read():
             produced = 0
-            with pacsv.open_csv(path, read_options=ropts, parse_options=popts) as reader:
+            from .object_store import open_input
+            with pacsv.open_csv(open_input(path), read_options=ropts, parse_options=popts) as reader:
                 for batch in reader:
                     t = pa.Table.from_batches([batch])
                     if not self._has_headers:

@@ -1,0 +1,59 @@
+"""IO configuration (reference parity: src/common/io-config — IOConfig with
+S3/HTTP sub-configs, attachable per-read or process-wide)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class S3Config:
+    endpoint_url: Optional[str] = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_S3_ENDPOINT") or None)
+    region: str = field(default_factory=lambda: os.environ.get("AWS_REGION", "us-east-1"))
+    access_key_id: Optional[str] = field(
+        default_factory=lambda: os.environ.get("AWS_ACCESS_KEY_ID") or None)
+    secret_access_key: Optional[str] = field(
+        default_factory=lambda: os.environ.get("AWS_SECRET_ACCESS_KEY") or None)
+    session_token: Optional[str] = field(
+        default_factory=lambda: os.environ.get("AWS_SESSION_TOKEN") or None)
+    anonymous: bool = False
+    max_retries: int = 4
+    retry_initial_backoff_ms: int = 100
+    # path-style addressing (endpoint/bucket/key) — required by most S3 mocks
+    force_path_style: bool = True
+
+
+@dataclass(frozen=True)
+class HTTPConfig:
+    max_retries: int = 4
+    retry_initial_backoff_ms: int = 100
+    user_agent: str = "daft-tpu/0.1"
+
+
+@dataclass(frozen=True)
+class IOConfig:
+    s3: S3Config = field(default_factory=S3Config)
+    http: HTTPConfig = field(default_factory=HTTPConfig)
+
+
+_default: Optional[IOConfig] = None
+
+
+def io_config() -> IOConfig:
+    global _default
+    if _default is None:
+        _default = IOConfig()
+    return _default
+
+
+def set_io_config(config: Optional[IOConfig] = None, **kwargs) -> IOConfig:
+    """Set the process-default IOConfig (or replace fields on the current one)."""
+    global _default
+    if config is not None:
+        _default = config
+    elif kwargs:
+        _default = replace(io_config(), **kwargs)
+    return io_config()

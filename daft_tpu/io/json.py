@@ -27,7 +27,8 @@ class JsonScanOperator(ScanOperator):
 
     def schema(self) -> Schema:
         if self._schema is None:
-            t = pajson.read_json(self._paths[0])
+            from .object_store import open_input
+            t = pajson.read_json(open_input(self._paths[0]))
             self._schema = Schema.from_arrow(t.schema)
         return self._schema
 
@@ -45,7 +46,9 @@ class JsonScanOperator(ScanOperator):
         for path in self._paths:
             def make(path=path):
                 def read():
-                    t = pajson.read_json(path)
+                    from .object_store import open_input
+
+                    t = pajson.read_json(open_input(path))
                     if columns is not None:
                         t = t.select(columns)
                     if pushdowns.limit is not None:

@@ -39,8 +39,11 @@ class ParquetScanOperator(ScanOperator):
 
     def schema(self) -> Schema:
         if self._schema is None:
-            # schema inference from the first file (reference: schema_inference.rs)
-            self._schema = Schema.from_arrow(pq.read_schema(self._paths[0]))
+            from .object_store import open_input
+
+            # schema inference from the first file (reference: schema_inference.rs);
+            # remote objects read only the footer via ranged reads
+            self._schema = Schema.from_arrow(pq.read_schema(open_input(self._paths[0])))
         return self._schema
 
     def can_absorb_select(self) -> bool:
@@ -53,10 +56,12 @@ class ParquetScanOperator(ScanOperator):
         return True
 
     def approx_num_rows(self, pushdowns: Pushdowns) -> Optional[float]:
+        from .object_store import open_input
+
         total = 0
         for p in self._paths:
             try:
-                total += pq.ParquetFile(p).metadata.num_rows
+                total += pq.ParquetFile(open_input(p)).metadata.num_rows
             except Exception:
                 return None
         if pushdowns.limit is not None:
@@ -69,13 +74,17 @@ class ParquetScanOperator(ScanOperator):
         out_schema = Schema([schema[c] for c in columns]) if columns is not None else schema
         arrow_filter = _expr_to_arrow_filter(pushdowns.filters) if pushdowns.filters is not None else None
 
+        from .object_store import is_remote
+
         tasks = []
         for path in self._paths:
             tasks.append(ScanTask(
                 read=_make_reader(path, columns, arrow_filter, pushdowns.limit, out_schema),
                 schema=out_schema,
                 size_bytes=os.path.getsize(path) if os.path.exists(path) else None,
-                filters_applied=arrow_filter is not None,
+                # remote readers don't evaluate the predicate; the executor
+                # re-applies it post-scan
+                filters_applied=arrow_filter is not None and not is_remote(path),
                 limit_applied=False,
                 source_label=path,
             ))
@@ -83,6 +92,28 @@ class ParquetScanOperator(ScanOperator):
 
 
 def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
+    from .object_store import is_remote
+
+    if is_remote(path):
+        def read_remote():
+            from .object_store import open_input
+
+            # ranged-read file: column pruning downloads only touched byte
+            # ranges; predicate re-applied by the executor (filters_applied is
+            # False for remote tasks)
+            pf = pq.ParquetFile(open_input(path))
+            produced = 0
+            for rb in pf.iter_batches(batch_size=_MORSEL_ROWS, columns=columns):
+                if limit is not None and produced >= limit:
+                    return
+                t = pa.Table.from_batches([rb])
+                if limit is not None and produced + t.num_rows > limit:
+                    t = t.slice(0, limit - produced)
+                produced += t.num_rows
+                yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
+
+        return read_remote
+
     def read():
         ds = pads.dataset(path, format="parquet")
         scanner = ds.scanner(columns=columns, filter=arrow_filter, batch_size=_MORSEL_ROWS)

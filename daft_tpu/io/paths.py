@@ -9,9 +9,14 @@ from typing import List, Sequence, Union
 
 
 def expand_paths(path: Union[str, List[str]], extensions: Sequence[str] = ()) -> List[str]:
+    from .object_store import expand_remote, is_remote
+
     paths = [path] if isinstance(path, str) else list(path)
     out: List[str] = []
     for p in paths:
+        if is_remote(p):
+            out.extend(expand_remote(p, extensions=tuple(extensions)))
+            continue
         if p.startswith("file://"):
             p = p[len("file://"):]
         if any(ch in p for ch in "*?["):

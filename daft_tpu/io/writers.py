@@ -43,6 +43,11 @@ class WriteInfo:
         return Schema([Field("path", DataType.string())])
 
     def execute_write(self, parts: Iterator[MicroPartition], input_schema: Schema) -> Iterator[MicroPartition]:
+        from .object_store import is_remote
+
+        if is_remote(self.root_dir):
+            yield from self._execute_remote_write(parts, input_schema)
+            return
         os.makedirs(self.root_dir, exist_ok=True)
         if self.write_mode == "overwrite":
             _clear_dir(self.root_dir)
@@ -57,6 +62,42 @@ class WriteInfo:
                     writer.write(b)
             written = writer.close()
         yield MicroPartition.from_pydict({"path": written}).cast_to_schema(self.result_schema())
+
+    def _execute_remote_write(self, parts: Iterator[MicroPartition],
+                              input_schema: Schema) -> Iterator[MicroPartition]:
+        """Remote sinks: write to a local staging dir, then upload each file to
+        the object store (reference: daft-writers storage_backend.rs)."""
+        import shutil
+        import tempfile
+
+        from .object_store import resolve_source
+
+        source, rel_root = resolve_source(self.root_dir)
+        scheme = self.root_dir.split("://", 1)[0] + "://"
+        if self.write_mode == "overwrite":
+            for key in source.ls(rel_root.rstrip("/") + "/"):
+                source.delete(key)
+        staging = tempfile.mkdtemp(prefix="daft_tpu_write_")
+        local = WriteInfo(self.format, staging, self.options,
+                          self.partition_cols, write_mode="append")
+        try:
+            manifest = list(local.execute_write(parts, input_schema))
+            remote_paths: List[str] = []
+            for mp in manifest:
+                for local_path in mp.to_pydict().get("path", []):
+                    rel = os.path.relpath(local_path, staging)
+                    key = rel_root.rstrip("/") + "/" + rel.replace(os.sep, "/")
+                    # NOTE: whole-object PUT (SigV4 hashes the payload); large
+                    # staged files are held in memory per upload — multipart
+                    # streaming upload is the planned upgrade (reference:
+                    # daft-io multipart.rs)
+                    with open(local_path, "rb") as f:
+                        source.put(key, f.read())
+                    remote_paths.append(scheme + key)
+            yield MicroPartition.from_pydict(
+                {"path": remote_paths}).cast_to_schema(self.result_schema())
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
 
     def _write_partitioned(self, parts: Iterator[MicroPartition], input_schema: Schema) -> List[str]:
         from ..expressions.eval import eval_expression

@@ -1,0 +1,288 @@
+"""Remote IO: S3-compatible object store against an in-process mock server.
+
+Mirrors the reference's test strategy (MinIO/moto integration + MockSource
+failure injection, daft-io mock.rs / tests/integration/io): a threaded HTTP
+server emulates the S3 REST surface (ranged GET, PUT, DELETE, ListObjectsV2)
+with on-demand failure injection, and the engine's read_parquet/csv/json +
+write_parquet run against s3:// URLs end-to-end.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.io.io_config import IOConfig, S3Config, set_io_config
+from daft_tpu.io.object_store import (
+    MockSource,
+    NotFoundError,
+    ObjectSourceError,
+    S3Source,
+    TransientError,
+    resolve_source,
+)
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    server_version = "MockS3/0.1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _store(self):
+        return self.server.store
+
+    def _fail_maybe(self) -> bool:
+        if self.server.fail_next > 0:
+            self.server.fail_next -= 1
+            self.send_response(503)
+            self.end_headers()
+            self.wfile.write(b"injected failure")
+            return True
+        return False
+
+    def _parse(self):
+        u = urlparse(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = unquote(parts[1]) if len(parts) > 1 else ""
+        return bucket, key, parse_qs(u.query)
+
+    def do_GET(self):
+        if self._fail_maybe():
+            return
+        bucket, key, q = self._parse()
+        self.server.requests.append(("GET", bucket, key))
+        if "list-type" in q:
+            prefix = q.get("prefix", [""])[0]
+            keys = sorted(k for (b, k) in self._store() if b == bucket
+                          and k.startswith(prefix))
+            body = "<ListBucketResult>"
+            for k in keys:
+                body += f"<Contents><Key>{k}</Key></Contents>"
+            body += "<IsTruncated>false</IsTruncated></ListBucketResult>"
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        obj = self._store().get((bucket, key))
+        if obj is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            spec = rng.split("=")[1]
+            start_s, end_s = spec.split("-")
+            start = int(start_s)
+            end = int(end_s) if end_s else len(obj) - 1
+            piece = obj[start:end + 1]
+            self.server.bytes_served += len(piece)
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {start}-{end}/{len(obj)}")
+            self.send_header("Content-Length", str(len(piece)))
+            self.end_headers()
+            self.wfile.write(piece)
+            return
+        self.server.bytes_served += len(obj)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(obj)))
+        self.end_headers()
+        self.wfile.write(obj)
+
+    def do_HEAD(self):
+        if self._fail_maybe():
+            return
+        bucket, key, _ = self._parse()
+        obj = self._store().get((bucket, key))
+        if obj is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(obj)))
+        self.end_headers()
+
+    def do_PUT(self):
+        if self._fail_maybe():
+            return
+        bucket, key, _ = self._parse()
+        n = int(self.headers.get("Content-Length", 0))
+        self._store()[(bucket, key)] = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        if self._fail_maybe():
+            return
+        bucket, key, _ = self._parse()
+        self._store().pop((bucket, key), None)
+        self.send_response(204)
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def s3_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _S3Handler)
+    srv.store = {}
+    srv.fail_next = 0
+    srv.bytes_served = 0
+    srv.requests = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    endpoint = f"http://127.0.0.1:{srv.server_port}"
+    prev = set_io_config(IOConfig(s3=S3Config(
+        endpoint_url=endpoint, access_key_id="test", secret_access_key="secret",
+        region="us-east-1", retry_initial_backoff_ms=1)))
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture
+def df():
+    rng = np.random.default_rng(0)
+    n = 2000
+    return daft_tpu.from_pydict({
+        "id": list(range(n)),
+        "v": rng.uniform(0, 100, n).tolist(),
+        "s": rng.choice(["x", "y", "z"], n).tolist(),
+    })
+
+
+def test_s3_put_get_roundtrip(s3_server):
+    src = S3Source()
+    src.put("bkt/a/b.txt", b"hello world")
+    assert src.get("bkt/a/b.txt") == b"hello world"
+    assert src.get("bkt/a/b.txt", range=(6, 11)) == b"world"
+    assert src.get_size("bkt/a/b.txt") == 11
+    src.delete("bkt/a/b.txt")
+    with pytest.raises(NotFoundError):
+        src.get("bkt/a/b.txt")
+
+
+def test_s3_glob(s3_server):
+    src = S3Source()
+    for i in range(3):
+        src.put(f"bkt/data/part-{i}.parquet", b"x")
+    src.put("bkt/data/other.txt", b"y")
+    got = src.glob("bkt/data/part-*.parquet")
+    assert got == [f"bkt/data/part-{i}.parquet" for i in range(3)]
+
+
+def test_write_then_read_parquet_s3(s3_server, df):
+    df.write_parquet("s3://bkt/tbl").to_pydict()
+    back = daft_tpu.read_parquet("s3://bkt/tbl/*.parquet").sort("id").to_pydict()
+    assert back == df.sort("id").to_pydict()
+
+
+def test_s3_parquet_with_pushdowns(s3_server, df):
+    df.write_parquet("s3://bkt/tbl2").to_pydict()
+    out = (daft_tpu.read_parquet("s3://bkt/tbl2/*.parquet")
+           .where(col("v") > 50.0)
+           .select("id", "v")
+           .sort("id")
+           .to_pydict())
+    expect = df.where(col("v") > 50.0).select("id", "v").sort("id").to_pydict()
+    assert out == expect
+
+
+def test_s3_column_pruning_reads_fewer_bytes(s3_server):
+    """Ranged reads + column pruning must download materially fewer bytes than
+    a full-file read (the file is much larger than the readahead window)."""
+    rng = np.random.default_rng(1)
+    n = 200_000
+    wide = daft_tpu.from_pydict({
+        "id": list(range(n)),
+        "payload": ["".join(rng.choice(list("abcdefgh"), 64)) for _ in range(n)],
+    })
+    wide.write_parquet("s3://bkt/tbl3").to_pydict()
+    s3_server.bytes_served = 0
+    daft_tpu.read_parquet("s3://bkt/tbl3/*.parquet").select("id").to_pydict()
+    pruned = s3_server.bytes_served
+    s3_server.bytes_served = 0
+    daft_tpu.read_parquet("s3://bkt/tbl3/*.parquet").to_pydict()
+    full = s3_server.bytes_served
+    assert pruned < full / 2, (pruned, full)
+
+
+def test_transient_failures_are_retried(s3_server):
+    src = S3Source()
+    src.put("bkt/r.txt", b"retry me")
+    s3_server.fail_next = 2
+    assert src.get("bkt/r.txt") == b"retry me"  # retries absorb 2x 503
+
+
+def test_too_many_failures_raise(s3_server):
+    src = S3Source()
+    src.put("bkt/r2.txt", b"data")
+    s3_server.fail_next = 50
+    with pytest.raises(TransientError):
+        src.get("bkt/r2.txt")
+    s3_server.fail_next = 0
+
+
+def test_csv_roundtrip_s3(s3_server, df):
+    df.write_csv("s3://bkt/csvs").to_pydict()
+    back = daft_tpu.read_csv("s3://bkt/csvs/*.csv").sort("id").to_pydict()
+    expect = df.sort("id").to_pydict()
+    assert back["id"] == expect["id"]
+    np.testing.assert_allclose(back["v"], expect["v"], rtol=1e-12)
+
+
+def test_mock_source_failure_injection():
+    from daft_tpu.io.object_store import LocalSource, with_retries
+
+    inner = LocalSource()
+    mock = MockSource(inner, fail_first=2)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "f.txt")
+        inner.put(p, b"abc")
+        # two injected transient failures, then success via retry wrapper
+        out = with_retries(lambda: mock.get(p), max_retries=3, initial_backoff_ms=1)
+        assert out == b"abc"
+        # fatal errors are not retried
+        fatal = MockSource(inner, fail_first=1, error=ObjectSourceError("fatal"))
+        with pytest.raises(ObjectSourceError):
+            with_retries(lambda: fatal.get(p), max_retries=3, initial_backoff_ms=1)
+
+
+def test_resolve_source_schemes():
+    from daft_tpu.io.object_store import HTTPSource, LocalSource
+
+    s, rel = resolve_source("s3://b/k.parquet")
+    assert isinstance(s, S3Source) and rel == "b/k.parquet"
+    s, rel = resolve_source("https://host/x.csv")
+    assert isinstance(s, HTTPSource) and rel == "https://host/x.csv"
+    s, rel = resolve_source("/tmp/x.csv")
+    assert isinstance(s, LocalSource)
+
+
+def test_s3_directory_read_without_glob(s3_server, df):
+    """write -> read of a bare s3 'directory' prefix round-trips (prefix list)."""
+    df.write_parquet("s3://bkt/dirtbl").to_pydict()
+    back = daft_tpu.read_parquet("s3://bkt/dirtbl").sort("id").to_pydict()
+    assert back == df.sort("id").to_pydict()
+
+
+def test_s3_overwrite_replaces_objects(s3_server, df):
+    df.write_parquet("s3://bkt/ow").to_pydict()
+    half = df.where(col("id") < 1000)
+    half.write_parquet("s3://bkt/ow", write_mode="overwrite").to_pydict()
+    back = daft_tpu.read_parquet("s3://bkt/ow").to_pydict()
+    assert len(back["id"]) == 1000
+
+
+def test_s3_glob_does_not_cross_directories(s3_server):
+    src = S3Source()
+    src.put("bkt/g/a.parquet", b"1")
+    src.put("bkt/g/sub/b.parquet", b"2")
+    assert src.glob("bkt/g/*.parquet") == ["bkt/g/a.parquet"]
+    assert src.glob("bkt/g/**.parquet") == ["bkt/g/a.parquet", "bkt/g/sub/b.parquet"]
