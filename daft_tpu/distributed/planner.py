@@ -179,6 +179,25 @@ def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
 
     if isinstance(node, pp.HashJoin):
         left = distribute(ctx, node.left)
+        # broadcast join: a build side small enough to replicate skips BOTH
+        # shuffles — every left fragment joins against the full right sub-plan
+        # (reference: pipeline_node/join broadcast variant + the 10MiB
+        # broadcast_join_size_bytes default)
+        from ..config import execution_config
+
+        r_bytes = _phys_bytes_estimate(node.right)
+        if (node.how in ("inner", "left", "semi", "anti")
+                and r_bytes is not None
+                and r_bytes <= execution_config().broadcast_join_size_bytes):
+            frags = [
+                pp.HashJoin(lf, node.right, node.left_on, node.right_on, node.how,
+                            node.merged_keys, node.right_rename, node.schema)
+                for lf in left.fragments
+            ]
+            keep = left.partitioned_by
+            if keep is not None and not set(keep).issubset(set(node.schema.column_names())):
+                keep = None
+            return Partitioned(frags, keep)
         right = distribute(ctx, node.right)
         lkeys = _key_names(node.left_on)
         rkeys = _key_names(node.right_on)
@@ -259,6 +278,26 @@ def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
     ctx.pool.run_tasks(tasks)
     return [pp.ShuffleRead(sid, p, ctx.shuffle_dir, schema)
             for p in range(ctx.n_partitions)]
+
+
+def _phys_bytes_estimate(node: pp.PhysicalPlan) -> Optional[int]:
+    """Upper-bound byte estimate for a physical subtree (broadcast decisions).
+    Exact for in-memory sources; filters/projects pass through (upper bound);
+    unknown sources return None (never broadcast blindly)."""
+    if isinstance(node, pp.InMemoryScan):
+        total = 0
+        for p in node.partitions:
+            for b in p.batches:
+                total += b.size_bytes()
+        return total
+    if isinstance(node, pp.TaskScan):
+        sizes = [t.size_bytes for t in node.tasks]
+        if any(s is None for s in sizes):
+            return None
+        return int(sum(sizes))
+    if isinstance(node, (pp.Project, pp.PhysFilter, pp.PhysLimit, pp.PhysSample)):
+        return _phys_bytes_estimate(node.input)
+    return None
 
 
 def _key_names(exprs) -> Optional[Tuple[str, ...]]:

@@ -369,7 +369,12 @@ def _glob_to_regex(pattern: str):
             if j == -1:
                 out.append(re.escape(c))
             else:
-                out.append(pattern[i:j + 1])
+                cls = pattern[i + 1:j]
+                if cls.startswith("!"):  # glob negation -> regex negation
+                    cls = "^" + cls[1:]
+                elif cls.startswith("^"):
+                    cls = "\\^" + cls[1:]
+                out.append("[" + cls + "]")
                 i = j + 1
                 continue
         else:
@@ -542,7 +547,7 @@ class RangedObjectFile:
         return out
 
 
-_HTTP_BODY_CACHE: "dict[str, bytes]" = {}
+_HTTP_BODY_CACHE: "dict[str, Tuple[bytes, float]]" = {}
 
 
 def open_input(path: str, config: Optional[IOConfig] = None):
@@ -555,12 +560,15 @@ def open_input(path: str, config: Optional[IOConfig] = None):
     source, rel = resolve_source(path, config)
     if isinstance(source, HTTPSource):
         # no reliable ranged reads on arbitrary HTTP servers: buffer fully.
-        # A 2-entry body cache stops schema inference + row-count estimation +
-        # the actual scan from downloading the same file repeatedly.
-        body = _HTTP_BODY_CACHE.get(path)
-        if body is None:
+        # A tiny TTL'd body cache stops schema inference + row-count estimation
+        # + the actual scan from downloading the same file repeatedly within
+        # one query, without serving stale bytes across sessions.
+        entry = _HTTP_BODY_CACHE.get(path)
+        if entry is not None and time.time() - entry[1] < 60.0:
+            body = entry[0]
+        else:
             body = source.get(rel)
-            _HTTP_BODY_CACHE[path] = body
+            _HTTP_BODY_CACHE[path] = (body, time.time())
             while len(_HTTP_BODY_CACHE) > 2:
                 _HTTP_BODY_CACHE.pop(next(iter(_HTTP_BODY_CACHE)))
         return pa.BufferReader(body)

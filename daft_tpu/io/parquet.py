@@ -77,7 +77,10 @@ class ParquetScanOperator(ScanOperator):
         from .object_store import is_remote
 
         tasks = []
+        conjuncts = _zone_map_conjuncts(pushdowns.filters) if pushdowns.filters is not None else []
         for path in self._paths:
+            if conjuncts and _file_prunable(path, conjuncts):
+                continue  # zone map proved no row can match (metadata-only read)
             tasks.append(ScanTask(
                 read=_make_reader(path, columns, arrow_filter, pushdowns.limit, out_schema),
                 schema=out_schema,
@@ -89,6 +92,74 @@ class ParquetScanOperator(ScanOperator):
                 source_label=path,
             ))
         return tasks
+
+
+def _zone_map_conjuncts(expr) -> List[tuple]:
+    """Extract (column, op, literal) constraints usable against row-group
+    min/max statistics (reference: daft-parquet statistics/ + daft-stats
+    zone-map pruning). Only top-level AND conjuncts of simple comparisons."""
+    from ..expressions import Between, BinaryOp, ColumnRef, Literal
+
+    out = []
+
+    def walk(e):
+        if isinstance(e, BinaryOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+            return
+        if isinstance(e, BinaryOp) and e.op in ("lt", "le", "gt", "ge", "eq"):
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+            if isinstance(e.left, ColumnRef) and isinstance(e.right, Literal):
+                out.append((e.left._name, e.op, e.right.value))
+            elif isinstance(e.right, ColumnRef) and isinstance(e.left, Literal):
+                out.append((e.right._name, flip[e.op], e.left.value))
+            return
+        if isinstance(e, Between) and isinstance(e.child, ColumnRef):
+            if isinstance(e.lower, Literal) and isinstance(e.upper, Literal):
+                out.append((e.child._name, "ge", e.lower.value))
+                out.append((e.child._name, "le", e.upper.value))
+
+    walk(expr)
+    return out
+
+
+def _file_prunable(path: str, conjuncts: List[tuple]) -> bool:
+    """True iff parquet row-group statistics PROVE no row satisfies the
+    predicate — every row group must be excluded by some conjunct. Metadata
+    only: remote objects read just the footer via ranged gets."""
+    from .object_store import open_input
+
+    try:
+        md = pq.ParquetFile(open_input(path)).metadata
+        for rg_i in range(md.num_row_groups):
+            rg = md.row_group(rg_i)
+            cols = {rg.column(i).path_in_schema: rg.column(i).statistics
+                    for i in range(rg.num_columns)}
+            excluded = False
+            for name, op, value in conjuncts:
+                st = cols.get(name)
+                if st is None or not st.has_min_max:
+                    continue
+                try:
+                    if op in ("lt",) and not (st.min < value):
+                        excluded = True
+                    elif op == "le" and not (st.min <= value):
+                        excluded = True
+                    elif op == "gt" and not (st.max > value):
+                        excluded = True
+                    elif op == "ge" and not (st.max >= value):
+                        excluded = True
+                    elif op == "eq" and not (st.min <= value <= st.max):
+                        excluded = True
+                except TypeError:
+                    continue  # incomparable stats (e.g. logical-type mismatch)
+                if excluded:
+                    break
+            if not excluded:
+                return False  # this row group might match
+        return md.num_row_groups > 0
+    except Exception:
+        return False  # never prune on metadata trouble
 
 
 def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):

@@ -41,6 +41,9 @@ class Optimizer:
             if batch.name == "pushdowns":
                 # global projection pushdown after filters have settled
                 plan = prune_columns(plan)
+                # then cost-based join reordering (top-down so each maximal
+                # inner-join chain is reordered exactly once, at its root)
+                plan = reorder_joins_global(plan)
         return plan
 
 
@@ -592,6 +595,223 @@ def rule_extract_windows(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
 
     new_proj = [e.transform(rewrite) for e in node.projection]
     return lp.Project(input_node, new_proj)
+
+
+def reorder_joins_global(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Top-down driver: reorder each maximal inner-join chain at its root, then
+    recurse into the chain's relation subtrees (nested chains under aggregates,
+    filters, etc. each get their own reorder)."""
+    if _plain_inner_join(plan):
+        rewritten = _reorder_join_chain(plan)
+        target = rewritten if rewritten is not None else plan
+
+        def recurse_spine(n):
+            # walk the join spine; recurse into relation leaves only
+            if _plain_inner_join(n):
+                kids = [recurse_spine(c) for c in n.children()]
+                if all(k is o for k, o in zip(kids, n.children())):
+                    return n
+                return n.with_children(kids)
+            return reorder_joins_global(n)
+
+        return recurse_spine(target) if not isinstance(target, lp.Project) \
+            else target.with_children([recurse_spine(target.input)])
+    children = plan.children()
+    if not children:
+        return plan
+    new_children = [reorder_joins_global(c) for c in children]
+    if all(n is o for n, o in zip(new_children, children)):
+        return plan
+    return plan.with_children(new_children)
+
+
+def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Greedy cost-based join reordering (reference:
+    optimization/rules/reorder_joins/ — greedy smallest-first over the
+    stats.py estimates instead of brute-force enumeration).
+
+    Applies to maximal chains of plain inner equi-joins (no explicit strategy,
+    no prefix/suffix renames, bare-column keys): start from the smallest
+    estimated relation, repeatedly join the smallest connected relation.
+    Filters pushed into scans (the pushdown batch runs first) make the
+    estimates selectivity-aware. The rewritten tree is wrapped in a Project
+    restoring the original column order; fires only when the order actually
+    changes (stable under re-application)."""
+    from .stats import estimate_rows
+
+    if not _plain_inner_join(node):
+        return None
+    rels: List[lp.LogicalPlan] = []
+    conds: List[tuple] = []  # (name_a, name_b)
+
+    def flatten(j) -> bool:
+        for lo, ro in zip(j.left_on, j.right_on):
+            a, b = _bare_name(lo), _bare_name(ro)
+            if a is None or b is None:
+                return False
+            conds.append((a, b))
+        for side in (j.left, j.right):
+            if _plain_inner_join(side):
+                if not flatten(side):
+                    return False
+            else:
+                rels.append(side)
+        return True
+
+    if not flatten(node) or len(rels) < 3:
+        return None
+
+    # column name -> owning relations. Same-named join keys (df.join(on="k"))
+    # legitimately live in several relations and merge at each join; any OTHER
+    # ambiguity bails (can't attribute the condition to a relation).
+    owners = {}
+    for i, r in enumerate(rels):
+        for name in r.schema.column_names():
+            owners.setdefault(name, []).append(i)
+    for a, b in conds:
+        if a not in owners or b not in owners:
+            return None
+        if a != b and (len(owners[a]) != 1 or len(owners[b]) != 1):
+            return None
+    # a name living in several relations is only safe when it is a same-name
+    # join key (inner-join merge makes the values equal, so any order binds the
+    # same data); shared NON-key names would silently swap sources on reorder
+    for name, ow in owners.items():
+        if len(ow) > 1 and not any(a == b == name for a, b in conds):
+            return None
+
+    from .stats import estimate_distinct, estimate_join_result
+
+    big = float("inf")
+    est = []
+    for r in rels:
+        e = estimate_rows(r)
+        if e is None:
+            return None
+        est.append(e)
+    # Selinger V(R, a) for every join-key column per owning relation
+    v: dict = {}
+    for a, b in conds:
+        for name in (a, b):
+            for i in owners[name]:
+                if (i, name) not in v:
+                    v[(i, name)] = estimate_distinct(rels[i], name)
+
+    def rel_cols(i):
+        return set(rels[i].schema.column_names())
+
+    def join_est(cur_rows, cur_v, i):
+        """Estimated result of joining relation i into the current set, using
+        every applicable condition (independence assumption)."""
+        out = cur_rows * est[i]
+        found = False
+        rc = rel_cols(i)
+        for a, b in conds:
+            sides = None
+            if a in cur_v and b in rc:
+                sides = (cur_v.get(a), v.get((i, b)))
+            elif b in cur_v and a in rc:
+                sides = (cur_v.get(b), v.get((i, a)))
+            if sides is None:
+                continue
+            found = True
+            vl = sides[0] if sides[0] is not None else cur_rows
+            vr = sides[1] if sides[1] is not None else est[i]
+            out = out / max(vl, vr, 1.0)
+        if not found:
+            return None  # not connected
+        return max(out, 1.0)
+
+    def simulate(order):
+        """Cost of a join order: the sum of INTERMEDIATE result sizes. The
+        final result is the query output — identical for every valid order —
+        so it is excluded (it would otherwise swamp the comparison)."""
+        cur_rows = est[order[0]]
+        cur_v = {name: v.get((order[0], name))
+                 for (i, name) in v if i == order[0]}
+        cost = 0.0
+        for step, i in enumerate(order[1:]):
+            res = join_est(cur_rows, cur_v, i)
+            if res is None:
+                return None, None
+            if step < len(order) - 2:
+                cost += res
+            for (j, name), val in v.items():
+                if j == i:
+                    cur_v[name] = val
+            # joining shrinks per-column distincts to at most the result rows
+            cur_v = {n: (min(x, res) if x is not None else None)
+                     for n, x in cur_v.items()}
+            cur_rows = res
+        return cost, cur_rows
+
+    # greedy: start from the smallest relation, repeatedly add the connected
+    # relation with the smallest estimated JOIN RESULT
+    order = [min(range(len(rels)), key=lambda i: (est[i], i))]
+    placed = {order[0]}
+    cur_rows = est[order[0]]
+    cur_v = {name: v.get((order[0], name)) for (i, name) in v if i == order[0]}
+    while len(placed) < len(rels):
+        best = None
+        for i in range(len(rels)):
+            if i in placed:
+                continue
+            res = join_est(cur_rows, cur_v, i)
+            if res is None:
+                continue
+            if best is None or res < best[0] or (res == best[0] and i < best[1]):
+                best = (res, i)
+        if best is None:
+            return None  # disconnected components would need a cross join
+        res, nxt = best
+        order.append(nxt)
+        placed.add(nxt)
+        for (j, name), val in v.items():
+            if j == nxt:
+                cur_v[name] = val
+        cur_v = {n: (min(x, res) if x is not None else None) for n, x in cur_v.items()}
+        cur_rows = res
+
+    current_order = list(range(len(rels)))  # flatten() emits left-deep order
+    if order == current_order:
+        return None
+    # only rewrite on a clear predicted win: estimates are rough, and
+    # hand-ordered queries must never be pessimized by a coin-flip estimate
+    orig_cost, _ = simulate(current_order)
+    new_cost, _ = simulate(order)
+    if orig_cost is None or new_cost is None or new_cost >= 0.5 * orig_cost:
+        return None
+
+    cur = rels[order[0]]
+    have = set(cur.schema.column_names())
+    for i in order[1:]:
+        r = rels[i]
+        rcols = set(r.schema.column_names())
+        left_on, right_on = [], []
+        for a, b in conds:
+            if a in have and b in rcols:
+                left_on.append(col(a))
+                right_on.append(col(b))
+            elif b in have and a in rcols:
+                left_on.append(col(b))
+                right_on.append(col(a))
+        cur = lp.Join(cur, r, left_on, right_on, "inner")
+        have |= rcols
+    if set(cur.schema.column_names()) != set(node.schema.column_names()):
+        return None  # merged-key set changed; keep the original plan
+    return lp.Project(cur, [col(f.name) for f in node.schema])
+
+
+def _plain_inner_join(n) -> bool:
+    return (isinstance(n, lp.Join) and n.how == "inner" and n.strategy is None
+            and n.prefix is None and n.suffix is None)
+
+
+def _bare_name(e: Expression) -> Optional[str]:
+    from ..expressions.expressions import Alias
+
+    node = e.child if isinstance(e, Alias) else e
+    return node._name if isinstance(node, ColumnRef) else None
 
 
 def default_rule_batches(config) -> List[RuleBatch]:

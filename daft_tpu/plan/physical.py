@@ -408,12 +408,36 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         return PhysConcat([translate(c, config) for c in plan.inputs], plan.schema)
 
     if isinstance(plan, lp.Join):
-        left = translate(plan.left, config)
-        right = translate(plan.right, config)
         merged_keys, right_rename = plan.output_naming()
         if plan.how == "cross":
-            return CrossJoin(left, right, right_rename, plan.schema)
-        return HashJoin(left, right, plan.left_on, plan.right_on, plan.how,
+            return CrossJoin(translate(plan.left, config),
+                             translate(plan.right, config), right_rename, plan.schema)
+        # Cost-based build-side selection (reference: translate_join.rs strategy
+        # pick + broadcast_join_size_bytes): the right side is the hash build;
+        # when the LEFT side is estimated much smaller (and small enough to
+        # hold), swap sides so the small side builds, restoring the original
+        # column order with a Project.
+        if plan.how == "inner" and plan.strategy is None and not right_rename:
+            from ..config import execution_config
+            from ..expressions import col as _col
+            from .stats import estimate_bytes
+
+            lb = estimate_bytes(plan.left)
+            rb = estimate_bytes(plan.right)
+            threshold = (config or execution_config()).broadcast_join_size_bytes
+            if lb is not None and rb is not None and lb <= threshold and lb < rb / 2:
+                swapped = lp.Join(plan.right, plan.left, plan.right_on, plan.left_on,
+                                  "inner")
+                s_merged, s_rename = swapped.output_naming()
+                if not s_rename and (set(swapped.schema.column_names())
+                                     == set(plan.schema.column_names())):
+                    hj = HashJoin(translate(plan.right, config),
+                                  translate(plan.left, config),
+                                  plan.right_on, plan.left_on, "inner",
+                                  s_merged, s_rename, swapped.schema)
+                    return Project(hj, [_col(f.name) for f in plan.schema], plan.schema)
+        return HashJoin(translate(plan.left, config), translate(plan.right, config),
+                        plan.left_on, plan.right_on, plan.how,
                         merged_keys, right_rename, plan.schema)
 
     if isinstance(plan, lp.Repartition):

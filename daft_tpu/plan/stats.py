@@ -1,0 +1,184 @@
+"""Plan-level cardinality/size estimation for cost-based decisions.
+
+Reference parity: src/daft-logical-plan/src/stats.rs (ApproxStats propagated
+by enrich_with_stats) + src/daft-stats. Estimates drive greedy join
+reordering, broadcast-join selection, and distributed-planner choices. All
+numbers are approximations — correctness never depends on them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..expressions import ColumnRef, Expression
+from ..expressions.expressions import Alias, Between, BinaryOp, IsIn, Literal, UnaryOp
+from . import logical as lp
+
+# default selectivities (reference stats.rs uses similar fixed factors)
+_SEL_EQ = 0.1
+_SEL_RANGE = 0.3
+_SEL_ISIN = 0.2
+_SEL_DEFAULT = 0.25
+
+
+def _dtype_width(dt) -> int:
+    if dt.is_boolean():
+        return 1
+    if dt.is_string() or dt.is_binary():
+        return 24
+    if dt.is_list() or dt.is_struct() or dt.is_map():
+        return 64
+    return 8
+
+
+def row_width(schema) -> int:
+    return max(sum(_dtype_width(f.dtype) for f in schema), 1)
+
+
+def selectivity(pred: Expression) -> float:
+    """Estimated fraction of rows a predicate keeps."""
+    if isinstance(pred, Alias):
+        return selectivity(pred.child)
+    if isinstance(pred, BinaryOp):
+        if pred.op == "and":
+            return selectivity(pred.left) * selectivity(pred.right)
+        if pred.op == "or":
+            return min(1.0, selectivity(pred.left) + selectivity(pred.right))
+        if pred.op == "eq":
+            return _SEL_EQ
+        if pred.op in ("lt", "le", "gt", "ge"):
+            return _SEL_RANGE
+        if pred.op == "neq":
+            return 1.0 - _SEL_EQ
+    if isinstance(pred, Between):
+        return _SEL_RANGE
+    if isinstance(pred, IsIn):
+        return min(1.0, _SEL_EQ * max(len(pred.items), 1))
+    if isinstance(pred, UnaryOp) and pred.op in ("is_null", "not_null"):
+        return 0.5
+    return _SEL_DEFAULT
+
+
+def estimate_rows(plan: lp.LogicalPlan) -> Optional[float]:
+    """Approximate output cardinality of a logical plan (None = unknown)."""
+    if isinstance(plan, lp.InMemorySource):
+        return float(sum(p.num_rows for p in plan.partitions))
+    if isinstance(plan, lp.ScanSource):
+        try:
+            return plan.scan_op.approx_num_rows(plan.pushdowns)
+        except Exception:
+            return None
+    if isinstance(plan, lp.Filter):
+        child = estimate_rows(plan.input)
+        return None if child is None else child * selectivity(plan.predicate)
+    if isinstance(plan, lp.Join):
+        l = estimate_rows(plan.left)
+        r = estimate_rows(plan.right)
+        if l is None or r is None:
+            return None
+        if plan.how == "cross":
+            return l * r
+        if plan.how in ("semi", "anti"):
+            return l * 0.5
+        if plan.how == "inner":
+            # FK-join assumption: result ~ the larger side
+            return max(l, r)
+        if plan.how == "left":
+            return l  # lower bound; duplicate right keys can fan out
+        if plan.how == "right":
+            return r
+        return l + r  # outer
+    if isinstance(plan, lp.Aggregate):
+        child = estimate_rows(plan.input)
+        if child is None:
+            return None
+        if not plan.groupby:
+            return 1.0
+        return max(child ** 0.7, 1.0)  # sublinear distinct-group heuristic
+    if isinstance(plan, lp.Distinct):
+        child = estimate_rows(plan.input)
+        return None if child is None else max(child * 0.3, 1.0)
+    if isinstance(plan, lp.Limit):
+        child = estimate_rows(plan.input)
+        lim = float(plan.limit) if plan.limit >= 0 else None
+        if child is None:
+            return lim
+        return min(child, lim) if lim is not None else child
+    if isinstance(plan, lp.Sample):
+        child = estimate_rows(plan.input)
+        return None if child is None else child * plan.fraction
+    if isinstance(plan, lp.Concat):
+        vals = [estimate_rows(c) for c in plan.inputs]
+        if any(v is None for v in vals):
+            return None
+        return float(sum(vals))
+    if isinstance(plan, lp.Explode):
+        child = estimate_rows(plan.input)
+        return None if child is None else child * 4.0
+    children = plan.children()
+    if len(children) == 1:
+        return estimate_rows(children[0])
+    return None
+
+
+def estimate_bytes(plan: lp.LogicalPlan) -> Optional[float]:
+    rows = estimate_rows(plan)
+    if rows is None:
+        return None
+    return rows * row_width(plan.schema)
+
+
+_DISTINCT_SAMPLE = 8192
+
+
+def estimate_distinct(plan: lp.LogicalPlan, column: str) -> Optional[float]:
+    """Approximate distinct-value count of one column (Selinger V(R, a)).
+
+    In-memory sources sample the first rows (near-saturated samples
+    extrapolate); filters cap V at the estimated surviving row count; unknown
+    sources return None (callers fall back to the unique-key assumption).
+    """
+    rows = estimate_rows(plan)
+    src = plan
+    while True:
+        if isinstance(src, lp.InMemorySource):
+            for p in src.partitions:
+                for b in p.batches:
+                    if column in b.column_names() and b.num_rows > 0:
+                        series = b.get_column(column)
+                        # cache on the Series (immutable): repeated optimizes
+                        # of queries over resident tables sample exactly once
+                        cache = getattr(series, "_device_cache", None)
+                        if cache is None:
+                            cache = {}
+                            object.__setattr__(series, "_device_cache", cache)
+                        k = cache.get(("distinct_est",))
+                        if k is None:
+                            s = series.head(_DISTINCT_SAMPLE)
+                            try:
+                                import numpy as np
+
+                                k = float(len(np.unique(s.to_numpy())))
+                            except Exception:
+                                k = float(len(set(s.to_pylist())))
+                            n = b.num_rows
+                            if n > _DISTINCT_SAMPLE and k > _DISTINCT_SAMPLE / 2:
+                                k = k * (n / _DISTINCT_SAMPLE)
+                            cache[("distinct_est",)] = k
+                        return min(k, rows) if rows is not None else k
+            return None
+        children = src.children()
+        if len(children) == 1 and column in children[0].schema.column_names():
+            src = children[0]
+            continue
+        return None
+
+
+def estimate_join_result(left_rows: float, right_rows: float,
+                         v_left: Optional[float], v_right: Optional[float]) -> float:
+    """Selinger equi-join estimate: |L||R| / max(V(L,a), V(R,b)); unknown V
+    falls back to the unique-key (FK) assumption on that side."""
+    vl = v_left if v_left is not None else left_rows
+    vr = v_right if v_right is not None else right_rows
+    denom = max(vl, vr, 1.0)
+    return max(left_rows * right_rows / denom, 1.0)

@@ -161,7 +161,9 @@ def test_distributed_join_matches_native(dist_runner):
                 .sort("name"))
 
     got, expect = _run_both(q, dist_runner)
-    assert got == expect
+    assert got["name"] == expect["name"]
+    # summation order differs across partitionings (broadcast vs shuffle)
+    np.testing.assert_allclose(got["sx"], expect["sx"], rtol=1e-9)
 
 
 def test_distributed_tpch_q5_shape(dist_runner):
