@@ -722,6 +722,9 @@ def _agg_sum_dtype(dt: DataType) -> DataType:
         return dt
     if dt.is_boolean():
         return DataType.uint64()
+    if dt.is_null():
+        # a column with no typed values (empty / all-null input) sums to null
+        return DataType.null()
     raise ValueError(f"cannot sum dtype {dt}")
 
 

@@ -263,3 +263,201 @@ def test_all_queries_run(tables):
     for i, q in ALL_QUERIES.items():
         out = q(tables).to_pydict()
         assert isinstance(out, dict), f"Q{i}"
+
+
+def test_q2(tables, pdf):
+    out = ALL_QUERIES[2](tables).to_pydict()
+    P, S, PS, N, R = pdf["part"], pdf["supplier"], pdf["partsupp"], pdf["nation"], pdf["region"]
+    europe = (R[R.r_name == "EUROPE"]
+              .merge(N, left_on="r_regionkey", right_on="n_regionkey")
+              .merge(S, left_on="n_nationkey", right_on="s_nationkey")
+              .merge(PS, left_on="s_suppkey", right_on="ps_suppkey"))
+    brass = P[(P.p_size == 15) & P.p_type.str.endswith("BRASS")]
+    merged = europe.merge(brass, left_on="ps_partkey", right_on="p_partkey")
+    min_cost = (merged.groupby("ps_partkey", as_index=False)
+                .agg(min_cost=("ps_supplycost", "min")))
+    res = merged.merge(min_cost, on="ps_partkey")
+    res = res[res.ps_supplycost == res.min_cost]
+    res = res.drop(columns=["p_partkey"]).rename(columns={"ps_partkey": "p_partkey"})[
+        ["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr", "s_address",
+         "s_phone", "s_comment"]]
+    res = res.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                          ascending=[False, True, True, True]).head(100)
+    assert_frame_matches(out, res.reset_index(drop=True))
+
+
+def test_q8(tables, pdf):
+    out = ALL_QUERIES[8](tables).to_pydict()
+    P, S, L, O, C, N, R = (pdf["part"], pdf["supplier"], pdf["lineitem"], pdf["orders"],
+                           pdf["customer"], pdf["nation"], pdf["region"])
+    n1 = N[["n_nationkey", "n_regionkey"]].rename(
+        columns={"n_nationkey": "cust_nationkey", "n_regionkey": "cust_regionkey"})
+    n2 = N[["n_nationkey", "n_name"]].rename(
+        columns={"n_nationkey": "supp_nationkey", "n_name": "supp_nation"})
+    f = (P[P.p_type == "ECONOMY ANODIZED STEEL"]
+         .merge(L, left_on="p_partkey", right_on="l_partkey")
+         .merge(S, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(O, left_on="l_orderkey", right_on="o_orderkey"))
+    f = f[(f.o_orderdate >= datetime.date(1995, 1, 1)) & (f.o_orderdate <= datetime.date(1996, 12, 31))]
+    f = (f.merge(C, left_on="o_custkey", right_on="c_custkey")
+         .merge(n1, left_on="c_nationkey", right_on="cust_nationkey"))
+    f = f.merge(R[R.r_name == "AMERICA"], left_on="cust_regionkey", right_on="r_regionkey")
+    f = f.merge(n2, left_on="s_nationkey", right_on="supp_nationkey")
+    f["o_year"] = pd.to_datetime(f.o_orderdate).dt.year
+    f["volume"] = f.l_extendedprice * (1 - f.l_discount)
+    f["brazil_volume"] = np.where(f.supp_nation == "BRAZIL", f.volume, 0.0)
+    g = f.groupby("o_year", as_index=False).agg(
+        brazil=("brazil_volume", "sum"), total=("volume", "sum"))
+    g["mkt_share"] = g.brazil / g.total
+    expected = g[["o_year", "mkt_share"]].sort_values("o_year").reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q9(tables, pdf):
+    out = ALL_QUERIES[9](tables).to_pydict()
+    P, S, L, PS, O, N = (pdf["part"], pdf["supplier"], pdf["lineitem"], pdf["partsupp"],
+                         pdf["orders"], pdf["nation"])
+    f = (P[P.p_name.str.contains("green")]
+         .merge(L, left_on="p_partkey", right_on="l_partkey")
+         .merge(S, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(PS, left_on=["l_suppkey", "p_partkey"], right_on=["ps_suppkey", "ps_partkey"])
+         .merge(O, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(N, left_on="s_nationkey", right_on="n_nationkey"))
+    f["o_year"] = pd.to_datetime(f.o_orderdate).dt.year
+    f["amount"] = f.l_extendedprice * (1 - f.l_discount) - f.ps_supplycost * f.l_quantity
+    g = (f.rename(columns={"n_name": "nation"})
+         .groupby(["nation", "o_year"], as_index=False)
+         .agg(sum_profit=("amount", "sum")))
+    expected = g.sort_values(["nation", "o_year"], ascending=[True, False]).reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q11(tables, pdf):
+    out = ALL_QUERIES[11](tables).to_pydict()
+    PS, S, N = pdf["partsupp"], pdf["supplier"], pdf["nation"]
+    g = (N[N.n_name == "GERMANY"]
+         .merge(S, left_on="n_nationkey", right_on="s_nationkey")
+         .merge(PS, left_on="s_suppkey", right_on="ps_suppkey"))
+    g["value"] = g.ps_supplycost * g.ps_availqty
+    total = g.value.sum()
+    by_part = g.groupby("ps_partkey", as_index=False).agg(value=("value", "sum"))
+    expected = by_part[by_part.value > total * 0.0001][["ps_partkey", "value"]]
+    expected = expected.sort_values(["value", "ps_partkey"],
+                                    ascending=[False, True]).reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q13(tables, pdf):
+    out = ALL_QUERIES[13](tables).to_pydict()
+    C, O = pdf["customer"], pdf["orders"]
+    filtered = O[~O.o_comment.str.contains("special requests")]
+    m = C.merge(filtered, left_on="c_custkey", right_on="o_custkey", how="left")
+    per_cust = m.groupby("c_custkey", as_index=False).agg(c_count=("o_orderkey", "count"))
+    g = per_cust.groupby("c_count", as_index=False).agg(custdist=("c_custkey", "count"))
+    expected = g.sort_values(["custdist", "c_count"],
+                             ascending=[False, False]).reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q15(tables, pdf):
+    out = ALL_QUERIES[15](tables).to_pydict()
+    L, S = pdf["lineitem"], pdf["supplier"]
+    f = L[(L.l_shipdate >= datetime.date(1996, 1, 1)) & (L.l_shipdate < datetime.date(1996, 4, 1))].copy()
+    f["rev"] = f.l_extendedprice * (1 - f.l_discount)
+    rev = (f.groupby("l_suppkey", as_index=False).agg(total_revenue=("rev", "sum"))
+           .rename(columns={"l_suppkey": "supplier_no"}))
+    top = rev[rev.total_revenue == rev.total_revenue.max()]
+    expected = (top.merge(S, left_on="supplier_no", right_on="s_suppkey")
+                .rename(columns={"supplier_no": "s_suppkey2"}))
+    expected = expected.assign(s_suppkey=expected.s_suppkey2)[
+        ["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]]
+    expected = expected.sort_values("s_suppkey").reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q16(tables, pdf):
+    out = ALL_QUERIES[16](tables).to_pydict()
+    PS, P, S = pdf["partsupp"], pdf["part"], pdf["supplier"]
+    complainers = S[S.s_comment.str.contains("Customer Complaints")].s_suppkey
+    f = P[(P.p_brand != "Brand#45")
+          & ~P.p_type.str.startswith("MEDIUM POLISHED")
+          & P.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    f = f.merge(PS, left_on="p_partkey", right_on="ps_partkey")
+    f = f[~f.ps_suppkey.isin(complainers)]
+    f = f.drop_duplicates(["p_brand", "p_type", "p_size", "ps_suppkey"])
+    g = (f.groupby(["p_brand", "p_type", "p_size"], as_index=False)
+         .agg(supplier_cnt=("ps_suppkey", "count")))
+    expected = g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                             ascending=[False, True, True, True]).reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q18_full(tables, pdf):
+    out = ALL_QUERIES[18](tables).to_pydict()
+    C, O, L = pdf["customer"], pdf["orders"], pdf["lineitem"]
+    big = (L.groupby("l_orderkey", as_index=False).agg(sum_qty=("l_quantity", "sum")))
+    big = big[big.sum_qty > 300].l_orderkey
+    f = O[O.o_orderkey.isin(big)]
+    f = f.merge(C, left_on="o_custkey", right_on="c_custkey")
+    f = f.merge(L, left_on="o_orderkey", right_on="l_orderkey")
+    g = (f.rename(columns={"o_custkey": "c_custkey2"})
+         .groupby(["c_name", "c_custkey2", "o_orderkey", "o_orderdate", "o_totalprice"],
+                  as_index=False)
+         .agg(col6=("l_quantity", "sum"))
+         .rename(columns={"c_custkey2": "c_custkey"}))
+    expected = (g.sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+                .head(100).reset_index(drop=True))
+    assert_frame_matches(out, expected)
+
+
+def test_q20(tables, pdf):
+    out = ALL_QUERIES[20](tables).to_pydict()
+    S, N, PS, P, L = pdf["supplier"], pdf["nation"], pdf["partsupp"], pdf["part"], pdf["lineitem"]
+    forest = P[P.p_name.str.startswith("forest")].p_partkey
+    f = L[(L.l_shipdate >= datetime.date(1994, 1, 1)) & (L.l_shipdate < datetime.date(1995, 1, 1))]
+    shipped = (f.groupby(["l_partkey", "l_suppkey"], as_index=False)
+               .agg(total_shipped=("l_quantity", "sum")))
+    q = PS[PS.ps_partkey.isin(forest)].merge(
+        shipped, left_on=["ps_partkey", "ps_suppkey"], right_on=["l_partkey", "l_suppkey"])
+    q = q[q.ps_availqty > 0.5 * q.total_shipped]
+    canada = N[N.n_name == "CANADA"].n_nationkey
+    expected = S[S.s_suppkey.isin(q.ps_suppkey) & S.s_nationkey.isin(canada)][
+        ["s_name", "s_address"]].sort_values("s_name").reset_index(drop=True)
+    assert_frame_matches(out, expected)
+
+
+def test_q21(tables, pdf):
+    out = ALL_QUERIES[21](tables).to_pydict()
+    S, L, O, N = pdf["supplier"], pdf["lineitem"], pdf["orders"], pdf["nation"]
+    late = L[L.l_receiptdate > L.l_commitdate]
+    multi = L.groupby("l_orderkey")["l_suppkey"].nunique()
+    multi = set(multi[multi > 1].index)
+    single_late = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    single_late = set(single_late[single_late == 1].index)
+    f_orders = set(O[O.o_orderstatus == "F"].o_orderkey)
+    f = late[late.l_orderkey.isin(f_orders)
+             & late.l_orderkey.isin(multi)
+             & late.l_orderkey.isin(single_late)]
+    f = f.merge(S, left_on="l_suppkey", right_on="s_suppkey")
+    saudi = set(N[N.n_name == "SAUDI ARABIA"].n_nationkey)
+    f = f[f.s_nationkey.isin(saudi)]
+    g = f.groupby("s_name", as_index=False).agg(numwait=("l_orderkey", "count"))
+    expected = (g.sort_values(["numwait", "s_name"], ascending=[False, True])
+                .head(100).reset_index(drop=True))
+    assert_frame_matches(out, expected)
+
+
+def test_q22(tables, pdf):
+    out = ALL_QUERIES[22](tables).to_pydict()
+    C, O = pdf["customer"], pdf["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    c = C.copy()
+    c["cntrycode"] = c.c_phone.str[:2]
+    eligible = c[c.cntrycode.isin(codes)]
+    avg_bal = eligible[eligible.c_acctbal > 0.0].c_acctbal.mean()
+    no_orders = eligible[~eligible.c_custkey.isin(O.o_custkey)]
+    f = no_orders[no_orders.c_acctbal > avg_bal]
+    g = f.groupby("cntrycode", as_index=False).agg(
+        numcust=("c_acctbal", "count"), totacctbal=("c_acctbal", "sum"))
+    expected = g.sort_values("cntrycode").reset_index(drop=True)
+    assert_frame_matches(out, expected)
