@@ -22,6 +22,7 @@ import sys
 import tempfile
 import traceback
 import uuid
+from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Dict, List, Optional
 
@@ -56,7 +57,8 @@ def _worker_loop(conn, worker_id: str) -> None:
 
 def main(argv: List[str]) -> None:
     address, worker_id = argv[0], argv[1]
-    conn = Client(address, family="AF_UNIX")
+    authkey = bytes.fromhex(os.environ["DAFT_TPU_WORKER_AUTHKEY"])
+    conn = Client(address, family="AF_UNIX", authkey=authkey)
     try:
         _worker_loop(conn, worker_id)
     finally:
@@ -91,6 +93,8 @@ class WorkerProcess:
             try:
                 self._conn = listener.accept()
                 break
+            except AuthenticationError:
+                continue  # stranger knocked; keep waiting for the real worker
             except (TimeoutError, OSError):
                 rc = self._proc.poll()
                 if rc is not None:
@@ -156,7 +160,12 @@ class WorkerPool:
                  env: Optional[Dict[str, str]] = None):
         sock = os.path.join(tempfile.gettempdir(),
                             f"daft_tpu_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock")
-        self._listener = Listener(sock, family="AF_UNIX")
+        # HMAC-authenticated socket: only processes holding the per-pool
+        # secret (passed via the child environment) can deliver pickles
+        authkey = os.urandom(32)
+        self._listener = Listener(sock, family="AF_UNIX", authkey=authkey)
+        env = dict(env or {})
+        env["DAFT_TPU_WORKER_AUTHKEY"] = authkey.hex()
         self.workers: Dict[str, WorkerProcess] = {}
         for i in range(num_workers):
             wid = f"worker-{i}"

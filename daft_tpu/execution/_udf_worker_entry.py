@@ -1,0 +1,9 @@
+"""UDF worker subprocess entry point (separate module so ``python -m`` does
+not re-execute anything the package already imported)."""
+
+import sys
+
+if __name__ == "__main__":
+    from daft_tpu.execution.udf_process import worker_main
+
+    worker_main(sys.argv[1:])

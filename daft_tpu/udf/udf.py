@@ -131,10 +131,20 @@ class _ClsInstance:
         return self._obj
 
     def __getattr__(self, name: str):
-        cached = self._method_funcs.get(name)
+        # guard against recursion during unpickling (cloudpickle reconstructs
+        # the object before __dict__ exists, so the proxy's OWN internals must
+        # fail fast here); user underscore-named methods still resolve
+        if name.startswith("__"):
+            raise AttributeError(name)
+        try:
+            method_funcs = object.__getattribute__(self, "_method_funcs")
+            wrapper = object.__getattribute__(self, "_wrapper")
+        except AttributeError:
+            raise AttributeError(name) from None
+        cached = method_funcs.get(name)
         if cached is not None:
             return cached
-        target = getattr(self._wrapper._klass, name, None)
+        target = getattr(wrapper._klass, name, None)
         if target is None:
             raise AttributeError(name)
         if not callable(target):

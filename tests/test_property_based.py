@@ -87,6 +87,8 @@ def test_groupby_sum_count_matches_pandas(case):
         assert gc == ec, (k, got[k], expect[k])
         if es is None:
             assert gs is None
+        elif es != es:  # NaN (e.g. inf + -inf): both sides must agree
+            assert gs != gs
         else:
             assert gs == pytest.approx(es, rel=1e-9, abs=1e-9)
 
