@@ -45,6 +45,35 @@ class DataFrame:
             s += "\n\n== Physical Plan ==\n" + translate(opt.plan).display()
         return s
 
+    def explain_analyze(self) -> str:
+        """Execute the plan through the configured runner collecting
+        per-operator runtime stats; returns the plans plus an operator table
+        (rows out / batches / self time) — reference: EXPLAIN ANALYZE over
+        runtime_stats."""
+        import time
+
+        from ..observability.runtime_stats import (StatsCollector,
+                                                   current_collector,
+                                                   format_stats, set_collector)
+        from ..plan.physical import translate
+        from ..runners import get_or_create_runner
+
+        optimized = self._builder.optimize()
+        phys = translate(optimized.plan)
+        collector = StatsCollector()
+        prev = current_collector()
+        set_collector(collector)
+        t0 = time.perf_counter()
+        try:
+            for _ in get_or_create_runner().run_iter(self._builder):
+                pass
+        finally:
+            set_collector(prev)
+        total = time.perf_counter() - t0
+        return ("== Physical Plan ==\n" + phys.display()
+                + "\n\n== Runtime Stats ==\n"
+                + format_stats(collector.finish(), total))
+
     def _next(self, builder: LogicalPlanBuilder) -> "DataFrame":
         return DataFrame(builder)
 

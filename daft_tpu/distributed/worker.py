@@ -68,7 +68,7 @@ def main(argv: List[str]) -> None:
 class WorkerProcess:
     """Handle to one worker subprocess (the WorkerHandle the scheduler targets)."""
 
-    def __init__(self, worker_id: str, listener: Listener, slots: int = 1,
+    def __init__(self, worker_id: str, acceptor, address: str, slots: int = 1,
                  env: Optional[Dict[str, str]] = None):
         self.worker_id = worker_id
         self.slots = slots
@@ -82,30 +82,45 @@ class WorkerProcess:
         child_env.update(env or {})
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "daft_tpu.distributed._worker_entry",
-             listener.address, worker_id],
+             address, worker_id],
             env=child_env)
-        # accept with a liveness check: a child that crashes on startup must
-        # raise here, not hang the driver forever in accept()
-        sock = listener._listener._socket  # noqa: SLF001 — stdlib has no accept timeout API
-        sock.settimeout(0.5)
+        # accept with a liveness check and a hard deadline: a child that
+        # crashes on startup (or a stranger stalling the auth handshake) must
+        # never hang the driver in accept()
+        # the acceptor is shared pool-wide, so an accepted connection may
+        # belong to a sibling worker — route by the hello's worker id
+        routed = getattr(acceptor, "routed_hellos", None)
+        if routed is None:
+            routed = {}
+            acceptor.routed_hellos = routed
         deadline = 60.0
-        while True:
-            try:
-                self._conn = listener.accept()
+        self._conn = None
+        while self._conn is None:
+            if worker_id in routed:
+                self._conn = routed.pop(worker_id)
                 break
+            try:
+                conn = acceptor.accept(0.5)
             except AuthenticationError:
-                continue  # stranger knocked; keep waiting for the real worker
-            except (TimeoutError, OSError):
-                rc = self._proc.poll()
-                if rc is not None:
-                    raise RuntimeError(
-                        f"worker {worker_id} exited with code {rc} before connecting")
-                deadline -= 0.5
-                if deadline <= 0:
-                    self._proc.terminate()
-                    raise RuntimeError(f"worker {worker_id} never connected (60s)")
-        hello = self._conn.recv()
-        assert hello == ("hello", worker_id), hello
+                conn = None  # stranger with the wrong key; keep waiting
+            if conn is not None:
+                if not conn.poll(30):
+                    raise RuntimeError(f"worker connection never sent hello")
+                hello = conn.recv()
+                assert hello[0] == "hello", hello
+                if hello[1] == worker_id:
+                    self._conn = conn
+                else:
+                    routed[hello[1]] = conn
+                continue
+            rc = self._proc.poll()
+            if rc is not None:
+                raise RuntimeError(
+                    f"worker {worker_id} exited with code {rc} before connecting")
+            deadline -= 0.5
+            if deadline <= 0:
+                self._proc.terminate()
+                raise RuntimeError(f"worker {worker_id} never connected (60s)")
         self.inflight: Dict[str, SubPlanTask] = {}
 
     def submit(self, task: SubPlanTask) -> None:
@@ -166,10 +181,13 @@ class WorkerPool:
         self._listener = Listener(sock, family="AF_UNIX", authkey=authkey)
         env = dict(env or {})
         env["DAFT_TPU_WORKER_AUTHKEY"] = authkey.hex()
+        from ..utils.sockets import DeadlineAcceptor
+
+        acceptor = DeadlineAcceptor(self._listener)
         self.workers: Dict[str, WorkerProcess] = {}
         for i in range(num_workers):
             wid = f"worker-{i}"
-            self.workers[wid] = WorkerProcess(wid, self._listener,
+            self.workers[wid] = WorkerProcess(wid, acceptor, sock,
                                               slots_per_worker, env=env)
 
     def run_tasks(self, tasks: List[SubPlanTask]) -> Dict[str, TaskResult]:

@@ -34,6 +34,17 @@ def execute_plan(plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
 
 
 def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+    """Dispatch one physical node; wraps its stream with per-operator runtime
+    stats when a collector is active (subscribers / explain_analyze), else the
+    zero-overhead direct generator."""
+    from ..observability.runtime_stats import current_collector
+
+    c = current_collector()
+    gen = _exec_impl(node)
+    return c.wrap(node, gen) if c is not None else gen
+
+
+def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
     if isinstance(node, pp.InMemoryScan):
         yield from node.partitions
         return

@@ -26,6 +26,8 @@ import traceback
 import uuid
 from multiprocessing import AuthenticationError as mp_AuthenticationError
 from multiprocessing.connection import Client, Listener
+
+from ..utils.sockets import DeadlineAcceptor
 from typing import Any, Dict, List, Optional, Tuple
 
 _POOLS: Dict[int, "UdfProcessPool"] = {}
@@ -127,33 +129,51 @@ class UdfProcessPool:
             for _ in range(n)
         ]
         self.workers: List[Tuple[Any, Any]] = []  # (Popen, conn)
-        lsock = self._listener._listener._socket  # noqa: SLF001 — no accept-timeout API
-        lsock.settimeout(0.5)
+        self._closed = False
+        by_pid = {p.pid: p for p in procs}
+
+        def _cleanup_and_raise(msg):
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            raise RuntimeError(msg)
+
         conns = []
+        acceptor = DeadlineAcceptor(self._listener)
         deadline = 120.0
         while len(conns) < n:
             try:
-                conns.append(self._listener.accept())
+                conn = acceptor.accept(0.5)
             except mp_AuthenticationError:
-                continue  # stranger knocked; keep waiting for real workers
-            except (TimeoutError, OSError):
-                dead = [p for p in procs if p.poll() is not None]
-                if len(dead) > n - len(conns) - 1:
-                    for p in procs:
-                        if p.poll() is None:
-                            p.terminate()
-                    raise RuntimeError(
-                        f"UDF worker for {func.name!r} exited with "
-                        f"code {dead[0].returncode} before connecting")
-                deadline -= 0.5
-                if deadline <= 0:
-                    for p in procs:
-                        p.terminate()
-                    raise RuntimeError("UDF workers never connected (120s)")
-        for proc, conn in zip(procs, conns):
-            hello = conn.recv()
-            assert hello[0] == "hello", hello
-            conn.send(("init", blob))
+                conn = None  # stranger with the wrong key
+            if conn is not None:
+                conns.append(conn)
+                continue
+            dead = [p for p in procs if p.poll() is not None]
+            if len(dead) > n - len(conns) - 1:
+                _cleanup_and_raise(
+                    f"UDF worker for {func.name!r} exited with "
+                    f"code {dead[0].returncode} before connecting")
+            deadline -= 0.5
+            if deadline <= 0:
+                _cleanup_and_raise("UDF workers never connected (120s)")
+        for conn in conns:
+            try:
+                if not conn.poll(30):
+                    _cleanup_and_raise("UDF worker never sent hello")
+                hello = conn.recv()
+                assert hello[0] == "hello", hello
+                conn.send(("init", blob))
+            except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                _cleanup_and_raise(
+                    f"UDF worker for {func.name!r} died during handshake")
+            # pair connection with ITS process via the hello pid (accept order
+            # is arrival order, not spawn order)
+            proc = by_pid.get(hello[1])
             self.workers.append((proc, conn))
         self._rr = itertools.cycle(range(n))
         self._locks = [threading.Lock() for _ in range(n)]
@@ -166,7 +186,7 @@ class UdfProcessPool:
         i = next(self._rr)
         p, conn = self.workers[i]
         with self._locks[i]:
-            if p.poll() is not None:
+            if p is not None and p.poll() is not None:
                 raise RuntimeError(f"UDF worker process for {self.func.name!r} died")
             try:
                 conn.send((
@@ -177,9 +197,10 @@ class UdfProcessPool:
                 ))
                 status, payload = conn.recv()
             except (EOFError, BrokenPipeError, ConnectionError, OSError) as e:
-                # segfault/OOM-kill mid-batch: surface WHICH udf died, and mark
-                # the pool dead so the next dispatch builds a fresh one
-                self.alive = False
+                # segfault/OOM-kill mid-batch: surface WHICH udf died; tear the
+                # whole pool down (surviving workers, listener, socket) so the
+                # next dispatch builds a fresh one with nothing leaked
+                self.shutdown()
                 raise RuntimeError(
                     f"UDF worker for {self.func.name!r} died mid-batch "
                     f"(crash in the UDF or native code?): {e}") from e
@@ -188,8 +209,9 @@ class UdfProcessPool:
         return payload
 
     def shutdown(self) -> None:
-        if not self.alive:
+        if self._closed:
             return
+        self._closed = True
         self.alive = False
         for p, conn in self.workers:
             try:
@@ -198,6 +220,8 @@ class UdfProcessPool:
             except Exception:
                 pass
         for p, _ in self.workers:
+            if p is None:
+                continue
             try:
                 p.wait(timeout=2)
             except subprocess.TimeoutExpired:

@@ -1,0 +1,35 @@
+"""Observability: query lifecycle events, per-operator runtime stats, EXPLAIN.
+
+Reference parity: daft/subscribers/abc.py:28 (Subscriber ABC with query
+lifecycle callbacks), src/common/metrics/src/ops.rs (per-operator metrics
+vocabulary), daft-local-execution/src/runtime_stats (rows/time per node).
+"""
+
+from .events import (
+    OperatorStats,
+    QueryEnd,
+    QueryOptimized,
+    QueryStart,
+)
+from .subscribers import (
+    Subscriber,
+    attach_subscriber,
+    detach_subscriber,
+    notify,
+    subscribers_active,
+)
+from .runtime_stats import StatsCollector, current_collector
+
+__all__ = [
+    "OperatorStats",
+    "QueryEnd",
+    "QueryOptimized",
+    "QueryStart",
+    "Subscriber",
+    "attach_subscriber",
+    "detach_subscriber",
+    "notify",
+    "subscribers_active",
+    "StatsCollector",
+    "current_collector",
+]

@@ -1,0 +1,41 @@
+"""Query lifecycle event payloads (reference: daft/subscribers/events.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class QueryStart:
+    query_id: str
+    unoptimized_plan: str
+
+
+@dataclass(frozen=True)
+class QueryOptimized:
+    query_id: str
+    optimized_plan: str
+    physical_plan: str
+    optimize_seconds: float
+
+
+@dataclass(frozen=True)
+class OperatorStats:
+    """Per-physical-operator runtime metrics for one query execution."""
+
+    node_id: int
+    name: str
+    rows_out: int
+    batches_out: int
+    seconds: float        # wall time attributed to this operator (self time)
+    detail: str = ""
+
+
+@dataclass(frozen=True)
+class QueryEnd:
+    query_id: str
+    rows: int
+    seconds: float
+    error: Optional[str] = None
+    operator_stats: List[OperatorStats] = field(default_factory=list)

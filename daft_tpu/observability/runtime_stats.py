@@ -1,0 +1,85 @@
+"""Per-operator runtime statistics (reference:
+daft-local-execution/src/runtime_stats — rows/CPU per pipeline node feeding
+progress bars, subscribers, and EXPLAIN ANALYZE).
+
+The executor asks current_collector() per query; when a collector is active
+(subscribers attached or explain_analyze running) every physical node's
+output iterator is wrapped to count rows/batches and attribute self-time.
+When inactive the executor takes its zero-overhead path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .events import OperatorStats
+
+_local = threading.local()
+
+
+class StatsCollector:
+    def __init__(self) -> None:
+        # node_id -> [name, rows, batches, total_seconds, child_seconds]
+        self._nodes: Dict[int, list] = {}
+
+    def wrap(self, node, iterator):
+        """Wrap one operator's output iterator with row/time accounting.
+
+        Attributed time is SELF time: total time blocked in this operator's
+        next() minus time its direct children spent producing for it.
+        """
+        nid = id(node)
+        entry = self._nodes.setdefault(nid, [node.name(), 0, 0, 0.0, 0.0])
+
+        def gen():
+            while True:
+                t0 = time.perf_counter()
+                prev = getattr(_local, "active", None)
+                _local.active = nid
+                try:
+                    part = next(iterator)
+                except StopIteration:
+                    _local.active = prev
+                    dt = time.perf_counter() - t0
+                    entry[3] += dt
+                    if prev is not None and prev in self._nodes:
+                        self._nodes[prev][4] += dt
+                    return
+                finally:
+                    _local.active = prev
+                dt = time.perf_counter() - t0
+                entry[3] += dt
+                if prev is not None and prev in self._nodes:
+                    self._nodes[prev][4] += dt
+                entry[1] += part.num_rows
+                entry[2] += 1
+                yield part
+
+        return gen()
+
+    def finish(self) -> List[OperatorStats]:
+        out = []
+        for nid, (name, rows, batches, total, child) in self._nodes.items():
+            out.append(OperatorStats(
+                node_id=nid, name=name, rows_out=rows, batches_out=batches,
+                seconds=max(total - child, 0.0)))
+        return out
+
+
+def current_collector() -> Optional[StatsCollector]:
+    return getattr(_local, "collector", None)
+
+
+def set_collector(c: Optional[StatsCollector]) -> None:
+    _local.collector = c
+
+
+def format_stats(stats: List[OperatorStats], total_seconds: float) -> str:
+    lines = [f"{'operator':<24} {'rows out':>12} {'batches':>8} {'self time':>10}"]
+    for s in sorted(stats, key=lambda s: -s.seconds):
+        lines.append(f"{s.name:<24} {s.rows_out:>12} {s.batches_out:>8} "
+                     f"{s.seconds * 1000:>8.1f}ms")
+    lines.append(f"{'TOTAL':<24} {'':>12} {'':>8} {total_seconds * 1000:>8.1f}ms")
+    return "\n".join(lines)

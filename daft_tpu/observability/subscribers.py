@@ -1,0 +1,59 @@
+"""Subscriber registry (reference: daft/subscribers/abc.py:28 + the Rust
+Subscriber trait in daft-context/src/subscribers/).
+
+Attach a Subscriber to receive query lifecycle events from every runner in
+the process. Callbacks must not raise; exceptions are swallowed so a broken
+subscriber can never fail a query.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+
+
+class Subscriber:
+    """Override any subset of the lifecycle callbacks."""
+
+    def on_query_start(self, event: QueryStart) -> None:  # pragma: no cover
+        pass
+
+    def on_query_optimized(self, event: QueryOptimized) -> None:  # pragma: no cover
+        pass
+
+    def on_operator_stats(self, query_id: str, stats: OperatorStats) -> None:  # pragma: no cover
+        pass
+
+    def on_query_end(self, event: QueryEnd) -> None:  # pragma: no cover
+        pass
+
+
+_SUBSCRIBERS: List[Subscriber] = []
+_LOCK = threading.Lock()
+
+
+def attach_subscriber(sub: Subscriber) -> None:
+    with _LOCK:
+        _SUBSCRIBERS.append(sub)
+
+
+def detach_subscriber(sub: Subscriber) -> None:
+    with _LOCK:
+        if sub in _SUBSCRIBERS:
+            _SUBSCRIBERS.remove(sub)
+
+
+def subscribers_active() -> bool:
+    return bool(_SUBSCRIBERS)
+
+
+def notify(method: str, *args) -> None:
+    with _LOCK:
+        subs = list(_SUBSCRIBERS)
+    for s in subs:
+        try:
+            getattr(s, method)(*args)
+        except Exception:
+            pass  # a broken subscriber must never fail the query

@@ -21,9 +21,60 @@ class Runner:
 
 class NativeRunner(Runner):
     def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
+        import time
+        import uuid
+
         from ..execution.executor import execute_plan
+        from ..observability import (QueryEnd, QueryOptimized, QueryStart,
+                                     notify, subscribers_active)
+        from ..observability.runtime_stats import StatsCollector, set_collector
         from ..plan.physical import translate
 
+        observed = subscribers_active()
+        qid = uuid.uuid4().hex[:12] if observed else ""
+        t_start = time.perf_counter()
+        if observed:
+            notify("on_query_start", QueryStart(qid, builder.plan.display()))
+        t0 = time.perf_counter()
         optimized = builder.optimize()
         phys = translate(optimized.plan)
-        yield from execute_plan(phys)
+        if observed:
+            notify("on_query_optimized", QueryOptimized(
+                qid, optimized.plan.display(), phys.display(),
+                time.perf_counter() - t0))
+        from ..observability.runtime_stats import current_collector
+
+        # inherit any ambient collector (explain_analyze routes through the
+        # runner); save/restore around every pull so interleaved queries on
+        # one thread never clobber each other's stats
+        prev = current_collector()
+        collector = StatsCollector() if observed else prev
+        rows = 0
+        err: str = None
+        try:
+            set_collector(collector)
+            try:
+                stream = execute_plan(phys)
+            finally:
+                set_collector(prev)
+            while True:
+                set_collector(collector)
+                try:
+                    part = next(stream)
+                except StopIteration:
+                    break
+                finally:
+                    set_collector(prev)
+                rows += part.num_rows
+                yield part
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            set_collector(prev)
+            if observed:
+                stats = collector.finish() if collector else []
+                for s in stats:
+                    notify("on_operator_stats", qid, s)
+                notify("on_query_end", QueryEnd(
+                    qid, rows, time.perf_counter() - t_start, err, stats))

@@ -1,0 +1,123 @@
+"""Observability: subscriber lifecycle events, per-operator runtime stats,
+EXPLAIN ANALYZE (reference: tests/test_subscribers.py / test_events.py)."""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.observability import (
+    OperatorStats,
+    QueryEnd,
+    QueryOptimized,
+    QueryStart,
+    Subscriber,
+    attach_subscriber,
+    detach_subscriber,
+)
+
+
+class Recorder(Subscriber):
+    def __init__(self):
+        self.events = []
+
+    def on_query_start(self, e):
+        self.events.append(("start", e))
+
+    def on_query_optimized(self, e):
+        self.events.append(("optimized", e))
+
+    def on_operator_stats(self, qid, s):
+        self.events.append(("op", s))
+
+    def on_query_end(self, e):
+        self.events.append(("end", e))
+
+
+@pytest.fixture
+def recorder():
+    r = Recorder()
+    attach_subscriber(r)
+    yield r
+    detach_subscriber(r)
+
+
+def test_event_sequence_and_contents(recorder):
+    df = daft_tpu.from_pydict({"a": list(range(100)), "b": ["x", "y"] * 50})
+    out = df.where(col("a") >= 50).select("a").to_pydict()
+    assert len(out["a"]) == 50
+
+    kinds = [k for k, _ in recorder.events]
+    assert kinds[0] == "start"
+    assert kinds[1] == "optimized"
+    assert kinds[-1] == "end"
+    assert "op" in kinds
+
+    start = recorder.events[0][1]
+    assert isinstance(start, QueryStart) and start.query_id
+    optimized = recorder.events[1][1]
+    assert isinstance(optimized, QueryOptimized)
+    assert "Filter" in start.unoptimized_plan
+    assert optimized.physical_plan  # physical display present
+    end = recorder.events[-1][1]
+    assert isinstance(end, QueryEnd)
+    assert end.rows == 50
+    assert end.error is None
+    assert end.query_id == start.query_id
+    # operator stats cover the pipeline with real row counts
+    ops = {s.name: s for k, s in recorder.events if k == "op"}
+    assert any(s.rows_out == 50 for s in ops.values()), ops
+
+
+def test_error_reported_in_query_end(recorder):
+    df = daft_tpu.from_pydict({"a": [1, 2, 3]})
+
+    @daft_tpu.func
+    def boom(x: int) -> int:
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        df.select(boom(col("a"))).to_pydict()
+    end = recorder.events[-1][1]
+    assert isinstance(end, QueryEnd)
+    assert end.error is not None and "nope" in end.error or "ValueError" in end.error
+
+
+def test_broken_subscriber_never_fails_query():
+    class Broken(Subscriber):
+        def on_query_start(self, e):
+            raise RuntimeError("subscriber bug")
+
+    b = Broken()
+    attach_subscriber(b)
+    try:
+        out = daft_tpu.from_pydict({"a": [1]}).to_pydict()
+        assert out == {"a": [1]}
+    finally:
+        detach_subscriber(b)
+
+
+def test_no_subscribers_no_overhead_path():
+    """Without subscribers the collector stays None (zero-overhead path)."""
+    from daft_tpu.observability.runtime_stats import current_collector
+
+    daft_tpu.from_pydict({"a": [1, 2]}).where(col("a") > 1).to_pydict()
+    assert current_collector() is None
+
+
+def test_explain_analyze_reports_operators():
+    rng = np.random.default_rng(0)
+    df = daft_tpu.from_pydict({
+        "k": rng.choice(["a", "b", "c"], 10_000).tolist(),
+        "v": rng.uniform(0, 1, 10_000).tolist(),
+    })
+    report = (df.where(col("v") > 0.5)
+              .groupby("k").agg(col("v").sum().alias("s"))
+              .sort("k")
+              .explain_analyze())
+    assert "== Physical Plan ==" in report
+    assert "== Runtime Stats ==" in report
+    assert "rows out" in report
+    assert "PhysSort" in report or "Sort" in report
+    # the final sort emits exactly 3 groups
+    assert " 3 " in report or "3" in report
