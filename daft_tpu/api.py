@@ -89,6 +89,20 @@ def read_json(path: Union[str, List[str]], **options) -> DataFrame:
     return DataFrame(LogicalPlanBuilder.from_scan(JsonScanOperator(path, **options)))
 
 
+def read_text(path: Union[str, List[str]], **options) -> DataFrame:
+    """Line-oriented text files (one string column 'text'; .gz supported)."""
+    from .io.text import TextScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(TextScanOperator(path, **options)))
+
+
+def read_warc(path: Union[str, List[str]], **options) -> DataFrame:
+    """WARC (Common Crawl) archives: one row per record (.gz supported)."""
+    from .io.warc import WarcScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(WarcScanOperator(path, **options)))
+
+
 def from_glob_path(path: str) -> DataFrame:
     from .io.glob_files import GlobPathScanOperator
 

@@ -56,3 +56,86 @@ def hll_estimate(regs: np.ndarray) -> int:
 
 def hll_count_distinct(series) -> int:
     return hll_estimate(hll_registers(series))
+
+
+# ---------------------------------------------------------------------------
+# DDSketch (relative-error quantiles; reference: src/daft-sketch)
+# ---------------------------------------------------------------------------
+
+DD_DEFAULT_ALPHA = 0.01  # 1% relative accuracy (reference default)
+
+
+class DDSketch:
+    """Distributed-quantile sketch with relative-error guarantee alpha.
+
+    Values bucket by log-gamma index (gamma = (1+a)/(1-a)); quantile answers
+    are within alpha relative error. Mergeable (bucket-wise add), so grouped /
+    distributed aggregation composes exactly like sum.
+    """
+
+    def __init__(self, alpha: float = DD_DEFAULT_ALPHA):
+        self.alpha = alpha
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._lg = np.log(self.gamma)
+        self.pos: dict = {}
+        self.neg: dict = {}
+        self.zeros = 0
+        self.count = 0
+
+    def add_array(self, vals: np.ndarray) -> None:
+        vals = vals[~np.isnan(vals)]
+        if len(vals) == 0:
+            return
+        self.count += len(vals)
+        self.zeros += int((vals == 0).sum())
+        for store, sel in ((self.pos, vals > 0), (self.neg, vals < 0)):
+            v = np.abs(vals[sel])
+            if len(v) == 0:
+                continue
+            keys = np.ceil(np.log(v) / self._lg).astype(np.int64)
+            uniq, cnt = np.unique(keys, return_counts=True)
+            for k, c in zip(uniq.tolist(), cnt.tolist()):
+                store[k] = store.get(k, 0) + int(c)
+
+    def merge(self, other: "DDSketch") -> None:
+        for mine, theirs in ((self.pos, other.pos), (self.neg, other.neg)):
+            for k, c in theirs.items():
+                mine[k] = mine.get(k, 0) + c
+        self.zeros += other.zeros
+        self.count += other.count
+
+    def _bucket_value(self, key: int, negative: bool) -> float:
+        v = 2.0 * (self.gamma ** key) / (self.gamma + 1.0)
+        return -v if negative else v
+
+    def quantile(self, q: float):
+        if self.count == 0:
+            return None
+        rank = q * (self.count - 1)
+        acc = 0
+        for k in sorted(self.neg.keys(), reverse=True):  # most negative first
+            acc += self.neg[k]
+            if acc > rank:
+                return self._bucket_value(k, negative=True)
+        acc += self.zeros
+        if acc > rank:
+            return 0.0
+        for k in sorted(self.pos.keys()):
+            acc += self.pos[k]
+            if acc > rank:
+                return self._bucket_value(k, negative=False)
+        # numeric edge: return the largest bucket
+        if self.pos:
+            return self._bucket_value(max(self.pos), negative=False)
+        if self.zeros:
+            return 0.0
+        return self._bucket_value(min(self.neg), negative=True) if self.neg else None
+
+
+def ddsketch_percentiles(series, percentiles, alpha: float = DD_DEFAULT_ALPHA):
+    """Approximate percentiles of a numeric Series (None for empty input)."""
+    sk = DDSketch(alpha)
+    vals = series.to_numpy()
+    valid = series.validity_numpy()
+    sk.add_array(vals[valid].astype(np.float64))
+    return [sk.quantile(float(p)) for p in percentiles]

@@ -88,6 +88,9 @@ def ungrouped_agg(batch: RecordBatch, aggs: Sequence[Expression]) -> RecordBatch
             res = s.any_value(inner.params.get("ignore_nulls", False))
         elif op in ("stddev", "var"):
             res = getattr(s, op)(ddof=inner.params.get("ddof", 0))
+        elif op == "approx_percentile":
+            res = s.approx_percentile(inner.params["percentiles"],
+                                      inner.params.get("alpha", 0.01))
         else:
             res = _SERIES_AGG[op](s)
         out.append(res.rename(name))
@@ -300,6 +303,24 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
             pos = starts
         take_idx = idx_sorted[pos] if n else np.empty(0, np.int64)
         return s.take(unseg(take_idx.astype(np.int64)))
+
+    if op == "approx_percentile":
+        from .kernels.sketches import ddsketch_percentiles
+
+        ps = agg.params["percentiles"]
+        alpha = agg.params.get("alpha", 0.01)
+        single = not isinstance(ps, list)
+        plist = [ps] if single else list(ps)
+        taken = s.take(order)
+        bounds = list(starts) + [len(order)]
+        rows = []
+        for g in range(num_groups):
+            seg = taken.slice(int(bounds[g]), int(bounds[g + 1]))
+            qs = ddsketch_percentiles(seg, plist, alpha)
+            rows.append(qs[0] if single else qs)
+        out_dt = DataType.float64() if single else DataType.list(DataType.float64())
+        out = Series.from_pylist(rows, s.name, out_dt)
+        return out.take(_invert_to_group_order(seg_gid, num_groups))
 
     if op in ("list", "concat"):
         taken = s.take(order)

@@ -639,6 +639,17 @@ class Series:
 
         return self._scalar(hll_count_distinct(self), DataType.uint64())
 
+    def approx_percentile(self, percentiles, alpha: float = 0.01) -> "Series":
+        """DDSketch approximate percentile(s): scalar float64 for one
+        percentile, fixed-size list for several (reference: daft-sketch)."""
+        from .kernels.sketches import ddsketch_percentiles
+
+        ps = [percentiles] if isinstance(percentiles, (int, float)) else list(percentiles)
+        out = ddsketch_percentiles(self, ps, alpha)
+        if isinstance(percentiles, (int, float)):
+            return self._scalar(out[0], DataType.float64())
+        return Series.from_pylist([out], self._name, DataType.list(DataType.float64()))
+
 
 # ---- helpers ---------------------------------------------------------------------
 

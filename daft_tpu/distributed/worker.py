@@ -105,7 +105,8 @@ class WorkerProcess:
                 conn = None  # stranger with the wrong key; keep waiting
             if conn is not None:
                 if not conn.poll(30):
-                    raise RuntimeError(f"worker connection never sent hello")
+                    self._proc.terminate()
+                    raise RuntimeError("worker connection never sent hello")
                 hello = conn.recv()
                 assert hello[0] == "hello", hello
                 if hello[1] == worker_id:

@@ -168,8 +168,9 @@ def _eval_one(we, batch, spec, sorted_idx, n, row_start, row_len, pos_in_seg,
         return _scatter_series(picked, sorted_idx, n)
     if func in ("first_value", "last_value"):
         sorted_child = child.take(sorted_idx)
+        rk = _compute_range_keys(batch, spec, sorted_idx) if spec.frame_type == "range" else None
         lo, hi, empty = _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg,
-                                      row_peer_first, row_peer_last)
+                                      row_peer_first, row_peer_last, rk)
         take = lo if func == "first_value" else hi
         picked = sorted_child.take(np.clip(take, 0, n - 1))
         if empty.any():
@@ -179,8 +180,9 @@ def _eval_one(we, batch, spec, sorted_idx, n, row_start, row_len, pos_in_seg,
 
     # ---- windowed aggregations --------------------------------------------------------
     sorted_child = child.take(sorted_idx)
+    rk = _compute_range_keys(batch, spec, sorted_idx) if spec.frame_type == "range" else None
     lo, hi, empty = _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg,
-                                  row_peer_first, row_peer_last)
+                                  row_peer_first, row_peer_last, rk)
     frame_rows = np.where(empty, 0, hi + 1 - lo)
     if spec.min_periods > 1:
         empty = empty | (frame_rows < spec.min_periods)
@@ -248,7 +250,66 @@ def _eval_one(we, batch, spec, sorted_idx, n, row_start, row_len, pos_in_seg,
     raise ValueError(f"window aggregation {func!r} not supported")
 
 
-def _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg, row_peer_first, row_peer_last):
+def _range_bounds(spec, range_keys, row_start, seg_end, row_peer_first,
+                  row_peer_last):
+    """RANGE BETWEEN x PRECEDING AND y FOLLOWING: the frame is every row whose
+    (single, numeric) ORDER BY key lies within [key + start, key + end]
+    (reference: the Range window sink variant). DESC order was normalized by
+    key negation upstream; nulls sort last ascending / first descending, so
+    the valid-key region is a contiguous prefix/suffix of each segment. Rows
+    with a NULL order key frame over their peer group (SQL null-peers rule)."""
+    keys, valid, nulls_first = range_keys
+    n = len(keys)
+    lo = np.empty(n, dtype=np.int64)
+    hi = np.empty(n, dtype=np.int64)
+    start, end = spec.frame_start, spec.frame_end
+    for s in np.unique(row_start):
+        s = int(s)
+        e = int(seg_end[s])
+        sl = slice(s, e + 1)
+        seg_keys = keys[sl]
+        nv = int(valid[sl].sum())
+        off = (e + 1 - s - nv) if nulls_first else 0  # where valid keys begin
+        vk = seg_keys[off:off + nv]
+        if start is Window.unbounded_preceding:
+            lo[sl] = s + off
+        else:
+            lo[sl] = s + off + np.searchsorted(vk, seg_keys + start, side="left")
+        if end is Window.unbounded_following:
+            hi[sl] = s + off + nv - 1
+        else:
+            hi[sl] = s + off + np.searchsorted(vk, seg_keys + end, side="right") - 1
+    # null order keys: frame = peer group
+    lo = np.where(valid, lo, row_peer_first)
+    hi = np.where(valid, hi, row_peer_last)
+    empty = lo > hi
+    return np.clip(lo, row_start, seg_end), np.clip(hi, row_start, seg_end), empty
+
+
+def _compute_range_keys(batch, spec, sorted_idx):
+    """(keys_sorted_f64, valid, nulls_first) for range frames, or None if the
+    spec doesn't qualify (callers raise a helpful error)."""
+    from ..expressions.eval import eval_expression
+
+    if len(spec.order_by_exprs) != 1:
+        return None
+    s = eval_expression(batch, spec.order_by_exprs[0]).take(sorted_idx)
+    vals = s.to_numpy()
+    if vals.dtype == object or vals.ndim != 1:
+        return None
+    keys = vals.astype(np.float64)
+    desc = bool(spec.descending[0]) if spec.descending else False
+    if desc:
+        keys = -keys  # normalize to ascending for searchsorted
+    # null placement must match the sort that positioned the rows: the
+    # user-set nulls_first wins, defaulting to the engine rule (last asc,
+    # first desc)
+    nulls_first = bool(spec.nulls_first[0]) if spec.nulls_first else desc
+    return keys, s.validity_numpy(), nulls_first
+
+
+def _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg, row_peer_first,
+                  row_peer_last, range_keys=None):
     """Per-row inclusive [lo, hi] sorted-position frame bounds + empty-frame mask."""
     seg_end = row_start + row_len - 1
     no_empty = np.zeros(len(row_start), dtype=bool)
@@ -259,7 +320,11 @@ def _frame_bounds(we, spec, n, row_start, row_len, pos_in_seg, row_peer_first, r
         empty = (lo > seg_end) | (hi < row_start) | (lo > hi)
         return np.clip(lo, row_start, seg_end), np.clip(hi, row_start, seg_end), empty
     if spec.frame_type == "range":
-        raise NotImplementedError("range_between frames: use rows_between or default frame")
+        if range_keys is None:
+            raise ValueError(
+                "range_between requires exactly one numeric ORDER BY key")
+        return _range_bounds(spec, range_keys, row_start, seg_end,
+                             row_peer_first, row_peer_last)
     if spec.order_by_exprs:
         # SQL default frame: RANGE UNBOUNDED PRECEDING .. CURRENT ROW (peers included)
         return row_start, row_peer_last, no_empty

@@ -263,6 +263,15 @@ class Expression:
     def minhash(self, num_hashes: int = 16, ngram_size: int = 1, seed: int = 1):
         return self._fn("minhash", num_hashes=num_hashes, ngram_size=ngram_size, seed=seed)
 
+    def tokenize_encode(self, tokenizer: str = "bytes"):
+        """Text -> token ids ('bytes' builtin or a HF tokenizers JSON path;
+        reference: src/daft-functions-tokenize)."""
+        return self._fn("tokenize_encode", tokenizer=tokenizer)
+
+    def tokenize_decode(self, tokenizer: str = "bytes"):
+        """Token ids -> text (inverse of tokenize_encode)."""
+        return self._fn("tokenize_decode", tokenizer=tokenizer)
+
     def apply(self, fn: Callable, return_dtype: DataType) -> "Expression":
         from ..udf.expr import UdfCall
         from ..udf.udf import Func
@@ -318,6 +327,17 @@ class Expression:
 
     def approx_count_distinct(self):
         return AggExpr("approx_count_distinct", self)
+
+    def approx_percentile(self, *percentiles, alpha: float = 0.01):
+        """DDSketch approximate percentile(s) in [0, 1]; one argument yields a
+        float64, several yield a fixed list (reference: daft-sketch)."""
+        if not percentiles:
+            raise ValueError("approx_percentile needs at least one percentile")
+        single = len(percentiles) == 1
+        return AggExpr("approx_percentile", self, {
+            "percentiles": float(percentiles[0]) if single else [float(p) for p in percentiles],
+            "alpha": alpha,
+        })
 
     # ---- window ---------------------------------------------------------------------
     def over(self, spec) -> "WindowExpr":
@@ -627,6 +647,7 @@ class Function(Expression):
 _AGG_OPS = {
     "sum", "mean", "min", "max", "count", "count_distinct", "any_value", "stddev",
     "var", "skew", "bool_and", "bool_or", "list", "concat", "approx_count_distinct",
+    "approx_percentile",
 }
 
 
@@ -668,6 +689,10 @@ class AggExpr(Expression):
             if not f.dtype.is_list():
                 raise ValueError(f"agg_concat requires list dtype, got {f.dtype}")
             return Field(f.name, f.dtype)
+        if op == "approx_percentile":
+            single = not isinstance(self.params.get("percentiles"), list)
+            return Field(f.name, DataType.float64() if single
+                         else DataType.list(DataType.float64()))
         raise ValueError(op)
 
     def __repr__(self):

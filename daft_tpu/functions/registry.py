@@ -956,6 +956,63 @@ register(
 
 
 # ===================================================================================
+# tokenize (reference: src/daft-functions-tokenize — BPE encode/decode)
+# ===================================================================================
+
+_TOKENIZERS: Dict[str, object] = {}
+
+
+def _load_tokenizer(name: str):
+    """'bytes' builtin (UTF-8 byte ids, reversible, dependency-free) or a path
+    to a HuggingFace tokenizers JSON file (BPE etc., no network needed)."""
+    if name in _TOKENIZERS:
+        return _TOKENIZERS[name]
+    if name == "bytes":
+        tok = None
+    else:
+        try:
+            from tokenizers import Tokenizer
+        except ImportError as e:  # pragma: no cover
+            raise ValueError(
+                "tokenize with a model file requires the 'tokenizers' package") from e
+        tok = Tokenizer.from_file(name)
+    _TOKENIZERS[name] = tok
+    return tok
+
+
+def _tokenize_encode(args, kwargs):
+    name = kwargs.get("tokenizer", "bytes")
+    tok = _load_tokenizer(name)
+    out = []
+    for text in args[0].to_pylist():
+        if text is None:
+            out.append(None)
+        elif tok is None:
+            out.append(list(text.encode("utf-8")))
+        else:
+            out.append(tok.encode(text).ids)
+    return Series.from_pylist(out, args[0].name, DataType.list(DataType.uint32()))
+
+
+def _tokenize_decode(args, kwargs):
+    name = kwargs.get("tokenizer", "bytes")
+    tok = _load_tokenizer(name)
+    out = []
+    for ids in args[0].to_pylist():
+        if ids is None:
+            out.append(None)
+        elif tok is None:
+            out.append(bytes(ids).decode("utf-8", "replace"))
+        else:
+            out.append(tok.decode(ids))
+    return Series.from_pylist(out, args[0].name, DataType.string())
+
+
+register("tokenize_encode", _rt_const(DataType.list(DataType.uint32())), _tokenize_encode)
+register("tokenize_decode", _rt_const(DataType.string()), _tokenize_decode)
+
+
+# ===================================================================================
 # misc
 # ===================================================================================
 

@@ -1,0 +1,78 @@
+"""Line-oriented text scan (reference parity: src/daft-text — newline-split
+reads for LLM/data-prep pipelines). One output column ``text``; supports local
+and remote paths plus .gz transparently."""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+from typing import List, Optional, Union
+
+from ..core.micropartition import MicroPartition
+from ..datatype import DataType, Field
+from ..schema import Schema
+from .paths import expand_paths
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+_LINES_PER_BATCH = 64 * 1024
+
+
+def _open_text(path: str):
+    from .object_store import is_remote, resolve_source
+
+    if is_remote(path):
+        source, rel = resolve_source(path)
+        raw: io.IOBase = io.BytesIO(source.get(rel))
+    else:
+        raw = open(path, "rb")
+    if path.endswith(".gz"):
+        raw = gzip.open(raw, "rb")
+    return io.TextIOWrapper(raw, encoding="utf-8", errors="replace")
+
+
+class TextScanOperator(ScanOperator):
+    def __init__(self, path: Union[str, List[str]], **_options):
+        self._paths = expand_paths(path)
+        if not self._paths:
+            raise FileNotFoundError(f"no text files matched {path!r}")
+        self._schema = Schema([Field("text", DataType.string())])
+
+    def name(self) -> str:
+        return f"TextScan({len(self._paths)} files)"
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def can_absorb_limit(self) -> bool:
+        return True
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        schema = self._schema
+        limit = pushdowns.limit
+        tasks = []
+        for path in self._paths:
+            def make(path=path):
+                def read():
+                    produced = 0
+                    buf: List[str] = []
+                    with _open_text(path) as f:
+                        for line in f:
+                            if limit is not None and produced >= limit:
+                                break
+                            buf.append(line.rstrip("\n"))
+                            produced += 1
+                            if len(buf) >= _LINES_PER_BATCH:
+                                yield MicroPartition.from_pydict({"text": buf})
+                                buf = []
+                    if buf:
+                        yield MicroPartition.from_pydict({"text": buf})
+
+                return read
+
+            tasks.append(ScanTask(
+                read=make(), schema=schema,
+                size_bytes=os.path.getsize(path) if os.path.exists(path) else None,
+                limit_applied=False, source_label=path,
+            ))
+        return tasks

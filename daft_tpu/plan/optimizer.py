@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional
 
 from ..expressions import ColumnRef, Expression, col
+from ..expressions.expressions import BinaryOp, Literal
 from . import logical as lp
 
 Rule = Callable[[lp.LogicalPlan], Optional[lp.LogicalPlan]]
@@ -58,6 +59,138 @@ def _track(rule: Rule) -> Rule:
 # ======================================================================================
 # Rules
 # ======================================================================================
+
+
+def simplify_expr(e: Expression, schema=None) -> Expression:
+    """Algebraic expression simplification (reference: src/daft-algebra
+    simplify_expr + the SimplifyExpressions optimizer rule). Conservative,
+    null-semantics-preserving rewrites applied bottom-up:
+
+    - literal folding: <lit> op <lit> evaluates at plan time
+    - arithmetic identities: x+0, 0+x, x-0, x*1, 1*x, x/1 -> x
+    - Kleene boolean identities: TRUE AND e -> e, FALSE AND e -> FALSE,
+      FALSE OR e -> e, TRUE OR e -> TRUE, NOT NOT e -> e
+    - if_else with a literal predicate picks its branch
+
+    (x*0 is NOT rewritten: nulls must propagate.) With a schema, every rewrite
+    is dtype-checked — a replacement that would change the resolved output
+    dtype (e.g. int_col / 1 -> int_col, where div promotes to float64) is
+    rejected.
+    """
+    from ..expressions.expressions import IfElse, UnaryOp
+
+    def lit_val(x):
+        return x.value if isinstance(x, Literal) else _MISSING
+
+    def is_num(x, v):
+        lv = lit_val(x)
+        return isinstance(lv, (int, float)) and not isinstance(lv, bool) and lv == v
+
+    def rewrite(node):
+        out = _rewrite(node)
+        if out is None or schema is None:
+            return out
+        try:
+            if out.to_field(schema).dtype != node.to_field(schema).dtype:
+                return None  # rewrite would change the output dtype
+        except Exception:
+            return None
+        return out
+
+    def _rewrite(node):
+        if isinstance(node, BinaryOp):
+            l, r = node.left, node.right
+            if isinstance(l, Literal) and isinstance(r, Literal) and node.op not in (
+                    "and", "or"):
+                folded = _fold_literal_binop(node)
+                if folded is not None:
+                    return folded
+            if node.op == "add":
+                if is_num(r, 0):
+                    return l
+                if is_num(l, 0):
+                    return r
+            elif node.op == "sub" and is_num(r, 0):
+                return l
+            elif node.op == "mul":
+                if is_num(r, 1):
+                    return l
+                if is_num(l, 1):
+                    return r
+            elif node.op == "and":
+                if lit_val(l) is True:
+                    return r
+                if lit_val(r) is True:
+                    return l
+                if lit_val(l) is False or lit_val(r) is False:
+                    return Literal(False)
+            elif node.op == "or":
+                if lit_val(l) is False:
+                    return r
+                if lit_val(r) is False:
+                    return l
+                if lit_val(l) is True or lit_val(r) is True:
+                    return Literal(True)
+        elif isinstance(node, UnaryOp) and node.op == "not":
+            c = node.child
+            if isinstance(c, UnaryOp) and c.op == "not":
+                return c.child
+            if isinstance(lit_val(c), bool):
+                return Literal(not c.value)
+        elif isinstance(node, IfElse):
+            pv = lit_val(node.predicate)
+            if pv is True:
+                return node.if_true
+            if pv is False or pv is None:
+                return node.if_false
+        return None
+
+    return e.transform(rewrite)
+
+
+_MISSING = object()
+
+
+def _fold_literal_binop(node) -> Optional[Expression]:
+    """Evaluate <lit> op <lit> via the host kernels (exact engine semantics)."""
+    try:
+        from ..core.recordbatch import RecordBatch
+        from ..expressions.eval import eval_expression
+
+        dummy = RecordBatch.from_pydict({"__x__": [0]})
+        s = eval_expression(dummy, node)
+        vals = s.to_pylist()
+        if len(vals) != 1:
+            return None
+        out = Literal(vals[0])
+        if out.dtype != s.dtype and not out.dtype.is_null():
+            return None  # dtype would change (e.g. int literal for float result)
+        return out
+    except Exception:
+        return None
+
+
+def rule_simplify_expressions(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Apply simplify_expr to Filter predicates and Project expressions."""
+    if isinstance(node, lp.Filter):
+        new = simplify_expr(node.predicate, node.input.schema)
+        if repr(new) != repr(node.predicate):
+            return lp.Filter(node.input, new)
+        return None
+    if isinstance(node, lp.Project):
+        new_exprs = []
+        changed = False
+        for e in node.projection:
+            ne = simplify_expr(e, node.input.schema)
+            if repr(ne) != repr(e):
+                changed = True
+                if ne.name() != e.name():
+                    ne = ne.alias(e.name())  # output names are part of the schema
+            new_exprs.append(ne)
+        if changed:
+            return lp.Project(node.input, new_exprs)
+        return None
+    return None
 
 
 def rule_drop_trivial_filter(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
@@ -817,6 +950,7 @@ def _bare_name(e: Expression) -> Optional[str]:
 def default_rule_batches(config) -> List[RuleBatch]:
     return [
         RuleBatch("simplify", [
+            rule_simplify_expressions,
             rule_drop_trivial_filter,
             rule_merge_filters,
             rule_merge_limits,

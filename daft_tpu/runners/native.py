@@ -45,10 +45,12 @@ class NativeRunner(Runner):
         from ..observability.runtime_stats import current_collector
 
         # inherit any ambient collector (explain_analyze routes through the
-        # runner); save/restore around every pull so interleaved queries on
-        # one thread never clobber each other's stats
+        # runner — it wins even with subscribers attached, who then see the
+        # same collector's stats); save/restore around every pull so
+        # interleaved queries on one thread never clobber each other's stats
         prev = current_collector()
-        collector = StatsCollector() if observed else prev
+        collector = prev if prev is not None \
+            else (StatsCollector() if observed else None)
         rows = 0
         err: str = None
         try:

@@ -1,0 +1,156 @@
+"""Breadth features: text/WARC readers, tokenize, DDSketch percentiles,
+simplify-expressions, range_between window frames."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit, Window
+
+
+def test_read_text(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\nworld\n\nlast")
+    out = daft_tpu.read_text(str(p)).to_pydict()
+    assert out == {"text": ["hello", "world", "", "last"]}
+
+
+def test_read_text_gz_and_limit(tmp_path):
+    p = tmp_path / "b.txt.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("\n".join(f"line{i}" for i in range(100)))
+    out = daft_tpu.read_text(str(p)).limit(5).to_pydict()
+    assert out["text"] == [f"line{i}" for i in range(5)]
+
+
+def _write_warc(path, records):
+    with open(path, "wb") as f:
+        for rid, rtype, uri, body in records:
+            payload = body.encode()
+            hdr = (f"WARC/1.0\r\n"
+                   f"WARC-Record-ID: {rid}\r\n"
+                   f"WARC-Type: {rtype}\r\n"
+                   + (f"WARC-Target-URI: {uri}\r\n" if uri else "")
+                   + f"Content-Length: {len(payload)}\r\n"
+                   f"Content-Type: text/plain\r\n\r\n").encode()
+            f.write(hdr + payload + b"\r\n\r\n")
+
+
+def test_read_warc(tmp_path):
+    p = tmp_path / "cc.warc"
+    _write_warc(p, [
+        ("<urn:uuid:1>", "warcinfo", None, "software: test"),
+        ("<urn:uuid:2>", "response", "http://example.com", "<html>hi</html>"),
+        ("<urn:uuid:3>", "response", "http://example.org", "body text"),
+    ])
+    out = daft_tpu.read_warc(str(p)).to_pydict()
+    assert out["warc_type"] == ["warcinfo", "response", "response"]
+    assert out["warc_target_uri"] == [None, "http://example.com", "http://example.org"]
+    assert out["content"][1] == "<html>hi</html>"
+    assert out["content_length"][2] == len(b"body text")
+
+
+def test_warc_common_crawl_dedup_shape(tmp_path):
+    """The Common Crawl config shape: read_warc -> minhash -> dedup."""
+    p = tmp_path / "cc.warc"
+    _write_warc(p, [
+        ("<urn:uuid:1>", "response", "http://a", "the quick brown fox jumps"),
+        ("<urn:uuid:2>", "response", "http://b", "the quick brown fox jumps"),
+        ("<urn:uuid:3>", "response", "http://c", "совершенно другой текст"),
+    ])
+    df = (daft_tpu.read_warc(str(p))
+          .where(col("warc_type") == "response")
+          .with_column("sig", col("content").minhash(num_hashes=8, ngram_size=2)))
+    out = df.to_pydict()
+    assert out["sig"][0] == out["sig"][1] != out["sig"][2]
+
+
+def test_tokenize_bytes_roundtrip():
+    df = daft_tpu.from_pydict({"t": ["hello", "héllo", None]})
+    enc = df.with_column("ids", col("t").tokenize_encode())
+    out = enc.with_column("back", col("ids").tokenize_decode()).to_pydict()
+    assert out["back"] == ["hello", "héllo", None]
+    assert out["ids"][0] == list(b"hello")
+
+
+def test_simplify_expressions_folds_plan():
+    from daft_tpu.plan import logical as lp
+    from daft_tpu.plan.optimizer import simplify_expr
+
+    e = (col("x") + 0) * 1 + (lit(2) + lit(3))
+    s = simplify_expr(e)
+    assert repr(s) == repr(col("x") + lit(5)), repr(s)
+    # boolean identities (Kleene-safe)
+    p = (lit(True) & (col("x") > 1)) | lit(False)
+    assert repr(simplify_expr(p)) == repr(col("x") > 1)
+    # x*0 must NOT fold (null propagation)
+    z = col("x") * 0
+    assert repr(simplify_expr(z)) == repr(z)
+    # end-to-end: results unchanged
+    df = daft_tpu.from_pydict({"x": [1, 2, None]})
+    assert df.select(((col("x") + 0) * 1).alias("x")).to_pydict() == {"x": [1, 2, None]}
+
+
+def test_range_between_window():
+    df = daft_tpu.from_pydict({
+        "g": ["a", "a", "a", "b", "b"],
+        "t": [1, 3, 6, 2, 4],
+        "v": [10.0, 20.0, 30.0, 5.0, 7.0],
+    })
+    w = Window().partition_by("g").order_by("t").range_between(-2, 0)
+    out = df.select("g", "t", col("v").sum().over(w).alias("s")).sort(["g", "t"]).to_pydict()
+    assert out["s"] == [10.0, 30.0, 30.0, 5.0, 12.0]
+    wd = Window().partition_by("g").order_by("t", desc=True).range_between(-2, 0)
+    outd = df.select("g", "t", col("v").sum().over(wd).alias("s")).sort(["g", "t"]).to_pydict()
+    assert outd["s"] == [30.0, 20.0, 30.0, 12.0, 7.0]
+
+
+def test_range_between_unbounded_and_nulls():
+    df = daft_tpu.from_pydict({
+        "t": [1, 2, None, 10],
+        "v": [1.0, 2.0, 4.0, 8.0],
+    })
+    w = Window().order_by("t").range_between(Window.unbounded_preceding, 0)
+    out = df.select("t", col("v").sum().over(w).alias("s")).sort("t").to_pydict()
+    # t=1 -> 1; t=2 -> 3; t=10 -> 11; null key frames over its peer group -> 4
+    assert out["s"][:3] == [1.0, 3.0, 11.0]
+    assert out["s"][3] == 4.0
+
+
+def test_approx_percentile_grouped_and_listed():
+    rng = np.random.default_rng(1)
+    vals = rng.uniform(0, 100, 20_000)
+    df = daft_tpu.from_pydict({"k": (np.arange(20_000) % 2).tolist(), "v": vals.tolist()})
+    out = df.groupby("k").agg(
+        col("v").approx_percentile(0.5).alias("p50"),
+        col("v").approx_percentile(0.25, 0.75).alias("pq")).sort("k").to_pydict()
+    for i in range(2):
+        sel = vals[np.arange(20_000) % 2 == i]
+        assert abs(out["p50"][i] - np.percentile(sel, 50)) / 50 < 0.05
+        assert len(out["pq"][i]) == 2
+
+
+def test_simplify_preserves_promotion_dtypes():
+    """int_col / 1 promotes to float64 and int_col + 0.0 to float — rewrites
+    that would change the resolved dtype must not fire."""
+    df = daft_tpu.from_pydict({"a": [1, 2, 3]})
+    out = df.select((col("a") / 1).alias("x"))
+    assert out.schema["x"].dtype == daft_tpu.DataType.float64()
+    assert out.to_pydict()["x"] == [1.0, 2.0, 3.0]
+    out2 = df.select((col("a") + 0.0).alias("x"))
+    assert out2.to_pydict()["x"] == [1.0, 2.0, 3.0]
+
+
+def test_range_between_nulls_first():
+    df = daft_tpu.from_pydict({
+        "t": [None, 1, 2, 3, 4],
+        "v": [10.0, 1.0, 1.0, 1.0, 1.0],
+    })
+    w = Window().order_by("t", nulls_first=True).range_between(-1, 0)
+    out = df.select("t", col("v").sum().over(w).alias("s")).to_pydict()
+    by_t = dict(zip(out["t"], out["s"]))
+    assert by_t[None] == 10.0  # null key frames over its peer group
+    assert by_t[1] == 1.0 and by_t[2] == 2.0 and by_t[3] == 2.0 and by_t[4] == 2.0

@@ -80,7 +80,7 @@ def test_error_reported_in_query_end(recorder):
         df.select(boom(col("a"))).to_pydict()
     end = recorder.events[-1][1]
     assert isinstance(end, QueryEnd)
-    assert end.error is not None and "nope" in end.error or "ValueError" in end.error
+    assert end.error is not None and ("nope" in end.error or "ValueError" in end.error)
 
 
 def test_broken_subscriber_never_fails_query():
