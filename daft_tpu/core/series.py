@@ -468,13 +468,70 @@ class Series:
 
     def __eq__(self, other):  # type: ignore[override]
         if isinstance(other, Series):
+            fast = self._string_literal_cmp(other, negate=False)
+            if fast is not None:
+                return fast
             return self._cmp(other, pc.equal)
         return NotImplemented
 
     def __ne__(self, other):  # type: ignore[override]
         if isinstance(other, Series):
+            fast = self._string_literal_cmp(other, negate=True)
+            if fast is not None:
+                return fast
             return self._cmp(other, pc.not_equal)
         return NotImplemented
+
+    def _filter_codes(self):
+        """Dictionary codes for predicate evaluation on low-cardinality string
+        columns: integer code compares beat arrow string compares ~5x on wide
+        scans. Gated by a head sample; the (one-time, cached) factorize is
+        shared with the device grouped-agg dictionary path."""
+        if (self._pyobjs is not None or not self._dtype.is_string()
+                or len(self) < 65_536):
+            return None
+        cache = getattr(self, "_device_cache", None)
+        if cache is not None and ("dict_reject",) in cache:
+            return None
+        cached = getattr(self, "_dict_codes", None)
+        if cached is None:
+            # strided sample (head samples are biased on clustered data)
+            step = max(len(self) // 2048, 1)
+            import numpy as np
+
+            sampled = self.take(np.arange(0, len(self), step, dtype=np.int64)[:2048])
+            if len(set(sampled.to_pylist())) > 256:  # not low-cardinality
+                if cache is None:
+                    cache = {}
+                    object.__setattr__(self, "_device_cache", cache)
+                cache[("dict_reject",)] = True
+                return None
+            cached = self.dict_codes()
+        if cached[2] > 4096:
+            return None  # vocabulary too large for linear literal lookups
+        return cached
+
+    def _string_literal_cmp(self, other: "Series", negate: bool):
+        """eq/neq against a 1-row string literal via cached dictionary codes
+        (None = take the generic arrow path). Null rows stay null."""
+        if len(other) != 1 or not other._dtype.is_string() or other._pyobjs is not None:
+            return None
+        enc = self._filter_codes()
+        if enc is None:
+            return None
+        codes, values, _k = enc
+        target = other.to_pylist()[0]
+        if target is None:
+            return Series.full_null(self._name, DataType.bool(), len(self))
+        try:
+            code = values.index(target)
+        except ValueError:
+            code = -1
+        mask = (codes != code) if negate else (codes == code)
+        valid = self.validity_numpy()
+        arr = pa.array(mask, type=pa.bool_(), mask=~valid) if not valid.all() \
+            else pa.array(mask, type=pa.bool_())
+        return Series(self._name, DataType.bool(), _combine(arr))
 
     def __lt__(self, other: "Series") -> "Series":
         return self._cmp(other, pc.less)
@@ -511,6 +568,20 @@ class Series:
 
     # ---- misc elementwise ---------------------------------------------------------
     def is_in(self, values: "Series") -> "Series":
+        if (values._dtype.is_string() and values._pyobjs is None
+                and len(values) <= 64 and values.null_count() == 0):
+            # (a null in the value set makes null rows match under arrow
+            # semantics — the generic path below handles that case)
+            enc = self._filter_codes()
+            if enc is not None:
+                codes, vocab, _k = enc
+                targets = set(values.to_pylist())
+                code_set = np.array(
+                    [i for i, v in enumerate(vocab) if v is not None and v in targets],
+                    dtype=codes.dtype)
+                mask = np.isin(codes, code_set) & self.validity_numpy()
+                return Series(self._name, DataType.bool(),
+                              _combine(pa.array(mask, type=pa.bool_())))
         self._require_arrow("is_in")
         out = pc.is_in(self._arrow, value_set=values._arrow)
         out = pc.fill_null(out, False)

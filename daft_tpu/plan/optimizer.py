@@ -856,9 +856,10 @@ def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
         return max(out, 1.0)
 
     def simulate(order):
-        """Cost of a join order: the sum of INTERMEDIATE result sizes. The
+        """Cost of a join order: each step pays its INPUT sizes (hash build +
+        probe are linear in rows processed) plus its intermediate result. The
         final result is the query output — identical for every valid order —
-        so it is excluded (it would otherwise swamp the comparison)."""
+        so only its inputs count."""
         cur_rows = est[order[0]]
         cur_v = {name: v.get((order[0], name))
                  for (i, name) in v if i == order[0]}
@@ -867,6 +868,7 @@ def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
             res = join_est(cur_rows, cur_v, i)
             if res is None:
                 return None, None
+            cost += cur_rows + est[i]
             if step < len(order) - 2:
                 cost += res
             for (j, name), val in v.items():
@@ -879,7 +881,8 @@ def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
         return cost, cur_rows
 
     # greedy: start from the smallest relation, repeatedly add the connected
-    # relation with the smallest estimated JOIN RESULT
+    # relation with the smallest step cost (its own size + the join result —
+    # pulling a huge relation in early is paid for, not hidden)
     order = [min(range(len(rels)), key=lambda i: (est[i], i))]
     placed = {order[0]}
     cur_rows = est[order[0]]
@@ -892,11 +895,12 @@ def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
             res = join_est(cur_rows, cur_v, i)
             if res is None:
                 continue
-            if best is None or res < best[0] or (res == best[0] and i < best[1]):
-                best = (res, i)
+            step_cost = est[i] + res
+            if best is None or step_cost < best[0] or (step_cost == best[0] and i < best[1]):
+                best = (step_cost, i, res)
         if best is None:
             return None  # disconnected components would need a cross join
-        res, nxt = best
+        _cost, nxt, res = best
         order.append(nxt)
         placed.add(nxt)
         for (j, name), val in v.items():
