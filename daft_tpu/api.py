@@ -12,7 +12,10 @@ from .config import execution_config, execution_config_ctx, set_execution_config
 from .core.micropartition import MicroPartition
 from .dataframe import DataFrame, GroupedDataFrame
 from .expressions import Expression, col, lit
+from .checkpoint import CheckpointStore, FileCheckpointStore, MemoryCheckpointStore
 from .io.io_config import HTTPConfig, IOConfig, S3Config, io_config, set_io_config
+from .io.sink import DataSink, WriteResult
+from .io.source import DataSource, DataSourceTask
 from .plan.builder import LogicalPlanBuilder
 from .schema import Schema
 from .udf import Func, cls, func, method, udf

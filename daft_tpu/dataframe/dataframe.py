@@ -319,11 +319,14 @@ class DataFrame:
     # ---- writes ------------------------------------------------------------------
     def write_parquet(self, root_dir: str, compression: str = "snappy",
                       partition_cols: Optional[List[ColumnInput]] = None,
-                      write_mode: str = "append") -> "DataFrame":
+                      write_mode: str = "append", checkpoint=None) -> "DataFrame":
+        """checkpoint=(CheckpointStore, key_column) enables resume: rows whose
+        key a prior run sealed are skipped (reference: daft-checkpoint)."""
         from ..io.writers import WriteInfo
 
         info = WriteInfo("parquet", root_dir, {"compression": compression},
-                         _to_exprs(partition_cols) if partition_cols else None, write_mode)
+                         _to_exprs(partition_cols) if partition_cols else None, write_mode,
+                         checkpoint=checkpoint)
         return self._write(info)
 
     def write_csv(self, root_dir: str, partition_cols: Optional[List[ColumnInput]] = None,
@@ -339,6 +342,13 @@ class DataFrame:
 
         info = WriteInfo("json", root_dir, {}, None, write_mode)
         return self._write(info)
+
+    def write_sink(self, sink) -> "DataFrame":
+        """Write through a custom DataSink (reference: daft/io/sink.py —
+        start() once, write() per partition, finalize() -> result table)."""
+        from ..io.sink import _SinkWriteInfo
+
+        return self._write(_SinkWriteInfo(sink))
 
     def _write(self, info) -> "DataFrame":
         return DataFrame(self._builder.write(info)).collect()

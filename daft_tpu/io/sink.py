@@ -1,0 +1,71 @@
+"""Custom Python data sinks.
+
+Reference parity: daft/io/sink.py — the DataSink ABC behind
+write_turbopuffer/clickhouse/bigtable-style connectors: start() once,
+write() per micropartition (possibly on workers), finalize() with the
+collected write results to produce the commit/result table.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Iterator, List
+
+from ..core.micropartition import MicroPartition
+from ..schema import Schema
+
+
+class WriteResult:
+    """What one write() call produced (rows/bytes plus sink-specific payload)."""
+
+    def __init__(self, result: Any = None, rows: int = 0, bytes_written: int = 0):
+        self.result = result
+        self.rows = rows
+        self.bytes_written = bytes_written
+
+
+class DataSink(ABC):
+    @abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abstractmethod
+    def schema(self) -> Schema:
+        """Schema of the result table finalize() returns."""
+        ...
+
+    def start(self) -> None:
+        """Called once before any write()."""
+
+    @abstractmethod
+    def write(self, part: MicroPartition) -> WriteResult:
+        ...
+
+    @abstractmethod
+    def finalize(self, results: List[WriteResult]) -> MicroPartition:
+        """Combine write results into the output table (e.g. commit + manifest)."""
+        ...
+
+
+class _SinkWriteInfo:
+    """Adapter matching io.writers.WriteInfo's execute_write contract so the
+    physical Sink node runs custom sinks through the same executor path."""
+
+    def __init__(self, sink: DataSink):
+        self.sink = sink
+
+    def __repr__(self) -> str:
+        return f"sink://{self.sink.name()}"
+
+    def result_schema(self) -> Schema:
+        return self.sink.schema()
+
+    def execute_write(self, parts: Iterator[MicroPartition], input_schema: Schema):
+        self.sink.start()
+        results: List[WriteResult] = []
+        for part in parts:
+            if part.num_rows == 0:
+                continue
+            results.append(self.sink.write(part))
+        out = self.sink.finalize(results)
+        yield out.cast_to_schema(self.sink.schema())

@@ -1,0 +1,99 @@
+"""Custom Python data sources.
+
+Reference parity: daft/io/source.py:26,74 — DataSource/DataSourceTask ABCs let
+users plug arbitrary systems (databases, APIs, queues) into the engine as
+first-class scans with pushdown visibility; tasks are independently
+executable units the engine parallelizes and ships to workers.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Iterator, List, Optional
+
+from ..core.micropartition import MicroPartition
+from ..schema import Schema
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+
+class DataSourceTask(ABC):
+    """One independently-readable slice of a DataSource."""
+
+    @property
+    @abstractmethod
+    def schema(self) -> Schema:
+        ...
+
+    @abstractmethod
+    def read(self) -> Iterator[MicroPartition]:
+        """Yield the task's data as MicroPartitions."""
+        ...
+
+    def size_bytes(self) -> Optional[int]:
+        return None
+
+
+class DataSource(ABC):
+    """A user-defined source of DataFrames.
+
+    Implement name/schema/get_tasks; call .read() for a lazy DataFrame. The
+    engine attaches Pushdowns (column pruning / filters / limit) — tasks may
+    exploit them or ignore them (the executor re-applies semantics it can't
+    verify were applied).
+    """
+
+    @property
+    @abstractmethod
+    def name(self) -> str:
+        ...
+
+    @property
+    @abstractmethod
+    def schema(self) -> Schema:
+        ...
+
+    @abstractmethod
+    def get_tasks(self, pushdowns: Pushdowns) -> Iterator[DataSourceTask]:
+        ...
+
+    def read(self):
+        from ..dataframe import DataFrame
+        from ..plan.builder import LogicalPlanBuilder
+
+        return DataFrame(LogicalPlanBuilder.from_scan(_DataSourceScanOperator(self)))
+
+
+class _DataSourceScanOperator(ScanOperator):
+    """Adapter: DataSource -> the engine's ScanOperator contract."""
+
+    def __init__(self, source: DataSource):
+        self._source = source
+
+    def name(self) -> str:
+        return f"DataSource({self._source.name})"
+
+    def schema(self) -> Schema:
+        return self._source.schema
+
+    # accept every pushdown as a HINT: tasks may exploit them, and the engine
+    # re-applies anything the task didn't verify (filters_applied=False below)
+    def can_absorb_filter(self) -> bool:
+        return True
+
+    def can_absorb_limit(self) -> bool:
+        return True
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        out = []
+        for task in self._source.get_tasks(pushdowns):
+            out.append(ScanTask(
+                read=task.read,
+                schema=task.schema,
+                size_bytes=task.size_bytes(),
+                # conservatively assume the task ignored the pushdowns; the
+                # executor re-filters / re-limits
+                filters_applied=False,
+                limit_applied=False,
+                source_label=self._source.name,
+            ))
+        return out

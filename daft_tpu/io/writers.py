@@ -27,7 +27,8 @@ _TARGET_FILE_BYTES = 512 * 1024 * 1024
 
 class WriteInfo:
     def __init__(self, format: str, root_dir: str, options: Dict[str, Any],
-                 partition_cols: Optional[List[Any]] = None, write_mode: str = "append"):
+                 partition_cols: Optional[List[Any]] = None, write_mode: str = "append",
+                 checkpoint=None):
         if format not in ("parquet", "csv", "json"):
             raise ValueError(f"unsupported write format {format!r}")
         self.format = format
@@ -35,6 +36,9 @@ class WriteInfo:
         self.options = options
         self.partition_cols = partition_cols
         self.write_mode = write_mode
+        # (CheckpointStore, key_column): skip-on-rerun + file staging for 2PC
+        # sinks (reference: daft-checkpoint store.rs lifecycle)
+        self.checkpoint = checkpoint
 
     def __repr__(self) -> str:
         return f"{self.format}://{self.root_dir}"
@@ -45,6 +49,9 @@ class WriteInfo:
     def execute_write(self, parts: Iterator[MicroPartition], input_schema: Schema) -> Iterator[MicroPartition]:
         from .object_store import is_remote
 
+        if self.checkpoint is not None:
+            yield from self._execute_checkpointed(parts, input_schema)
+            return
         if is_remote(self.root_dir):
             yield from self._execute_remote_write(parts, input_schema)
             return
@@ -62,6 +69,49 @@ class WriteInfo:
                     writer.write(b)
             written = writer.close()
         yield MicroPartition.from_pydict({"path": written}).cast_to_schema(self.result_schema())
+
+    def _execute_checkpointed(self, parts: Iterator[MicroPartition],
+                              input_schema: Schema) -> Iterator[MicroPartition]:
+        """Checkpointed write: rows whose key was sealed by a previous run are
+        skipped; this run's keys stage under a fresh CheckpointId which seals
+        (with the written file manifest) only after every batch succeeded
+        (reference: stage_checkpoint_keys.rs + CheckpointStore lifecycle)."""
+        import uuid as _uuid
+
+        from ..expressions import col as _col
+        from ..expressions.eval import eval_expression
+
+        store, key_col = self.checkpoint
+        done = store.get_checkpointed_keys()
+        cid = _uuid.uuid4().hex[:16]
+
+        def filtered_parts():
+            for part in parts:
+                for b in part.batches:
+                    if b.num_rows == 0:
+                        continue
+                    keys = eval_expression(b, _col(key_col)).to_pylist()
+                    if done:
+                        import numpy as np
+
+                        keep = np.array([k not in done for k in keys], dtype=bool)
+                        if not keep.any():
+                            continue
+                        if not keep.all():
+                            from ..core.series import Series
+
+                            b = b.filter_by_mask(Series.from_numpy(keep, "m"))
+                            keys = [k for k, kp in zip(keys, keep) if kp]
+                    store.stage_keys(cid, keys)
+                    yield MicroPartition(b.schema, [b])
+
+        inner = WriteInfo(self.format, self.root_dir, self.options,
+                          self.partition_cols, self.write_mode)
+        manifest = list(inner.execute_write(filtered_parts(), input_schema))
+        files = [p for mp in manifest for p in mp.to_pydict().get("path", [])]
+        store.stage_files(cid, files)
+        store.checkpoint(cid)  # seal: keys+files visible atomically
+        yield from manifest
 
     def _execute_remote_write(self, parts: Iterator[MicroPartition],
                               input_schema: Schema) -> Iterator[MicroPartition]:
