@@ -1,0 +1,82 @@
+"""Pallas TPU kernels (SURVEY.md §7: custom kernels for the hot relational ops).
+
+segment_sum_planes: the grouped-aggregation inner loop — accumulate P value
+planes into a (segments x P) table keyed by per-row segment codes — as ONE
+Pallas kernel. Instead of materializing a one-hot matrix in HBM (the lax.scan
+formulation in grouped_stage.py materializes chunk-sized one-hots per step),
+the kernel builds each block's one-hot in VMEM and accumulates the block's
+(cap x P) partial into the output block across sequential grid steps, so HBM
+traffic is exactly: read planes once, read codes once, write the table once.
+
+Used by the grouped device stage when DAFT_TPU_PALLAS=1 (the lax.scan path
+remains the default — on small segment counts XLA's fusion is already at
+bandwidth). Correctness is pinned by interpret-mode tests; NOTE: this build
+environment's tunneled device rejects Mosaic compilation (its remote-compile
+service returns HTTP 500 for Pallas lowerings), so on-chip dispatch could not
+be exercised here — co-located TPU runtimes compile it normally.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+_BLOCK_ROWS = 1024
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def segment_sum_planes(planes: jnp.ndarray, codes: jnp.ndarray, cap: int,
+                       interpret: bool = False) -> jnp.ndarray:
+    """sum planes (N x P, f32) into segments (cap x P, f32) by codes (N, i32).
+
+    N must be a multiple of the block size (the callers' quantized padding
+    guarantees this); rows whose code is outside [0, cap) are dropped (the
+    trash segment for filtered/padding rows).
+    """
+    from jax.experimental import pallas as pl
+
+    n, p = planes.shape
+    assert n % _BLOCK_ROWS == 0, n
+    grid = n // _BLOCK_ROWS
+
+    def kernel(planes_ref, codes_ref, out_ref):
+        step = pl.program_id(0)
+        blk = planes_ref[...]                      # (BLOCK, P) in VMEM
+        cds = codes_ref[...].astype(jnp.int32)     # (BLOCK, 1) — 2D for mosaic
+        seg_ids = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_ROWS, cap), 1)
+        oh = (cds == seg_ids).astype(jnp.float32)  # (BLOCK, cap)
+        part = jax.lax.dot_general(                # (cap, P) on the MXU
+            oh, blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[...] = part
+
+        @pl.when(step != 0)
+        def _acc():
+            out_ref[...] += part
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, p), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((cap, p), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((cap, p), jnp.float32),
+        interpret=interpret,
+    )(planes, codes.reshape(-1, 1))
+
+
+def pallas_available() -> bool:
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False

@@ -1,0 +1,41 @@
+"""CLI + progress subscriber (reference: daft/cli.py, progress_bar.py)."""
+
+import io
+import json
+
+import daft_tpu
+from daft_tpu.cli import main
+
+
+def test_cli_schema_and_sql(tmp_path, capsys):
+    daft_tpu.from_pydict({"a": [3, 1, 2], "b": ["x", "y", "z"]}).write_parquet(str(tmp_path / "t"))
+    pat = str(tmp_path / "t" / "*.parquet")
+    assert main(["schema", pat]) == 0
+    out = capsys.readouterr().out
+    assert "a: Int64" in out and "b: String" in out
+
+    assert main(["sql", "SELECT a FROM t ORDER BY a DESC", "-t", f"t={pat}", "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip()) == {"a": [3, 2, 1]}
+
+
+def test_cli_info(capsys):
+    assert main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "daft_tpu" in out and "execution config" in out
+
+
+def test_progress_subscriber_reports_queries():
+    from daft_tpu.observability.progress import ProgressSubscriber
+    from daft_tpu.observability import attach_subscriber, detach_subscriber
+
+    buf = io.StringIO()
+    buf.isatty = lambda: False
+    sub = ProgressSubscriber(stream=buf)
+    attach_subscriber(sub)
+    try:
+        daft_tpu.from_pydict({"a": [1, 2, 3]}).where(daft_tpu.col("a") > 1).to_pydict()
+    finally:
+        detach_subscriber(sub)
+    text = buf.getvalue()
+    assert "✓ query" in text and "2 rows" in text
