@@ -168,6 +168,23 @@ class RecordBatch:
         return RecordBatch(self._schema, [c.take(indices) for c in self._columns], n)
 
     def filter_by_mask(self, mask: Series) -> "RecordBatch":
+        # selective filters run as flatnonzero + take: arrow's filter kernel
+        # pays O(input) per COLUMN (mask rescan + rebuild), while take pays
+        # O(output) per column after one O(input) mask scan (measured ~8ms vs
+        # ~0.4ms per 6M-row string column at low selectivity)
+        if self._columns and self._num_rows >= 65_536 and mask._pyobjs is None:
+            import pyarrow.compute as pc
+
+            # null mask entries drop (like null_selection_behavior="drop");
+            # fill first so pyarrow hands back a typed bool buffer, not objects
+            arr = mask._arrow
+            if arr.null_count:
+                arr = pc.fill_null(arr, False)
+            keep = arr.to_numpy(zero_copy_only=False)
+            if np.count_nonzero(keep) <= self._num_rows // 2:
+                idx = np.flatnonzero(keep)
+                cols = [c.take(idx) for c in self._columns]
+                return RecordBatch(self._schema, cols, len(idx))
         cols = [c.filter(mask) for c in self._columns]
         n = len(cols[0]) if cols else int(
             np.count_nonzero(np.nan_to_num(mask.to_numpy()) & mask.validity_numpy())

@@ -574,12 +574,14 @@ class Series:
             # semantics — the generic path below handles that case)
             enc = self._filter_codes()
             if enc is not None:
-                codes, vocab, _k = enc
+                codes, vocab, k = enc
                 targets = set(values.to_pylist())
-                code_set = np.array(
-                    [i for i, v in enumerate(vocab) if v is not None and v in targets],
-                    dtype=codes.dtype)
-                mask = np.isin(codes, code_set) & self.validity_numpy()
+                # dense codes -> O(n) lookup table beats np.isin's sort path
+                lut = np.zeros(max(k, 1), dtype=bool)
+                for i, v in enumerate(vocab):
+                    if v is not None and v in targets:
+                        lut[i] = True
+                mask = lut[codes] & self.validity_numpy()
                 return Series(self._name, DataType.bool(),
                               _combine(pa.array(mask, type=pa.bool_())))
         self._require_arrow("is_in")
@@ -596,9 +598,10 @@ class Series:
     def if_else(predicate: "Series", if_true: "Series", if_false: "Series") -> "Series":
         n = max(len(predicate), len(if_true), len(if_false))
 
-        def bcast(a: pa.Array) -> pa.Array:
+        def bcast(a: pa.Array):
             if len(a) == 1 and n != 1:
-                return pa.concat_arrays([a] * n)
+                # arrow kernels broadcast scalars natively — no O(n) materialize
+                return a[0]
             return a
 
         t, f = bcast(if_true._arrow), bcast(if_false._arrow)
@@ -606,6 +609,7 @@ class Series:
         if t.type != f.type:
             target = _common_arrow_type(t.type, f.type)
             t, f = t.cast(target), f.cast(target)
+        # n = max(lengths), so at least one operand is always a length-n array
         out = pc.if_else(p, t, f)
         return Series(if_true._name, DataType.from_arrow(out.type), _combine(out))
 
