@@ -69,11 +69,40 @@ class DummyProvider(Provider):
         def classify_text(self, texts, labels):
             return [labels[abs(hash(t)) % len(labels)] for t in texts]
 
+    class _ImageEmbedder:
+        dimensions = 16
+
+        def embed_image(self, images):
+            import numpy as np
+
+            out = []
+            for img in images:
+                data = bytes(img) if isinstance(img, (bytes, bytearray)) \
+                    else np.asarray(img).tobytes()
+                rng = np.random.default_rng(abs(hash(data)) % (2**32))
+                v = rng.standard_normal(self.dimensions).astype(np.float32)
+                out.append(v / np.linalg.norm(v))
+            return out
+
+    class _Prompter:
+        def __init__(self, model):
+            self.model = model or "dummy-1"
+
+        def prompt(self, prompts):
+            # deterministic echo "generation" for offline tests/pipelines
+            return [f"[{self.model}] {p[:64]}" for p in prompts]
+
     def get_text_embedder(self, model=None, **options):
         return DummyProvider._Embedder()
 
+    def get_image_embedder(self, model=None, **options):
+        return DummyProvider._ImageEmbedder()
+
     def get_text_classifier(self, model=None, **options):
         return DummyProvider._Classifier()
+
+    def get_prompter(self, model=None, **options):
+        return DummyProvider._Prompter(model)
 
 
 class TransformersProvider(Provider):

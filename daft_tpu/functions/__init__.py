@@ -1,9 +1,9 @@
 from .registry import FunctionSpec, get_function, has_function, register
-from .ai import classify_text, embed_text, prompt
+from .ai import classify_text, embed_image, embed_text, llm_generate, prompt
 from .window import cume_dist, dense_rank, ntile, percent_rank, rank, row_number
 
 __all__ = [
     "FunctionSpec", "get_function", "has_function", "register",
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
-    "embed_text", "classify_text", "prompt",
+    "embed_text", "embed_image", "classify_text", "prompt", "llm_generate",
 ]

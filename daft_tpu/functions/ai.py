@@ -74,3 +74,53 @@ def prompt(expr: Expression, provider: str, model: Optional[str] = None, **optio
         return Series.from_pylist(out, s.name, DataType.string())
 
     return _batch_func(run, "prompt", DataType.string())(expr)
+
+
+def embed_image(expr: Expression, provider: str = "dummy",
+                model: Optional[str] = None, **options) -> Expression:
+    """Embed an image column via the named provider (reference:
+    daft/functions/ai embed_image over the ImageEmbedder protocol)."""
+    from ..ai.provider import get_provider
+    from ..core.series import Series
+
+    state = {}
+
+    def run(s: Series) -> Series:
+        if "e" not in state:
+            state["e"] = get_provider(provider).get_image_embedder(model, **options)
+        imgs = s.to_pylist()
+        mask = [i is not None for i in imgs]
+        vecs = state["e"].embed_image([i for i in imgs if i is not None])
+        it = iter(vecs)
+        out = [list(map(float, next(it))) if m else None for m in mask]
+        return Series.from_pylist(out, s.name, DataType.list(DataType.float32()))
+
+    return _batch_func(run, "embed_image", DataType.list(DataType.float32()))(expr)
+
+
+def llm_generate(expr: Expression, provider: str = "dummy",
+                 model: Optional[str] = None, max_concurrency: int = 1,
+                 use_process: bool = False, **options) -> Expression:
+    """LLM generation operator (reference: the VLLMExpr first-class operator +
+    actor pool, daft-dsl expr/mod.rs:311). Runs the provider's prompter as a
+    batched stateful operator: the optimizer's split-UDF rule isolates it into
+    its own pipeline node, and max_concurrency replicas serve batches
+    (use_process=True puts each replica in its own worker process — the
+    engine's actor-pool execution tier)."""
+    from ..ai.provider import get_provider
+    from ..core.series import Series
+
+    state = {}
+
+    def run(s: Series) -> Series:
+        if "p" not in state:
+            state["p"] = get_provider(provider).get_prompter(model, **options)
+        texts = s.to_pylist()
+        mask = [t is not None for t in texts]
+        res = state["p"].prompt([t for t in texts if t is not None])
+        it = iter(res)
+        out = [next(it) if m else None for m in mask]
+        return Series.from_pylist(out, s.name, DataType.string())
+
+    return _batch_func(run, "llm_generate", DataType.string(),
+                       max_concurrency=max_concurrency, use_process=use_process)(expr)

@@ -1,0 +1,137 @@
+"""Embedded web dashboard: live query history + per-operator stats.
+
+Reference parity: src/daft-dashboard (axum server with bundled UI and live
+query/operator state, launched via daft.subscribers.dashboard.launch() and the
+CLI). Here: a Subscriber records query lifecycle events into a bounded
+in-memory history; a threaded HTTP server renders them as JSON
+(/api/queries) and a self-contained HTML page (/).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+from .subscribers import Subscriber, attach_subscriber, detach_subscriber
+
+_HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
+<style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+table{border-collapse:collapse;width:100%%}
+td,th{border:1px solid #333;padding:4px 8px;text-align:left}
+th{background:#222}.ok{color:#7c7}.err{color:#e77}
+</style></head><body>
+<h2>daft_tpu — recent queries</h2><div id="t"></div>
+<script>
+async function refresh(){
+  const qs = await (await fetch('/api/queries')).json();
+  let h = '<table><tr><th>id</th><th>status</th><th>rows</th><th>seconds</th><th>top operators (rows / self ms)</th></tr>';
+  for (const q of qs){
+    const ops = (q.operators||[]).slice(0,4).map(o=>`${o.name}: ${o.rows_out} / ${(o.seconds*1000).toFixed(1)}ms`).join('<br>');
+    h += `<tr><td>${q.query_id}</td><td class="${q.error?'err':'ok'}">${q.error||(q.done?'done':'running')}</td>`+
+         `<td>${q.rows??''}</td><td>${q.seconds?.toFixed?.(3)??''}</td><td>${ops}</td></tr>`;
+  }
+  document.getElementById('t').innerHTML = h + '</table>';
+}
+refresh(); setInterval(refresh, 1000);
+</script></body></html>"""
+
+
+class DashboardState(Subscriber):
+    """Bounded history of query events (newest first)."""
+
+    def __init__(self, max_queries: int = 100):
+        self._lock = threading.Lock()
+        self._queries: deque = deque(maxlen=max_queries)
+        self._by_id: dict = {}
+
+    def on_query_start(self, event: QueryStart) -> None:
+        rec = {"query_id": event.query_id, "started": time.time(),
+               "plan": event.unoptimized_plan, "done": False, "operators": []}
+        with self._lock:
+            self._queries.appendleft(rec)
+            self._by_id[event.query_id] = rec
+
+    def on_query_optimized(self, event: QueryOptimized) -> None:
+        with self._lock:
+            rec = self._by_id.get(event.query_id)
+            if rec is not None:
+                rec["physical_plan"] = event.physical_plan
+
+    def on_operator_stats(self, query_id: str, stats: OperatorStats) -> None:
+        with self._lock:
+            rec = self._by_id.get(query_id)
+            if rec is not None:
+                rec["operators"].append({
+                    "name": stats.name, "rows_out": stats.rows_out,
+                    "batches": stats.batches_out, "seconds": stats.seconds,
+                })
+
+    def on_query_end(self, event: QueryEnd) -> None:
+        with self._lock:
+            rec = self._by_id.get(event.query_id)
+            if rec is not None:
+                rec.update(done=True, rows=event.rows, seconds=event.seconds,
+                           error=event.error)
+                rec["operators"].sort(key=lambda o: -o["seconds"])
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self._queries]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/api/queries"):
+            body = json.dumps(self.server.state.snapshot(), default=str).encode()
+            ctype = "application/json"
+        elif self.path == "/" or self.path.startswith("/index"):
+            body = _HTML.encode()
+            ctype = "text/html"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class Dashboard:
+    """launch() attaches the subscriber and serves until shutdown()."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.state = DashboardState()
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.state = self.state
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self._server.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def launch(self) -> "Dashboard":
+        attach_subscriber(self.state)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        detach_subscriber(self.state)
+        self._server.shutdown()
+
+
+def launch(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    """Start the dashboard (reference: daft.subscribers.dashboard.launch)."""
+    return Dashboard(host, port).launch()

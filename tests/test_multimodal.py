@@ -129,3 +129,34 @@ def test_approx_count_distinct():
     approx = d.agg(col("x").approx_count_distinct().alias("a")).to_pydict()["a"][0]
     exact = d.agg(col("x").count_distinct().alias("e")).to_pydict()["e"][0]
     assert abs(approx - exact) / exact < 0.15
+
+
+def test_image_embed_and_llm_generate():
+    """AI tier: image embedding protocol + the LLM-generation operator shape
+    (stateful batched prompter isolated into its own pipeline node)."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.functions import embed_image, llm_generate
+
+    df = daft_tpu.from_pydict({
+        "img": [b"\x00\x01\x02", b"\x03\x04\x05", None],
+        "q": ["what is 2+2?", None, "name a color"],
+    })
+    out = df.select(
+        embed_image(col("img")).alias("e"),
+        llm_generate(col("q"), provider="dummy", model="m1").alias("a"),
+    ).to_pydict()
+    assert len(out["e"][0]) == 16 and out["e"][2] is None
+    assert out["a"][0].startswith("[m1] what is 2+2?")
+    assert out["a"][1] is None
+
+
+def test_llm_generate_process_actor_pool():
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.functions import llm_generate
+
+    df = daft_tpu.from_pydict({"q": [f"q{i}" for i in range(20)]})
+    out = df.select(llm_generate(col("q"), provider="dummy", use_process=True,
+                                 max_concurrency=2).alias("a")).to_pydict()
+    assert all(a.endswith(q) for a, q in zip(out["a"], [f"q{i}" for i in range(20)]))

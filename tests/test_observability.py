@@ -121,3 +121,24 @@ def test_explain_analyze_reports_operators():
     assert "PhysSort" in report or "Sort" in report
     # the final sort emits exactly 3 groups
     assert " 3 " in report or "3" in report
+
+
+def test_dashboard_serves_query_history():
+    import json
+    import urllib.request
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.observability.dashboard import launch
+
+    dash = launch()
+    try:
+        daft_tpu.from_pydict({"a": list(range(10))}).where(col("a") > 4).to_pydict()
+        with urllib.request.urlopen(dash.url + "/api/queries", timeout=5) as r:
+            data = json.loads(r.read())
+        assert data and data[0]["done"] and data[0]["rows"] == 5
+        assert data[0]["operators"], "no operator stats recorded"
+        with urllib.request.urlopen(dash.url + "/", timeout=5) as r:
+            assert b"daft_tpu" in r.read()
+    finally:
+        dash.shutdown()
