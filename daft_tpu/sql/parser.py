@@ -57,6 +57,33 @@ _TYPE_NAMES = {
 }
 
 
+class InSubquery(Expression):
+    """`expr IN (SELECT ...)` marker (reference: daft-dsl Expr::InSubquery).
+    Never evaluated directly — the planner rewrites it into a semi join (anti
+    under NOT)."""
+
+    def __init__(self, child: Expression, select):
+        self.child = child
+        self.select = select
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return InSubquery(children[0], self.select)
+
+    def to_field(self, schema):
+        from ..datatype import Field
+
+        return Field(self.name(), DataType.bool())
+
+    def __repr__(self):
+        return f"{self.child!r} IN (<subquery>)"
+
+
 @dataclasses.dataclass
 class SelectItem:
     expr: Optional[Expression]   # None for wildcard
@@ -230,7 +257,9 @@ class Parser:
         if op == "IN":
             self.expect("punct", "(")
             if self.at_kw("SELECT"):
-                raise NotImplementedError("IN (subquery) not supported yet")
+                sub = self._parse_select()
+                self.expect("punct", ")")
+                return InSubquery(lhs, sub)
             items = [self.parse_expr()]
             while self.eat("punct", ","):
                 items.append(self.parse_expr())
@@ -314,7 +343,27 @@ class Parser:
                 s = self.next().value
                 return lit(_dt.datetime.fromisoformat(s))
             if up == "INTERVAL":
-                raise NotImplementedError("INTERVAL literals not supported yet")
+                self.next()
+                import datetime as _dt
+
+                spec = self.expect("string").value.strip()
+                parts = spec.split()
+                if len(parts) == 2:
+                    n, unit = parts
+                elif self.peek().kind == "ident":
+                    n, unit = spec, self.next().value
+                else:
+                    raise ValueError(f"malformed INTERVAL {spec!r}")
+                n = float(n)
+                unit = unit.rstrip("sS").lower()
+                fixed = {"day": 86400.0, "week": 7 * 86400.0, "hour": 3600.0,
+                         "minute": 60.0, "second": 1.0, "millisecond": 1e-3}
+                if unit in fixed:
+                    return lit(_dt.timedelta(seconds=n * fixed[unit]))
+                raise NotImplementedError(
+                    f"INTERVAL unit {unit!r}: calendar units (month/year) are not "
+                    "fixed durations; use the dt namespace (e.g. add via "
+                    "datetime arithmetic in the DataFrame API)")
             # function call?
             if self.peek(1).kind == "punct" and self.peek(1).value == "(":
                 return self._parse_function_call()

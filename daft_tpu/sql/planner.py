@@ -84,6 +84,44 @@ class SQLPlanner:
         return df
 
     # ---- expression resolution ----------------------------------------------------
+    def _apply_where(self, df, where: Expression, scope: Scope):
+        """Apply a WHERE clause; top-level [NOT] IN (SELECT ...) conjuncts
+        become semi/anti joins against the planned subquery (reference:
+        unnest_subquery + push_down_anti_semi_join)."""
+        from ..expressions.expressions import BinaryOp, UnaryOp
+        from .parser import InSubquery
+
+        def conjuncts(e):
+            if isinstance(e, BinaryOp) and e.op == "and":
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        rest = []
+        for c in conjuncts(where):
+            negated = False
+            node = c
+            if isinstance(node, UnaryOp) and node.op == "not" and isinstance(node.child, InSubquery):
+                negated = True
+                node = node.child
+            if isinstance(node, InSubquery):
+                sub_df = SQLPlanner(self.bindings, self.cte_frames,
+                                    session=self.session).plan(node.select)
+                key = sub_df.column_names[0]
+                df = df.join(sub_df, left_on=self._resolve_expr(node.child, scope),
+                             right_on=key, how="anti" if negated else "semi")
+            else:
+                for n in node.walk():
+                    if isinstance(n, InSubquery):
+                        raise NotImplementedError(
+                            "IN (subquery) only supported as a top-level AND conjunct")
+                rest.append(node)
+        if rest:
+            pred = rest[0]
+            for r in rest[1:]:
+                pred = pred & r
+            df = df.where(self._resolve_expr(pred, scope))
+        return df
+
     def _resolve_expr(self, e: Expression, scope: Scope) -> Expression:
         def rewrite(node):
             if isinstance(node, ColumnRef) and "." in node._name:
@@ -135,7 +173,7 @@ class SQLPlanner:
             df = self._plan_join(df, j, scope)
 
         if sel.where is not None:
-            df = df.where(self._resolve_expr(sel.where, scope))
+            df = self._apply_where(df, sel.where, scope)
 
         # expand wildcards
         items: List[SelectItem] = []

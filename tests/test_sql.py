@@ -189,3 +189,33 @@ def test_window_partition_col_survives_pruning(tmp_path):
         col("v").sum().over(Window().partition_by("g")).alias("s")
     ).to_pydict()
     assert sorted(out["s"]) == [3.0, 3.0, 3.0]
+
+
+def test_sql_in_subquery_semi_and_anti():
+    import daft_tpu
+
+    orders = daft_tpu.from_pydict({"okey": [1, 2, 3, 4], "amt": [10, 20, 30, 40]})
+    big = daft_tpu.from_pydict({"k": [2, 4, 9]})
+    out = daft_tpu.sql(
+        "SELECT okey FROM orders WHERE okey IN (SELECT k FROM big) ORDER BY okey",
+        orders=orders, big=big).to_pydict()
+    assert out == {"okey": [2, 4]}
+    out = daft_tpu.sql(
+        "SELECT okey FROM orders WHERE okey NOT IN (SELECT k FROM big) AND amt > 10 "
+        "ORDER BY okey", orders=orders, big=big).to_pydict()
+    assert out == {"okey": [3]}
+
+
+def test_sql_interval_literal():
+    import datetime
+
+    import daft_tpu
+
+    df = daft_tpu.from_pydict({
+        "d": [datetime.date(1994, 1, 1), datetime.date(1994, 6, 1)],
+        "v": [1, 2],
+    })
+    out = daft_tpu.sql(
+        "SELECT v FROM t WHERE d < DATE '1994-01-01' + INTERVAL '90' DAY", t=df
+    ).to_pydict()
+    assert out == {"v": [1]}
