@@ -1,0 +1,51 @@
+"""JSONL query event log (reference parity: daft/subscribers/event_log.py).
+
+Attach an EventLogSubscriber to append one JSON line per lifecycle event —
+a durable, grep-able audit trail that doubles as the integration point for
+external trace pipelines (each record carries the query id, wall time, and
+the event payload).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+from .subscribers import Subscriber, attach_subscriber, detach_subscriber
+
+
+class EventLogSubscriber(Subscriber):
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _emit(self, kind: str, payload: dict) -> None:
+        rec = {"ts": time.time(), "event": kind, **payload}
+        with self._lock, open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+
+    def on_query_start(self, e) -> None:
+        self._emit("query_start", dataclasses.asdict(e))
+
+    def on_query_optimized(self, e) -> None:
+        self._emit("query_optimized", dataclasses.asdict(e))
+
+    def on_operator_stats(self, qid, s) -> None:
+        self._emit("operator_stats", {"query_id": qid, **dataclasses.asdict(s)})
+
+    def on_query_end(self, e) -> None:
+        d = dataclasses.asdict(e)
+        d.pop("operator_stats", None)  # emitted individually above
+        self._emit("query_end", d)
+
+
+def enable_event_log(path: str) -> EventLogSubscriber:
+    sub = EventLogSubscriber(path)
+    attach_subscriber(sub)
+    return sub
+
+
+def disable_event_log(sub: EventLogSubscriber) -> None:
+    detach_subscriber(sub)

@@ -142,3 +142,23 @@ def test_dashboard_serves_query_history():
             assert b"daft_tpu" in r.read()
     finally:
         dash.shutdown()
+
+
+def test_event_log_writes_jsonl(tmp_path):
+    import json as _json
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.observability.event_log import disable_event_log, enable_event_log
+
+    p = str(tmp_path / "events.jsonl")
+    sub = enable_event_log(p)
+    try:
+        daft_tpu.from_pydict({"a": [1, 2, 3]}).where(col("a") > 1).to_pydict()
+    finally:
+        disable_event_log(sub)
+    events = [_json.loads(l) for l in open(p)]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "query_start" and kinds[-1] == "query_end"
+    assert "operator_stats" in kinds
+    assert events[-1]["rows"] == 2
