@@ -343,6 +343,73 @@ class DataFrame:
         info = WriteInfo("json", root_dir, {}, None, write_mode)
         return self._write(info)
 
+    def __len__(self) -> int:
+        return self.count_rows()
+
+    def add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
+        return self._next(self._builder.add_monotonically_increasing_id(column_name))
+
+    def except_(self, other: "DataFrame") -> "DataFrame":
+        """Set difference (EXCEPT DISTINCT): rows of self not present in other."""
+        on = self.column_names
+        return self.distinct().join(other, left_on=on, right_on=other.column_names,
+                                    how="anti")
+
+    def pipe(self, fn, *args, **kwargs):
+        """Apply fn(self, *args, **kwargs) — fluent composition helper."""
+        return fn(self, *args, **kwargs)
+
+    def transform(self, fn, *args, **kwargs) -> "DataFrame":
+        out = fn(self, *args, **kwargs)
+        if not isinstance(out, DataFrame):
+            raise ValueError(f"transform fn must return a DataFrame, got {type(out).__name__}")
+        return out
+
+    def drop_null(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows with nulls in the given columns (all columns if none)."""
+        exprs = _to_exprs(cols) if cols else [_to_expr(c) for c in self.column_names]
+        pred = exprs[0].not_null()
+        for e in exprs[1:]:
+            pred = pred & e.not_null()
+        return self.where(pred)
+
+    def drop_nan(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows with NaNs in the given float columns (all float columns
+        if none)."""
+        from ..expressions.expressions import Function
+
+        if cols:
+            exprs = _to_exprs(cols)
+        else:
+            exprs = [_to_expr(f.name) for f in self.schema if f.dtype.is_floating()]
+        if not exprs:
+            return self
+        pred = None
+        for e in exprs:
+            c = ~Function("is_nan", [e]) & e.not_null() | e.is_null()
+            pred = c if pred is None else pred & c
+        return self.where(pred)
+
+    def describe(self) -> "DataFrame":
+        """Per-numeric-column summary: count / mean / stddev / min / max
+        (reference: DataFrame.describe / summarize)."""
+        from ..expressions import col as _col
+
+        aggs = []
+        for f in self.schema:
+            if f.dtype.is_numeric() and not f.dtype.is_decimal():
+                c = _col(f.name)
+                aggs += [c.count().alias(f"{f.name}_count"),
+                         c.mean().alias(f"{f.name}_mean"),
+                         c.stddev().alias(f"{f.name}_stddev"),
+                         c.min().alias(f"{f.name}_min"),
+                         c.max().alias(f"{f.name}_max")]
+        if not aggs:
+            raise ValueError("describe() needs at least one numeric column")
+        return self.agg(*aggs)
+
+    summarize = describe
+
     def write_sink(self, sink) -> "DataFrame":
         """Write through a custom DataSink (reference: daft/io/sink.py —
         start() once, write() per partition, finalize() -> result table)."""

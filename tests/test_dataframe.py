@@ -234,3 +234,23 @@ def test_count_distinct_grouped():
     d = dt.from_pydict({"k": ["a", "a", "a", "b"], "v": [1, 1, 2, None]})
     out = d.groupby("k").agg(col("v").count_distinct().alias("n")).sort("k").to_pydict()
     assert out["n"] == [2, 0]
+
+
+def test_api_breadth_methods():
+    import daft_tpu
+    from daft_tpu import col
+
+    df = daft_tpu.from_pydict({"a": [1, 2, None, 4], "b": [1.0, float("nan"), 3.0, 4.0]})
+    assert len(df) == 4
+    assert df.drop_null("a").count_rows() == 3
+    assert df.drop_nan("b").count_rows() == 3
+    ids = df.add_monotonically_increasing_id("rid").to_pydict()["rid"]
+    assert len(set(ids)) == 4
+    out = df.pipe(lambda d, k: d.where(col("a") > k), 1).to_pydict()
+    assert out["a"] == [2, 4]
+    d = df.drop_null("a").select("a").describe().to_pydict()
+    assert d["a_count"] == [3] and d["a_min"] == [1] and d["a_max"] == [4]
+
+    x = daft_tpu.from_pydict({"k": [1, 2, 3]})
+    y = daft_tpu.from_pydict({"k": [2]})
+    assert x.except_(y).sort("k").to_pydict() == {"k": [1, 3]}
