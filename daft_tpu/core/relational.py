@@ -493,7 +493,7 @@ def sample(batch: RecordBatch, fraction: float, with_replacement: bool, seed: Op
 def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expression],
               right_on: Sequence[Expression], how: str,
               output_schema: Schema, merged_keys: Sequence[str],
-              right_rename: dict) -> RecordBatch:
+              right_rename: dict, null_equals_null: bool = False) -> RecordBatch:
     """Hash join via encoded key codes (kernels/join.py).
 
     `merged_keys` = right column names that merge into the left key column.
@@ -501,7 +501,7 @@ def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expressio
     """
     lkeys = _eval_keys(left, left_on)
     rkeys = _eval_keys(right, right_on)
-    lidx, ridx = join_indices(lkeys, rkeys, how)
+    lidx, ridx = join_indices(lkeys, rkeys, how, null_equals_null)
 
     if how in ("semi", "anti"):
         return left.take(lidx)

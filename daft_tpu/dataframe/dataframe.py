@@ -142,8 +142,10 @@ class DataFrame:
         by_list = by if isinstance(by, list) else [by]
         return self._next(self._builder.sort(by_list, desc, nulls_first))
 
-    def _add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
+    def add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
         return self._next(self._builder.add_monotonically_increasing_id(column_name))
+
+    _add_monotonically_increasing_id = add_monotonically_increasing_id
 
     def repartition(self, num: Optional[int], *partition_by: ColumnInput) -> "DataFrame":
         if partition_by:
@@ -162,7 +164,7 @@ class DataFrame:
              left_on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
              right_on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
              how: str = "inner", prefix: Optional[str] = None, suffix: Optional[str] = None,
-             strategy: Optional[str] = None) -> "DataFrame":
+             strategy: Optional[str] = None, null_equals_null: bool = False) -> "DataFrame":
         if on is not None:
             left_on = right_on = on
         if how == "cross":
@@ -171,7 +173,8 @@ class DataFrame:
             raise ValueError("join requires `on` or both `left_on` and `right_on`")
         lo = left_on if isinstance(left_on, list) else [left_on]
         ro = right_on if isinstance(right_on, list) else [right_on]
-        return self._next(self._builder.join(other._builder, lo, ro, how, prefix, suffix, strategy))
+        return self._next(self._builder.join(other._builder, lo, ro, how, prefix, suffix,
+                                             strategy, null_equals_null))
 
     def concat(self, other: "DataFrame") -> "DataFrame":
         return self._next(self._builder.concat(other._builder))
@@ -182,15 +185,22 @@ class DataFrame:
         return self.concat(other).distinct()
 
     def intersect(self, other: "DataFrame") -> "DataFrame":
-        # semi join on all columns + distinct (reference: ops/intersect.rs semantics)
+        # semi join on all columns + distinct (reference: ops/intersect.rs
+        # semantics); SQL set ops treat NULL keys as equal
         names = self.column_names
         return self.join(other, left_on=[col(n) for n in names],
-                         right_on=[col(n) for n in names], how="semi").distinct()
+                         right_on=[col(n) for n in names], how="semi",
+                         null_equals_null=True).distinct()
 
     def except_distinct(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT DISTINCT: rows of self absent from other (NULLs match NULLs,
+        per SQL set-op semantics)."""
         names = self.column_names
         return self.join(other, left_on=[col(n) for n in names],
-                         right_on=[col(n) for n in names], how="anti").distinct()
+                         right_on=[col(n) for n in names], how="anti",
+                         null_equals_null=True).distinct()
+
+    except_ = except_distinct
 
     # ---- aggregation -------------------------------------------------------------
     def groupby(self, *group_by: ColumnInput) -> "GroupedDataFrame":
@@ -343,18 +353,6 @@ class DataFrame:
         info = WriteInfo("json", root_dir, {}, None, write_mode)
         return self._write(info)
 
-    def __len__(self) -> int:
-        return self.count_rows()
-
-    def add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
-        return self._next(self._builder.add_monotonically_increasing_id(column_name))
-
-    def except_(self, other: "DataFrame") -> "DataFrame":
-        """Set difference (EXCEPT DISTINCT): rows of self not present in other."""
-        on = self.column_names
-        return self.distinct().join(other, left_on=on, right_on=other.column_names,
-                                    how="anti")
-
     def pipe(self, fn, *args, **kwargs):
         """Apply fn(self, *args, **kwargs) — fluent composition helper."""
         return fn(self, *args, **kwargs)
@@ -376,8 +374,6 @@ class DataFrame:
     def drop_nan(self, *cols: ColumnInput) -> "DataFrame":
         """Drop rows with NaNs in the given float columns (all float columns
         if none)."""
-        from ..expressions.expressions import Function
-
         if cols:
             exprs = _to_exprs(cols)
         else:
@@ -386,7 +382,7 @@ class DataFrame:
             return self
         pred = None
         for e in exprs:
-            c = ~Function("is_nan", [e]) & e.not_null() | e.is_null()
+            c = e.is_null() | ~e.float.is_nan()
             pred = c if pred is None else pred & c
         return self.where(pred)
 

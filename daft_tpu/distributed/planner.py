@@ -191,7 +191,8 @@ def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
                 and r_bytes <= execution_config().broadcast_join_size_bytes):
             frags = [
                 pp.HashJoin(lf, node.right, node.left_on, node.right_on, node.how,
-                            node.merged_keys, node.right_rename, node.schema)
+                            node.merged_keys, node.right_rename, node.schema,
+                            node.null_equals_null)
                 for lf in left.fragments
             ]
             keep = left.partitioned_by
@@ -211,7 +212,8 @@ def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
             rfrags = right.fragments
         frags = [
             pp.HashJoin(lf, rf, node.left_on, node.right_on, node.how,
-                        node.merged_keys, node.right_rename, node.schema)
+                        node.merged_keys, node.right_rename, node.schema,
+                        node.null_equals_null)
             for lf, rf in zip(lfrags, rfrags)
         ]
         out_keys = lkeys if lkeys and set(lkeys).issubset(set(node.schema.column_names())) else None

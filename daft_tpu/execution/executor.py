@@ -851,7 +851,8 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
             # probe side streams batch-by-batch: never materialized
             for b in _batch_iter(_exec(node.left)):
                 out = rel.hash_join(b, right, node.left_on, node.right_on, node.how,
-                                    node.schema, node.merged_keys, node.right_rename)
+                                    node.schema, node.merged_keys, node.right_rename,
+                                node.null_equals_null)
                 yield MicroPartition(node.schema, [out])
             return
         # right/outer need the full left side to find unmatched build rows
@@ -866,7 +867,8 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
             left = RecordBatch.concat(left_prefix) if left_prefix \
                 else RecordBatch.empty(node.left.schema)
             out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
-                                node.schema, node.merged_keys, node.right_rename)
+                                node.schema, node.merged_keys, node.right_rename,
+                                node.null_equals_null)
             yield MicroPartition(node.schema, [out])
             return
 
@@ -888,7 +890,8 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
             left = RecordBatch.concat(lbs) if lbs else RecordBatch.empty(node.left.schema)
             right = RecordBatch.concat(rbs) if rbs else RecordBatch.empty(node.right.schema)
             out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
-                                node.schema, node.merged_keys, node.right_rename)
+                                node.schema, node.merged_keys, node.right_rename,
+                                node.null_equals_null)
             if out.num_rows:
                 yield MicroPartition(node.schema, [out])
     finally:

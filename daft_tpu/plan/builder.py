@@ -143,10 +143,11 @@ class LogicalPlanBuilder:
     def join(self, right: "LogicalPlanBuilder", left_on: Sequence[ColumnInput],
              right_on: Sequence[ColumnInput], how: str = "inner",
              prefix: Optional[str] = None, suffix: Optional[str] = None,
-             strategy: Optional[str] = None) -> "LogicalPlanBuilder":
+             strategy: Optional[str] = None,
+             null_equals_null: bool = False) -> "LogicalPlanBuilder":
         return self._next(
             lp.Join(self._plan, right._plan, _to_exprs(left_on), _to_exprs(right_on),
-                    how, prefix, suffix, strategy)
+                    how, prefix, suffix, strategy, null_equals_null)
         )
 
     def cross_join(self, right: "LogicalPlanBuilder", prefix: Optional[str] = None,

@@ -536,7 +536,7 @@ class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, left_on: List[Expression],
                  right_on: List[Expression], how: str = "inner",
                  prefix: Optional[str] = None, suffix: Optional[str] = None,
-                 strategy: Optional[str] = None):
+                 strategy: Optional[str] = None, null_equals_null: bool = False):
         super().__init__()
         if how not in self.JOIN_TYPES:
             raise ValueError(f"unknown join type {how!r}")
@@ -548,13 +548,15 @@ class Join(LogicalPlan):
         self.prefix = prefix
         self.suffix = suffix
         self.strategy = strategy  # None=auto, 'hash', 'sort_merge', 'broadcast', 'cross'
+        # SQL set ops (EXCEPT/INTERSECT) match NULL keys to NULL keys
+        self.null_equals_null = null_equals_null
 
     def children(self):
         return [self.left, self.right]
 
     def with_children(self, children):
         return Join(children[0], children[1], self.left_on, self.right_on, self.how,
-                    self.prefix, self.suffix, self.strategy)
+                    self.prefix, self.suffix, self.strategy, self.null_equals_null)
 
     def output_naming(self):
         """(merged_keys, right_rename): join keys with identical names merge into one

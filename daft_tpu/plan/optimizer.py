@@ -396,7 +396,7 @@ def rule_push_filter_through_join(node: lp.LogicalPlan) -> Optional[lp.LogicalPl
     if right_push or derived_right:
         new_right = lp.Filter(new_right, _and_all(right_push + derived_right))
     new_join = lp.Join(new_left, new_right, join.left_on, join.right_on, join.how,
-                       join.prefix, join.suffix, join.strategy)
+                       join.prefix, join.suffix, join.strategy, join.null_equals_null)
     if remaining:
         return lp.Filter(new_join, _and_all(remaining))
     return new_join
@@ -648,7 +648,7 @@ def _prune(node: lp.LogicalPlan, needed: Optional[List[str]]) -> lp.LogicalPlan:
                     _refs(node.right_on))
         return lp.Join(_prune(node.left, left_needed), _prune(node.right, right_needed),
                        node.left_on, node.right_on, node.how,
-                       node.prefix, node.suffix, node.strategy)
+                       node.prefix, node.suffix, node.strategy, node.null_equals_null)
 
     # Window / Pivot / Unpivot / Sink / anything else: conservatively need all
     return node.with_children([_prune(c, None) for c in node.children()])

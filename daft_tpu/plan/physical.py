@@ -223,7 +223,7 @@ class PhysConcat(PhysicalPlan):
 
 class HashJoin(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on, how,
-                 merged_keys, right_rename, schema: Schema):
+                 merged_keys, right_rename, schema: Schema, null_equals_null: bool = False):
         super().__init__()
         self.left = left
         self.right = right
@@ -233,6 +233,7 @@ class HashJoin(PhysicalPlan):
         self.merged_keys = merged_keys
         self.right_rename = right_rename
         self.schema = schema
+        self.null_equals_null = null_equals_null
 
     def children(self):
         return [self.left, self.right]
@@ -434,11 +435,12 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
                     hj = HashJoin(translate(plan.right, config),
                                   translate(plan.left, config),
                                   plan.right_on, plan.left_on, "inner",
-                                  s_merged, s_rename, swapped.schema)
+                                  s_merged, s_rename, swapped.schema,
+                                  plan.null_equals_null)
                     return Project(hj, [_col(f.name) for f in plan.schema], plan.schema)
         return HashJoin(translate(plan.left, config), translate(plan.right, config),
                         plan.left_on, plan.right_on, plan.how,
-                        merged_keys, right_rename, plan.schema)
+                        merged_keys, right_rename, plan.schema, plan.null_equals_null)
 
     if isinstance(plan, lp.Repartition):
         return PhysRepartition(translate(plan.input, config), plan.num_partitions,

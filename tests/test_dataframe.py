@@ -159,7 +159,7 @@ def test_sample(df):
 
 
 def test_monotonic_id(df):
-    out = df._add_monotonically_increasing_id().to_pydict()
+    out = df.add_monotonically_increasing_id().to_pydict()
     assert out["id"] == [0, 1, 2, 3, 4]
 
 
@@ -254,3 +254,14 @@ def test_api_breadth_methods():
     x = daft_tpu.from_pydict({"k": [1, 2, 3]})
     y = daft_tpu.from_pydict({"k": [2]})
     assert x.except_(y).sort("k").to_pydict() == {"k": [1, 3]}
+
+
+def test_set_ops_null_semantics():
+    """SQL set-op semantics: NULL keys match NULL keys in EXCEPT/INTERSECT."""
+    import daft_tpu
+
+    a = daft_tpu.from_pydict({"k": [1, None, 2], "v": [1.0, 2.0, 3.0]})
+    b = daft_tpu.from_pydict({"k": [None, 2], "v": [2.0, 3.0]})
+    assert a.except_(b).to_pydict() == {"k": [1], "v": [1.0]}
+    got = a.intersect(b).sort("v").to_pydict()
+    assert got == {"k": [None, 2], "v": [2.0, 3.0]}

@@ -261,3 +261,28 @@ def test_task_error_propagates_with_traceback():
             pool.run_tasks([SubPlanTask.from_plan("boom", bad)])
     finally:
         pool.shutdown()
+
+
+def test_distributed_tpch_sweep(dist_runner):
+    """Several TPC-H shapes (scan-agg, join-agg-topn, multi-join) through the
+    distributed runner must match the native runner."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from benchmarking.tpch.datagen import load_dataframes
+    from benchmarking.tpch.queries import ALL_QUERIES
+
+    tables = {k: v.collect() for k, v in load_dataframes(sf=0.01, seed=0).items()}
+    for qnum in (1, 3, 10, 12):
+        def q(qnum=qnum):
+            return ALL_QUERIES[qnum](tables)
+
+        got, expect = _run_both(q, dist_runner)
+        assert list(got.keys()) == list(expect.keys()), qnum
+        for c in expect:
+            if expect[c] and isinstance(expect[c][0], float):
+                np.testing.assert_allclose(got[c], expect[c], rtol=1e-9,
+                                           err_msg=f"q{qnum}.{c}")
+            else:
+                assert got[c] == expect[c], f"q{qnum}.{c}"
