@@ -383,6 +383,10 @@ def device_agg(op: str, v: jnp.ndarray, m: jnp.ndarray) -> Tuple[jnp.ndarray, jn
     if op == "sum":
         if v.dtype == jnp.bool_:
             v = v.astype(jnp.uint64)
+        elif jnp.issubdtype(v.dtype, jnp.floating):
+            # accumulate float sums in f64 like `mean` does: an f32 whole-bucket
+            # reduction would cap the partial at ~7 significant digits
+            v = v.astype(jnp.float64)
         s = jnp.sum(jnp.where(m, v, jnp.zeros_like(v)))
         if jnp.issubdtype(s.dtype, jnp.signedinteger):
             s = s.astype(jnp.int64)

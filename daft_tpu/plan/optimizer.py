@@ -141,8 +141,15 @@ def simplify_expr(e: Expression, schema=None) -> Expression:
             pv = lit_val(node.predicate)
             if pv is True:
                 return node.if_true
-            if pv is False or pv is None:
+            if pv is False:
                 return node.if_false
+            if pv is None and schema is not None:
+                # a literal-NULL predicate yields NULL (pc.if_else / device
+                # masked-where semantics), not the if_false branch
+                try:
+                    return Literal(None).cast(node.to_field(schema).dtype)
+                except Exception:
+                    return None
         return None
 
     return e.transform(rewrite)
@@ -940,8 +947,10 @@ def _reorder_join_chain(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
 
 
 def _plain_inner_join(n) -> bool:
+    # null_equals_null joins are excluded: the reordered chain is rebuilt with
+    # default join semantics, which would silently flip nulls-match behavior
     return (isinstance(n, lp.Join) and n.how == "inner" and n.strategy is None
-            and n.prefix is None and n.suffix is None)
+            and n.prefix is None and n.suffix is None and not n.null_equals_null)
 
 
 def _bare_name(e: Expression) -> Optional[str]:

@@ -107,8 +107,27 @@ class SQLPlanner:
                 sub_df = SQLPlanner(self.bindings, self.cte_frames,
                                     session=self.session).plan(node.select)
                 key = sub_df.column_names[0]
-                df = df.join(sub_df, left_on=self._resolve_expr(node.child, scope),
-                             right_on=key, how="anti" if negated else "semi")
+                left_key = self._resolve_expr(node.child, scope)
+                if negated:
+                    # SQL three-valued NOT IN: a NULL anywhere in the subquery
+                    # makes the predicate NULL for every row (no rows pass);
+                    # NULL left-side keys pass only against an EMPTY subquery
+                    # (vacuously true). A plain anti join keeps both, so guard
+                    # with a cross-joined (total, non-null) count before it.
+                    # materialize once: the plan is consumed twice below (stats
+                    # agg + anti join) and the executor has no subplan caching
+                    sub_df = sub_df.collect()
+                    stats = sub_df.agg(
+                        lit(1).count("all").alias("__in_sub_cnt__"),
+                        col(key).count().alias("__in_sub_nn__"))
+                    guard = (col("__in_sub_cnt__") == col("__in_sub_nn__")) & (
+                        (col("__in_sub_cnt__") == lit(0)) | left_key.not_null())
+                    df = (df.join(stats, how="cross")
+                            .where(guard)
+                            .exclude("__in_sub_cnt__", "__in_sub_nn__")
+                            .join(sub_df, left_on=left_key, right_on=key, how="anti"))
+                else:
+                    df = df.join(sub_df, left_on=left_key, right_on=key, how="semi")
             else:
                 for n in node.walk():
                     if isinstance(n, InSubquery):

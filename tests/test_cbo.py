@@ -179,3 +179,28 @@ def test_join_reorder_refuses_shared_nonkey_column_names():
     # 'x' must still be relation A's values (<1), 'right.x' relation C's (>=100)
     assert all(v < 1.0 for v in out["x"])
     assert all(v >= 100.0 for v in out["right.x"])
+
+
+def test_join_reorder_preserves_null_equals_null():
+    """A reorderable >=3-relation chain with null_equals_null=True must keep
+    nulls-match semantics (the rebuilt chain used to drop the flag)."""
+    from daft_tpu import col
+
+    a = daft_tpu.from_pydict({"k1": [1, None], "v1": [10, 20]})
+    b = daft_tpu.from_pydict({"k1": [1, None], "k2": [5, 6]})
+    c = daft_tpu.from_pydict({"k2": [5, 6], "v3": [100, 200]})
+    j = (a.join(b, on=col("k1"), null_equals_null=True)
+          .join(c, on=col("k2"), null_equals_null=True))
+    assert sorted(j.to_pydict()["v1"]) == [10, 20]
+
+
+def test_simplify_null_predicate_if_else_stays_null():
+    """Literal-NULL if_else predicates yield NULL (pc.if_else semantics); the
+    optimizer must not fold them to the if_false branch."""
+    import daft_tpu as dt
+    from daft_tpu import col, lit
+
+    df = daft_tpu.from_pydict({"a": [1, 2, 3]})
+    pred = lit(None).cast(dt.DataType.bool())
+    out = df.select(pred.if_else(col("a"), col("a") * 10).alias("r")).to_pydict()
+    assert out == {"r": [None, None, None]}

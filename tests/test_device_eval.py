@@ -123,3 +123,16 @@ def test_padding_invariance():
     v5 = fn({"a": b.get_column("a").to_device()})
     np.testing.assert_array_equal(np.asarray(v8[0])[:5], np.asarray(v5[0]))
     np.testing.assert_array_equal(np.asarray(v8[1])[:5], np.asarray(v5[1]))
+
+
+def test_device_agg_float_sum_uses_f64_accumulation():
+    """Float sums must accumulate in f64: an f32 whole-bucket reduction carries
+    only ~7 significant digits, corrupting partials before the host combine."""
+    n = 200_000
+    v = jnp.concatenate([jnp.asarray([1e8], jnp.float32),
+                         jnp.full((n,), 0.25, jnp.float32)])
+    m = jnp.ones((n + 1,), jnp.bool_)
+    val, valid = jax.jit(lambda v, m: device_agg("sum", v, m))(v, m)
+    assert bool(valid)
+    expect = 1e8 + 0.25 * n
+    assert abs(float(val) - expect) < 1.0  # f32 accumulation would be off by ~50k

@@ -219,3 +219,22 @@ def test_sql_interval_literal():
         "SELECT v FROM t WHERE d < DATE '1994-01-01' + INTERVAL '90' DAY", t=df
     ).to_pydict()
     assert out == {"v": [1]}
+
+
+def test_sql_not_in_subquery_three_valued_nulls():
+    """NOT IN three-valued semantics (reference: sqlparser NOT IN planning +
+    unnest_subquery): NULL in the subquery -> zero rows; NULL left keys pass
+    only against an empty subquery."""
+    import daft_tpu
+
+    df = daft_tpu.from_pydict({"x": [1, 2, 3, None]})
+    q = "SELECT x FROM df WHERE x NOT IN (SELECT y FROM sub)"
+    # any NULL in the subquery nullifies the predicate for every row
+    sub = daft_tpu.from_pydict({"y": [1, None]})
+    assert daft_tpu.sql(q, df=df, sub=sub).to_pydict() == {"x": []}
+    # no NULLs: left NULL keys are dropped, non-matching rows kept
+    sub = daft_tpu.from_pydict({"y": [1]})
+    assert sorted(daft_tpu.sql(q, df=df, sub=sub).to_pydict()["x"]) == [2, 3]
+    # empty subquery: vacuously true for every row, including NULL keys
+    sub = daft_tpu.from_pydict({"y": []})
+    assert daft_tpu.sql(q, df=df, sub=sub).to_pydict()["x"] == [1, 2, 3, None]
