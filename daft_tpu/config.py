@@ -63,6 +63,15 @@ class ExecutionConfig:
     num_threads: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4)
     )
+    # Pipeline-parallel execution (reference: daft-local-execution pipeline.rs —
+    # operators run as concurrent tasks over bounded channels, intermediate ops
+    # fan morsels across a worker pool). "on" (default: parallel when the
+    # compute pool has >1 worker, else the zero-overhead sequential
+    # interpreter) | "force" (parallel even on one core — correctness tests) |
+    # "off" (sequential; exact per-op time attribution).
+    pipeline_mode: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_PIPELINE", "on")
+    )
     # Multi-chip mesh execution: when >= 2 (and that many JAX devices exist),
     # qualifying grouped aggregations execute via the mesh-sharded exact groupby
     # (parallel/distributed.py: per-shard sort/unique + segment-reduce, one

@@ -267,3 +267,31 @@ def _common_key_dtype(a, b):
             __import__("pyarrow").from_numpy_dtype(np.promote_types(a.to_numpy(), b.to_numpy()))
         )
     raise ValueError(f"cannot join/compare keys of dtypes {a} and {b}")
+
+
+def canonical_key_values(s):
+    """(kind, values, valid) for join-key probing (kernels/join.py ProbeTable).
+
+    THE single copy of the key-equality canonicalization rules, shared with
+    equality_codes above: values canonicalized so hash equality matches
+    equality_codes() — floats bit-canonicalized (-0.0 == 0.0, NaNs equal),
+    temporals as int64, strings/binary/decimal as objects, nested via hash."""
+    dt = s.dtype
+    valid = s.validity_numpy()
+    n = len(s)
+    if dt.is_null():
+        return "null", np.zeros(n, dtype=np.int64), valid
+    if (dt.is_numeric() and not dt.is_decimal()) or dt.is_boolean() or dt.is_temporal():
+        vals = s.to_numpy()
+        if vals.dtype.kind == "f":
+            vals = (vals + 0.0).view(np.int64 if vals.dtype.itemsize == 8
+                                     else np.int32).astype(np.int64, copy=False)
+        elif vals.dtype == bool:
+            vals = vals.astype(np.int64)
+        elif vals.dtype.kind in "mM":
+            vals = vals.view(np.int64)
+        return "num", vals, valid
+    if dt.is_string() or dt.is_binary() or dt.is_decimal():
+        vals = np.asarray(s.to_arrow().to_numpy(zero_copy_only=False))
+        return "obj", vals, valid
+    return "hash", s.hash().to_numpy(), valid

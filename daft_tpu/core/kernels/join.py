@@ -11,11 +11,12 @@ Returns (left_indices, right_indices) where -1 marks a missing partner.
 
 from __future__ import annotations
 
-from typing import Tuple
+import threading
+from typing import Optional, Tuple
 
 import numpy as np
 
-from .encoding import encode_keys_equality
+from .encoding import canonical_key_values, encode_keys_equality
 
 
 def _expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
@@ -102,3 +103,230 @@ def cross_join_indices(n_left: int, n_right: int) -> Tuple[np.ndarray, np.ndarra
     lidx = np.repeat(np.arange(n_left, dtype=np.int64), n_right)
     ridx = np.tile(np.arange(n_right, dtype=np.int64), n_left)
     return lidx, ridx
+
+
+# ======================================================================================
+# Reusable probe table (build once, probe many)
+# ======================================================================================
+
+
+class ProbeTable:
+    """Build-side index for streaming/parallel hash-join probes.
+
+    Reference parity: src/daft-recordbatch/src/probeable/mod.rs (probe table
+    built once per build side) + src/daft-local-execution/src/join/probe.rs
+    (each probe morsel looks keys up without touching build rows again).
+    join_indices() above re-encodes BOTH sides jointly per call — O(build) per
+    probe batch — which this class exists to avoid.
+
+    Build: canonicalize + factorize each build key column into a hash
+    dictionary (pandas Index, engine primed so concurrent probes are safe),
+    combine per-column codes into joint compact codes via replayable pairing
+    levels, bucket build rows CSR-style. Probe: per-column hash lookup into the
+    stored dictionaries (absent values are unmatchable), replay the pairing
+    levels, expand CSR ranges. Match set and output order are identical to
+    join_indices (left-major; build rows in original order within a key).
+    """
+
+    def __init__(self, right_keys: list, left_dtypes: list, null_equals_null: bool):
+        import pandas as pd
+
+        from .encoding import _common_key_dtype
+
+        self.null_equals_null = null_equals_null
+        self.n_right = len(right_keys[0]) if right_keys else 0
+        self._dtypes = []
+        self._kinds = []
+        self._lookups = []  # per col: ("dense", lo, hi) | ("sorted", uniq) | ("index", pd.Index) | ("null",)
+        rcols = []
+        rnull = np.zeros(self.n_right, dtype=bool)
+        for rs, ldt in zip(right_keys, left_dtypes):
+            target = rs.dtype if rs.dtype == ldt else _common_key_dtype(ldt, rs.dtype)
+            if rs.dtype != target:
+                rs = rs.cast(target)
+            self._dtypes.append(target)
+            kind, vals, valid = canonical_key_values(rs)
+            self._kinds.append(kind)
+            if kind == "null":
+                codes = np.full(self.n_right, -1, dtype=np.int64)
+                self._lookups.append(("null",))
+            elif kind in ("num", "hash"):
+                vals = vals.astype(np.int64, copy=False)
+                vv = vals[valid] if not valid.all() else vals
+                lo = int(vv.min()) if len(vv) else 0
+                hi = int(vv.max()) if len(vv) else -1
+                domain = hi - lo + 1
+                if 0 < domain <= max(4096, 4 * len(vv)):
+                    # dense int value domain (the TPC-H key shape): codes are
+                    # plain subtraction, no sort/hash at all — mirrors
+                    # encoding._dense_int_pair_codes. Buckets over the domain
+                    # may be sparse; bincount/CSR handle that.
+                    codes = vals - lo
+                    self._lookups.append(("dense", lo, hi))
+                else:
+                    # sparse domain: native O(1)/row open-addressing hash map
+                    # when the C library is loaded, else sorted-unique ranks
+                    # with O(log u) searchsorted probes
+                    from ...native import native_i64_map_build, native_i64_map_lookup
+
+                    uniq = np.unique(vv)
+                    hm = native_i64_map_build(uniq) if len(uniq) else None
+                    if hm is not None:
+                        codes = native_i64_map_lookup(hm[0], hm[1], hm[2], vals)
+                        self._lookups.append(("hashmap", hm))
+                    else:
+                        codes = np.searchsorted(uniq, vals).astype(np.int64, copy=False) \
+                            if len(uniq) else np.zeros(self.n_right, dtype=np.int64)
+                        self._lookups.append(("sorted", uniq))
+            else:
+                codes, uniq = pd.factorize(vals)
+                codes = codes.astype(np.int64, copy=False)
+                if not codes.flags.writeable:
+                    codes = codes.copy()
+                idx = pd.Index(uniq)
+                if len(idx):
+                    idx.get_indexer(idx[:1])  # prime the hash engine: probes are concurrent
+                self._lookups.append(("index", idx))
+            codes[~valid] = -1
+            rcols.append(codes)
+            rnull |= ~valid
+
+        self._levels = []
+        codes = rcols[0] if rcols else np.zeros(0, dtype=np.int64)
+        for c in rcols[1:]:
+            g = int(c.max()) + 1 if len(c) else 1
+            pair = (codes + 1) * (g + 2) + (c + 1)
+            jc, uniq = pd.factorize(pair)
+            idx = pd.Index(uniq)
+            if len(idx):
+                idx.get_indexer(idx[:1])
+            self._levels.append((idx, g))
+            codes = jc.astype(np.int64, copy=False)
+
+        self._shift = 0
+        if null_equals_null:
+            if len(rcols) <= 1:
+                # single column: joint code IS the per-column code, so the -1
+                # null marker must become a real bucket (multi-column pairing
+                # already gives null tuples real buckets)
+                codes = codes + 1
+                self._shift = 1
+        else:
+            codes = codes.copy()
+            codes[rnull] = -1  # any-null build rows never match
+
+        G = int(codes.max(initial=-1)) + 1
+        pos = codes >= 0
+        self._counts = np.ascontiguousarray(
+            np.bincount(codes[pos], minlength=max(G, 1)), dtype=np.int64)
+        self._starts = np.ascontiguousarray(
+            np.concatenate([[0], np.cumsum(self._counts)[:-1]]), dtype=np.int64)
+        self._num_codes = G
+        # bucket rows (the argsort) are only needed for inner/left row fills —
+        # built lazily so semi/anti joins never pay for them
+        self._joint_codes = codes
+        self._bucket_rows: Optional[np.ndarray] = None
+        self._rows_lock = threading.Lock()
+
+    def _ensure_bucket_rows(self) -> np.ndarray:
+        if self._bucket_rows is None:
+            with self._rows_lock:
+                if self._bucket_rows is None:
+                    codes = self._joint_codes
+                    pos = codes >= 0
+                    pcodes = codes[pos]
+                    rows = np.nonzero(pos)[0].astype(np.int64)
+                    order = np.argsort(pcodes, kind="stable")
+                    self._bucket_rows = np.ascontiguousarray(rows[order], dtype=np.int64)
+        return self._bucket_rows
+
+    def probe_codes(self, left_keys: list) -> Tuple[np.ndarray, np.ndarray]:
+        """Map probe-side key columns into the build side's joint code space.
+        Returns (codes, any_null_mask); negative codes never match."""
+        n = len(left_keys[0]) if left_keys else 0
+        lcols = []
+        lnull = np.zeros(n, dtype=bool)
+        for ls, target, lookup in zip(left_keys, self._dtypes, self._lookups):
+            if ls.dtype != target:
+                ls = ls.cast(target)
+            _kind, vals, valid = canonical_key_values(ls)
+            if lookup[0] == "null":
+                codes = np.full(n, -2, dtype=np.int64)  # null-dtype build col
+            elif lookup[0] == "dense":
+                lo, hi = lookup[1], lookup[2]
+                vals = vals.astype(np.int64, copy=False)
+                codes = vals - lo
+                codes[(vals < lo) | (vals > hi)] = -2
+            elif lookup[0] == "hashmap":
+                from ...native import native_i64_map_lookup
+
+                hm = lookup[1]
+                vals = vals.astype(np.int64, copy=False)
+                codes = native_i64_map_lookup(hm[0], hm[1], hm[2], vals)
+                codes[codes == -1] = -2
+            elif lookup[0] == "sorted":
+                uniq = lookup[1]
+                vals = vals.astype(np.int64, copy=False)
+                if len(uniq):
+                    pos = np.searchsorted(uniq, vals)
+                    pos_c = np.minimum(pos, len(uniq) - 1)
+                    codes = np.where(uniq[pos_c] == vals, pos_c, -2).astype(np.int64)
+                else:
+                    codes = np.full(n, -2, dtype=np.int64)
+            else:
+                codes = lookup[1].get_indexer(vals).astype(np.int64, copy=False)
+                if not codes.flags.writeable:
+                    codes = codes.copy()
+                codes[codes == -1] = -2  # absent from build side: unmatchable
+            codes[~valid] = -1
+            lcols.append(codes)
+            lnull |= ~valid
+        codes = lcols[0] if lcols else np.zeros(0, dtype=np.int64)
+        for (idx, _g), c in zip(self._levels, lcols[1:]):
+            pair = (codes + 1) * (_g + 2) + (c + 1)
+            codes = idx.get_indexer(pair).astype(np.int64, copy=False)
+            if not codes.flags.writeable:
+                codes = codes.copy()
+        if self.null_equals_null:
+            codes = codes + self._shift
+        else:
+            codes = codes.copy()
+            codes[lnull] = -1
+        return codes, lnull
+
+    def probe(self, left_keys: list, how: str) -> Tuple[np.ndarray, np.ndarray]:
+        from ...native import native_probe
+
+        lcodes, _ = self.probe_codes(left_keys)
+        nl = len(lcodes)
+        G = self._num_codes
+
+        if how in ("semi", "anti"):
+            valid = (lcodes >= 0) & (lcodes < G)
+            safe = np.where(valid, lcodes, 0)
+            counts = np.where(valid, self._counts[safe], 0).astype(np.int64)
+            keep = counts > 0 if how == "semi" else counts == 0
+            lidx = np.nonzero(keep)[0].astype(np.int64)
+            return lidx, np.full(len(lidx), -1, dtype=np.int64)
+
+        bucket_rows = self._ensure_bucket_rows()
+        native = native_probe(lcodes, G, self._starts, self._counts, bucket_rows)
+        if native is not None:
+            matched_l, matched_r, counts = native
+        else:
+            valid = (lcodes >= 0) & (lcodes < G)
+            safe = np.where(valid, lcodes, 0)
+            counts = np.where(valid, self._counts[safe], 0).astype(np.int64)
+            starts = np.where(valid, self._starts[safe], 0).astype(np.int64)
+            matched_l = np.repeat(np.arange(nl, dtype=np.int64), counts)
+            pos = _expand_ranges(starts, counts)
+            matched_r = bucket_rows[pos] if len(pos) else np.empty(0, dtype=np.int64)
+        if how == "inner":
+            return matched_l, matched_r
+        if how == "left":
+            unmatched_l = np.nonzero(counts == 0)[0].astype(np.int64)
+            lidx = np.concatenate([matched_l, unmatched_l])
+            ridx = np.concatenate([matched_r, np.full(len(unmatched_l), -1, dtype=np.int64)])
+            return lidx, ridx
+        raise ValueError(f"ProbeTable.probe does not support how={how!r}")
+

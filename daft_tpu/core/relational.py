@@ -473,6 +473,26 @@ def distinct(batch: RecordBatch, on: Optional[Sequence[Expression]] = None) -> R
     return batch.take(np.sort(first_idx))
 
 
+def sample_at(batch: RecordBatch, fraction: float, seed: int, offset: int) -> RecordBatch:
+    """Chunking-invariant seeded Bernoulli sample: row at global position p is
+    kept iff splitmix64(p, seed) maps below `fraction` — the SAME rows are
+    chosen no matter how the stream is batched or morselized, so seeded
+    sampling reproduces across pipeline modes and host core counts."""
+    n = batch.num_rows
+    if n == 0:
+        return batch
+    x = np.arange(offset, offset + n, dtype=np.uint64)
+    salt = (0x9E3779B97F4A7C15 * ((seed & 0x7FFFFFFFFFFFFFFF) + 1)) & 0xFFFFFFFFFFFFFFFF
+    x = x + np.uint64(salt)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    keep = (x >> np.uint64(11)).astype(np.float64) * (2.0 ** -53) < fraction
+    return batch.take(np.nonzero(keep)[0].astype(np.int64))
+
+
 def sample(batch: RecordBatch, fraction: float, with_replacement: bool, seed: Optional[int]) -> RecordBatch:
     n = batch.num_rows
     k = int(round(n * fraction))
@@ -502,7 +522,13 @@ def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expressio
     lkeys = _eval_keys(left, left_on)
     rkeys = _eval_keys(right, right_on)
     lidx, ridx = join_indices(lkeys, rkeys, how, null_equals_null)
+    return _assemble_join(left, right, lidx, ridx, rkeys, left_on, right_on, how,
+                          output_schema, merged_keys, right_rename)
 
+
+def _assemble_join(left: RecordBatch, right: RecordBatch, lidx: np.ndarray,
+                   ridx: np.ndarray, rkeys: List[Series], left_on, right_on,
+                   how: str, output_schema: Schema, merged_keys, right_rename) -> RecordBatch:
     if how in ("semi", "anti"):
         return left.take(lidx)
 
@@ -535,6 +561,42 @@ def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expressio
                                       for c, f in zip(cols, output_schema.fields)],
                       len(lidx))
     return out
+
+
+class JoinProbe:
+    """Build-once probe-many streaming join for inner/left/semi/anti.
+
+    Reference parity: src/daft-local-execution/src/join/build.rs (build the
+    probe table once) + probe.rs (each probe morsel is an index lookup). The
+    underlying ProbeTable primes its hash engines at build time, so concurrent
+    probes from the morsel pool are safe. Output rows for each probe batch are
+    identical to hash_join(batch, right, ...) — but without re-encoding the
+    build side per batch.
+    """
+
+    def __init__(self, right: RecordBatch, left_on, right_on, how: str,
+                 output_schema: Schema, merged_keys, right_rename,
+                 null_equals_null: bool, left_schema: Schema):
+        from .kernels.join import ProbeTable
+
+        assert how in ("inner", "left", "semi", "anti"), how
+        self.right = right
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.output_schema = output_schema
+        self.merged_keys = merged_keys
+        self.right_rename = right_rename
+        rkeys = _eval_keys(right, right_on)
+        left_dtypes = [e.to_field(left_schema).dtype for e in self.left_on]
+        self.table = ProbeTable(rkeys, left_dtypes, null_equals_null)
+
+    def probe(self, left: RecordBatch) -> RecordBatch:
+        lkeys = _eval_keys(left, self.left_on)
+        lidx, ridx = self.table.probe(lkeys, self.how)
+        return _assemble_join(left, self.right, lidx, ridx, [], self.left_on,
+                              self.right_on, self.how, self.output_schema,
+                              self.merged_keys, self.right_rename)
 
 
 def _find_col(cols: List[Series], name: str, schema: Schema) -> int:

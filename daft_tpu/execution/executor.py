@@ -36,12 +36,51 @@ def execute_plan(plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
 def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
     """Dispatch one physical node; wraps its stream with per-operator runtime
     stats when a collector is active (subscribers / explain_analyze), else the
-    zero-overhead direct generator."""
+    zero-overhead direct generator. In pipeline mode (config.pipeline_mode ==
+    "on", the default) substantial operators additionally run on their own
+    stage thread behind a bounded channel, so the whole plan executes as
+    concurrent tasks with backpressure (reference: pipeline.rs:358 +
+    channel.rs)."""
     from ..observability.runtime_stats import current_collector
 
     c = current_collector()
     gen = _exec_impl(node)
-    return c.wrap(node, gen) if c is not None else gen
+    if c is not None:
+        gen = c.wrap(node, gen)
+    if isinstance(node, _STAGE_NODES) and _pipeline_on():
+        from .pipeline import spawn_stage
+
+        gen = spawn_stage(gen)
+    return gen
+
+
+def _pipeline_on() -> bool:
+    from ..config import execution_config
+    from ..utils.pool import compute_pool
+
+    mode = execution_config().pipeline_mode
+    if mode == "force":
+        return True
+    # on a single-core host, fan-out and stage threads are pure overhead
+    return mode == "on" and compute_pool()._max_workers > 1
+
+
+def _map_op(stream: Iterator[MicroPartition], fn) -> Iterator[MicroPartition]:
+    """Run fn(part, index) over a partition stream. Pipeline mode: morselize
+    oversized partitions into zero-copy slices and fan out across the compute
+    pool, yielding in order (reference: intermediate_op.rs:45-59 — every
+    intermediate op runs N concurrent workers over morsels). Off mode: plain
+    sequential map."""
+    from ..config import execution_config
+
+    if _pipeline_on():
+        from .pipeline import morsel_stream, pmap_stream
+
+        cfg = execution_config()
+        yield from pmap_stream(morsel_stream(stream, cfg.morsel_size_rows), fn)
+    else:
+        for i, part in enumerate(stream):
+            yield fn(part, i)
 
 
 def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
@@ -89,12 +128,16 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.Project):
-        for part in _exec(node.input):
+        def _project(part, _i):
             batches = [eval_projection(b, node.projection) for b in part.batches]
-            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+            return MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+
+        yield from _map_op(_exec(node.input), _project)
         return
 
     if isinstance(node, pp.UDFProject):
+        # sequential: UDFs may hold non-thread-safe state (heavy ones run on the
+        # process pool via the UDF tier; concurrency is governed there)
         exprs = list(node.passthrough) + [node.udf_expr]
         for part in _exec(node.input):
             batches = [eval_projection(b, exprs) for b in part.batches]
@@ -102,8 +145,8 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.PhysFilter):
-        for part in _exec(node.input):
-            yield _filter_part(part, node.predicate)
+        yield from _map_op(_exec(node.input),
+                           lambda part, _i: _filter_part(part, node.predicate))
         return
 
     if isinstance(node, pp.PhysLimit):
@@ -130,23 +173,37 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.PhysExplode):
-        for part in _exec(node.input):
+        def _explode(part, _i):
             batches = [rel.explode(b, node.to_explode, node.schema) for b in part.batches]
-            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+            return MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+
+        yield from _map_op(_exec(node.input), _explode)
         return
 
     if isinstance(node, pp.PhysUnpivot):
-        for part in _exec(node.input):
+        def _unpivot(part, _i):
             batches = [rel.unpivot(b, node.ids, node.values, node.variable_name,
                                    node.value_name, node.schema) for b in part.batches]
-            yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+            return MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+
+        yield from _map_op(_exec(node.input), _unpivot)
         return
 
     if isinstance(node, pp.PhysSample):
-        seed = node.seed
+        # sequential (sampling is cheap). Seeded without-replacement sampling
+        # is position-hashed (rel.sample_at), so the chosen rows do not depend
+        # on how upstream operators batched the stream — the same seed gives
+        # the same rows in pipeline and sequential modes on any host.
+        offset = 0
         for i, part in enumerate(_exec(node.input)):
-            s = None if seed is None else seed + i
-            batches = [rel.sample(b, node.fraction, node.with_replacement, s) for b in part.batches]
+            batches = []
+            for b in part.batches:
+                if node.seed is not None and not node.with_replacement:
+                    batches.append(rel.sample_at(b, node.fraction, node.seed, offset))
+                else:
+                    s = None if node.seed is None else node.seed + i
+                    batches.append(rel.sample(b, node.fraction, node.with_replacement, s))
+                offset += b.num_rows
             yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
         return
 
@@ -300,6 +357,17 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
 
 
 _MORSEL_ROWS = 256 * 1024
+
+# Operators that run as their own concurrent stage in pipeline mode. Excluded:
+# InMemoryScan (yields references), PhysConcat (pass-through), PhysLimit/TopN/
+# IntoBatches (cheap sequential state machines), ShuffleWrite/PhysWrite (sinks
+# driven by their consumer), UDFProject (UDF concurrency is governed by the
+# UDF tier).
+_STAGE_NODES = (pp.TaskScan, pp.Project, pp.PhysFilter, pp.PhysExplode,
+                pp.PhysUnpivot, pp.PhysSample, pp.PhysSort, pp.UngroupedAggregate,
+                pp.HashAggregate, pp.DeviceFilterAgg, pp.DeviceGroupedAgg,
+                pp.Dedup, pp.PhysPivot, pp.PhysWindow, pp.HashJoin, pp.CrossJoin,
+                pp.PhysRepartition)
 
 
 def _exec_device_agg(node) -> MicroPartition:
@@ -848,12 +916,18 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
         right = RecordBatch.concat(right_parts) if right_parts \
             else RecordBatch.empty(node.right.schema)
         if node.how not in ("right", "outer"):
-            # probe side streams batch-by-batch: never materialized
-            for b in _batch_iter(_exec(node.left)):
-                out = rel.hash_join(b, right, node.left_on, node.right_on, node.how,
-                                    node.schema, node.merged_keys, node.right_rename,
-                                node.null_equals_null)
-                yield MicroPartition(node.schema, [out])
+            # probe side streams morsel-by-morsel: never materialized. The
+            # probe table is built ONCE from the build side; each morsel is an
+            # index lookup, fanned across the pool in pipeline mode.
+            probe = rel.JoinProbe(right, node.left_on, node.right_on, node.how,
+                                  node.schema, node.merged_keys, node.right_rename,
+                                  node.null_equals_null, node.left.schema)
+
+            def _probe(part, _i):
+                outs = [probe.probe(b) for b in part.batches if b.num_rows]
+                return MicroPartition(node.schema, outs or [RecordBatch.empty(node.schema)])
+
+            yield from _map_op(_exec(node.left), _probe)
             return
         # right/outer need the full left side to find unmatched build rows
         # exactly once — admit it against the budget too

@@ -1,0 +1,172 @@
+"""Pipeline-parallel execution primitives.
+
+Reference parity: src/daft-local-execution/src/pipeline.rs:358 (every pipeline
+node runs as its own concurrent task), src/daft-local-execution/src/channel.rs
+(bounded channels with backpressure), and
+src/daft-local-execution/src/intermediate_ops/intermediate_op.rs:45-59
+(intermediate operators fan morsels across a shared worker pool).
+
+Host parallelism on threads is real here: the hot kernels are numpy / pyarrow
+/ the C++ extension / JAX dispatch, all of which release the GIL. Three
+primitives:
+
+- Channel / spawn_stage: run one operator's generator on a dedicated thread,
+  pushing into a bounded queue. Backpressure = the bounded queue; cancellation
+  (a downstream limit stops pulling, or the query errors) propagates upstream
+  by closing the producer's generator, which unwinds its `finally` blocks
+  (spill-file cleanup etc.) on the producer thread.
+- pmap_stream: ordered morsel fan-out — submit fn(item, i) for a bounded
+  window of in-flight items to the shared compute pool, yield results in input
+  order (row order is part of the engine's semantics).
+- morsels: split one oversized MicroPartition into zero-copy slices so a
+  single in-memory partition still feeds the whole pool.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from queue import Full, Queue
+from typing import Callable, Iterator, List, Optional
+
+from ..core.micropartition import MicroPartition
+
+
+class StageCancelled(BaseException):
+    """Raised inside a producer blocked on a closed channel. BaseException so
+    user-level `except Exception` inside operator bodies can't swallow it."""
+
+
+_SENTINEL = object()
+
+
+class Channel:
+    """Bounded single-producer/single-consumer channel with error and
+    cancellation propagation."""
+
+    def __init__(self, maxsize: int = 4):
+        self._q: Queue = Queue(maxsize)
+        self._cancel = threading.Event()
+        self._err: Optional[BaseException] = None
+
+    # ---- producer side -----------------------------------------------------------
+    def put(self, item) -> None:
+        while True:
+            if self._cancel.is_set():
+                raise StageCancelled()
+            try:
+                self._q.put(item, timeout=0.05)
+                return
+            except Full:
+                continue
+
+    def close(self, err: Optional[BaseException] = None) -> None:
+        self._err = err
+        while True:
+            if self._cancel.is_set():
+                return
+            try:
+                self._q.put(_SENTINEL, timeout=0.05)
+                return
+            except Full:
+                continue
+
+    # ---- consumer side -----------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        try:
+            while True:
+                item = self._q.get()
+                if item is _SENTINEL:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            # normal exhaustion, consumer abandonment (GeneratorExit), or error:
+            # unblock and cancel the producer either way
+            self._cancel.set()
+
+
+def spawn_stage(gen: Iterator, maxsize: int = 4) -> Iterator:
+    """Run `gen` on a dedicated stage thread; return a bounded-channel iterator
+    over its output. The stage thread inherits the ambient stats collector
+    (threading.local in observability.runtime_stats).
+
+    The thread starts on the FIRST pull, not at call time: a plan that is
+    built but never iterated (caller bails before next()) must not leak
+    producer threads — the channel's cancel flag is only ever set by the
+    consumer iterator, which would otherwise never run."""
+    from ..observability.runtime_stats import current_collector, set_collector
+
+    ch = Channel(maxsize)
+    collector = current_collector()
+
+    def run():
+        set_collector(collector)
+        err: Optional[BaseException] = None
+        try:
+            for item in gen:
+                ch.put(item)
+        except StageCancelled:
+            pass
+        except BaseException as e:  # noqa: BLE001 — must ferry to the consumer
+            err = e
+        finally:
+            try:
+                gen.close()  # unwind upstream finally blocks on this thread
+            except BaseException:
+                pass
+            ch.close(err)
+
+    def consume():
+        threading.Thread(target=run, daemon=True, name="daft-stage").start()
+        yield from ch
+
+    return consume()
+
+
+def pmap_stream(stream: Iterator, fn: Callable, window: int = 0) -> Iterator:
+    """Ordered parallel map over a stream: keep up to `window` fn(item, index)
+    calls in flight on the shared compute pool, yielding results in input
+    order. While the window is full this thread blocks on the OLDEST future,
+    so upstream production, pool workers, and downstream consumption overlap.
+    """
+    from ..utils.pool import compute_pool
+
+    pool = compute_pool()
+    if window <= 0:
+        window = pool._max_workers
+    futs: deque = deque()
+    try:
+        for i, item in enumerate(stream):
+            futs.append(pool.submit(fn, item, i))
+            if len(futs) >= window:
+                yield futs.popleft().result()
+        while futs:
+            yield futs.popleft().result()
+    finally:
+        for f in futs:
+            f.cancel()
+
+
+def morsels(part: MicroPartition, morsel_rows: int) -> List[MicroPartition]:
+    """Split one partition into ~morsel_rows zero-copy slices (arrow slicing)
+    so a single large in-memory partition can fan out across the pool. Small
+    partitions pass through untouched."""
+    n = part.num_rows
+    if n <= morsel_rows * 2 or not part.batches:
+        return [part]
+    out: List[MicroPartition] = []
+    for b in part.batches:
+        if b.num_rows <= morsel_rows * 2:
+            if b.num_rows:
+                out.append(MicroPartition(part.schema, [b]))
+            continue
+        for s in range(0, b.num_rows, morsel_rows):
+            out.append(MicroPartition(part.schema, [b.slice(s, min(s + morsel_rows, b.num_rows))]))
+    return out or [part]
+
+
+def morsel_stream(stream: Iterator, morsel_rows: int) -> Iterator:
+    for part in stream:
+        yield from morsels(part, morsel_rows)

@@ -89,6 +89,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.join_fill.restype = None
     lib.join_fill.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
                               i64p, i64p, i64p, i64p]
+    lib.probe_count.restype = ctypes.c_int64
+    lib.probe_count.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.i64_map_build.restype = None
+    lib.i64_map_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.i64_map_lookup.restype = None
+    lib.i64_map_lookup.argtypes = [i64p, i64p, ctypes.c_int64, i64p, ctypes.c_int64, i64p]
+    lib.probe_fill.restype = None
+    lib.probe_fill.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p,
+                               i64p, i64p]
     _LIB = lib
     return _LIB
 
@@ -209,3 +218,58 @@ def native_grouped_minmax(gids: np.ndarray, vals: np.ndarray, valid: np.ndarray,
                                _p(mn, ctypes.c_int64), _p(mx, ctypes.c_int64))
         return mn, mx
     return None
+
+
+def native_probe(lcodes: np.ndarray, num_codes: int, bucket_offsets: np.ndarray,
+                 bucket_counts: np.ndarray, bucket_rows: np.ndarray) -> Optional[tuple]:
+    """Probe prebuilt join buckets: (l_idx, r_idx, l_match_counts) or None.
+    Buckets are built once by kernels/join.py ProbeTable; this is the per-morsel
+    lookup (all inputs read-only -> safe from concurrent pool threads)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lcodes = np.ascontiguousarray(lcodes, dtype=np.int64)
+    nl = len(lcodes)
+    l_match = np.empty(max(nl, 1), dtype=np.int64)
+    total = lib.probe_count(_p(lcodes, ctypes.c_int64), nl, int(num_codes),
+                            _p(bucket_counts, ctypes.c_int64), _p(l_match, ctypes.c_int64))
+    out_l = np.empty(max(total, 1), dtype=np.int64)
+    out_r = np.empty(max(total, 1), dtype=np.int64)
+    lib.probe_fill(_p(lcodes, ctypes.c_int64), nl, int(num_codes),
+                   _p(bucket_offsets, ctypes.c_int64), _p(bucket_counts, ctypes.c_int64),
+                   _p(bucket_rows, ctypes.c_int64), _p(out_l, ctypes.c_int64),
+                   _p(out_r, ctypes.c_int64))
+    return out_l[:total], out_r[:total], l_match[:nl]
+
+
+def native_i64_map_build(keys: np.ndarray) -> Optional[tuple]:
+    """Open-addressing hash map over unique int64 keys -> their positions.
+    Returns (slot_keys, slot_vals, cap) or None. Read-only after build, so
+    lookups are safe from concurrent pool threads."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    n = len(keys)
+    cap = 1
+    while cap < max(2 * n, 16):
+        cap <<= 1
+    slot_keys = np.empty(cap, dtype=np.int64)
+    slot_vals = np.full(cap, -1, dtype=np.int64)
+    lib.i64_map_build(_p(keys, ctypes.c_int64), n, cap,
+                      _p(slot_keys, ctypes.c_int64), _p(slot_vals, ctypes.c_int64))
+    return slot_keys, slot_vals, cap
+
+
+def native_i64_map_lookup(slot_keys: np.ndarray, slot_vals: np.ndarray, cap: int,
+                          vals: np.ndarray) -> Optional[np.ndarray]:
+    """Positions of vals in the map's key set (-1 for absent), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = np.empty(max(len(vals), 1), dtype=np.int64)
+    lib.i64_map_lookup(_p(slot_keys, ctypes.c_int64), _p(slot_vals, ctypes.c_int64),
+                       int(cap), _p(vals, ctypes.c_int64), len(vals),
+                       _p(out, ctypes.c_int64))
+    return out[:len(vals)]

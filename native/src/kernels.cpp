@@ -252,4 +252,78 @@ void join_fill(const int64_t* lcodes, int64_t nl, const int64_t* rcodes, int64_t
   }
 }
 
+// ---------------------------------------------------------------------------------
+// probe-table lookups: buckets prebuilt ONCE (ProbeTable), probed per morsel
+// ---------------------------------------------------------------------------------
+
+// Count matches per left row against prebuilt bucket counts. Returns total.
+int64_t probe_count(const int64_t* lcodes, int64_t nl, int64_t num_codes,
+                    const int64_t* bucket_counts, int64_t* l_match_counts) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nl; i++) {
+    const int64_t c = lcodes[i];
+    const int64_t m = (c >= 0 && c < num_codes) ? bucket_counts[c] : 0;
+    l_match_counts[i] = m;
+    total += m;
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------------
+// open-addressing int64 -> int64 map (power-of-2 capacity, linear probing):
+// sparse-domain join-key dictionaries where dense subtraction doesn't apply
+// ---------------------------------------------------------------------------------
+
+static inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+// keys must be unique; slot_vals pre-filled with -1 (empty marker).
+void i64_map_build(const int64_t* keys, int64_t n, int64_t cap,
+                   int64_t* slot_keys, int64_t* slot_vals) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = mix64((uint64_t)keys[i]) & mask;
+    while (slot_vals[h] != -1) h = (h + 1) & mask;
+    slot_keys[h] = keys[i];
+    slot_vals[h] = i;
+  }
+}
+
+void i64_map_lookup(const int64_t* slot_keys, const int64_t* slot_vals, int64_t cap,
+                    const int64_t* vals, int64_t n, int64_t* out) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = mix64((uint64_t)vals[i]) & mask;
+    int64_t r = -1;
+    while (slot_vals[h] != -1) {
+      if (slot_keys[h] == vals[i]) { r = slot_vals[h]; break; }
+      h = (h + 1) & mask;
+    }
+    out[i] = r;
+  }
+}
+
+// Emit matched pairs from prebuilt buckets (left-major; build rows in
+// original order within a key — bucket_rows is stable-sorted by code).
+void probe_fill(const int64_t* lcodes, int64_t nl, int64_t num_codes,
+                const int64_t* bucket_offsets, const int64_t* bucket_counts,
+                const int64_t* bucket_rows, int64_t* out_l, int64_t* out_r) {
+  int64_t out = 0;
+  for (int64_t i = 0; i < nl; i++) {
+    const int64_t c = lcodes[i];
+    if (c < 0 || c >= num_codes) continue;
+    const int64_t s = bucket_offsets[c];
+    const int64_t e = s + bucket_counts[c];
+    for (int64_t j = s; j < e; j++) {
+      out_l[out] = i;
+      out_r[out] = bucket_rows[j];
+      out++;
+    }
+  }
+}
+
 }  // extern "C"

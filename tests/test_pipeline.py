@@ -1,0 +1,247 @@
+"""Pipeline-parallel executor (execution/pipeline.py).
+
+Covers the reference's execution-concurrency contract
+(daft-local-execution pipeline.rs + channel.rs + intermediate_op.rs): operator
+overlap, bounded-queue backpressure, ordered morsel fan-out, cancellation on
+early consumer exit, and error propagation — plus engine-level parity between
+the parallel and sequential interpreters.
+"""
+
+import threading
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+from daft_tpu.config import execution_config_ctx
+from daft_tpu.execution.pipeline import (Channel, StageCancelled, morsels,
+                                         pmap_stream, spawn_stage)
+
+
+def _stage_threads() -> int:
+    return sum(1 for t in threading.enumerate() if t.name.startswith("daft-stage"))
+
+
+# ---- primitives -------------------------------------------------------------------
+
+
+def test_spawn_stage_streams_and_overlaps():
+    def produce():
+        for i in range(4):
+            time.sleep(0.05)
+            yield i
+
+    t0 = time.perf_counter()
+    out = []
+    for item in spawn_stage(produce()):
+        time.sleep(0.05)  # consumer work overlaps producer work
+        out.append(item)
+    elapsed = time.perf_counter() - t0
+    assert out == [0, 1, 2, 3]
+    assert elapsed < 0.38  # serial would be ~0.40s+; overlapped ~0.25s
+
+
+def test_spawn_stage_propagates_errors():
+    def produce():
+        yield 1
+        raise ValueError("boom")
+
+    it = spawn_stage(produce())
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
+def test_spawn_stage_cancellation_unwinds_producer():
+    cleaned = threading.Event()
+
+    def produce():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            cleaned.set()
+
+    it = spawn_stage(produce(), maxsize=2)
+    assert next(it) == 0
+    it.close()  # consumer abandons (e.g. a downstream limit)
+    assert cleaned.wait(timeout=5.0), "producer finally-block never ran"
+
+
+def test_channel_backpressure_bounds_producer():
+    ch = Channel(maxsize=2)
+    produced = []
+
+    def run():
+        try:
+            for i in range(100):
+                ch.put(i)
+                produced.append(i)
+        except StageCancelled:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert len(produced) <= 3  # 2 queued + 1 in flight: bounded, not run-ahead
+    it = iter(ch)
+    assert [next(it) for _ in range(5)] == [0, 1, 2, 3, 4]
+    it.close()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_pmap_stream_preserves_order_and_parallelizes():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.utils import pool as pool_mod
+
+    def slow_double(x, i):
+        time.sleep(0.03)
+        return (i, x * 2)
+
+    # this box may have one core; prove overlap with an explicit 4-worker pool
+    prev = pool_mod._POOL
+    pool_mod._POOL = ThreadPoolExecutor(max_workers=4, thread_name_prefix="daft-compute")
+    try:
+        t0 = time.perf_counter()
+        out = list(pmap_stream(iter(range(8)), slow_double, window=4))
+        elapsed = time.perf_counter() - t0
+    finally:
+        pool_mod._POOL.shutdown(wait=False)
+        pool_mod._POOL = prev
+    assert out == [(i, i * 2) for i in range(8)]
+    assert elapsed < 0.03 * 8  # sleeps overlap across the window
+
+
+def test_pmap_stream_propagates_worker_errors():
+    def fn(x, i):
+        if x == 3:
+            raise RuntimeError("worker failed")
+        return x
+
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(pmap_stream(iter(range(6)), fn))
+
+
+def test_morsels_zero_copy_slicing_roundtrip():
+    df = daft_tpu.from_pydict({"a": list(range(10_000))}).collect()
+    [part] = df.iter_partitions()
+    pieces = morsels(part, 1024)
+    assert len(pieces) == 10
+    total = [v for p in pieces for v in p.batches[0].get_column("a").to_pylist()]
+    assert total == list(range(10_000))
+    assert morsels(part, 100_000) == [part]  # small inputs pass through
+
+
+# ---- engine-level -----------------------------------------------------------------
+
+
+def _queries(df, dim):
+    return [
+        lambda: df.where(col("a") % 7 != 0).select((col("a") * 3).alias("t"), col("k"))
+                  .groupby("k").agg(col("t").sum().alias("s")).sort("k").to_pydict(),
+        lambda: df.join(dim, on="k").where(col("w") > 5).count_rows(),
+        lambda: df.join(dim, on="k", how="left").select(col("a"), col("w"))
+                  .sort(["a"]).limit(17).to_pydict(),
+        lambda: df.select(col("a")).limit(13).to_pydict(),
+        lambda: df.distinct("k").sort("k").to_pydict(),
+    ]
+
+
+def test_parallel_matches_sequential_results():
+    n = 300_000
+    df = daft_tpu.from_pydict({
+        "a": list(range(n)),
+        "k": [i % 53 for i in range(n)],
+    }).collect()
+    dim = daft_tpu.from_pydict({"k": list(range(53)), "w": [float(i) for i in range(53)]})
+
+    with execution_config_ctx(pipeline_mode="force", morsel_size_rows=32 * 1024):
+        par = [q() for q in _queries(df, dim)]
+    with execution_config_ctx(pipeline_mode="off"):
+        seq = [q() for q in _queries(df, dim)]
+    assert par == seq
+
+
+def test_parallel_limit_leaves_no_stage_threads():
+    n = 500_000
+    df = daft_tpu.from_pydict({"a": list(range(n))}).collect()
+    with execution_config_ctx(pipeline_mode="force", morsel_size_rows=16 * 1024):
+        out = df.select((col("a") + 1).alias("b")).limit(5).to_pydict()
+    assert out == {"b": [1, 2, 3, 4, 5]}
+    deadline = time.time() + 5.0
+    while _stage_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    assert _stage_threads() == 0
+
+
+def test_parallel_error_propagates_and_cleans_up():
+    from daft_tpu.udf import func
+
+    @func
+    def explode_on_three(x: int) -> int:
+        if x == 3:
+            raise ValueError("udf boom")
+        return x
+
+    df = daft_tpu.from_pydict({"a": list(range(10))})
+    with execution_config_ctx(pipeline_mode="force"):
+        with pytest.raises(Exception, match="udf boom"):
+            df.select(explode_on_three(col("a"))).to_pydict()
+    deadline = time.time() + 5.0
+    while _stage_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    assert _stage_threads() == 0
+
+
+def test_probe_table_streaming_join_matches_batch_join():
+    """JoinProbe (build-once probe-many) must agree with one-shot hash_join
+    across join types, incl. nulls on both sides."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    n = 50_000
+    left = daft_tpu.from_pydict({
+        "k": [int(x) if x % 11 else None for x in rng.integers(0, 997, n)],
+        "v": list(range(n)),
+    }).collect()
+    right = daft_tpu.from_pydict({
+        "k": [int(x) if x % 13 else None for x in rng.integers(0, 997, 900)],
+        "w": [float(i) for i in range(900)],
+    }).collect()
+    for how in ("inner", "left", "semi", "anti"):
+        with execution_config_ctx(pipeline_mode="force", morsel_size_rows=8 * 1024):
+            par = left.join(right, on="k", how=how).sort(["v"]).to_pydict()
+        with execution_config_ctx(pipeline_mode="off"):
+            seq = left.join(right, on="k", how=how).sort(["v"]).to_pydict()
+        assert par == seq, how
+
+
+def test_seeded_sample_is_chunking_invariant():
+    """Seeded sampling picks the same rows whether the engine runs sequential
+    or pipeline-parallel with morselized streams (position-hashed Bernoulli)."""
+    n = 200_000
+    df = daft_tpu.from_pydict({"a": list(range(n))}).collect()
+    with execution_config_ctx(pipeline_mode="force", morsel_size_rows=16 * 1024):
+        par = df.select((col("a") * 2).alias("b")).sample(0.01, seed=7).to_pydict()
+    with execution_config_ctx(pipeline_mode="off"):
+        seq = df.select((col("a") * 2).alias("b")).sample(0.01, seed=7).to_pydict()
+    assert par == seq
+    assert 0.005 * n < len(par["b"]) < 0.015 * n
+
+
+def test_unstarted_plan_spawns_no_stage_threads():
+    """Building an execution stream and abandoning it before the first pull
+    must not leak stage threads (lazy thread start)."""
+    df = daft_tpu.from_pydict({"a": list(range(100_000))}).collect()
+    with execution_config_ctx(pipeline_mode="force"):
+        from daft_tpu.execution.executor import execute_plan
+        from daft_tpu.plan.physical import translate
+
+        builder = df.select((col("a") + 1).alias("b"))._builder
+        stream = execute_plan(translate(builder.optimize().plan))
+        del stream
+    time.sleep(0.3)
+    assert _stage_threads() == 0
