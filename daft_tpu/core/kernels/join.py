@@ -33,14 +33,26 @@ def join_indices(
     right_keys: list,
     how: str = "inner",
     null_equals_null: bool = False,
+    algorithm: str = "hash",
 ) -> Tuple[np.ndarray, np.ndarray]:
+    """algorithm="hash" (default): equality-hash key encoding + native bucket
+    join. algorithm="sort_merge": order-preserving key encoding + the sorted
+    binary-search merge below — the engine's sort-merge join strategy
+    (reference: translate_join.rs JoinStrategy::SortMerge). Output contract is
+    identical either way."""
     if how == "right":
-        ridx2, lidx2 = join_indices(right_keys, left_keys, "left", null_equals_null)
+        ridx2, lidx2 = join_indices(right_keys, left_keys, "left", null_equals_null,
+                                    algorithm)
         return lidx2, ridx2
     if how not in ("inner", "left", "outer", "semi", "anti"):
         raise ValueError(f"unsupported join type: {how}")
 
-    lcodes, rcodes, lnull, rnull = encode_keys_equality(left_keys, right_keys)
+    if algorithm == "sort_merge":
+        from .encoding import encode_keys
+
+        lcodes, rcodes, lnull, rnull = encode_keys(left_keys, right_keys)
+    else:
+        lcodes, rcodes, lnull, rnull = encode_keys_equality(left_keys, right_keys)
     assert rcodes is not None
 
     lcodes = lcodes.copy()
@@ -59,7 +71,8 @@ def join_indices(
     num_codes = int(max(lcodes.max(initial=-1), rcodes.max(initial=-1))) + 1
 
     if how in ("semi", "anti"):
-        counts = native_join_counts(lcodes, rcodes, num_codes)
+        counts = native_join_counts(lcodes, rcodes, num_codes) \
+            if algorithm != "sort_merge" else None
         if counts is None:
             r_sorted = np.sort(rcodes, kind="stable")
             counts = (np.searchsorted(r_sorted, lcodes, side="right")
@@ -68,7 +81,8 @@ def join_indices(
         lidx = np.nonzero(keep)[0].astype(np.int64)
         return lidx, np.full(len(lidx), -1, dtype=np.int64)
 
-    native = native_join_indices(lcodes, rcodes, num_codes)
+    native = native_join_indices(lcodes, rcodes, num_codes) \
+        if algorithm != "sort_merge" else None
     if native is not None:
         matched_l, matched_r, counts = native
     else:

@@ -513,15 +513,17 @@ def sample(batch: RecordBatch, fraction: float, with_replacement: bool, seed: Op
 def hash_join(left: RecordBatch, right: RecordBatch, left_on: Sequence[Expression],
               right_on: Sequence[Expression], how: str,
               output_schema: Schema, merged_keys: Sequence[str],
-              right_rename: dict, null_equals_null: bool = False) -> RecordBatch:
-    """Hash join via encoded key codes (kernels/join.py).
+              right_rename: dict, null_equals_null: bool = False,
+              algorithm: str = "hash") -> RecordBatch:
+    """Join via encoded key codes (kernels/join.py); algorithm="sort_merge"
+    switches to the order-preserving sorted-merge strategy.
 
     `merged_keys` = right column names that merge into the left key column.
     `right_rename` = mapping right name -> output name for non-merged columns.
     """
     lkeys = _eval_keys(left, left_on)
     rkeys = _eval_keys(right, right_on)
-    lidx, ridx = join_indices(lkeys, rkeys, how, null_equals_null)
+    lidx, ridx = join_indices(lkeys, rkeys, how, null_equals_null, algorithm)
     return _assemble_join(left, right, lidx, ridx, rkeys, left_on, right_on, how,
                           output_schema, merged_keys, right_rename)
 

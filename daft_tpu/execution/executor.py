@@ -261,18 +261,50 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.Dedup):
-        # streaming dedup: keep first occurrence across the stream
-        seen: Optional[RecordBatch] = None
+        # streaming dedup, keep-first: each batch dedups internally, then drops
+        # rows whose keys were already seen — probed against an amortized
+        # ProbeTable over older rows (rebuilt only when the recent buffer
+        # doubles past it: O(n log n) total instead of re-running distinct over
+        # the whole accumulated set per batch). Nulls equal nulls, matching
+        # distinct()/make_groups semantics.
+        from ..core.kernels.join import ProbeTable
+        from ..core.relational import _eval_keys
+        from ..expressions import col as _col
+
+        key_exprs = list(node.on) if node.on else \
+            [_col(f.name) for f in node.input.schema]
+        table: Optional[ProbeTable] = None
+        base: List[RecordBatch] = []     # rows the probe table covers
+        recent: List[RecordBatch] = []   # rows seen since the last rebuild
+        base_rows = recent_rows = 0
+        emitted = False
         for part in _exec(node.input):
             for b in part.batches:
-                cur = b if seen is None else RecordBatch.concat([seen, b])
-                deduped = rel.distinct(cur, node.on)
-                new_rows = deduped.slice(0 if seen is None else seen.num_rows, deduped.num_rows)
-                # distinct() keeps first occurrences in row order, so prior rows stay a prefix
-                seen = deduped
-                if new_rows.num_rows:
-                    yield MicroPartition(node.schema, [new_rows])
-        if seen is None:
+                if b.num_rows == 0:
+                    continue
+                nb = rel.distinct(b, node.on)
+                if table is not None and nb.num_rows:
+                    lidx, _ = table.probe(_eval_keys(nb, key_exprs), "anti")
+                    nb = nb.take(lidx)
+                if recent and nb.num_rows:
+                    seen_recent = RecordBatch.concat(recent)
+                    nb = rel.hash_join(nb, seen_recent, key_exprs, key_exprs,
+                                       "anti", nb.schema, [], {}, True)
+                if nb.num_rows:
+                    emitted = True
+                    recent.append(nb)
+                    recent_rows += nb.num_rows
+                    yield MicroPartition(node.schema, [nb])
+                if recent_rows > max(64 * 1024, base_rows):
+                    base.extend(recent)
+                    base_rows += recent_rows
+                    recent, recent_rows = [], 0
+                    seen_all = RecordBatch.concat(base)
+                    base = [seen_all]
+                    key_dtypes = [e.to_field(node.input.schema).dtype for e in key_exprs]
+                    table = ProbeTable(_eval_keys(seen_all, key_exprs), key_dtypes,
+                                       null_equals_null=True)
+        if not emitted:
             yield MicroPartition.empty(node.schema)
         return
 
@@ -284,11 +316,7 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.PhysWindow):
-        from .window import eval_window
-
-        batch = _gather(node.input, node.input.schema)
-        out = eval_window(batch, node.window_exprs, node.spec, node.schema)
-        yield MicroPartition(node.schema, [out])
+        yield from _window_exec(node)
         return
 
     if isinstance(node, pp.PhysConcat):
@@ -695,11 +723,7 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
 
     if ungrouped:
         if split is None:
-            # unsplittable global agg (e.g. count_distinct) needs full value
-            # sets; keep gathering (documented gap — bounded by distinct count
-            # only after dedup, not implemented as spill yet)
-            big = RecordBatch.concat(list(rest))
-            return rel.ungrouped_agg(big, aggs)
+            return _ungrouped_agg_spilled(child, aggs, rest)
         # streamed partials: memory is one 1-row partial batch per morsel
         partials = [rel.ungrouped_agg(b, split.partial) for b in rest]
         final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)
@@ -749,6 +773,82 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
         return RecordBatch.concat(outs)
     finally:
         sp.delete()
+
+
+def _ungrouped_agg_spilled(child: pp.PhysicalPlan, aggs, stream) -> RecordBatch:
+    """Over-budget ungrouped aggregation with unsplittable aggs: spill the raw
+    stream once, then evaluate each aggregation with bounded memory —
+    count_distinct Grace-partitions its OWN value column (distinct values land
+    in exactly one partition, so per-partition counts sum exactly); aggs that
+    split individually stream partials from the spill; anything else gathers
+    only its value column (one column, not the whole table). Reference:
+    blocking_sink.rs memory gating + grouped spill strategies."""
+    from . import memory as mem
+    from ..core.series import Series
+    from ..expressions import col as _col
+    from ..expressions.expressions import AggExpr, Alias
+    from ..plan.agg_split import split_aggs
+    from ..schema import Schema
+
+    spill = mem.SpillFile(child.schema)
+    try:
+        for b in stream:
+            spill.append(b)
+
+        cols: List[Series] = []
+        for e in aggs:
+            inner = e
+            while isinstance(inner, Alias):
+                inner = inner.child
+            name = e.name()
+            out_field = e.to_field(child.schema)
+            if isinstance(inner, AggExpr) and inner.op == "count_distinct":
+                K = 32
+                val_field = inner.child.to_field(child.schema)
+                vschema = Schema([val_field])
+                sp = mem.SpillPartitions(vschema, K)
+                try:
+                    for b in spill.read():
+                        s = eval_expression(b, inner.child).rename(val_field.name)
+                        sp.append_partitioned(RecordBatch(vschema, [s], len(s)),
+                                              [_col(val_field.name)])
+                    total = 0
+                    for f in sp.files:
+                        bs = list(f.read())
+                        if not bs:
+                            continue
+                        u = rel.distinct(RecordBatch.concat(bs), None)
+                        uv = u.get_column(val_field.name)
+                        total += int(uv.validity_numpy().sum())  # non-null distinct
+                finally:
+                    sp.delete()
+                cols.append(Series.from_pylist([total], name, dtype=out_field.dtype))
+                continue
+            single = split_aggs([e])
+            if single is not None:
+                partials = [rel.ungrouped_agg(b, single.partial) for b in spill.read()]
+                final = rel.ungrouped_agg(RecordBatch.concat(partials), single.final)
+                projected = eval_projection(final, single.projection)
+                cols.append(projected.get_column(name))
+                continue
+            # e.g. approx_count_distinct: gather just the value column
+            val_field = inner.child.to_field(child.schema) if isinstance(inner, AggExpr) \
+                else None
+            if val_field is None:
+                big = RecordBatch.concat(list(spill.read()))
+                cols.append(rel.ungrouped_agg(big, [e]).get_column(name))
+            else:
+                vschema = Schema([val_field])
+                parts = []
+                for b in spill.read():
+                    s = eval_expression(b, inner.child).rename(val_field.name)
+                    parts.append(RecordBatch(vschema, [s], len(s)))
+                big = RecordBatch.concat(parts) if parts else RecordBatch.empty(vschema)
+                one = AggExpr(inner.op, _col(val_field.name), dict(inner.params)).alias(name)
+                cols.append(rel.ungrouped_agg(big, [one]).get_column(name))
+        return RecordBatch(Schema([e.to_field(child.schema) for e in aggs]), cols, 1)
+    finally:
+        spill.delete()
 
 
 def _sort_exec(node: pp.PhysSort) -> Iterator[MicroPartition]:
@@ -892,6 +992,65 @@ def _sort_bucket(node: pp.PhysSort, f, limit: int, depth: int,
                          [bucket.sort(keys, node.descending, node.nulls_first)])
 
 
+def _window_exec(node) -> Iterator[MicroPartition]:
+    """Window evaluation with out-of-core partitioning: input is admitted
+    against the operator memory budget; once over budget (and the window has
+    PARTITION BY keys) the stream Grace-partitions into K spill files by
+    partition-key hash, and each spill partition evaluates independently —
+    window partitions are wholly contained in one spill file, so results are
+    exact (reference: sinks/window_partition_only.rs partitioned evaluation).
+    Partitions evaluate on the pool in pipeline mode. Global windows (no
+    PARTITION BY) need every row in one frame and still gather.
+
+    Output row order: under budget, original input order (results scatter
+    back); spilled, rows come out grouped by spill partition."""
+    from . import memory as mem
+    from .window import eval_window
+
+    budget = mem.operator_budget()
+    it = _batch_iter(_exec(node.input))
+    buffered: List[RecordBatch] = []
+    over = False
+    for b in it:
+        buffered.append(b)
+        if not budget.admit(b.size_bytes()):
+            over = True
+            break
+
+    if not over or not node.spec.partition_by_exprs:
+        rest = list(it) if over else []
+        all_batches = buffered + rest
+        batch = RecordBatch.concat(all_batches) if all_batches \
+            else RecordBatch.empty(node.input.schema)
+        out = eval_window(batch, node.window_exprs, node.spec, node.schema)
+        yield MicroPartition(node.schema, [out])
+        return
+
+    K = 16
+    sp = mem.SpillPartitions(node.input.schema, K)
+    try:
+        for b in itertools.chain(buffered, it):
+            sp.append_partitioned(b, node.spec.partition_by_exprs)
+
+        def eval_file(f, _i):
+            bs = list(f.read())
+            if not bs:
+                return MicroPartition.empty(node.schema)
+            out = eval_window(RecordBatch.concat(bs), node.window_exprs,
+                              node.spec, node.schema)
+            return MicroPartition(node.schema, [out])
+
+        if _pipeline_on():
+            from .pipeline import pmap_stream
+
+            yield from pmap_stream(iter(sp.files), eval_file)
+        else:
+            for i, f in enumerate(sp.files):
+                yield eval_file(f, i)
+    finally:
+        sp.delete()
+
+
 def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
     """Hash join with a spillable build side: the right (build) side is
     admitted against the memory budget; if it exceeds the budget, both sides
@@ -916,6 +1075,19 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
         right = RecordBatch.concat(right_parts) if right_parts \
             else RecordBatch.empty(node.right.schema)
         if node.how not in ("right", "outer"):
+            if node.strategy == "sort_merge":
+                # sort-merge strategy: per-batch order-preserving encode +
+                # sorted merge (no probe table)
+                def _sm(part, _i):
+                    outs = [rel.hash_join(b, right, node.left_on, node.right_on,
+                                          node.how, node.schema, node.merged_keys,
+                                          node.right_rename, node.null_equals_null,
+                                          algorithm="sort_merge")
+                            for b in part.batches if b.num_rows]
+                    return MicroPartition(node.schema, outs or [RecordBatch.empty(node.schema)])
+
+                yield from _map_op(_exec(node.left), _sm)
+                return
             # probe side streams morsel-by-morsel: never materialized. The
             # probe table is built ONCE from the build side; each morsel is an
             # index lookup, fanned across the pool in pipeline mode.
@@ -942,7 +1114,8 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
                 else RecordBatch.empty(node.left.schema)
             out = rel.hash_join(left, right, node.left_on, node.right_on, node.how,
                                 node.schema, node.merged_keys, node.right_rename,
-                                node.null_equals_null)
+                                node.null_equals_null,
+                                algorithm=node.strategy or "hash")
             yield MicroPartition(node.schema, [out])
             return
 

@@ -310,6 +310,47 @@ def _existing_conjunct_reprs(node: lp.LogicalPlan) -> set:
             return out
 
 
+def rule_cross_join_to_inner(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
+    """Filter(CrossJoin) with cross-side equality conjuncts -> inner hash join
+    + residual filter (reference: SQL-92 comma-join recovery in join planning).
+    Only fires when every extracted key pair has distinct column names, so the
+    rewritten join merges nothing and the output schema is unchanged."""
+    if not (isinstance(node, lp.Filter) and isinstance(node.input, lp.Join)
+            and node.input.how == "cross"):
+        return None
+    join = node.input
+    left_names = set(join.left.schema.column_names())
+    _merged, right_rename = join.output_naming()
+    right_out_to_src = {right_rename.get(n, n): n
+                        for n in join.right.schema.column_names()}
+
+    keys, rest = [], []
+    for c in _split_conjuncts(node.predicate):
+        if isinstance(c, BinaryOp) and c.op == "eq" \
+                and isinstance(c.left, ColumnRef) and isinstance(c.right, ColumnRef):
+            ln, rn = c.left._name, c.right._name
+            if ln in left_names and rn in right_out_to_src and rn not in left_names:
+                keys.append((ln, right_out_to_src[rn]))
+                continue
+            if rn in left_names and ln in right_out_to_src and ln not in left_names:
+                keys.append((rn, right_out_to_src[ln]))
+                continue
+        rest.append(c)
+    if not keys or any(l == r for l, r in keys):
+        return None
+    inner = lp.Join(join.left, join.right, [col(l) for l, _ in keys],
+                    [col(r) for _, r in keys], "inner", join.prefix, join.suffix)
+    if set(inner.schema.column_names()) != set(node.input.schema.column_names()):
+        return None  # renaming diverged; keep the cross join
+    out: lp.LogicalPlan = inner
+    if rest:
+        pred = rest[0]
+        for r in rest[1:]:
+            pred = pred & r
+        out = lp.Filter(inner, pred)
+    return out
+
+
 def rule_push_filter_through_join(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     """Filter(Join) → push side-local conjuncts below the join; derive relaxed
     OR-predicates for cross-side disjunctions.
@@ -970,6 +1011,7 @@ def default_rule_batches(config) -> List[RuleBatch]:
             rule_drop_noop_project,
         ]),
         RuleBatch("pushdowns", [
+            rule_cross_join_to_inner,
             rule_push_filter_through_join,
             rule_push_filter_through_project,
             rule_merge_filters,

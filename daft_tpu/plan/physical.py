@@ -223,7 +223,8 @@ class PhysConcat(PhysicalPlan):
 
 class HashJoin(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on, how,
-                 merged_keys, right_rename, schema: Schema, null_equals_null: bool = False):
+                 merged_keys, right_rename, schema: Schema, null_equals_null: bool = False,
+                 strategy: Optional[str] = None):
         super().__init__()
         self.left = left
         self.right = right
@@ -234,6 +235,9 @@ class HashJoin(PhysicalPlan):
         self.right_rename = right_rename
         self.schema = schema
         self.null_equals_null = null_equals_null
+        # None/'hash' = probe-table join; 'sort_merge' = order-preserving
+        # encode + sorted merge (executor algorithm switch)
+        self.strategy = strategy
 
     def children(self):
         return [self.left, self.right]
@@ -440,7 +444,8 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
                     return Project(hj, [_col(f.name) for f in plan.schema], plan.schema)
         return HashJoin(translate(plan.left, config), translate(plan.right, config),
                         plan.left_on, plan.right_on, plan.how,
-                        merged_keys, right_rename, plan.schema, plan.null_equals_null)
+                        merged_keys, right_rename, plan.schema, plan.null_equals_null,
+                        plan.strategy)
 
     if isinstance(plan, lp.Repartition):
         return PhysRepartition(translate(plan.input, config), plan.num_partitions,

@@ -84,6 +84,58 @@ class InSubquery(Expression):
         return f"{self.child!r} IN (<subquery>)"
 
 
+class ExistsSubquery(Expression):
+    """`EXISTS (SELECT ...)` marker (reference: daft-dsl Expr::Exists +
+    unnest_subquery lowering). Never evaluated directly — the planner rewrites
+    it into a semi join (anti under NOT), extracting equality correlation
+    predicates from the subquery's WHERE as the join keys."""
+
+    def __init__(self, select):
+        self.select = select
+
+    def name(self) -> str:
+        return "exists"
+
+    def children(self):
+        return []
+
+    def with_children(self, children):
+        return self
+
+    def to_field(self, schema):
+        from ..datatype import Field
+
+        return Field("exists", DataType.bool())
+
+    def __repr__(self):
+        return "EXISTS (<subquery>)"
+
+
+class ScalarSubquery(Expression):
+    """`(SELECT <agg> ...)` used as a value (reference: daft-sql planner
+    scalar-subquery planning). The planner binds it to a column: uncorrelated
+    subqueries cross-join a 1-row frame; correlated ones become a grouped
+    aggregate left-joined on the correlation keys."""
+
+    def __init__(self, select):
+        self.select = select
+
+    def name(self) -> str:
+        return "__scalar_subquery__"
+
+    def children(self):
+        return []
+
+    def with_children(self, children):
+        return self
+
+    def to_field(self, schema):
+        raise ValueError("scalar subquery must be bound by the planner before evaluation")
+
+    def __repr__(self):
+        return "(<scalar subquery>)"
+
+
 @dataclasses.dataclass
 class SelectItem:
     expr: Optional[Expression]   # None for wildcard
@@ -297,6 +349,10 @@ class Parser:
             return ~self.parse_expr(25)
         if t.kind == "punct" and t.value == "(":
             self.next()
+            if self.at_kw("SELECT"):
+                sub = self._parse_select()
+                self.expect("punct", ")")
+                return ScalarSubquery(sub)
             e = self.parse_expr()
             self.expect("punct", ")")
             return e
@@ -311,6 +367,12 @@ class Parser:
             return lit(t.value)
         if t.kind == "ident":
             up = t.upper()
+            if up == "EXISTS":
+                self.next()
+                self.expect("punct", "(")
+                sub = self._parse_select()
+                self.expect("punct", ")")
+                return ExistsSubquery(sub)
             if up == "NULL":
                 self.next()
                 return lit(None)
@@ -612,6 +674,11 @@ class Parser:
         if self.eat_kw("FROM"):
             sel.from_table = self._parse_table_factor()
             while True:
+                # SQL-92 comma list = implicit cross join; the optimizer's
+                # filter-into-join pushdown recovers the equi-join
+                if self.eat("punct", ","):
+                    sel.joins.append(JoinClause(self._parse_table_factor(), "cross", None))
+                    continue
                 j = self._try_parse_join()
                 if j is None:
                     break

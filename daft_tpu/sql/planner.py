@@ -85,11 +85,13 @@ class SQLPlanner:
 
     # ---- expression resolution ----------------------------------------------------
     def _apply_where(self, df, where: Expression, scope: Scope):
-        """Apply a WHERE clause; top-level [NOT] IN (SELECT ...) conjuncts
-        become semi/anti joins against the planned subquery (reference:
-        unnest_subquery + push_down_anti_semi_join)."""
+        """Apply a WHERE clause; top-level [NOT] IN (SELECT ...) and [NOT]
+        EXISTS (SELECT ...) conjuncts become semi/anti joins against the
+        planned subquery, and scalar subqueries bind to joined columns
+        (reference: unnest_subquery + push_down_anti_semi_join +
+        planner.rs scalar-subquery planning)."""
         from ..expressions.expressions import BinaryOp, UnaryOp
-        from .parser import InSubquery
+        from .parser import ExistsSubquery, InSubquery, ScalarSubquery
 
         def conjuncts(e):
             if isinstance(e, BinaryOp) and e.op == "and":
@@ -97,12 +99,23 @@ class SQLPlanner:
             return [e]
 
         rest = []
+        helpers: List[str] = []
         for c in conjuncts(where):
             negated = False
             node = c
-            if isinstance(node, UnaryOp) and node.op == "not" and isinstance(node.child, InSubquery):
+            if isinstance(node, UnaryOp) and node.op == "not" \
+                    and isinstance(node.child, (InSubquery, ExistsSubquery)):
                 negated = True
                 node = node.child
+            if isinstance(node, ExistsSubquery):
+                df = self._plan_exists(df, node.select, negated, scope)
+                continue
+            if not isinstance(node, InSubquery) and \
+                    any(isinstance(n, ScalarSubquery) for n in node.walk()):
+                df, node, h = self._bind_scalar_subqueries(df, node, scope)
+                helpers.extend(h)
+                rest.append(node)
+                continue
             if isinstance(node, InSubquery):
                 sub_df = SQLPlanner(self.bindings, self.cte_frames,
                                     session=self.session).plan(node.select)
@@ -139,7 +152,175 @@ class SQLPlanner:
             for r in rest[1:]:
                 pred = pred & r
             df = df.where(self._resolve_expr(pred, scope))
+        if helpers:
+            df = df.exclude(*[h for h in helpers if h in df.column_names])
         return df
+
+    # ---- subquery unnesting --------------------------------------------------------
+    def _inner_frame(self, sub_sel: Select):
+        """Plan only the FROM/JOIN part of a subquery to learn which column
+        names resolve inside it (cheap: plans are lazy)."""
+        import daft_tpu as dt
+
+        planner = SQLPlanner(self.bindings, self.cte_frames, session=self.session)
+        inner_scope = Scope()
+        if sub_sel.from_table is None:
+            return dt.from_pydict({"__dummy__": [1]}), inner_scope
+        inner_df = planner._plan_factor(sub_sel.from_table, inner_scope)
+        for j in sub_sel.joins:
+            inner_df = planner._plan_join(inner_df, j, inner_scope)
+        return inner_df, inner_scope
+
+    def _split_correlation(self, sub_sel: Select, inner_df, inner_scope: Scope,
+                           outer_df, outer_scope: Scope):
+        """Split the subquery WHERE into equality correlation pairs
+        [(inner_ref, outer_ref)] and the remaining (inner-only) predicate.
+        Raises for correlated predicates that aren't plain equalities —
+        matching the reference's unnest_subquery coverage."""
+        from ..expressions.expressions import BinaryOp
+
+        inner_cols = set(inner_df.column_names)
+        inner_aliases = set(inner_scope.tables.keys())
+        outer_cols = set(outer_df.column_names)
+
+        def is_inner(ref) -> bool:
+            n = ref._name
+            if "." in n:
+                return n.split(".", 1)[0].lower() in inner_aliases
+            return n in inner_cols
+
+        def is_outer(ref) -> bool:
+            n = ref._name
+            if "." in n:
+                return n.split(".", 1)[0].lower() in outer_scope.tables
+            return n in outer_cols
+
+        pairs, remaining = [], []
+        if sub_sel.where is not None:
+            for c in self._split_and(sub_sel.where):
+                if (isinstance(c, BinaryOp) and c.op == "eq"
+                        and isinstance(c.left, ColumnRef) and isinstance(c.right, ColumnRef)):
+                    li, ri = is_inner(c.left), is_inner(c.right)
+                    if li and not ri and is_outer(c.right):
+                        pairs.append((c.left, c.right))
+                        continue
+                    if ri and not li and is_outer(c.left):
+                        pairs.append((c.right, c.left))
+                        continue
+                for n in c.walk():
+                    if isinstance(n, ColumnRef) and not is_inner(n) and is_outer(n):
+                        raise NotImplementedError(
+                            f"unsupported correlated subquery predicate: {c!r}")
+                remaining.append(c)
+        rem = None
+        for r in remaining:
+            rem = r if rem is None else rem & r
+        return pairs, rem
+
+    def _plan_exists(self, df, sub_sel: Select, negated: bool, scope: Scope):
+        """[NOT] EXISTS (SELECT ...) -> semi/anti join on extracted correlation
+        keys; uncorrelated EXISTS guards on the subquery's row count."""
+        import dataclasses as dc
+
+        from .parser import SelectItem
+
+        inner_df, inner_scope = self._inner_frame(sub_sel)
+        pairs, remaining = self._split_correlation(sub_sel, inner_df, inner_scope, df, scope)
+        if not pairs:
+            sub_df = SQLPlanner(self.bindings, self.cte_frames,
+                                session=self.session).plan(sub_sel)
+            cnt = sub_df.agg(lit(1).count("all").alias("__exists_cnt__"))
+            cond = (col("__exists_cnt__") == lit(0)) if negated \
+                else (col("__exists_cnt__") > lit(0))
+            return df.join(cnt, how="cross").where(cond).exclude("__exists_cnt__")
+        if sub_sel.group_by or sub_sel.having is not None:
+            raise NotImplementedError("correlated EXISTS with GROUP BY/HAVING")
+        if sub_sel.offset:
+            raise NotImplementedError("correlated EXISTS with OFFSET")
+        if sub_sel.limit == 0:
+            # EXISTS over zero rows is constant FALSE
+            return df if negated else df.limit(0)
+        # LIMIT n >= 1 can't change "at least one row exists": safe to drop
+        items = [SelectItem(inner_ref, f"__ek_{i}__") for i, (inner_ref, _o) in enumerate(pairs)]
+        sub2 = dc.replace(sub_sel, items=items, where=remaining,
+                          order_by=[], limit=None, offset=None, distinct=False)
+        sub_df = SQLPlanner(self.bindings, self.cte_frames, session=self.session).plan(sub2)
+        left_keys = [self._resolve_expr(o, scope) for _i, o in pairs]
+        right_keys = [col(f"__ek_{i}__") for i in range(len(pairs))]
+        return df.join(sub_df, left_on=left_keys, right_on=right_keys,
+                       how="anti" if negated else "semi")
+
+    def _bind_scalar_subqueries(self, df, expr: Expression, scope: Scope):
+        """Replace each ScalarSubquery in `expr` with a column bound onto `df`:
+        uncorrelated -> 1-row cross join; correlated -> grouped aggregate over
+        the correlation keys, left-joined (missing keys yield NULL, matching
+        SQL scalar-subquery semantics). Returns (df, expr, helper_columns)."""
+        import dataclasses as dc
+
+        from .parser import ScalarSubquery, SelectItem
+
+        helpers: List[str] = []
+
+        def rewrite(node):
+            nonlocal df
+            if not isinstance(node, ScalarSubquery):
+                return None
+            sub_sel = node.select
+            n = self._scalar_counter = getattr(self, "_scalar_counter", 0) + 1
+            alias = f"__scalar_{n}__"
+            inner_df, inner_scope = self._inner_frame(sub_sel)
+            pairs, remaining = self._split_correlation(sub_sel, inner_df, inner_scope, df, scope)
+            if not pairs:
+                sub_df = SQLPlanner(self.bindings, self.cte_frames,
+                                    session=self.session).plan(sub_sel)
+                first = sub_df.column_names[0]
+                # SQL scalar semantics: >1 row is an error, 0 rows binds NULL —
+                # materialize (cheap: a scalar) to enforce both
+                probe = sub_df.select(col(first).alias(alias)).limit(2).collect()
+                vals = probe.to_pydict()[alias]
+                if len(vals) > 1:
+                    raise ValueError("scalar subquery returned more than one row")
+                dtype = probe.schema[alias].dtype
+                import daft_tpu as dt
+
+                one = dt.from_pydict({alias: [vals[0] if vals else None]})
+                one = one.select(col(alias).cast(dtype))
+                df = df.join(one, how="cross")
+                helpers.append(alias)
+                return ColumnRef(alias)
+            if len(sub_sel.items) != 1 or sub_sel.items[0].expr is None:
+                raise NotImplementedError(
+                    "correlated scalar subquery must select exactly one expression")
+            if sub_sel.group_by or sub_sel.having is not None:
+                raise NotImplementedError("correlated scalar subquery with GROUP BY/HAVING")
+            if sub_sel.limit is not None or sub_sel.offset:
+                raise NotImplementedError(
+                    "correlated scalar subquery with LIMIT/OFFSET (ORDER BY ... "
+                    "LIMIT 1 idiom): rewrite as MAX/MIN")
+            if not self._contains_agg(sub_sel.items[0].expr):
+                raise NotImplementedError(
+                    "correlated scalar subquery must select a single aggregate")
+            key_aliases = [f"__sk_{n}_{i}__" for i in range(len(pairs))]
+            items = [SelectItem(inner_ref, None) for inner_ref, _o in pairs]
+            items.append(SelectItem(sub_sel.items[0].expr, alias))
+            sub2 = dc.replace(sub_sel, items=items, where=remaining,
+                              group_by=list(range(1, len(pairs) + 1)),
+                              order_by=[], limit=None, offset=None, distinct=False)
+            sub_df = SQLPlanner(self.bindings, self.cte_frames,
+                                session=self.session).plan(sub2)
+            names = sub_df.column_names  # group keys in order, then the aggregate
+            sub_df = sub_df.select(
+                *[col(names[i]).alias(key_aliases[i]) for i in range(len(pairs))],
+                col(names[-1]).alias(alias))
+            df = df.join(sub_df,
+                         left_on=[self._resolve_expr(o, scope) for _i, o in pairs],
+                         right_on=[col(a) for a in key_aliases], how="left")
+            helpers.extend(key_aliases)
+            helpers.append(alias)
+            return ColumnRef(alias)
+
+        new = expr.transform(rewrite)
+        return df, new, helpers
 
     def _resolve_expr(self, e: Expression, scope: Scope) -> Expression:
         def rewrite(node):

@@ -238,3 +238,150 @@ def test_sql_not_in_subquery_three_valued_nulls():
     # empty subquery: vacuously true for every row, including NULL keys
     sub = daft_tpu.from_pydict({"y": []})
     assert daft_tpu.sql(q, df=df, sub=sub).to_pydict()["x"] == [1, 2, 3, None]
+
+
+def test_sql_exists_correlated_tpch_q4_shape():
+    """TPC-H Q4 shape: correlated EXISTS lowered to a semi join (reference:
+    planner.rs:321 + unnest_subquery.rs)."""
+    import daft_tpu
+
+    orders = daft_tpu.from_pydict({
+        "o_orderkey": [1, 2, 3, 4], "o_pri": ["H", "L", "H", "M"]})
+    lineitem = daft_tpu.from_pydict({
+        "l_orderkey": [1, 1, 2, 4], "l_commit": [5, 9, 3, 7], "l_receipt": [6, 2, 9, 7]})
+    out = daft_tpu.sql(
+        "SELECT o_pri, COUNT(*) AS n FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM lineitem WHERE l_orderkey = o_orderkey AND l_commit < l_receipt) "
+        "GROUP BY o_pri ORDER BY o_pri", orders=orders, lineitem=lineitem).to_pydict()
+    assert out == {"o_pri": ["H", "L"], "n": [1, 1]}
+    # dataframe equivalent for cross-checking
+    from daft_tpu import col
+    sub = lineitem.where(col("l_commit") < col("l_receipt"))
+    expect = (orders.join(sub, left_on="o_orderkey", right_on="l_orderkey", how="semi")
+              .groupby("o_pri").agg(col("o_pri").count().alias("n"))
+              .sort("o_pri").to_pydict())
+    assert out["n"] == expect["n"]
+
+
+def test_sql_not_exists_and_uncorrelated_exists():
+    import daft_tpu
+
+    orders = daft_tpu.from_pydict({"o_orderkey": [1, 2, 3]})
+    lineitem = daft_tpu.from_pydict({"l_orderkey": [1, 2], "l_x": [5, -1]})
+    out = daft_tpu.sql(
+        "SELECT o_orderkey FROM orders WHERE NOT EXISTS "
+        "(SELECT 1 FROM lineitem WHERE l_orderkey = o_orderkey) ORDER BY o_orderkey",
+        orders=orders, lineitem=lineitem).to_pydict()
+    assert out == {"o_orderkey": [3]}
+    # uncorrelated: empty subquery -> no rows; nonempty -> all rows
+    out = daft_tpu.sql(
+        "SELECT o_orderkey FROM orders WHERE EXISTS (SELECT 1 FROM lineitem WHERE l_x > 100)",
+        orders=orders, lineitem=lineitem).to_pydict()
+    assert out == {"o_orderkey": []}
+    out = daft_tpu.sql(
+        "SELECT o_orderkey FROM orders WHERE EXISTS (SELECT 1 FROM lineitem WHERE l_x > 0) "
+        "ORDER BY o_orderkey", orders=orders, lineitem=lineitem).to_pydict()
+    assert out == {"o_orderkey": [1, 2, 3]}
+
+
+def test_sql_scalar_subquery_tpch_q17_shape():
+    """TPC-H Q17 shape: correlated scalar aggregate bound via grouped left
+    join; NULL thresholds (keys absent from the subquery) filter out."""
+    import daft_tpu
+
+    part = daft_tpu.from_pydict({"p_partkey": [10, 20, 30], "p_brand": ["A", "B", "C"]})
+    li = daft_tpu.from_pydict({
+        "l_partkey": [10, 10, 10, 20, 20, 30],
+        "l_qty": [1.0, 2.0, 9.0, 4.0, 4.0, 2.0],
+        "l_price": [5.0, 6.0, 7.0, 8.0, 9.0, 1.0]})
+    out = daft_tpu.sql(
+        "SELECT SUM(l_price) AS rev FROM li, part WHERE p_partkey = l_partkey "
+        "AND l_qty < (SELECT 0.5 * AVG(l_qty) FROM li WHERE l_partkey = p_partkey)",
+        li=li, part=part).to_pydict()
+    # pk10: avg 4 -> thr 2 -> qty 1 (5.0); pk20: thr 2 -> none; pk30: thr 1 -> none
+    assert out == {"rev": [5.0]}
+
+
+def test_sql_scalar_subquery_uncorrelated():
+    import daft_tpu
+
+    li = daft_tpu.from_pydict({"q": [1.0, 2.0, 9.0, 4.0]})
+    out = daft_tpu.sql("SELECT q FROM li WHERE q > (SELECT AVG(q) FROM li) ORDER BY q",
+                       li=li).to_pydict()
+    assert out == {"q": [9.0]}
+
+
+def test_sql_comma_join_plans_as_hash_join():
+    """SQL-92 comma FROM lists must execute as equi hash joins, not cartesian
+    products (rule_cross_join_to_inner)."""
+    import daft_tpu
+    from daft_tpu.plan import logical as lp
+    from daft_tpu.sql.planner import plan_sql
+
+    a = daft_tpu.from_pydict({"x": list(range(200)), "v": list(range(200))})
+    b = daft_tpu.from_pydict({"y": list(range(0, 200, 2)), "w": list(range(100))})
+    df = plan_sql("SELECT SUM(v) AS s FROM a, b WHERE x = y AND w >= 0", {"a": a, "b": b})
+    plan = df._builder.optimize().plan
+    crosses = []
+    inners = []
+
+    def walk(n):
+        if isinstance(n, lp.Join):
+            (crosses if n.how == "cross" else inners).append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    assert not crosses and inners, "comma join was not rewritten to an inner join"
+    assert df.to_pydict() == {"s": [sum(range(0, 200, 2))]}
+
+
+def test_sql_scalar_subquery_multi_row_errors_and_empty_binds_null():
+    import pytest
+
+    import daft_tpu
+
+    t = daft_tpu.from_pydict({"x": [1, 5, 9]})
+    multi = daft_tpu.from_pydict({"q": [1.0, 2.0]})
+    with pytest.raises(ValueError, match="more than one row"):
+        daft_tpu.sql("SELECT x FROM t WHERE x > (SELECT q FROM multi)", t=t, multi=multi)
+    empty = daft_tpu.from_pydict({"q": []})
+    out = daft_tpu.sql(
+        "SELECT x FROM t WHERE x = 1 OR x > (SELECT q FROM empty) ORDER BY x",
+        t=t, empty=empty).to_pydict()
+    assert out == {"x": [1]}  # NULL comparison is NULL; OR keeps the x=1 row
+
+
+def test_sql_exists_limit_zero_is_false():
+    import daft_tpu
+
+    orders = daft_tpu.from_pydict({"o": [1, 2]})
+    li = daft_tpu.from_pydict({"l": [1, 2]})
+    out = daft_tpu.sql(
+        "SELECT o FROM orders WHERE EXISTS (SELECT 1 FROM li WHERE l = o LIMIT 0)",
+        orders=orders, li=li).to_pydict()
+    assert out == {"o": []}
+    out = daft_tpu.sql(
+        "SELECT o FROM orders WHERE NOT EXISTS (SELECT 1 FROM li WHERE l = o LIMIT 0) "
+        "ORDER BY o", orders=orders, li=li).to_pydict()
+    assert out == {"o": [1, 2]}
+    # LIMIT >= 1 doesn't change existence
+    out = daft_tpu.sql(
+        "SELECT o FROM orders WHERE EXISTS (SELECT 1 FROM li WHERE l = o LIMIT 5) "
+        "ORDER BY o", orders=orders, li=li).to_pydict()
+    assert out == {"o": [1, 2]}
+
+
+def test_sql_correlated_scalar_unsupported_shapes_raise_cleanly():
+    import pytest
+
+    import daft_tpu
+
+    t = daft_tpu.from_pydict({"k": [1, 2]})
+    s = daft_tpu.from_pydict({"k2": [1, 2], "v": [10, 20]})
+    with pytest.raises(NotImplementedError, match="aggregate"):
+        daft_tpu.sql("SELECT k FROM t WHERE k > (SELECT v FROM s WHERE k2 = k)", t=t, s=s)
+    with pytest.raises(NotImplementedError, match="LIMIT"):
+        daft_tpu.sql(
+            "SELECT k FROM t WHERE k > (SELECT MAX(v) FROM s WHERE k2 = k LIMIT 1)",
+            t=t, s=s)
