@@ -1,0 +1,171 @@
+"""OpenAI-compatible HTTP provider (stdlib urllib — dependency-free).
+
+Reference parity: daft/ai/openai/__init__.py (OpenAIProvider: text embedder +
+prompter over the /embeddings and /chat/completions endpoints) and the
+lm_studio provider (same protocol, custom base_url). Any OpenAI-compatible
+server works: api.openai.com, vLLM's openai server, LM Studio, llama.cpp.
+
+Concurrency: requests within one batch fan out over a bounded thread pool
+(`request_concurrency`), the HTTP-level analogue of the reference's routed
+vLLM actor replicas. Retries with exponential backoff on 429/5xx/connection
+errors. The API key is read from options or OPENAI_API_KEY and never logged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from .provider import Provider
+
+_DEFAULT_BASE = "https://api.openai.com/v1"
+
+
+class _Http:
+    def __init__(self, base_url: str, api_key: Optional[str], timeout: float,
+                 max_retries: int):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.timeout = timeout
+        self.max_retries = max_retries
+
+    def post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        body = json.dumps(payload).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        delay = 0.5
+        last: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            req = urllib.request.Request(self.base_url + path, data=body,
+                                         headers=headers, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read().decode("utf-8"))
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 500, 502, 503, 504):
+                    last = e
+                else:
+                    detail = ""
+                    try:
+                        detail = e.read().decode("utf-8", "replace")[:500]
+                    except Exception:
+                        pass
+                    raise RuntimeError(
+                        f"openai-compatible server returned {e.code}: {detail}") from e
+            except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
+                last = e
+            if attempt < self.max_retries:  # no dead wait after the final try
+                time.sleep(delay)
+                delay = min(delay * 2, 8.0)
+        raise RuntimeError(f"openai-compatible request failed after "
+                           f"{self.max_retries + 1} attempts: {last}") from last
+
+
+class OpenAIProvider(Provider):
+    name = "openai"
+
+    def __init__(self, base_url: Optional[str] = None, api_key: Optional[str] = None,
+                 timeout: float = 60.0, max_retries: int = 3,
+                 request_concurrency: int = 8):
+        self.http = _Http(
+            base_url or os.environ.get("OPENAI_BASE_URL", _DEFAULT_BASE),
+            api_key if api_key is not None else os.environ.get("OPENAI_API_KEY"),
+            timeout, max_retries)
+        self.request_concurrency = max(1, request_concurrency)
+
+    # ---- embeddings ---------------------------------------------------------------
+    class _TextEmbedder:
+        def __init__(self, http: _Http, model: str, batch_size: int):
+            self.http = http
+            self.model = model
+            self.batch_size = batch_size
+            self._dims: Optional[int] = None
+
+        @property
+        def dimensions(self) -> int:
+            if self._dims is None:
+                self._dims = len(self.embed_text(["probe"])[0])
+            return self._dims
+
+        def embed_text(self, texts: List[str]):
+            out = []
+            for i in range(0, len(texts), self.batch_size):
+                chunk = texts[i:i + self.batch_size]
+                resp = self.http.post("/embeddings",
+                                      {"model": self.model, "input": chunk})
+                data = sorted(resp["data"], key=lambda d: d["index"])
+                out.extend([d["embedding"] for d in data])
+            return out
+
+    def get_text_embedder(self, model: Optional[str] = None, **options):
+        return OpenAIProvider._TextEmbedder(
+            self.http, model or "text-embedding-3-small",
+            int(options.get("batch_size", 256)))
+
+    # ---- chat / generation --------------------------------------------------------
+    class _Prompter:
+        def __init__(self, http: _Http, model: str, concurrency: int,
+                     options: Dict[str, Any]):
+            self.http = http
+            self.model = model
+            self.concurrency = concurrency
+            self.options = {k: v for k, v in options.items()
+                            if k in ("temperature", "max_tokens", "top_p", "seed",
+                                     "system")}
+
+        def _one(self, prompt: str) -> str:
+            messages = []
+            system = self.options.get("system")
+            if system:
+                messages.append({"role": "system", "content": system})
+            messages.append({"role": "user", "content": prompt})
+            payload: Dict[str, Any] = {"model": self.model, "messages": messages}
+            for k in ("temperature", "max_tokens", "top_p", "seed"):
+                if k in self.options:
+                    payload[k] = self.options[k]
+            resp = self.http.post("/chat/completions", payload)
+            return resp["choices"][0]["message"]["content"]
+
+        def prompt(self, prompts: List[str]) -> List[str]:
+            if len(prompts) <= 1 or self.concurrency <= 1:
+                return [self._one(p) for p in prompts]
+            with ThreadPoolExecutor(max_workers=self.concurrency,
+                                    thread_name_prefix="daft-openai") as pool:
+                return list(pool.map(self._one, prompts))
+
+    def get_prompter(self, model: Optional[str] = None, **options):
+        return OpenAIProvider._Prompter(
+            self.http, model or "gpt-4o-mini",
+            int(options.get("request_concurrency", self.request_concurrency)),
+            options)
+
+    # ---- classification (prompt-routed) -------------------------------------------
+    class _Classifier:
+        def __init__(self, prompter: "OpenAIProvider._Prompter"):
+            self.prompter = prompter
+
+        def classify_text(self, texts: List[str], labels: List[str]) -> List[str]:
+            label_list = ", ".join(labels)
+            prompts = [
+                f"Classify the following text into exactly one of these labels: "
+                f"{label_list}.\nRespond with only the label.\n\nText: {t}"
+                for t in texts
+            ]
+            raw = self.prompter.prompt(prompts)
+            out = []
+            for r in raw:
+                r = (r or "").strip()
+                match = next((l for l in labels if l.lower() == r.lower()), None)
+                if match is None:
+                    match = next((l for l in labels if l.lower() in r.lower()), labels[0])
+                out.append(match)
+            return out
+
+    def get_text_classifier(self, model: Optional[str] = None, **options):
+        return OpenAIProvider._Classifier(self.get_prompter(model, **options))

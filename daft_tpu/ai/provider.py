@@ -42,6 +42,15 @@ def get_provider(name: str) -> Provider:
             register_provider(TransformersProvider())
         elif key == "dummy":
             register_provider(DummyProvider())
+        elif key in ("openai", "lm_studio"):
+            from .openai_provider import OpenAIProvider
+
+            base = None
+            if key == "lm_studio":  # LM Studio's default local endpoint
+                import os
+
+                base = os.environ.get("LM_STUDIO_BASE_URL", "http://localhost:1234/v1")
+            register_provider(OpenAIProvider(base_url=base), name=key)
         else:
             raise ValueError(f"unknown AI provider {name!r}; registered: {sorted(_PROVIDERS)}")
     return _PROVIDERS[key]

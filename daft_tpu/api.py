@@ -106,6 +106,28 @@ def read_warc(path: Union[str, List[str]], **options) -> DataFrame:
     return DataFrame(LogicalPlanBuilder.from_scan(WarcScanOperator(path, **options)))
 
 
+def read_iceberg(table_path: str, snapshot_id: "Optional[int]" = None) -> DataFrame:
+    """Read an Apache Iceberg table (v1/v2 metadata; Avro manifests parsed
+    natively — io/iceberg.py). Identity partition pruning and parquet
+    predicate/column pushdowns apply through the optimizer."""
+    from .io.iceberg import IcebergScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(
+        IcebergScanOperator(table_path, snapshot_id=snapshot_id)))
+
+
+def read_deltalake(table_path: str) -> DataFrame:
+    """Read a Delta Lake table (_delta_log JSON replay + parquet checkpoints —
+    io/delta.py). Partition/stats pruning applies through the optimizer;
+    partition columns are reconstructed from the log."""
+    from .io.delta import DeltaScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(DeltaScanOperator(table_path)))
+
+
+read_delta_lake = read_deltalake
+
+
 def from_glob_path(path: str) -> DataFrame:
     from .io.glob_files import GlobPathScanOperator
 

@@ -1,0 +1,148 @@
+"""Shuffle fetch server: partition files served over authenticated TCP.
+
+Reference parity: src/daft-shuffles/src/server/flight_server.rs:72 (Arrow
+Flight `do_get` streams one shuffle partition's files) + client/fetch.rs
+fan-in. Here the transport is a multiprocessing.connection TCP listener —
+the same HMAC challenge/response machinery the worker tier already uses —
+serving the Arrow-IPC files written by MapOutputWriter (shuffle.py).
+
+Topology: every host that runs map tasks starts one ShuffleFetchServer over
+its local shuffle directory; reduce tasks fetch each partition from EVERY
+endpoint and merge (map outputs for one partition are spread across hosts).
+On a single host there is one endpoint, but the fan-in path is identical.
+
+Protocol (pickle frames over the authenticated connection):
+    -> ("list",  shuffle_id, partition_idx)          <- ("files", [name, ...])
+    -> ("fetch", shuffle_id, partition_idx, name)    <- ("file", bytes)
+    -> ("bye",)                                       closes the connection
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import secrets
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Iterator, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ..core.micropartition import MicroPartition
+from ..core.recordbatch import RecordBatch
+from ..schema import Schema
+from .shuffle import partition_dir
+
+_SAFE_ID = re.compile(r"^[A-Za-z0-9_\-]+$")
+_SAFE_FILE = re.compile(r"^m\d+\.arrow$")
+
+Endpoint = Tuple[str, int, str]  # (host, port, authkey_hex)
+
+
+class ShuffleFetchServer:
+    """Serves one host's shuffle directory. Thread-per-connection; all state
+    is the immutable base path, so concurrent fetches need no locks."""
+
+    def __init__(self, base: str, host: str = "127.0.0.1", port: int = 0,
+                 authkey: Optional[bytes] = None):
+        self.base = base
+        self.authkey = authkey if authkey is not None else secrets.token_bytes(32)
+        self._listener = Listener((host, port), family="AF_INET", authkey=self.authkey)
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="daft-shuffle-fetch")
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def endpoint(self) -> Endpoint:
+        host, port = self._listener.address
+        return (host, port, self.authkey.hex())
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):  # noqa: BLE001 — closed or bad auth
+                if self._closed:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                             name="daft-shuffle-conn").start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if not msg or msg[0] == "bye":
+                    return
+                try:
+                    if msg[0] == "list":
+                        _kind, sid, pidx = msg
+                        conn.send(("files", self._list(sid, int(pidx))))
+                    elif msg[0] == "fetch":
+                        _kind, sid, pidx, name = msg
+                        conn.send(("file", self._read(sid, int(pidx), name)))
+                    else:
+                        conn.send(("error", f"unknown request {msg[0]!r}"))
+                except Exception as e:  # noqa: BLE001 — refuse the request, keep serving
+                    conn.send(("error", f"{type(e).__name__}: {e}"))
+        finally:
+            conn.close()
+
+    def _pdir(self, shuffle_id: str, partition_idx: int) -> str:
+        if not _SAFE_ID.match(shuffle_id):
+            raise ValueError(f"bad shuffle id {shuffle_id!r}")
+        return partition_dir(self.base, shuffle_id, partition_idx)
+
+    def _list(self, shuffle_id: str, partition_idx: int) -> List[str]:
+        d = self._pdir(shuffle_id, partition_idx)
+        if not os.path.isdir(d):
+            return []
+        return sorted(n for n in os.listdir(d) if _SAFE_FILE.match(n))
+
+    def _read(self, shuffle_id: str, partition_idx: int, name: str) -> bytes:
+        if not _SAFE_FILE.match(name):
+            raise ValueError(f"bad shuffle file name {name!r}")
+        with open(os.path.join(self._pdir(shuffle_id, partition_idx), name), "rb") as f:
+            return f.read()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,
+                    schema: Schema) -> Iterator[MicroPartition]:
+    """Stream one shuffle partition by fetching every map file from every
+    endpoint (the reference's flight-client fan-in, get_flight_client +
+    do_get per partition)."""
+    for host, port, key_hex in endpoints:
+        conn = Client((host, port), family="AF_INET", authkey=bytes.fromhex(key_hex))
+        try:
+            conn.send(("list", shuffle_id, partition_idx))
+            kind, names = conn.recv()
+            if kind == "error":
+                raise RuntimeError(f"shuffle fetch refused: {names}")
+            assert kind == "files", kind
+            for name in names:
+                conn.send(("fetch", shuffle_id, partition_idx, name))
+                kind, data = conn.recv()
+                if kind == "error":
+                    raise RuntimeError(f"shuffle fetch refused: {data}")
+                assert kind == "file", kind
+                with ipc.RecordBatchFileReader(pa.BufferReader(data)) as r:
+                    table = r.read_all()
+                batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
+                yield MicroPartition(schema, [batch])
+            conn.send(("bye",))
+        finally:
+            conn.close()

@@ -39,6 +39,9 @@ class DistContext:
     pool: object               # WorkerPool
     shuffle_dir: str
     n_partitions: int
+    # fetch-server endpoints [(host, port, authkey_hex)]; when set, reduce
+    # tasks read shuffle partitions over the socket tier, never the local dir
+    fetch_endpoints: Optional[list] = None
     _task_seq: itertools.count = None  # type: ignore[assignment]
     _run_tag: str = ""
     shuffle_ids: List[str] = None  # type: ignore[assignment]
@@ -278,7 +281,8 @@ def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
         for i, frag in enumerate(fragments)
     ]
     ctx.pool.run_tasks(tasks)
-    return [pp.ShuffleRead(sid, p, ctx.shuffle_dir, schema)
+    return [pp.ShuffleRead(sid, p, "" if ctx.fetch_endpoints else ctx.shuffle_dir,
+                           schema, ctx.fetch_endpoints)
             for p in range(ctx.n_partitions)]
 
 

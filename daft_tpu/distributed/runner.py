@@ -29,19 +29,32 @@ from .worker import WorkerPool
 
 class DistributedRunner(Runner):
     def __init__(self, num_workers: int = 4, n_partitions: Optional[int] = None,
-                 slots_per_worker: int = 1, shuffle_dir: Optional[str] = None):
+                 slots_per_worker: int = 1, shuffle_dir: Optional[str] = None,
+                 shuffle_transport: str = "local"):
+        """shuffle_transport: "local" (reduce tasks read the shared shuffle
+        directory — single-host fast path) or "socket" (reduce tasks fetch
+        partitions from the HMAC-authenticated ShuffleFetchServer, the
+        multi-host topology; reference flight_server.rs)."""
+        if shuffle_transport not in ("local", "socket"):
+            raise ValueError(f"unknown shuffle transport {shuffle_transport!r}")
         self.num_workers = num_workers
         self.n_partitions = n_partitions or num_workers
         self.slots_per_worker = slots_per_worker
+        self.shuffle_transport = shuffle_transport
         self._shuffle_dir = shuffle_dir
         self._owns_shuffle_dir = shuffle_dir is None
         self._pool: Optional[WorkerPool] = None
+        self._fetch_server = None
 
     def _ensure_pool(self) -> WorkerPool:
         if self._pool is None:
             self._pool = WorkerPool(self.num_workers, self.slots_per_worker)
             if self._shuffle_dir is None:
                 self._shuffle_dir = tempfile.mkdtemp(prefix="daft_tpu_shuffle_")
+            if self.shuffle_transport == "socket" and self._fetch_server is None:
+                from .fetch_server import ShuffleFetchServer
+
+                self._fetch_server = ShuffleFetchServer(self._shuffle_dir)
         return self._pool
 
     def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
@@ -54,12 +67,17 @@ class DistributedRunner(Runner):
         # use the device; Device* nodes inside shipped subtrees are rewritten to
         # host equivalents by the planner (workers are host-only executors)
         phys = translate(optimized.plan)
+        endpoints = [self._fetch_server.endpoint] if self._fetch_server else None
         ctx = DistContext(pool=pool, shuffle_dir=self._shuffle_dir,
-                          n_partitions=self.n_partitions)
+                          n_partitions=self.n_partitions,
+                          fetch_endpoints=endpoints)
         plan = localize(ctx, phys)
         yield from execute_plan(plan)
 
     def shutdown(self) -> None:
+        if self._fetch_server is not None:
+            self._fetch_server.close()
+            self._fetch_server = None
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None

@@ -33,3 +33,8 @@ __all__ = [
     "StatsCollector",
     "current_collector",
 ]
+
+# OTLP trace export opt-in via environment (DAFT_TPU_OTLP_ENDPOINT)
+from .otlp import OTLPSubscriber, maybe_attach_from_env as _maybe_attach_otlp
+
+_maybe_attach_otlp()

@@ -1,0 +1,157 @@
+"""OpenTelemetry trace export: query lifecycle -> OTLP/HTTP JSON spans.
+
+Reference parity: src/common/tracing/src/config.rs:3-38 (DAFT_OTEL_EXPORTER_*
+wiring, OTLP exporter endpoint) + daft/subscribers — the reference exports
+query/optimize/operator spans via the opentelemetry crates. Here the OTLP JSON
+encoding (ExportTraceServiceRequest shape) is emitted directly over stdlib
+urllib: no SDK dependency, works against any OTLP/HTTP collector
+(otel-collector, Jaeger, Tempo, Grafana Alloy) at {endpoint}/v1/traces.
+
+Span tree per query:
+    daft.query  (root: query id, row count, error status)
+      +- daft.optimize               (plan optimization)
+      +- daft.operator:{name} x N    (per-physical-operator self time + rows)
+
+Attach with:
+    from daft_tpu.observability.otlp import OTLPSubscriber
+    attach_subscriber(OTLPSubscriber("http://localhost:4318"))
+or set DAFT_TPU_OTLP_ENDPOINT and call maybe_attach_from_env() (done by
+observability.__init__ on import).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+from .subscribers import Subscriber, attach_subscriber
+
+
+def _span_id(*parts: str) -> str:
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _trace_id(query_id: str) -> str:
+    return hashlib.sha256(query_id.encode()).hexdigest()[:32]
+
+
+def _attr(key: str, value) -> dict:
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+class OTLPSubscriber(Subscriber):
+    """Buffers spans per query; exports one OTLP/HTTP JSON request per query
+    end. Export runs on a daemon thread and failures are swallowed (the
+    subscriber contract: observability must never fail a query)."""
+
+    def __init__(self, endpoint: str, service_name: str = "daft_tpu",
+                 timeout: float = 5.0, asynchronous: bool = True):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.timeout = timeout
+        self.asynchronous = asynchronous
+        self._starts: Dict[str, float] = {}
+        self._optimize: Dict[str, QueryOptimized] = {}
+        self._op_stats: Dict[str, List[OperatorStats]] = {}
+        self._lock = threading.Lock()
+        self.exported = 0          # test/observability hook
+        self.last_error: Optional[str] = None
+
+    # ---- lifecycle ---------------------------------------------------------------
+    def on_query_start(self, event: QueryStart) -> None:
+        with self._lock:
+            self._starts[event.query_id] = time.time()
+
+    def on_query_optimized(self, event: QueryOptimized) -> None:
+        with self._lock:
+            self._optimize[event.query_id] = event
+
+    def on_operator_stats(self, query_id: str, stats: OperatorStats) -> None:
+        with self._lock:
+            self._op_stats.setdefault(query_id, []).append(stats)
+
+    def on_query_end(self, event: QueryEnd) -> None:
+        with self._lock:
+            t0 = self._starts.pop(event.query_id, time.time() - event.seconds)
+            opt = self._optimize.pop(event.query_id, None)
+            ops = self._op_stats.pop(event.query_id, [])
+        payload = self._encode(event, t0, opt, ops)
+        if self.asynchronous:
+            threading.Thread(target=self._post, args=(payload,), daemon=True,
+                             name="daft-otlp").start()
+        else:
+            self._post(payload)
+
+    # ---- OTLP JSON ----------------------------------------------------------------
+    def _encode(self, end: QueryEnd, t0: float, opt: Optional[QueryOptimized],
+                ops: List[OperatorStats]) -> dict:
+        qid = end.query_id
+        trace = _trace_id(qid)
+        root = _span_id(qid, "query")
+        ns0 = int(t0 * 1e9)
+        ns_end = int((t0 + end.seconds) * 1e9)
+        spans = [{
+            "traceId": trace, "spanId": root, "name": "daft.query",
+            "kind": 1, "startTimeUnixNano": str(ns0), "endTimeUnixNano": str(ns_end),
+            "attributes": [_attr("daft.query_id", qid), _attr("daft.rows", end.rows)],
+            "status": {"code": 2, "message": end.error} if end.error else {"code": 1},
+        }]
+        if opt is not None:
+            spans.append({
+                "traceId": trace, "spanId": _span_id(qid, "optimize"),
+                "parentSpanId": root, "name": "daft.optimize", "kind": 1,
+                "startTimeUnixNano": str(ns0),
+                "endTimeUnixNano": str(ns0 + int(opt.optimize_seconds * 1e9)),
+                "attributes": [],
+                "status": {"code": 1},
+            })
+        for s in ops:
+            spans.append({
+                "traceId": trace, "spanId": _span_id(qid, "op", str(s.node_id)),
+                "parentSpanId": root, "name": f"daft.operator:{s.name}", "kind": 1,
+                "startTimeUnixNano": str(ns0),
+                "endTimeUnixNano": str(ns0 + int(s.seconds * 1e9)),
+                "attributes": [_attr("daft.rows_out", s.rows_out),
+                               _attr("daft.batches_out", s.batches_out)],
+                "status": {"code": 1},
+            })
+        return {"resourceSpans": [{
+            "resource": {"attributes": [_attr("service.name", self.service_name)]},
+            "scopeSpans": [{"scope": {"name": "daft_tpu"}, "spans": spans}],
+        }]}
+
+    def _post(self, payload: dict) -> None:
+        try:
+            body = json.dumps(payload).encode("utf-8")
+            req = urllib.request.Request(
+                self.endpoint + "/v1/traces", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+            self.exported += 1
+            self.last_error = None
+        except Exception as e:  # noqa: BLE001 — never fail the query
+            self.last_error = f"{type(e).__name__}: {e}"
+
+
+def maybe_attach_from_env() -> Optional[OTLPSubscriber]:
+    """Attach an exporter when DAFT_TPU_OTLP_ENDPOINT is set (reference:
+    config.rs reads DAFT_DEV_ENABLE_EXPLICIT_OTEL / OTEL_EXPORTER_* env)."""
+    endpoint = os.environ.get("DAFT_TPU_OTLP_ENDPOINT")
+    if not endpoint:
+        return None
+    sub = OTLPSubscriber(endpoint)
+    attach_subscriber(sub)
+    return sub

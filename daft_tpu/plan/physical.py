@@ -294,15 +294,18 @@ class ShuffleWrite(_Unary):
 
 class ShuffleRead(PhysicalPlan):
     """Leaf of a distributed reduce task: stream every map's IPC file for one
-    shuffle partition (reference: daft-shuffles flight client do_get)."""
+    shuffle partition (reference: daft-shuffles flight client do_get). With
+    `fetch_endpoints` set, files come over the authenticated fetch-server
+    sockets instead of the local filesystem (multi-host topology)."""
 
     def __init__(self, shuffle_id: str, partition_idx: int, shuffle_dir: str,
-                 schema: Schema):
+                 schema: Schema, fetch_endpoints=None):
         super().__init__()
         self.shuffle_id = shuffle_id
         self.partition_idx = partition_idx
         self.shuffle_dir = shuffle_dir
         self.schema = schema
+        self.fetch_endpoints = fetch_endpoints  # [(host, port, authkey_hex)]
 
 
 # ======================================================================================

@@ -55,6 +55,64 @@ class Session:
         return plan_sql(query, bindings, session=self)
 
 
+class FilesystemCatalog:
+    """Concrete catalog over a directory tree: {root}/{namespace...}/{table},
+    each table directory an Iceberg (metadata/) or Delta (_delta_log/) table,
+    auto-detected per load. Reference parity: daft/catalog/__iceberg.py
+    IcebergCatalog.load_table + daft/catalog/__init__.py Catalog protocol.
+
+        session.attach_catalog(FilesystemCatalog("/warehouse", name="wh"))
+        session.sql("SELECT * FROM wh.sales.orders")
+    """
+
+    def __init__(self, root: str, name: str = "fs"):
+        import os
+
+        self.root = root
+        self.name = name
+        if not os.path.isdir(root):
+            raise FileNotFoundError(f"catalog root {root!r} does not exist")
+
+    def _table_dir(self, name: str) -> str:
+        import os
+
+        parts = [p for p in name.split(".") if p]
+        d = os.path.join(self.root, *parts)
+        if not os.path.isdir(d):
+            raise KeyError(f"table {name!r} not found under {self.root}")
+        return d
+
+    def load_table(self, name: str):
+        import os
+
+        import daft_tpu
+
+        d = self._table_dir(name)
+        if os.path.isdir(os.path.join(d, "metadata")):
+            return daft_tpu.read_iceberg(d)
+        if os.path.isdir(os.path.join(d, "_delta_log")):
+            return daft_tpu.read_deltalake(d)
+        raise ValueError(f"{d} is neither an Iceberg nor a Delta table")
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        import os
+
+        out = []
+        for dirpath, dirnames, _files in os.walk(self.root):
+            base = os.path.basename(dirpath)
+            if base in ("metadata", "_delta_log"):
+                dirnames.clear()
+                continue
+            if os.path.isdir(os.path.join(dirpath, "metadata")) or \
+                    os.path.isdir(os.path.join(dirpath, "_delta_log")):
+                rel = os.path.relpath(dirpath, self.root)
+                name = rel.replace(os.sep, ".")
+                if pattern is None or pattern in name:
+                    out.append(name)
+                dirnames.clear()
+        return sorted(out)
+
+
 _SESSION: Optional[Session] = None
 
 

@@ -1,0 +1,239 @@
+"""Iceberg + Delta Lake read connectors and the filesystem catalog.
+
+Tables are constructed on disk in the exact on-disk layout the specs define
+(Iceberg v2 metadata JSON + Avro manifest list/manifests; Delta _delta_log
+newline-JSON actions), then read back through the engine: schema mapping,
+log/snapshot replay, partition + stats pruning through Pushdowns, and the
+session catalog + SQL path."""
+
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.io.avro import write_container
+from daft_tpu.io.scan import Pushdowns
+
+
+# ======================================================================================
+# fixture builders
+# ======================================================================================
+
+
+def _write_parquet(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(pa.table(rows), path)
+    return os.path.getsize(path)
+
+
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102", "fields": [
+                        {"name": "p", "type": ["null", "string"]}]}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+            ]}},
+    ]}
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "content", "type": "int"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+@pytest.fixture
+def iceberg_table(tmp_path):
+    """Two identity-partitioned data files (p='a', p='b') under one snapshot."""
+    t = str(tmp_path / "wh" / "sales" / "events")
+    loc = "file:///original/warehouse/events"  # written elsewhere: tests re-anchoring
+    files = []
+    for pval, ks in (("a", [1, 2, 3]), ("b", [10, 20])):
+        path = os.path.join(t, "data", f"p={pval}", "f.parquet")
+        size = _write_parquet(path, {
+            "k": pa.array(ks, pa.int64()),
+            "v": pa.array([float(k) * 0.5 for k in ks], pa.float64()),
+            "p": pa.array([pval] * len(ks), pa.string()),
+        })
+        files.append((f"{loc}/data/p={pval}/f.parquet", pval, len(ks), size))
+
+    mdir = os.path.join(t, "metadata")
+    os.makedirs(mdir, exist_ok=True)
+    entries = [{"status": 1, "data_file": {
+        "content": 0, "file_path": fp, "file_format": "PARQUET",
+        "partition": {"p": pval}, "record_count": n, "file_size_in_bytes": size,
+    }} for fp, pval, n, size in files]
+    write_container(os.path.join(mdir, "m0.avro"), _MANIFEST_ENTRY_SCHEMA, entries)
+    write_container(os.path.join(mdir, "snap-99.avro"), _MANIFEST_LIST_SCHEMA,
+                    [{"manifest_path": f"{loc}/metadata/m0.avro", "content": 0,
+                      "added_snapshot_id": 99}])
+    meta = {
+        "format-version": 2, "table-uuid": "0000", "location": loc,
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "k", "type": "long", "required": False},
+            {"id": 2, "name": "v", "type": "double", "required": False},
+            {"id": 3, "name": "p", "type": "string", "required": False},
+        ]}],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": [
+            {"name": "p", "transform": "identity", "source-id": 3, "field-id": 1000}]}],
+        "current-snapshot-id": 99,
+        "snapshots": [{"snapshot-id": 99, "timestamp-ms": 0,
+                       "manifest-list": f"{loc}/metadata/snap-99.avro"}],
+    }
+    with open(os.path.join(mdir, "v1.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    return t, str(tmp_path / "wh")
+
+
+@pytest.fixture
+def delta_table(tmp_path):
+    """Partitioned delta table with a remove action in a second commit."""
+    t = str(tmp_path / "dw" / "orders")
+    log = os.path.join(t, "_delta_log")
+    os.makedirs(log, exist_ok=True)
+    # data files do NOT contain the partition column
+    _write_parquet(os.path.join(t, "p=x", "f1.parquet"),
+                   {"k": pa.array([1, 2], pa.int64()),
+                    "v": pa.array([1.0, 2.0], pa.float64())})
+    _write_parquet(os.path.join(t, "p=y", "f2.parquet"),
+                   {"k": pa.array([30, 40], pa.int64()),
+                    "v": pa.array([3.0, 4.0], pa.float64())})
+    _write_parquet(os.path.join(t, "p=x", "dead.parquet"),
+                   {"k": pa.array([999], pa.int64()),
+                    "v": pa.array([9.9], pa.float64())})
+    schema_string = json.dumps({"type": "struct", "fields": [
+        {"name": "k", "type": "long", "nullable": True, "metadata": {}},
+        {"name": "v", "type": "double", "nullable": True, "metadata": {}},
+        {"name": "p", "type": "string", "nullable": True, "metadata": {}},
+    ]})
+    v0 = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+        {"metaData": {"id": "m", "schemaString": schema_string,
+                      "partitionColumns": ["p"], "configuration": {}}},
+        {"add": {"path": "p=x/f1.parquet", "partitionValues": {"p": "x"},
+                 "size": 1, "modificationTime": 0, "dataChange": True,
+                 "stats": json.dumps({"numRecords": 2, "minValues": {"k": 1},
+                                      "maxValues": {"k": 2}})}},
+        {"add": {"path": "p=x/dead.parquet", "partitionValues": {"p": "x"},
+                 "size": 1, "modificationTime": 0, "dataChange": True,
+                 "stats": json.dumps({"numRecords": 1, "minValues": {"k": 999},
+                                      "maxValues": {"k": 999}})}},
+    ]
+    v1 = [
+        {"remove": {"path": "p=x/dead.parquet", "dataChange": True}},
+        {"add": {"path": "p=y/f2.parquet", "partitionValues": {"p": "y"},
+                 "size": 1, "modificationTime": 0, "dataChange": True,
+                 "stats": json.dumps({"numRecords": 2, "minValues": {"k": 30},
+                                      "maxValues": {"k": 40}})}},
+    ]
+    for i, actions in enumerate((v0, v1)):
+        with open(os.path.join(log, f"{i:020d}.json"), "w") as f:
+            for a in actions:
+                f.write(json.dumps(a) + "\n")
+    return t, str(tmp_path / "dw")
+
+
+# ======================================================================================
+# iceberg
+# ======================================================================================
+
+
+def test_iceberg_read_schema_and_rows(iceberg_table):
+    t, _root = iceberg_table
+    df = daft_tpu.read_iceberg(t)
+    assert df.column_names == ["k", "v", "p"]
+    out = df.sort("k").to_pydict()
+    assert out["k"] == [1, 2, 3, 10, 20]
+    assert out["p"] == ["a", "a", "a", "b", "b"]
+    assert out["v"] == [0.5, 1.0, 1.5, 5.0, 10.0]
+
+
+def test_iceberg_partition_pruning(iceberg_table):
+    t, _root = iceberg_table
+    from daft_tpu.io.iceberg import IcebergScanOperator
+
+    op = IcebergScanOperator(t)
+    assert len(op.to_scan_tasks(Pushdowns())) == 2
+    pruned = op.to_scan_tasks(Pushdowns(filters=col("p") == "a"))
+    assert len(pruned) == 1 and "p=a" in pruned[0].source_label
+    # engine-level: the pushdown happens through the optimizer
+    out = daft_tpu.read_iceberg(t).where(col("p") == "b").sort("k").to_pydict()
+    assert out["k"] == [10, 20]
+
+
+def test_iceberg_approx_rows_and_predicate(iceberg_table):
+    t, _root = iceberg_table
+    from daft_tpu.io.iceberg import IcebergScanOperator
+
+    assert IcebergScanOperator(t).approx_num_rows(Pushdowns()) == 5.0
+    out = daft_tpu.read_iceberg(t).where(col("k") >= 3).sum("k").to_pydict()
+    assert out["k"] == [33]
+
+
+# ======================================================================================
+# delta
+# ======================================================================================
+
+
+def test_delta_read_replays_log_and_restores_partition_columns(delta_table):
+    t, _root = delta_table
+    df = daft_tpu.read_deltalake(t)
+    assert df.column_names == ["k", "v", "p"]
+    out = df.sort("k").to_pydict()
+    assert out["k"] == [1, 2, 30, 40]           # dead.parquet removed by v1
+    assert out["p"] == ["x", "x", "y", "y"]     # partition col reconstructed
+
+
+def test_delta_partition_and_stats_pruning(delta_table):
+    t, _root = delta_table
+    from daft_tpu.io.delta import DeltaScanOperator
+
+    op = DeltaScanOperator(t)
+    assert len(op.to_scan_tasks(Pushdowns())) == 2
+    by_part = op.to_scan_tasks(Pushdowns(filters=col("p") == "y"))
+    assert len(by_part) == 1 and "f2" in by_part[0].source_label
+    by_stats = op.to_scan_tasks(Pushdowns(filters=col("k") > 25))
+    assert len(by_stats) == 1 and "f2" in by_stats[0].source_label
+    out = daft_tpu.read_deltalake(t).where(col("p") == "x").sum("v").to_pydict()
+    assert out["v"] == [3.0]
+
+
+# ======================================================================================
+# catalog + SQL
+# ======================================================================================
+
+
+def test_filesystem_catalog_lists_and_loads(iceberg_table):
+    _t, root = iceberg_table
+    from daft_tpu.session import FilesystemCatalog, Session
+
+    cat = FilesystemCatalog(root, name="wh")
+    assert cat.list_tables() == ["sales.events"]
+    s = Session()
+    s.attach_catalog(cat, alias="wh")
+    out = s.sql("SELECT p, SUM(k) AS sk FROM wh.sales.events GROUP BY p ORDER BY p")
+    assert out.to_pydict() == {"p": ["a", "b"], "sk": [6, 30]}
+
+
+def test_filesystem_catalog_delta(delta_table):
+    _t, root = delta_table
+    from daft_tpu.session import FilesystemCatalog, Session
+
+    s = Session()
+    s.attach_catalog(FilesystemCatalog(root, name="dw"), alias="dw")
+    out = s.sql("SELECT p, COUNT(*) AS n FROM dw.orders GROUP BY p ORDER BY p")
+    assert out.to_pydict() == {"p": ["x", "y"], "n": [2, 2]}

@@ -286,3 +286,68 @@ def test_distributed_tpch_sweep(dist_runner):
                                            err_msg=f"q{qnum}.{c}")
             else:
                 assert got[c] == expect[c], f"q{qnum}.{c}"
+
+
+def test_socket_shuffle_transport_matches_native():
+    """shuffle_transport='socket': reduce tasks fetch partitions over the
+    HMAC-authenticated fetch server ONLY — the ShuffleRead plans they execute
+    carry no shuffle_dir, so any filesystem fallback would fail loudly
+    (reference: flight_server.rs:72 + client fan-in)."""
+    import daft_tpu.runners as runners
+    from daft_tpu.distributed import DistributedRunner
+
+    r = DistributedRunner(num_workers=2, n_partitions=3, shuffle_transport="socket")
+    native = runners.NativeRunner()
+    try:
+        rng = np.random.default_rng(3)
+        n = 8_000
+        data = daft_tpu.from_pydict({
+            "k": rng.integers(0, 300, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        })
+        dim = daft_tpu.from_pydict({"k": list(range(300)),
+                                    "w": [float(i) for i in range(300)]})
+
+        def q():
+            return (data.join(dim, on="k")
+                    .groupby("k").agg(col("v").sum().alias("s"),
+                                      col("w").max().alias("mw"))
+                    .sort("k"))
+
+        runners.set_runner(native)
+        expect = q().to_pydict()
+        runners.set_runner(r)
+        got = q().to_pydict()
+        assert got["k"] == expect["k"]
+        np.testing.assert_allclose(got["s"], expect["s"], rtol=1e-12)
+        np.testing.assert_allclose(got["mw"], expect["mw"], rtol=1e-12)
+    finally:
+        runners.set_runner(native)
+        r.shutdown()
+
+
+def test_fetch_server_rejects_bad_auth_and_traversal():
+    import tempfile
+
+    from daft_tpu.distributed.fetch_server import ShuffleFetchServer, fetch_partition
+    from daft_tpu.schema import Schema
+
+    with tempfile.TemporaryDirectory() as td:
+        srv = ShuffleFetchServer(td)
+        try:
+            host, port, key = srv.endpoint
+            # wrong auth key never reaches the protocol
+            import multiprocessing.connection as mpc
+
+            with pytest.raises(Exception):
+                c = mpc.Client((host, port), family="AF_INET", authkey=b"wrong-key")
+                c.close()
+            # traversal-shaped shuffle ids are refused server-side
+            good = mpc.Client((host, port), family="AF_INET",
+                              authkey=bytes.fromhex(key))
+            good.send(("list", "../etc", 0))
+            kind, detail = good.recv()
+            assert kind == "error" and "bad shuffle id" in detail
+            good.close()
+        finally:
+            srv.close()

@@ -160,3 +160,126 @@ def test_llm_generate_process_actor_pool():
     out = df.select(llm_generate(col("q"), provider="dummy", use_process=True,
                                  max_concurrency=2).alias("a")).to_pydict()
     assert all(a.endswith(q) for a, q in zip(out["a"], [f"q{i}" for i in range(20)]))
+
+
+class _MockOpenAI:
+    """In-process OpenAI-compatible server: /embeddings + /chat/completions,
+    with auth check, one injected 500 (retry path), and a high-water mark of
+    concurrent in-flight requests."""
+
+    def __init__(self):
+        import http.server
+        import json as _json
+        import threading
+        import time as _time
+
+        self.inflight = 0
+        self.max_inflight = 0
+        self.requests = []
+        self.fail_next = 0
+        self._lock = threading.Lock()
+        mock = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = _json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+                with mock._lock:
+                    if mock.fail_next > 0:
+                        mock.fail_next -= 1
+                        self.send_response(500)
+                        self.end_headers()
+                        return
+                    mock.inflight += 1
+                    mock.max_inflight = max(mock.max_inflight, mock.inflight)
+                    mock.requests.append((self.path, self.headers.get("Authorization")))
+                _time.sleep(0.05)  # hold the request so concurrency is observable
+                try:
+                    if self.path == "/v1/embeddings":
+                        data = [{"index": i, "embedding": [float(len(t)), 1.0]}
+                                for i, t in enumerate(body["input"])]
+                        out = {"data": data}
+                    else:
+                        content = "echo: " + body["messages"][-1]["content"][:32]
+                        out = {"choices": [{"message": {"content": content}}]}
+                    payload = _json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                finally:
+                    with mock._lock:
+                        mock.inflight -= 1
+
+        from http.server import ThreadingHTTPServer
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+def test_openai_provider_embeddings_and_generation_with_concurrency():
+    """OpenAI-compatible HTTP provider against a mock server: embeddings batch
+    through /embeddings, generation fans out concurrent /chat/completions
+    (reference: daft/ai/openai + the vLLM prompt operator)."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.ai.openai_provider import OpenAIProvider
+    from daft_tpu.ai.provider import register_provider
+    from daft_tpu.functions.ai import embed_text, llm_generate
+
+    mock = _MockOpenAI()
+    try:
+        provider = OpenAIProvider(base_url=f"http://127.0.0.1:{mock.port}/v1",
+                                  api_key="sk-test", request_concurrency=4)
+        register_provider(provider, name="openai_test")
+        df = daft_tpu.from_pydict({"t": ["alpha", "bz", None, "gamma!", "dd", "eee"]})
+        out = df.select(embed_text(col("t"), provider="openai_test",
+                                   model="emb-1").alias("e")).to_pydict()
+        assert out["e"][2] is None
+        assert out["e"][0] == [5.0, 1.0] and out["e"][1] == [2.0, 1.0]
+        # auth header reached the server
+        assert all(a == "Bearer sk-test" for _p, a in mock.requests)
+
+        out = df.select(llm_generate(col("t"), provider="openai_test",
+                                     model="m").alias("g")).to_pydict()
+        assert out["g"][0] == "echo: alpha" and out["g"][2] is None
+        assert mock.max_inflight > 1, "generation requests never overlapped"
+    finally:
+        mock.close()
+
+
+def test_openai_provider_retries_on_500():
+    from daft_tpu.ai.openai_provider import OpenAIProvider
+
+    mock = _MockOpenAI()
+    try:
+        mock.fail_next = 2
+        p = OpenAIProvider(base_url=f"http://127.0.0.1:{mock.port}/v1",
+                           api_key="k", max_retries=3)
+        got = p.get_prompter("m").prompt(["hi"])
+        assert got == ["echo: hi"]
+    finally:
+        mock.close()
+
+
+def test_openai_classifier_routes_through_prompts():
+    from daft_tpu.ai.openai_provider import OpenAIProvider
+
+    mock = _MockOpenAI()
+    try:
+        p = OpenAIProvider(base_url=f"http://127.0.0.1:{mock.port}/v1", api_key="k")
+        # mock echoes the prompt; 'spam' appears in the echoed label list
+        out = p.get_text_classifier("m").classify_text(["buy pills"], ["spam", "ham"])
+        assert out == ["spam"]
+    finally:
+        mock.close()

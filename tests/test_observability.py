@@ -162,3 +162,65 @@ def test_event_log_writes_jsonl(tmp_path):
     assert kinds[0] == "query_start" and kinds[-1] == "query_end"
     assert "operator_stats" in kinds
     assert events[-1]["rows"] == 2
+
+
+def test_otlp_subscriber_exports_span_tree():
+    """OTLP/HTTP JSON export: one root query span with optimize + operator
+    children, asserted against a mock collector (reference:
+    common/tracing/src/config.rs OTLP exporter)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, _json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        import daft_tpu
+        from daft_tpu import col
+        from daft_tpu.observability.otlp import OTLPSubscriber
+        from daft_tpu.observability.subscribers import (attach_subscriber,
+                                                        detach_subscriber)
+
+        sub = OTLPSubscriber(f"http://127.0.0.1:{srv.server_address[1]}",
+                             asynchronous=False)
+        attach_subscriber(sub)
+        try:
+            df = daft_tpu.from_pydict({"a": list(range(100))})
+            df.where(col("a") % 2 == 0).select((col("a") * 3).alias("b")).to_pydict()
+        finally:
+            detach_subscriber(sub)
+
+        assert sub.exported == 1 and sub.last_error is None
+        path, payload = received[0]
+        assert path == "/v1/traces"
+        rs = payload["resourceSpans"][0]
+        svc = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert svc["service.name"]["stringValue"] == "daft_tpu"
+        spans = rs["scopeSpans"][0]["spans"]
+        roots = [s for s in spans if "parentSpanId" not in s]
+        assert len(roots) == 1 and roots[0]["name"] == "daft.query"
+        root = roots[0]
+        children = [s for s in spans if s.get("parentSpanId") == root["spanId"]]
+        names = {s["name"] for s in children}
+        assert "daft.optimize" in names
+        assert any(n.startswith("daft.operator:") for n in names)
+        assert all(s["traceId"] == root["traceId"] for s in spans)
+        # timing sanity: children end within the root span
+        assert all(int(s["endTimeUnixNano"]) <= int(root["endTimeUnixNano"]) + 10**9
+                   for s in children)
+    finally:
+        srv.shutdown()
