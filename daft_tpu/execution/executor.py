@@ -260,6 +260,10 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield _exec_device_agg(node)
         return
 
+    if isinstance(node, pp.DeviceJoinAgg):
+        yield _exec_device_join_agg(node)
+        return
+
     if isinstance(node, pp.Dedup):
         # streaming dedup, keep-first: each batch dedups internally, then drops
         # rows whose keys were already seen — probed against an amortized
@@ -468,7 +472,8 @@ def _exec_device_agg(node) -> MicroPartition:
             # the offending batch): rerun the whole stage on host
             return _host_agg(itertools.chain(buffered, stream))
         key_rows, results = run.finalize()
-        return _grouped_output(node, key_rows, results)
+        return _grouped_output(node.schema, node.groupby, node.aggregations,
+                               key_rows, results)
 
     from ..ops.stage import try_build_filter_agg_stage
 
@@ -487,22 +492,105 @@ def _exec_device_agg(node) -> MicroPartition:
     return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
 
 
-def _grouped_output(node, key_rows, results) -> MicroPartition:
+def _exec_device_join_agg(node) -> MicroPartition:
+    """Run a DeviceJoinAgg node: the gather-join device program, or the
+    untouched host plan (config off, small input, or runtime DeviceFallback).
+    """
+    from ..config import execution_config
+    from ..ops.device_join import (DeviceJoinGroupedRun, DeviceJoinUngroupedRun,
+                                   _JoinContext, build_join_stage)
+    from ..ops.grouped_stage import DeviceFallback
+
+    cfg = execution_config()
+
+    def _host() -> MicroPartition:
+        parts = list(_exec(node.host_plan))
+        batch = _concat_parts(parts, node.schema)
+        return MicroPartition(node.schema, [batch])
+
+    # Device joins move per-query dim-sized arrays (codes, visibility, match
+    # sets) host->device. On a locally attached TPU those transfers are
+    # microseconds; over a tunneled device EACH pays the link round trip
+    # (~50-90ms measured), which dwarfs the compute. So "auto" requires an
+    # explicit opt-in (DAFT_TPU_JOIN_DEVICE=1) — the bench-honest default —
+    # while device_mode="on" always exercises the path (tests do).
+    import os
+
+    use_device = cfg.device_mode == "on" or (
+        cfg.device_mode == "auto"
+        and os.environ.get("DAFT_TPU_JOIN_DEVICE") == "1")
+    raw_stream = None      # the closeable generator (cancellation must reach it)
+    fact_stream = None
+    if use_device and cfg.device_mode == "auto":
+        import jax
+
+        if jax.default_backend() in ("cpu",):
+            use_device = False
+        else:
+            raw_stream = _exec(node.fact)
+            first = next(raw_stream, None)
+            if first is not None:
+                fact_stream = itertools.chain([first], raw_stream)
+                use_device = first.num_rows >= cfg.device_min_rows
+            else:
+                fact_stream = raw_stream
+    if not use_device:
+        if raw_stream is not None:
+            raw_stream.close()
+        return _host()
+
+    try:
+        stage, grouped = build_join_stage(node.spec)
+        if stage is None:
+            if raw_stream is not None:
+                raw_stream.close()
+            return _host()
+        dim_batches = {}
+        for name, plan in node.dim_plans:
+            dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
+        ctx = _JoinContext(node.spec, dim_batches)
+        run = DeviceJoinGroupedRun(stage, ctx) if grouped \
+            else DeviceJoinUngroupedRun(stage, ctx)
+        if fact_stream is None:
+            raw_stream = fact_stream = _exec(node.fact)
+        for part in fact_stream:
+            for b in part.batches:
+                run.feed_batch(b)
+        if grouped:
+            key_rows, results = run.finalize()
+            return _grouped_output(node.schema, node.spec.groupby,
+                                   node.spec.aggregations, key_rows, results)
+        from ..core.series import Series
+
+        final = run.finalize()
+        cols = []
+        for name, _agg in stage.aggs:
+            f = node.schema[name]
+            cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
+        out = RecordBatch(node.schema, cols, 1)
+        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+    except DeviceFallback:
+        if raw_stream is not None:
+            raw_stream.close()
+        return _host()
+
+
+def _grouped_output(schema, groupby, aggregations, key_rows, results) -> MicroPartition:
     """Assemble a grouped-agg result batch from key tuples + per-agg
     (values, valid) arrays — shared by the single-chip and mesh device paths
     so null/dtype semantics cannot drift."""
     from ..core.series import Series
 
     cols = []
-    for i, g in enumerate(node.groupby):
-        f = node.schema[g.name()]
+    for i, g in enumerate(groupby):
+        f = schema[g.name()]
         cols.append(Series.from_pylist([k[i] for k in key_rows], f.name, dtype=f.dtype))
-    for e, (vals, valid) in zip(node.aggregations, results):
-        f = node.schema[e.name()]
+    for e, (vals, valid) in zip(aggregations, results):
+        f = schema[e.name()]
         data = [v.item() if ok else None for v, ok in zip(vals, valid)]
         cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
-    out = RecordBatch(node.schema, cols, len(key_rows))
-    return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+    out = RecordBatch(schema, cols, len(key_rows))
+    return MicroPartition(schema, [out.cast_to_schema(schema)])
 
 
 def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
@@ -574,7 +662,8 @@ def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
 
     # gk is sorted ascending = dense-code order = first-occurrence order
     ordered_keys = [key_rows[int(k)] for k in gk]
-    return _grouped_output(node, ordered_keys, out_cols)
+    return _grouped_output(node.schema, node.groupby, node.aggregations,
+                           ordered_keys, out_cols)
 
 
 def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
@@ -1173,6 +1262,10 @@ def _concat_parts(parts: List[MicroPartition], schema) -> RecordBatch:
     batches = [b for p in parts for b in p.batches if b.num_rows > 0]
     if not batches:
         return RecordBatch.empty(schema)
+    if len(batches) == 1:
+        # zero-copy: preserves batch identity, so device-join caches keyed on
+        # the stored batch survive across queries over resident tables
+        return batches[0]
     return RecordBatch.concat(batches)
 
 

@@ -11,6 +11,7 @@ device_stage_batches = 0     # batches through FilterAggStage (ungrouped)
 device_grouped_batches = 0   # batches through GroupedAggStage
 device_stage_runs = 0        # completed device agg node executions
 mesh_grouped_runs = 0        # grouped aggs executed via the mesh-sharded path
+device_join_batches = 0      # batches through the gather-join device stages
 
 
 def bump(name: str, n: int = 1) -> None:
@@ -19,8 +20,9 @@ def bump(name: str, n: int = 1) -> None:
 
 def reset() -> None:
     global device_stage_batches, device_grouped_batches, device_stage_runs
-    global mesh_grouped_runs
+    global mesh_grouped_runs, device_join_batches
     device_stage_batches = 0
     device_grouped_batches = 0
     device_stage_runs = 0
     mesh_grouped_runs = 0
+    device_join_batches = 0

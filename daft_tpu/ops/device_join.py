@@ -1,0 +1,744 @@
+"""Device join+aggregate fusion: star-schema joins as gather networks on TPU.
+
+Reference contrast: the reference executes joins as host probe tables
+(src/daft-local-execution/src/join/build.rs + probe.rs) and then aggregates.
+A TPU-native engine inverts the design: for the analytics shape — one large
+fact relation inner-joined to smaller dims on unique keys, then aggregated —
+the join never materializes. Each dim becomes
+
+    per-fact-row index  idx_d[i] = dim row whose key equals the fact row's
+                        key value (-1 = no match), a STATIC host-computed
+                        int32 array cached per (fact column, dim key) pair
+
+and every dim column the query touches is one device GATHER dim_col[idx_d].
+Per query, only the dim-side filter masks and dictionary codes change (small,
+dim-sized uploads); the fact columns and join indices are resident in HBM.
+The aggregation then rides the existing MXU segment-reduction machinery
+(ops/grouped_stage.py) / ungrouped stage (ops/stage.py) unchanged — the fused
+program is filter -> gather-join -> segment-reduce in one XLA computation
+chain with ONE d2h fetch per query.
+
+Capture (plan/physical.py translate calls try_capture_join_agg):
+    Aggregate <- [Project]* <- [Filter]* <- inner-join tree
+flattened to relations + equality conditions; the largest relation is the
+fact, the rest must connect as a tree of unique-key dims (extra equality
+edges become device predicates). Dim-only subexpressions are hoisted to
+host-evaluated synthetic dim columns (strings, LIKE, is_in — dims are small),
+so the device only ever sees numeric/bool planes.
+
+Fallback: any shape this file cannot prove safe returns None at capture time,
+or raises DeviceFallback before the first dispatch at run time — the executor
+then runs the untouched host plan (exact same semantics, tested side-by-side).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from ..core.kernels.encoding import _common_key_dtype, canonical_key_values
+from ..datatype import DataType, Field
+from ..expressions.expressions import (AggExpr, Alias, BinaryOp, ColumnRef,
+                                       Expression, IsIn, Literal)
+from ..schema import Schema
+from . import counters
+from . import device_eval as dev
+from .grouped_stage import (DeviceFallback, GroupedAggRun, GroupedAggStage,
+                            MAX_MATMUL_SEGMENTS, _Decode, _pad_groups,
+                            cached_dict_code_plane, try_build_grouped_agg_stage)
+from .stage import FilterAggRun, FilterAggStage, device_row_mask, pad_bucket
+
+
+# ======================================================================================
+# capture: logical plan -> JoinAggSpec
+# ======================================================================================
+
+
+@dataclass
+class DimSpec:
+    base: object                     # LOGICAL plan of the dim without trailing filters
+    filters: List[Expression]        # dim-local filters (host-evaluated per run)
+    key_col: str                     # dim-side unique join key column
+    parent: Tuple[str, str]          # ("fact"|dim_name, column) providing probe values
+    name: str                        # dim identifier (for caches/debug)
+    synthetic: List[Tuple[str, Expression]] = field(default_factory=list)
+    used_cols: List[str] = field(default_factory=list)
+
+
+@dataclass
+class JoinAggSpec:
+    fact: object                     # LOGICAL plan of the fact side (filters stripped)
+    dims: List[DimSpec]              # topologically ordered (parents first)
+    schema: Schema                   # joined schema: fact + dim (+synthetic) columns
+    col_side: Dict[str, str]         # column -> "fact" | dim name
+    predicate: Optional[Expression]
+    groupby: List[Expression]
+    aggregations: List[Expression]
+    # fact-side string membership predicates lowered to dictionary-code
+    # comparisons: syn name -> (fact column, match values). The codes plane is
+    # resident (Series dict codes); only the tiny match set is per-query.
+    fact_synthetic: Dict[str, Tuple[str, tuple]] = field(default_factory=dict)
+
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _flatten_joins(node) -> Optional[Tuple[list, list]]:
+    """Flatten a tree of plain inner equi-joins into (relations, conditions);
+    conditions are (left_col_name, right_col_name) pairs. Bails on renames or
+    merged keys (capture requires globally unique column names)."""
+    from ..plan import logical as lp
+
+    rels: list = []
+    conds: list = []
+
+    def walk(n) -> bool:
+        if isinstance(n, lp.Join) and n.how == "inner" and n.strategy is None \
+                and not n.null_equals_null:
+            merged, rename = n.output_naming()
+            if merged or rename:
+                return False
+            if len(n.left_on) != len(n.right_on) or not n.left_on:
+                return False
+            pairs = []
+            for le, re_ in zip(n.left_on, n.right_on):
+                le = le.child if isinstance(le, Alias) else le
+                re_ = re_.child if isinstance(re_, Alias) else re_
+                if not (isinstance(le, ColumnRef) and isinstance(re_, ColumnRef)):
+                    return False
+                pairs.append((le._name, re_._name))
+            if not walk(n.left):
+                return False
+            conds.extend(pairs)
+            if not walk(n.right):
+                return False
+            return True
+        rels.append(n)
+        return True
+
+    if not walk(node):
+        return None
+    names: set = set()
+    for r in rels:
+        cols = r.schema.column_names()
+        if names & set(cols):
+            return None  # duplicated names across relations: provenance ambiguous
+        names |= set(cols)
+    return rels, conds
+
+
+def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
+    """Match Aggregate <- [Project]* <- [Filter]* <- inner-join tree into a
+    JoinAggSpec, or None when the shape isn't provably safe."""
+    from ..plan import logical as lp
+    from ..plan.stats import estimate_rows
+
+    groupby = list(agg_plan.groupby)
+    aggs = list(agg_plan.aggregations)
+    conjuncts: List[Expression] = []
+    src = agg_plan.input
+
+    def substitute(exprs: List[Expression], proj: List[Expression]) -> Optional[List[Expression]]:
+        mapping: Dict[str, Expression] = {}
+        for p in proj:
+            inner = p.child if isinstance(p, Alias) else p
+            mapping[p.name()] = inner
+        out = []
+        for e in exprs:
+            def rw(node):
+                if isinstance(node, ColumnRef) and node._name in mapping:
+                    return mapping[node._name]
+                return None
+
+            ne = e.transform(rw)
+            if ne.name() != e.name():
+                ne = ne.alias(e.name())  # projections define output names
+            out.append(ne)
+        return out
+
+    for _ in range(16):
+        if isinstance(src, lp.Project):
+            all_exprs = groupby + aggs + conjuncts
+            new = substitute(all_exprs, src.projection)
+            if new is None:
+                return None
+            groupby = new[:len(groupby)]
+            aggs = new[len(groupby):len(groupby) + len(aggs)]
+            conjuncts = new[len(groupby) + len(aggs):]
+            src = src.input
+        elif isinstance(src, lp.Filter):
+            conjuncts.extend(_split_conjuncts(src.predicate))
+            src = src.input
+        else:
+            break
+
+    flat = _flatten_joins(src)
+    if flat is None:
+        return None
+    rels, conds = flat
+    if len(rels) < 2:
+        return None
+
+    # strip trailing filters per relation
+    def strip_filters(n) -> Tuple[object, List[Expression]]:
+        fs: List[Expression] = []
+        while isinstance(n, lp.Filter):
+            fs.extend(_split_conjuncts(n.predicate))
+            n = n.input
+        return n, fs
+
+    # fact = the largest relation by UNFILTERED base size: the fact is the
+    # relation that streams through the gather program, and dims must carry
+    # unique keys — a heavily filtered fact is still the fact
+    sizes = [estimate_rows(strip_filters(r)[0]) for r in rels]
+    if any(s is None for s in sizes):
+        return None
+    fact_i = int(np.argmax(sizes))
+
+    fact_base, fact_filters = strip_filters(rels[fact_i])
+    conjuncts.extend(fact_filters)
+
+    col_side: Dict[str, str] = {c: "fact" for c in rels[fact_i].schema.column_names()}
+    available = dict(col_side)
+
+    # grow the dim tree from the fact over unique-key edges
+    pending = [(i, r) for i, r in enumerate(rels) if i != fact_i]
+    remaining_conds = list(conds)
+    dims: List[DimSpec] = []
+    progress = True
+    while pending and progress:
+        progress = False
+        for pi, (ri, rel) in enumerate(pending):
+            rel_cols = set(rel.schema.column_names())
+            edge = None
+            for ci, (a, b) in enumerate(remaining_conds):
+                if a in available and b in rel_cols:
+                    edge = (ci, a, b)
+                    break
+                if b in available and a in rel_cols:
+                    edge = (ci, b, a)
+                    break
+            if edge is None:
+                continue
+            ci, avail_col, dim_key = edge
+            remaining_conds.pop(ci)
+            base, filters = strip_filters(rel)
+            name = f"d{len(dims)}"
+            dims.append(DimSpec(base=base, filters=filters, key_col=dim_key,
+                                parent=(available[avail_col], avail_col), name=name))
+            for c in rel.schema.column_names():
+                col_side[c] = name
+                available[c] = name
+            pending.pop(pi)
+            progress = True
+            break
+    if pending:
+        return None
+    # leftover equality edges: both sides now available -> device predicates.
+    # Only integer-like columns: device eq runs on f32 planes, which would
+    # corrupt float join-key semantics (f32 false-equals; NaN/-0.0 diverge
+    # from the host's bit-canonicalized key equality)
+    def _intish(colname: str) -> bool:
+        for r in rels:
+            if colname in r.schema.column_names():
+                dt = r.schema[colname].dtype
+                return (dt.is_integer() or dt.is_temporal() or dt.is_boolean())
+        return False
+
+    for a, b in remaining_conds:
+        if a not in available or b not in available:
+            return None
+        if not (_intish(a) and _intish(b)):
+            return None
+        conjuncts.append(BinaryOp("eq", ColumnRef(a), ColumnRef(b)))
+
+    # joined schema over original (globally unique) names
+    fields: List[Field] = list(rels[fact_i].schema.fields)
+    for i, r in enumerate(rels):
+        if i != fact_i:
+            fields.extend(r.schema.fields)
+    schema = Schema(fields)
+
+    # hoist maximal single-dim subexpressions to synthetic host-evaluated
+    # dim columns (strings/likes/is_in run on the small dim side)
+    dim_by_name = {d.name: d for d in dims}
+    counter = [0]
+    fact_synthetic: Dict[str, Tuple[str, tuple]] = {}
+
+    def fact_string_membership(node) -> Optional[Tuple[str, tuple]]:
+        """(fact string column, literal match values) for `col == lit` /
+        `col.is_in([lits])` over a fact string column, else None."""
+        if isinstance(node, IsIn) and isinstance(node.child, ColumnRef):
+            cn = node.child._name
+            if col_side.get(cn) == "fact" and schema[cn].dtype.is_string() \
+                    and all(isinstance(it, Literal) for it in node.items):
+                return cn, tuple(it.value for it in node.items)
+        if isinstance(node, BinaryOp) and node.op == "eq":
+            for a, b in ((node.left, node.right), (node.right, node.left)):
+                if isinstance(a, ColumnRef) and isinstance(b, Literal) \
+                        and col_side.get(a._name) == "fact" \
+                        and schema[a._name].dtype.is_string() \
+                        and isinstance(b.value, str):
+                    return a._name, (b.value,)
+        return None
+
+    def hoist(e: Expression) -> Optional[Expression]:
+        def side_of(expr) -> Optional[str]:
+            sides = {col_side.get(c) for c in expr.referenced_columns()}
+            sides.discard(None)
+            if len(sides) == 1:
+                return next(iter(sides))
+            return None
+
+        def rw(node):
+            if isinstance(node, (ColumnRef, Alias)) or isinstance(node, AggExpr):
+                return None
+            s = side_of(node)
+            if s is None or s == "fact":
+                fsm = fact_string_membership(node)
+                if fsm is not None:
+                    syn = f"__fsyn_{counter[0]}__"
+                    counter[0] += 1
+                    fact_synthetic[syn] = fsm
+                    return ColumnRef(syn)
+                return None
+            if not node.referenced_columns():
+                return None
+            dim_schema = dim_by_name[s].base.schema
+            if dev.is_device_evaluable(node, schema) and all(
+                    schema[c].dtype.is_numeric() or schema[c].dtype.is_boolean()
+                    or schema[c].dtype.is_temporal()
+                    for c in node.referenced_columns()):
+                return None  # numeric dim math can gather its leaves directly
+            try:
+                node.to_field(dim_schema)
+            except Exception:
+                return None
+            syn = f"__syn_{s}_{counter[0]}__"
+            counter[0] += 1
+            dim_by_name[s].synthetic.append((syn, node))
+            return ColumnRef(syn)
+
+        return e.transform(rw)
+
+    def hoist_named(e: Expression) -> Expression:
+        out = hoist(e)
+        if out.name() != e.name():
+            out = out.alias(e.name())  # output column names are part of the schema
+        return out
+
+    groupby = [hoist_named(g) for g in groupby]
+    aggs = [hoist_named(a) for a in aggs]
+    conjuncts = [hoist(c) for c in conjuncts]
+
+    # register synthetic columns in schema + provenance
+    for d in dims:
+        for syn, expr in d.synthetic:
+            f = expr.to_field(d.base.schema)
+            fields.append(Field(syn, f.dtype))
+            col_side[syn] = d.name
+    for syn in fact_synthetic:
+        fields.append(Field(syn, DataType.bool()))
+        col_side[syn] = "fact"
+    schema = Schema(fields)
+
+    # ---- eligibility over the joined schema --------------------------------------
+    for g in groupby:
+        node = g.child if isinstance(g, Alias) else g
+        if not isinstance(node, ColumnRef):
+            return None
+    predicate = None
+    for c in conjuncts:
+        if not dev.is_device_evaluable(c, schema):
+            return None
+        predicate = c if predicate is None else (predicate & c)
+    # dim join keys + parent columns must canonicalize to ints (num kind)
+    for d in dims:
+        kdt = d.base.schema[d.key_col].dtype
+        if not ((kdt.is_numeric() and not kdt.is_decimal()) or kdt.is_temporal()):
+            return None
+    # record per-dim referenced columns (gather planes)
+    referenced = set()
+    for e in ([predicate] if predicate is not None else []) + groupby + aggs:
+        referenced |= set(e.referenced_columns())
+    for d in dims:
+        d.used_cols = [c for c in referenced
+                       if col_side.get(c) == d.name
+                       and not c.startswith("__syn_")]
+    # float min/max must be exact (see FilterAggStage._use_f64); the gather
+    # path feeds f32 planes, so such stages stay on host
+    for a in aggs:
+        inner = a
+        while isinstance(inner, Alias):
+            inner = inner.child
+        if isinstance(inner, AggExpr) and inner.op in ("min", "max") \
+                and inner.child.to_field(schema).dtype.is_floating():
+            return None
+    spec = JoinAggSpec(fact=fact_base, dims=dims, schema=schema, col_side=col_side,
+                       predicate=predicate, groupby=groupby, aggregations=aggs,
+                       fact_synthetic=fact_synthetic)
+    # eligibility == buildability of the REAL stage (with the join-ok plane)
+    stage, _grouped = build_join_stage(spec)
+    if stage is None:
+        return None
+    return spec
+
+
+# ======================================================================================
+# runtime: static join indices + gathered device columns
+# ======================================================================================
+
+
+def unique_key_index(dim_key_series, probe_vals: np.ndarray,
+                     probe_valid: np.ndarray, target_dtype) -> np.ndarray:
+    """idx[i] = dim row with key == probe value i, else -1. Raises
+    DeviceFallback when dim keys are not unique (join would multiply rows) or
+    aren't integer-encodable."""
+    from ..native import native_i64_map_build, native_i64_map_lookup
+
+    s = dim_key_series
+    if s.dtype != target_dtype:
+        s = s.cast(target_dtype)
+    kind, vals, valid = canonical_key_values(s)
+    if kind not in ("num",):
+        raise DeviceFallback(f"dim key {s.name!r} is not an integer-like key")
+    vals = vals.astype(np.int64, copy=False)
+    vv = vals[valid] if not valid.all() else vals
+    if len(np.unique(vv)) != len(vv):
+        raise DeviceFallback(f"dim key {s.name!r} is not unique")
+    pv = probe_vals.astype(np.int64, copy=False)
+    lo = int(vv.min()) if len(vv) else 0
+    hi = int(vv.max()) if len(vv) else -1
+    domain = hi - lo + 1
+    if 0 < domain <= max(4096, 8 * max(len(vv), 1)):
+        table = np.full(domain, -1, dtype=np.int64)
+        rows = np.nonzero(valid)[0]
+        table[vals[valid] - lo] = rows
+        safe = np.clip(pv - lo, 0, max(domain - 1, 0))
+        idx = np.where((pv >= lo) & (pv <= hi), table[safe], -1)
+    else:
+        hm = native_i64_map_build(vv)
+        if hm is None:
+            order = np.argsort(vv, kind="stable")
+            su = vv[order]
+            pos = np.searchsorted(su, pv)
+            pos_c = np.minimum(pos, max(len(su) - 1, 0))
+            hit = (len(su) > 0) & (su[pos_c] == pv)
+            rows = np.nonzero(valid)[0][order] if len(su) else np.empty(0, np.int64)
+            idx = np.where(hit, rows[pos_c] if len(su) else -1, -1)
+        else:
+            pos = native_i64_map_lookup(hm[0], hm[1], hm[2], pv)
+            rows = np.nonzero(valid)[0]
+            if len(rows) == 0:
+                idx = np.full(len(pv), -1, dtype=np.int64)
+            else:
+                idx = np.where(pos >= 0, rows[np.clip(pos, 0, len(rows) - 1)], -1)
+    idx = np.where(probe_valid, idx, -1)
+    return idx.astype(np.int32, copy=False)
+
+
+@jax.jit
+def _gather_col(arr, arr_valid, idx):
+    safe = jnp.clip(idx, 0, arr.shape[0] - 1)
+    ok = idx >= 0
+    return arr[safe], arr_valid[safe] & ok
+
+
+class _JoinContext:
+    """Materialized dims + per-fact-batch index/gather preparation."""
+
+    def __init__(self, spec: JoinAggSpec, dim_batches: Dict[str, object]):
+        from ..expressions.eval import eval_expression
+
+        self.spec = spec
+        self.dims = spec.dims
+        self.batches = dim_batches              # dim name -> RecordBatch (base rows)
+        self.visible: Dict[str, np.ndarray] = {}
+        self.syn_series: Dict[str, Dict[str, object]] = {}
+        for d in self.dims:
+            b = dim_batches[d.name]
+            vis = np.ones(b.num_rows, dtype=bool)
+            for f in d.filters:
+                m = eval_expression(b, f)
+                mv = m.to_numpy()
+                ok = m.validity_numpy()
+                vis &= np.asarray(mv, dtype=bool) & ok
+            self.visible[d.name] = vis
+            syn = {}
+            for name, expr in d.synthetic:
+                syn[name] = eval_expression(b, expr).rename(name)
+            self.syn_series[d.name] = syn
+
+    def _fact_membership_plane(self, batch, bucket: int, syn: str) -> dev.DCol:
+        """bool plane for a fact string membership predicate: resident dict
+        codes compared against the (tiny) per-query match-code set. Null rows
+        are invalid (SQL three-valued comparisons), matching host eval."""
+        colname, values = self.spec.fact_synthetic[syn]
+        s = batch.get_column(colname)
+        codes, vals, _k = s.dict_codes()
+        match = np.array([i for i, v in enumerate(vals) if v in values],
+                         dtype=np.int32)
+        null_codes = np.array([i for i, v in enumerate(vals) if v is None],
+                              dtype=np.int32)
+        dcodes = cached_dict_code_plane(s, codes, batch.num_rows, bucket)
+        plane = jnp.isin(dcodes, jnp.asarray(match))
+        valid = ~jnp.isin(dcodes, jnp.asarray(null_codes)) if len(null_codes) \
+            else jnp.ones(bucket, dtype=bool)
+        return plane, valid
+
+    # ---- per fact batch -----------------------------------------------------------
+    def indices_for(self, batch) -> Dict[str, np.ndarray]:
+        """Static per-fact-row dim indices, cached on the fact batch."""
+        cache = getattr(batch, "_stage_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(batch, "_stage_cache", cache)
+        key = ("__join_idx__",) + tuple((d.name, d.key_col) for d in self.dims)
+        hit = cache.get(key)
+        if hit is not None:
+            cached_dims, cached_idx = hit
+            # identity check against LIVE references (held in the entry, so a
+            # freed batch can never alias a new one via id() reuse)
+            if all(cached_dims[d.name] is self.batches[d.name] for d in self.dims):
+                return cached_idx
+        out: Dict[str, np.ndarray] = {}
+        n = batch.num_rows
+        for d in self.dims:
+            dim_b = self.batches[d.name]
+            kdt = _common_key_dtype(
+                self._probe_dtype(batch, d), dim_b.schema[d.key_col].dtype)
+            probe_vals, probe_valid = self._probe_values(batch, d, out, kdt)
+            idx = unique_key_index(dim_b.get_column(d.key_col), probe_vals,
+                                   probe_valid, kdt)
+            assert len(idx) == n
+            out[d.name] = idx
+        cache[key] = (dict(self.batches), out)
+        return out
+
+    def _probe_dtype(self, batch, d: DimSpec):
+        side, colname = d.parent
+        if side == "fact":
+            return batch.schema[colname].dtype
+        return self.batches[side].schema[colname].dtype
+
+    def _probe_values(self, batch, d: DimSpec, idx_so_far: Dict[str, np.ndarray],
+                      target_dtype) -> Tuple[np.ndarray, np.ndarray]:
+        side, colname = d.parent
+        if side == "fact":
+            s = batch.get_column(colname)
+            if s.dtype != target_dtype:
+                s = s.cast(target_dtype)
+            kind, vals, valid = canonical_key_values(s)
+            if kind != "num":
+                raise DeviceFallback(f"fact key {colname!r} is not integer-like")
+            return vals.astype(np.int64, copy=False), valid
+        # chained: gather the parent dim's column on host (static)
+        pidx = idx_so_far[side]
+        s = self.batches[side].get_column(colname)
+        if s.dtype != target_dtype:
+            s = s.cast(target_dtype)
+        kind, vals, valid = canonical_key_values(s)
+        if kind != "num":
+            raise DeviceFallback(f"dim key {colname!r} is not integer-like")
+        vals = vals.astype(np.int64, copy=False)
+        if len(vals) == 0:  # empty parent dim: nothing can chain through it
+            return (np.zeros(len(pidx), dtype=np.int64),
+                    np.zeros(len(pidx), dtype=bool))
+        safe = np.clip(pidx, 0, len(vals) - 1)
+        pv = vals[safe]
+        pvalid = (pidx >= 0) & valid[safe]
+        return pv, pvalid
+
+    def device_cols(self, batch, bucket: int, needed: Sequence[str]) -> Dict[str, dev.DCol]:
+        """DCol dict over the joined schema for one fact batch: fact columns
+        resident; dim columns gathered on device via the static indices."""
+        spec = self.spec
+        idxs = self.indices_for(batch)
+        cache = getattr(batch, "_stage_cache", None)
+        dcols: Dict[str, dev.DCol] = {}
+        didx_dev: Dict[str, object] = {}
+
+        def dev_idx(dname: str):
+            if dname not in didx_dev:
+                key = ("__join_didx__", dname, bucket)
+                hit = cache.get(key) if cache is not None else None
+                if hit is not None and hit[0] is self.batches[dname]:
+                    didx_dev[dname] = hit[1]
+                else:
+                    padded = np.full(bucket, -1, dtype=np.int32)
+                    padded[:batch.num_rows] = idxs[dname]
+                    arr = jnp.asarray(padded)
+                    if cache is not None:
+                        cache[key] = (self.batches[dname], arr)
+                    didx_dev[dname] = arr
+            return didx_dev[dname]
+
+        for name in needed:
+            side = spec.col_side.get(name)
+            if side == "fact":
+                if name in spec.fact_synthetic:
+                    dcols[name] = self._fact_membership_plane(batch, bucket, name)
+                    continue
+                dcols[name] = batch.get_column(name).to_device_cached(bucket, f32=True)
+                continue
+            if name == "__join_ok__":
+                continue
+            d = next(dd for dd in self.dims if dd.name == side)
+            dim_b = self.batches[side]
+            cap_d = pad_bucket(dim_b.num_rows)
+            if name.startswith("__syn_"):
+                s = self.syn_series[side][name]
+                arrv, arrm = s.to_device_cached(cap_d, f32=True)
+            else:
+                arrv, arrm = dim_b.get_column(name).to_device_cached(cap_d, f32=True)
+            dcols[name] = _gather_col(arrv, arrm, dev_idx(side))
+
+        # join-validity plane: every dim matched AND its row passes dim filters
+        ok = None
+        for d in self.dims:
+            dim_b = self.batches[d.name]
+            cap_d = pad_bucket(dim_b.num_rows)
+            if not hasattr(self, "_vis_dev"):
+                self._vis_dev = {}
+            if d.name not in self._vis_dev:  # per-run (visibility is per-query)
+                padded = np.zeros(cap_d, dtype=bool)
+                padded[:dim_b.num_rows] = self.visible[d.name]
+                self._vis_dev[d.name] = jnp.asarray(padded)
+            vis_dev = self._vis_dev[d.name]
+            _vals, vmask = _gather_col(vis_dev.astype(jnp.float32),
+                                       vis_dev, dev_idx(d.name))
+            ok = vmask if ok is None else (ok & vmask)
+        if ok is None:
+            ok = jnp.ones(bucket, dtype=bool)
+        dcols["__join_ok__"] = (ok, jnp.ones(bucket, dtype=bool))
+        return dcols
+
+
+# ======================================================================================
+# runs: grouped + ungrouped over joined columns
+# ======================================================================================
+
+
+def _joined_stage_schema(spec: JoinAggSpec) -> Schema:
+    return Schema(list(spec.schema.fields) + [Field("__join_ok__", DataType.bool())])
+
+
+def _with_join_ok(predicate: Optional[Expression]) -> Expression:
+    ok = ColumnRef("__join_ok__")
+    return ok if predicate is None else (predicate & ok)
+
+
+class DeviceJoinGroupedRun(GroupedAggRun):
+    """GroupedAggRun over gather-joined columns: same jitted programs, same
+    finalize/merge — only column provisioning and group codes differ."""
+
+    def __init__(self, stage: GroupedAggStage, ctx: _JoinContext):
+        super().__init__(stage)
+        self.ctx = ctx
+
+    def feed_batch(self, batch) -> None:
+        stage = self.stage
+        n = batch.num_rows
+        if n == 0:
+            return
+        bucket = pad_bucket(n)
+        decode = self._join_codes(batch, n, bucket)
+        prog = stage._jit_for(decode.cap)
+        dcols = self.ctx.device_cols(batch, bucket,
+                                     list(stage._input_cols) + ["__join_ok__"])
+        out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
+                   jnp.asarray(float(self._row_offset)))
+        self._row_offset += n
+        self._pending.append((out, decode))
+        counters.bump("device_grouped_batches")
+        counters.bump("device_join_batches")
+
+    def _join_codes(self, batch, n: int, bucket: int) -> _Decode:
+        """Group codes over fact/dim key columns: per-column dictionary codes
+        (fact: cached on the Series; dim: dim-side codes gathered on device),
+        radix-combined on device."""
+        ctx = self.ctx
+        spec = ctx.spec
+        encoded = []     # (device codes[bucket], values, K)
+        for g in self.stage.groupby:
+            node = g.child if isinstance(g, Alias) else g
+            name = node._name
+            side = spec.col_side.get(name)
+            if side == "fact":
+                s = batch.get_column(name)
+                codes, values, k = s.dict_codes()
+                encoded.append((cached_dict_code_plane(s, codes, n, bucket),
+                                values, k))
+            else:
+                dim_b = ctx.batches[side]
+                src = ctx.syn_series[side][name] if name.startswith("__syn_") \
+                    else dim_b.get_column(name)
+                codes, values, k = src.dict_codes()
+                cap_d = pad_bucket(dim_b.num_rows)
+                dplane = cached_dict_code_plane(src, codes, dim_b.num_rows, cap_d)
+                idxs = ctx.indices_for(batch)
+                padded_idx = np.full(bucket, -1, dtype=np.int32)
+                padded_idx[:n] = idxs[side]
+                gathered, _ok = _gather_col(dplane, jnp.ones(cap_d, dtype=bool),
+                                            jnp.asarray(padded_idx))
+                encoded.append((gathered.astype(jnp.int32), values, k))
+        total = 1
+        for _, _, k in encoded:
+            total *= max(k, 1)
+        if not (0 < total <= MAX_MATMUL_SEGMENTS):
+            raise DeviceFallback(
+                f"joined group-key cardinality {total} exceeds the matmul "
+                f"segment ceiling {MAX_MATMUL_SEGMENTS}")
+        cap = _pad_groups(total)
+        radices = []
+        mult = 1
+        for _, _, k in reversed(encoded):
+            radices.append(mult)
+            mult *= max(k, 1)
+        radices.reverse()
+        combined = encoded[0][0] * radices[0]
+        for (dc, _, _), r in zip(encoded[1:], radices[1:]):
+            combined = combined + dc * r
+        combined = jnp.clip(combined, 0, cap - 1)  # join-miss garbage is masked anyway
+        return _Decode(cap=cap, dcodes=combined,
+                       dicts=[(vals, k) for _, vals, k in encoded],
+                       radices=radices, key_rows=None)
+
+
+class DeviceJoinUngroupedRun(FilterAggRun):
+    def __init__(self, stage: FilterAggStage, ctx: _JoinContext):
+        super().__init__(stage)
+        self.ctx = ctx
+
+    def feed_batch(self, batch) -> None:
+        n = batch.num_rows
+        if n == 0:
+            return
+        bucket = pad_bucket(n)
+        dcols = self.ctx.device_cols(batch, bucket,
+                                     list(self.stage._input_cols) + ["__join_ok__"])
+        self._run(dcols, n, bucket)
+        counters.bump("device_join_batches")
+
+
+def build_join_stage(spec: JoinAggSpec):
+    """(stage, grouped) with __join_ok__ folded into the predicate."""
+    schema = _joined_stage_schema(spec)
+    predicate = _with_join_ok(spec.predicate)
+    if spec.groupby:
+        stage = try_build_grouped_agg_stage(schema, predicate, spec.groupby,
+                                            spec.aggregations)
+        return stage, True
+    from .stage import try_build_filter_agg_stage
+
+    stage = try_build_filter_agg_stage(schema, predicate, spec.aggregations)
+    return stage, False

@@ -69,6 +69,22 @@ def _pad_groups(g: int) -> int:
     return c
 
 
+def cached_dict_code_plane(src, codes: np.ndarray, rows: int, cap: int):
+    """Device plane of dictionary codes padded to `cap`, cached on the Series
+    (THE one implementation — grouped stages and the join stage share it, so
+    the padding-rows-are-code-0 invariant lives in one place)."""
+    cache = getattr(src, "_device_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(src, "_device_cache", cache)
+    ck = ("dictcodes", cap)
+    if ck not in cache:
+        padded = np.zeros(cap, dtype=np.int32)
+        padded[:rows] = codes
+        cache[ck] = jnp.asarray(padded)
+    return cache[ck]
+
+
 def resolve_key_series(batch, groupby, n: int):
     """Evaluate group-key expressions, resolving Alias(ColumnRef) to the
     underlying stored column so dictionary/device caches land on the
@@ -138,6 +154,13 @@ class GroupedAggStage:
         self.dict_keys = all(isinstance(g, ColumnRef) or
                              (isinstance(g, Alias) and isinstance(g.child, ColumnRef))
                              for g in groupby)
+        # float min/max must be EXACT (downstream equality joins against the
+        # aggregate — TPC-H Q2/Q15 shapes — would otherwise never match): such
+        # stages run wholly in f64, trading the f32 fast path for host parity
+        self._use_f64 = any(
+            agg.op in ("min", "max")
+            and agg.child.to_field(schema).dtype.is_floating()
+            for _n, agg in self.aggs)
         self._classify_planes()
 
     def _classify_planes(self) -> None:
@@ -166,10 +189,10 @@ class GroupedAggStage:
                     self._sct_specs.append((i, "sum"))
             elif agg.op in ("min", "max"):
                 if is_float:
-                    # float extremes ride the chunked broadcast path in f32 (the
-                    # device compute dtype; ~1e-7 relative rounding, documented)
+                    # float extremes ride the chunked broadcast path; with
+                    # _use_f64 the whole stage runs f64 so they are exact
                     slots[agg.op] = ("ext", len(self._ext_specs))
-                    self._ext_specs.append((i, agg.op, False))
+                    self._ext_specs.append((i, agg.op, self._use_f64))
                 else:
                     # int/temporal extremes must be exact over the full int64
                     # domain (f64 loses integers past 2^53) -> scatter in i64
@@ -193,12 +216,13 @@ class GroupedAggStage:
 
     def _build(self, cap: int) -> Callable:
         schema = self.schema
-        pred_fn = (dev.build_device_expr(self.predicate, schema, float_dtype=jnp.float32)
+        fdt = jnp.float64 if self._use_f64 else jnp.float32
+        pred_fn = (dev.build_device_expr(self.predicate, schema, float_dtype=fdt)
                    if self.predicate is not None else None)
         child_fns = []
         for name, agg in self.aggs:
             count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
-            child_fns.append((dev.build_device_expr(agg.child, schema, float_dtype=jnp.float32),
+            child_fns.append((dev.build_device_expr(agg.child, schema, float_dtype=fdt),
                               count_all))
 
         mm_specs, ext_specs, sct_specs = self._mm_specs, self._ext_specs, self._sct_specs
@@ -223,16 +247,17 @@ class GroupedAggStage:
                 mask = keep if count_all else dev._broadcast_valid(v, m) & keep
                 evaluated.append((v, mask))
 
-            # matmul planes (f32), chunk-reduced on the MXU with f64 combine
+            pdt = fdt
+            # matmul planes (f32; f64 in exact mode), MXU chunk-reduce, f64 combine
             planes = []
             for agg_idx, kind in mm_specs:
                 if kind == "rows":
-                    planes.append(keep.astype(jnp.float32))
+                    planes.append(keep.astype(pdt))
                 elif kind == "count":
-                    planes.append(evaluated[agg_idx][1].astype(jnp.float32))
+                    planes.append(evaluated[agg_idx][1].astype(pdt))
                 else:  # float/bool sum
                     v, mask = evaluated[agg_idx]
-                    planes.append(jnp.where(mask, v.astype(jnp.float32), 0.0))
+                    planes.append(jnp.where(mask, v.astype(pdt), 0.0))
 
             # extreme planes: masked-out rows carry the identity
             ext_planes = []
@@ -255,7 +280,7 @@ class GroupedAggStage:
                 s, v = xs[0], xs[1]
                 ext_ch = xs[2:]
                 oh = s[:, None] == jnp.arange(cap + 1, dtype=jnp.int32)[None, :]
-                acc_mm = acc_mm + (oh.astype(jnp.float32).T @ v).astype(jnp.float64)
+                acc_mm = acc_mm + (oh.astype(v.dtype).T @ v).astype(jnp.float64)
                 new_ext = []
                 for (agg_idx, op, use_f64), ev_ch, acc in zip(ext_specs, ext_ch, acc_ext):
                     dt = jnp.float64 if use_f64 else jnp.float32
@@ -319,7 +344,8 @@ class GroupedAggRun:
         bucket = pad_bucket(n)
         decode = self._codes_for(batch, n, bucket)
         prog = stage._jit_for(decode.cap)
-        dcols = {name: batch.get_column(name).to_device_cached(bucket, f32=True)
+        dcols = {name: batch.get_column(name).to_device_cached(
+                     bucket, f32=not stage._use_f64)
                  for name in stage._input_cols}
         out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
                    jnp.asarray(float(self._row_offset)))
@@ -346,18 +372,8 @@ class GroupedAggRun:
             if 0 < total <= MAX_MATMUL_SEGMENTS:
                 cap = _pad_groups(total)
                 # radix-combine per-column codes on device (codes cached per Series)
-                dcode_cols = []
-                for s, (codes, _, _) in zip(key_series, encoded):
-                    cache = getattr(s, "_device_cache", None)
-                    if cache is None:
-                        cache = {}
-                        object.__setattr__(s, "_device_cache", cache)
-                    ck = ("dictcodes", bucket)
-                    if ck not in cache:
-                        padded = np.zeros(bucket, dtype=np.int32)
-                        padded[:n] = codes
-                        cache[ck] = jnp.asarray(padded)
-                    dcode_cols.append(cache[ck])
+                dcode_cols = [cached_dict_code_plane(s, codes, n, bucket)
+                              for s, (codes, _, _) in zip(key_series, encoded)]
                 radices = []
                 mult = 1
                 for _, _, k in reversed(encoded):

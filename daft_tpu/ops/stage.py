@@ -104,6 +104,12 @@ class FilterAggStage:
         self.aggs = list(aggs)
         self._jitted: Dict[int, Callable] = {}
         self._input_cols = self._referenced_columns()
+        # float min/max must be EXACT (downstream equality joins against the
+        # aggregate — TPC-H Q15 — would otherwise never match): such stages run
+        # wholly in f64, trading the f32 fast path for bit-parity with host
+        self._use_f64 = any(
+            agg.op in ("min", "max") and agg.child.to_field(schema).dtype.is_floating()
+            for _n, agg in self.aggs)
 
     def _referenced_columns(self) -> List[str]:
         cols: List[str] = []
@@ -121,11 +127,12 @@ class FilterAggStage:
 
     def _build(self) -> Callable:
         schema = self.schema
-        pred_fn = (dev.build_device_expr(self.predicate, schema, float_dtype=jnp.float32)
+        fdt = jnp.float64 if self._use_f64 else jnp.float32
+        pred_fn = (dev.build_device_expr(self.predicate, schema, float_dtype=fdt)
                    if self.predicate is not None else None)
         agg_specs = []
         for name, agg in self.aggs:
-            child_fn = dev.build_device_expr(agg.child, schema, float_dtype=jnp.float32)
+            child_fn = dev.build_device_expr(agg.child, schema, float_dtype=fdt)
             count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
             agg_specs.append((name, agg.op, count_all, child_fn))
 
@@ -180,7 +187,7 @@ class FilterAggRun:
         dcols = {}
         for name in self.stage._input_cols:
             vals, valid = columns[name]
-            if vals.dtype == np.float64:
+            if vals.dtype == np.float64 and not self.stage._use_f64:
                 vals = vals.astype(np.float32)
             if len(vals) < bucket:
                 pad = bucket - len(vals)
@@ -193,7 +200,8 @@ class FilterAggRun:
         """Feed a host RecordBatch (referenced columns go to device, cached)."""
         n = batch.num_rows
         bucket = pad_bucket(n)
-        dcols = {name: batch.get_column(name).to_device_cached(bucket, f32=True)
+        f32 = not self.stage._use_f64
+        dcols = {name: batch.get_column(name).to_device_cached(bucket, f32=f32)
                  for name in self.stage._input_cols}
         self._run(dcols, n, bucket)
 

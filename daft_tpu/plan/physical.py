@@ -173,6 +173,30 @@ class DeviceFilterAgg(_Unary):
         self.aggregations = aggregations
 
 
+class DeviceJoinAgg(PhysicalPlan):
+    """Star-schema join + aggregate fused for the device (ops/device_join.py):
+    the fact side streams; each dim materializes once per run and joins as a
+    device gather through static per-row indices; the aggregation rides the
+    MXU segment-reduction stages. `host_plan` is the untouched translation of
+    the same logical subtree — the executor's fallback (config off, runtime
+    DeviceFallback, or cost model says host)."""
+
+    def __init__(self, fact: PhysicalPlan, dim_plans, spec, host_plan: PhysicalPlan,
+                 schema: Schema):
+        super().__init__()
+        self.fact = fact
+        self.dim_plans = dim_plans  # [(name, PhysicalPlan)] base dims, parents first
+        self.spec = spec            # ops.device_join.JoinAggSpec
+        self.host_plan = host_plan
+        self.schema = schema
+
+    def children(self):
+        return [self.fact] + [p for _n, p in self.dim_plans]
+
+    def name(self) -> str:
+        return f"DeviceJoinAgg({len(self.dim_plans)} dims)"
+
+
 class DeviceGroupedAgg(_Unary):
     """Fused (optional filter)+grouped-agg stage eligible for the JAX device.
 
@@ -313,6 +337,15 @@ class ShuffleRead(PhysicalPlan):
 # ======================================================================================
 
 
+def _translate_agg_host(plan, config) -> PhysicalPlan:
+    """Translate an Aggregate subtree with plain host operators (the fallback
+    plan carried by DeviceJoinAgg)."""
+    child = translate(plan.input, config)
+    if plan.groupby:
+        return HashAggregate(child, plan.groupby, plan.aggregations, plan.schema)
+    return UngroupedAggregate(child, plan.aggregations, plan.schema)
+
+
 def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
     """Lower an (optimized) logical plan to a physical plan."""
     if isinstance(plan, lp.InMemorySource):
@@ -370,12 +403,25 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
 
     if isinstance(plan, lp.Aggregate):
         # Device-stage fusion: Aggregate(+optional Filter) whose expressions are
-        # device-evaluable lowers to a fused Device*Agg node; the executor picks
-        # device vs host at runtime. An absorbed filter stays in the fused node.
+        # device-evaluable lowers to a fused Device*Agg node — and when the
+        # input is a star-shaped inner-join tree, to a DeviceJoinAgg gather
+        # program; the executor picks device vs host at runtime.
         from ..config import execution_config
 
         cfg = config or execution_config()
         if getattr(cfg, "device_mode", "off") != "off":
+            from ..ops.device_join import try_capture_join_agg
+
+            try:
+                jspec = try_capture_join_agg(plan)
+            except Exception:
+                jspec = None  # capture must never break planning
+            if jspec is not None:
+                host = _translate_agg_host(plan, config)
+                return DeviceJoinAgg(
+                    translate(jspec.fact, config),
+                    [(d.name, translate(d.base, config)) for d in jspec.dims],
+                    jspec, host, plan.schema)
             src = plan.input
             predicate = None
             if isinstance(src, lp.Filter):
