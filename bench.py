@@ -3,15 +3,21 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 Metric = engine rows/sec through the full path (plan → optimize → translate →
 execute) over the BENCH_QUERIES subset (default: the 9 scan/join/agg-heavy
-queries 1,3,4,5,6,10,12,14,19 — the shape of the reference's Q1-Q10 benchmark;
-set BENCH_QUERIES=1,...,22 for the full suite): total lineitem rows touched per
-query run divided by total wall-clock. Baseline anchor: reference NativeRunner
-TPC-H throughput on server CPU (BASELINE.md §6), scaled to one chip.
+queries 1,3,4,5,6,10,12,14,19 — the shape of the reference's Q1-Q10 benchmark):
+total lineitem rows touched per query run divided by total wall-clock. Baseline
+anchor: reference NativeRunner TPC-H throughput on server CPU (BASELINE.md §6),
+scaled to one chip.
 
-The run reports which engine paths actually executed: device_grouped_batches /
-device_stage_batches count real XLA dispatches of the TPU agg stages
-(ops/counters.py), so a number produced entirely on host CPU is visible as
-device_batches == 0.
+Environment knobs:
+    BENCH_SF=10           scale factor (default 1; SF10 ~60M lineitem rows)
+    BENCH_QUERIES=1,..,22 query subset (default the 9-query headline set)
+    BENCH_REPS=2          timed repetitions (best-of; tunnel jitter guard)
+
+The run reports which engine paths actually executed: device_batches counts
+real XLA dispatches of the TPU agg/join stages (ops/counters.py), so a number
+produced entirely on host CPU is visible as device_batches == 0. The JSON also
+carries a per-query millisecond breakdown (best-of-reps) — the driver's
+one-line contract is preserved; the extra keys ride along.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SF = float(os.environ.get("BENCH_SF", 1.0))
 BASELINE_ROWS_PER_SEC = 50e6
 QUERIES = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,4,5,6,10,12,14,19").split(",")]
+REPS = int(os.environ.get("BENCH_REPS", 2))
 
 
 def main() -> None:
@@ -42,13 +49,16 @@ def main() -> None:
         ALL_QUERIES[q](tables).to_pydict()
 
     counters.reset()
-    # best of 2 timed repetitions: the tunneled device's d2h round trip
+    # best-of-N timed repetitions: the tunneled device's d2h round trip
     # occasionally spikes 5-10x, which is link jitter, not engine throughput
+    per_query = {q: float("inf") for q in QUERIES}
     elapsed = float("inf")
-    for _ in range(2):
+    for _ in range(REPS):
         t0 = time.perf_counter()
         for q in QUERIES:
+            tq = time.perf_counter()
             ALL_QUERIES[q](tables).to_pydict()
+            per_query[q] = min(per_query[q], time.perf_counter() - tq)
         elapsed = min(elapsed, time.perf_counter() - t0)
 
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
@@ -57,7 +67,12 @@ def main() -> None:
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
-        "device_batches": counters.device_grouped_batches + counters.device_stage_batches,
+        "device_batches": (counters.device_grouped_batches
+                           + counters.device_stage_batches
+                           + counters.device_join_batches),
+        "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
+        "sf": SF,
+        "lineitem_rows": n_lineitem,
     }))
 
 

@@ -394,6 +394,18 @@ class Expression:
     def url(self) -> "UrlNamespace":
         return UrlNamespace(self)
 
+    @property
+    def binary(self) -> "BinaryNamespace":
+        return BinaryNamespace(self)
+
+    @property
+    def map(self) -> "MapNamespace":
+        return MapNamespace(self)
+
+    @property
+    def json(self) -> "JsonNamespace":
+        return JsonNamespace(self)
+
 
 class ColumnRef(Expression):
     def __init__(self, name: str):
@@ -809,6 +821,21 @@ class StringNamespace(_Namespace):
     def upper(self):
         return self._e._fn("utf8_upper")
 
+    def title(self):
+        return self._e._fn("utf8_title")
+
+    def levenshtein(self, other):
+        return self._e._fn("levenshtein", other)
+
+    def jaccard_similarity(self, other, ngram: int = 2):
+        return self._e._fn("jaccard_similarity", other, ngram=ngram)
+
+    def md5(self):
+        return self._e._fn("md5")
+
+    def sha256(self):
+        return self._e._fn("sha256")
+
     def lower(self):
         return self._e._fn("utf8_lower")
 
@@ -913,6 +940,15 @@ class StringNamespace(_Namespace):
 
 
 class TemporalNamespace(_Namespace):
+    def quarter(self):
+        return self._e._fn("dt_quarter")
+
+    def is_leap_year(self):
+        return self._e._fn("dt_is_leap_year")
+
+    def days_in_month(self):
+        return self._e._fn("dt_days_in_month")
+
     def year(self):
         return self._e._fn("dt_year")
 
@@ -1180,3 +1216,45 @@ def _common_supertype(a: DataType, b: DataType) -> DataType:
     if a.is_string() and b.is_string():
         return a
     raise ValueError(f"no common supertype for {a} and {b}")
+
+
+class BinaryNamespace(_Namespace):
+    """Binary-column kernels (reference: daft-functions-binary)."""
+
+    def length(self):
+        return self._e._fn("binary_length")
+
+    def concat(self, other):
+        return self._e._fn("binary_concat", other)
+
+    def slice(self, start: int, length=None):
+        kw = {"start": start}
+        if length is not None:
+            kw["length"] = length
+        return self._e._fn("binary_slice", **kw)
+
+    def encode_hex(self):
+        return self._e._fn("encode_hex")
+
+    def decode_hex(self):
+        return self._e._fn("decode_hex")
+
+    def encode_base64(self):
+        return self._e._fn("encode_base64")
+
+    def decode_base64(self):
+        return self._e._fn("decode_base64")
+
+
+class MapNamespace(_Namespace):
+    """Map-column kernels (reference: daft-functions map_get)."""
+
+    def get(self, key):
+        return self._e._fn("map_get", key=key)
+
+
+class JsonNamespace(_Namespace):
+    """JSON string kernels (reference: daft-functions-json jsonpath query)."""
+
+    def query(self, path: str):
+        return self._e._fn("json_query", path=path)

@@ -1122,3 +1122,7 @@ def _url_upload(args, kwargs):
 
 
 register("url_upload", _rt_const(DataType.string()), _url_upload)
+
+
+# breadth modules register on import (binary/crypto/bitwise/json/map/...)
+from . import extra  # noqa: E402,F401  (registration side effects)

@@ -27,6 +27,40 @@ class S3Config:
 
 
 @dataclass(frozen=True)
+class GCSConfig:
+    """Google Cloud Storage over the JSON API (reference: io-config GCSConfig +
+    src/daft-io/src/google_cloud.rs). Auth: bearer token (env
+    GCS_TOKEN / GOOGLE_CLOUD_TOKEN) or anonymous; endpoint override targets
+    fake-gcs-server-style mocks."""
+
+    endpoint_url: Optional[str] = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_GCS_ENDPOINT") or None)
+    token: Optional[str] = field(
+        default_factory=lambda: os.environ.get("GCS_TOKEN")
+        or os.environ.get("GOOGLE_CLOUD_TOKEN") or None)
+    anonymous: bool = False
+    max_retries: int = 4
+    retry_initial_backoff_ms: int = 100
+
+
+@dataclass(frozen=True)
+class AzureConfig:
+    """Azure Blob Storage REST (reference: io-config AzureConfig +
+    src/daft-io/src/azure_blob.rs). Auth: SAS token or anonymous (shared-key
+    signing is not implemented — use SAS); endpoint override targets Azurite."""
+
+    storage_account: Optional[str] = field(
+        default_factory=lambda: os.environ.get("AZURE_STORAGE_ACCOUNT") or None)
+    sas_token: Optional[str] = field(
+        default_factory=lambda: os.environ.get("AZURE_STORAGE_SAS_TOKEN") or None)
+    endpoint_url: Optional[str] = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_AZURE_ENDPOINT") or None)
+    anonymous: bool = False
+    max_retries: int = 4
+    retry_initial_backoff_ms: int = 100
+
+
+@dataclass(frozen=True)
 class HTTPConfig:
     max_retries: int = 4
     retry_initial_backoff_ms: int = 100
@@ -36,6 +70,8 @@ class HTTPConfig:
 @dataclass(frozen=True)
 class IOConfig:
     s3: S3Config = field(default_factory=S3Config)
+    gcs: GCSConfig = field(default_factory=GCSConfig)
+    azure: AzureConfig = field(default_factory=AzureConfig)
     http: HTTPConfig = field(default_factory=HTTPConfig)
 
 

@@ -179,6 +179,30 @@ class HTTPSource(ObjectSource):
         raise ObjectSourceError("HTTP source does not support globs")
 
 
+
+
+def _split_bucket(path: str) -> Tuple[str, str]:
+    """"bucket/key" -> (bucket, key) — shared by the bucketed backends."""
+    parts = path.split("/", 1)
+    return parts[0], parts[1] if len(parts) > 1 else ""
+
+
+def _prefix_glob(ls, pattern: str) -> List[str]:
+    """List the longest literal prefix, filter client-side (reference:
+    object_store_glob.rs prefix optimization). `*`/`?` do NOT cross `/`,
+    `**` does. Shared by every bucketed backend so glob semantics cannot
+    diverge."""
+    cut = len(pattern)
+    for i, ch in enumerate(pattern):
+        if ch in "*?[":
+            cut = i
+            break
+    prefix = pattern[:cut]
+    prefix = prefix[: prefix.rfind("/") + 1] if "/" in prefix else prefix
+    rx = _glob_to_regex(pattern)
+    return sorted(p for p in ls(prefix) if rx.match(p))
+
+
 # ---------------------------------------------------------------------------
 # S3 (SigV4 over stdlib urllib; path-style endpoints; ListObjectsV2 glob)
 # ---------------------------------------------------------------------------
@@ -247,10 +271,7 @@ class S3Source(ObjectSource):
         url = self.endpoint + uri + (f"?{query}" if query else "")
         return url, host, uri
 
-    @staticmethod
-    def split(path: str) -> Tuple[str, str]:
-        parts = path.split("/", 1)
-        return parts[0], parts[1] if len(parts) > 1 else ""
+    split = staticmethod(_split_bucket)
 
     def get(self, path: str, range: Optional[Tuple[int, int]] = None) -> bytes:
         bucket, key = self.split(path)
@@ -334,18 +355,7 @@ class S3Source(ObjectSource):
         return out
 
     def glob(self, pattern: str) -> List[str]:
-        """List the longest literal prefix, filter client-side (reference:
-        object_store_glob.rs prefix optimization). Matching follows filesystem
-        glob semantics: `*`/`?` do NOT cross `/`, `**` does."""
-        cut = len(pattern)
-        for i, ch in enumerate(pattern):
-            if ch in "*?[":
-                cut = i
-                break
-        prefix = pattern[:cut]
-        prefix = prefix[: prefix.rfind("/") + 1] if "/" in prefix else prefix
-        rx = _glob_to_regex(pattern)
-        return sorted(p for p in self.ls(prefix) if rx.match(p))
+        return _prefix_glob(self.ls, pattern)
 
 
 def _glob_to_regex(pattern: str):
@@ -429,6 +439,160 @@ class MockSource(ObjectSource):
         return self.inner.delete(path)
 
 
+
+
+# ---------------------------------------------------------------------------
+# Google Cloud Storage (JSON API over stdlib urllib)
+# ---------------------------------------------------------------------------
+
+
+class GCSSource(ObjectSource):
+    """GCS over the JSON API (reference: src/daft-io/src/google_cloud.rs).
+    Paths are "bucket/key". Download = objects.get?alt=media; listing =
+    objects.list with prefix + page tokens. Works against fake-gcs-server
+    mocks via GCSConfig.endpoint_url."""
+
+    def __init__(self, config: Optional[IOConfig] = None):
+        self.cfg = (config or io_config()).gcs
+        self.endpoint = (self.cfg.endpoint_url or
+                         "https://storage.googleapis.com").rstrip("/")
+
+    def _do(self, fn):
+        return with_retries(fn, self.cfg.max_retries, self.cfg.retry_initial_backoff_ms)
+
+    def _headers(self, range: Optional[Tuple[int, int]] = None) -> dict:
+        h = {}
+        if self.cfg.token and not self.cfg.anonymous:
+            h["Authorization"] = f"Bearer {self.cfg.token}"
+        if range is not None:
+            h["Range"] = f"bytes={range[0]}-{range[1] - 1}"
+        return h
+
+    split = staticmethod(_split_bucket)
+
+    def _obj_url(self, bucket: str, key: str, query: str = "") -> str:
+        return (f"{self.endpoint}/storage/v1/b/{urllib.parse.quote(bucket)}"
+                f"/o/{urllib.parse.quote(key, safe='')}" + (f"?{query}" if query else ""))
+
+    def get(self, path: str, range: Optional[Tuple[int, int]] = None) -> bytes:
+        bucket, key = self.split(path)
+        url = self._obj_url(bucket, key, "alt=media")
+        _s, _h, body = self._do(lambda: _http_request(url, headers=self._headers(range)))
+        return body
+
+    def get_size(self, path: str) -> int:
+        import json as _json
+
+        bucket, key = self.split(path)
+        url = self._obj_url(bucket, key)
+        _s, _h, body = self._do(lambda: _http_request(url, headers=self._headers()))
+        return int(_json.loads(body)["size"])
+
+    def ls(self, prefix: str) -> List[str]:
+        import json as _json
+
+        bucket, key_prefix = self.split(prefix)
+        out: List[str] = []
+        token: Optional[str] = None
+        while True:
+            q = {"prefix": key_prefix, "maxResults": "1000"}
+            if token:
+                q["pageToken"] = token
+            query = urllib.parse.urlencode(q)
+            url = (f"{self.endpoint}/storage/v1/b/{urllib.parse.quote(bucket)}/o"
+                   f"?{query}")
+            _s, _h, body = self._do(lambda u=url: _http_request(u, headers=self._headers()))
+            doc = _json.loads(body)
+            for item in doc.get("items", []):
+                out.append(f"{bucket}/{item['name']}")
+            token = doc.get("nextPageToken")
+            if not token:
+                return sorted(out)
+
+    def glob(self, pattern: str) -> List[str]:
+        return _prefix_glob(self.ls, pattern)
+
+
+# ---------------------------------------------------------------------------
+# Azure Blob Storage (REST; SAS or anonymous auth)
+# ---------------------------------------------------------------------------
+
+
+class AzureBlobSource(ObjectSource):
+    """Azure Blob over REST (reference: src/daft-io/src/azure_blob.rs). Paths
+    are "container/blob". Auth: SAS token appended to every URL, or anonymous
+    (public containers / Azurite). Listing = List Blobs XML with prefix."""
+
+    def __init__(self, config: Optional[IOConfig] = None,
+                 account: Optional[str] = None):
+        self.cfg = (config or io_config()).azure
+        account = account or self.cfg.storage_account
+        if self.cfg.endpoint_url:
+            self.endpoint = self.cfg.endpoint_url.rstrip("/")
+        elif account:
+            self.endpoint = f"https://{account}.blob.core.windows.net"
+        else:
+            raise ObjectSourceError(
+                "azure: set AZURE_STORAGE_ACCOUNT or AzureConfig.endpoint_url")
+
+    def _do(self, fn):
+        return with_retries(fn, self.cfg.max_retries, self.cfg.retry_initial_backoff_ms)
+
+    def _with_sas(self, url: str) -> str:
+        sas = (self.cfg.sas_token or "").lstrip("?")
+        if not sas or self.cfg.anonymous:
+            return url
+        return url + ("&" if "?" in url else "?") + sas
+
+    @staticmethod
+    def split(path: str) -> Tuple[str, str]:
+        parts = path.split("/", 1)
+        return parts[0], parts[1] if len(parts) > 1 else ""
+
+    def get(self, path: str, range: Optional[Tuple[int, int]] = None) -> bytes:
+        container, blob = self.split(path)
+        url = self._with_sas(f"{self.endpoint}/{container}/{urllib.parse.quote(blob)}")
+        headers = {"x-ms-version": "2021-08-06"}
+        if range is not None:
+            headers["Range"] = f"bytes={range[0]}-{range[1] - 1}"
+        _s, _h, body = self._do(lambda: _http_request(url, headers=headers))
+        return body
+
+    def get_size(self, path: str) -> int:
+        container, blob = self.split(path)
+        url = self._with_sas(f"{self.endpoint}/{container}/{urllib.parse.quote(blob)}")
+        _s, h, _b = self._do(lambda: _http_request(
+            url, method="HEAD", headers={"x-ms-version": "2021-08-06"}))
+        cl = h.get("Content-Length")
+        if cl is None:
+            raise ObjectSourceError(f"{path}: no Content-Length")
+        return int(cl)
+
+    def ls(self, prefix: str) -> List[str]:
+        container, blob_prefix = self.split(prefix)
+        out: List[str] = []
+        marker = ""
+        while True:
+            q = {"restype": "container", "comp": "list", "prefix": blob_prefix}
+            if marker:
+                q["marker"] = marker
+            url = self._with_sas(
+                f"{self.endpoint}/{container}?{urllib.parse.urlencode(q)}")
+            _s, _h, body = self._do(lambda u=url: _http_request(
+                u, headers={"x-ms-version": "2021-08-06"}))
+            root = ET.fromstring(body)
+            for name in root.iter("Name"):
+                if name.text:
+                    out.append(f"{container}/{name.text}")
+            nm = root.find("NextMarker")
+            marker = nm.text if nm is not None and nm.text else ""
+            if not marker:
+                return sorted(out)
+
+    def glob(self, pattern: str) -> List[str]:
+        return _prefix_glob(self.ls, pattern)
+
+
 # ---------------------------------------------------------------------------
 # resolution
 # ---------------------------------------------------------------------------
@@ -440,6 +604,37 @@ def resolve_source(path: str, config: Optional[IOConfig] = None
     if path.startswith("s3://") or path.startswith("s3a://"):
         rest = path.split("://", 1)[1]
         return S3Source(config), rest
+    if path.startswith("gs://") or path.startswith("gcs://"):
+        return GCSSource(config), path.split("://", 1)[1]
+    if path.startswith("az://"):
+        return AzureBlobSource(config), path.split("://", 1)[1]
+    if path.startswith(("abfs://", "abfss://")):
+        # abfs(s)://container@account.dfs.core.windows.net/path
+        rest = path.split("://", 1)[1]
+        authority, _, blob_path = rest.partition("/")
+        if "@" in authority:
+            container, host = authority.split("@", 1)
+            account = host.split(".", 1)[0]
+            return (AzureBlobSource(config, account=account),
+                    f"{container}/{blob_path}")
+        return AzureBlobSource(config), rest
+    if path.startswith("hf://"):
+        # HuggingFace Hub: hf://datasets/{repo}/{path} resolves to the public
+        # CDN URL (reference: src/daft-io/src/huggingface.rs path mapping)
+        rest = path[len("hf://"):]
+        parts = rest.split("/")
+        if parts and parts[0] in ("datasets", "spaces", "models"):
+            kind = parts[0]
+            repo = "/".join(parts[1:3])
+            file_path = "/".join(parts[3:])
+        else:
+            kind, repo, file_path = "models", "/".join(parts[:2]), "/".join(parts[2:])
+        if any(ch in rest for ch in "*?["):
+            raise ObjectSourceError(
+                "hf:// paths do not support globs; name the file explicitly")
+        base = os.environ.get("DAFT_TPU_HF_ENDPOINT", "https://huggingface.co")
+        prefix = "" if kind == "models" else f"{kind}/"
+        return HTTPSource(config), f"{base}/{prefix}{repo}/resolve/main/{file_path}"
     if path.startswith("http://") or path.startswith("https://"):
         return HTTPSource(config), path
     if path.startswith("file://"):
@@ -448,7 +643,8 @@ def resolve_source(path: str, config: Optional[IOConfig] = None
 
 
 def is_remote(path: str) -> bool:
-    return path.startswith(("s3://", "s3a://", "http://", "https://"))
+    return path.startswith(("s3://", "s3a://", "gs://", "gcs://", "az://",
+                            "abfs://", "abfss://", "hf://", "http://", "https://"))
 
 
 def expand_remote(path: str, config: Optional[IOConfig] = None,

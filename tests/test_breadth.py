@@ -154,3 +154,75 @@ def test_range_between_nulls_first():
     by_t = dict(zip(out["t"], out["s"]))
     assert by_t[None] == 10.0  # null key frames over its peer group
     assert by_t[1] == 1.0 and by_t[2] == 2.0 and by_t[3] == 2.0 and by_t[4] == 2.0
+
+
+def test_function_breadth_binary_crypto_bitwise():
+    """daft-functions-binary / hash / bitwise parity (registry extra module)."""
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    df = dt.from_pydict({"s": ["hello", None], "b": [b"\x01\xff", b""],
+                         "x": [12, 10], "y": [10, 3]})
+    out = df.select(
+        col("b").binary.length().alias("bl"),
+        col("b").binary.encode_hex().alias("hx"),
+        col("s").binary.encode_base64().alias("b64"),
+        col("s").str.md5().alias("md5"),
+        col("s").str.sha256().alias("sha"),
+        col("x")._fn("bitwise_and", col("y")).alias("ba"),
+        col("x")._fn("bitwise_or", col("y")).alias("bo"),
+        col("x")._fn("bitwise_xor", col("y")).alias("bx"),
+        col("x")._fn("shift_left", 2).alias("sl"),
+    ).to_pydict()
+    assert out["bl"] == [2, 0]
+    assert out["hx"] == ["01ff", ""]
+    assert out["b64"] == ["aGVsbG8=", None]
+    assert out["md5"][0] == "5d41402abc4b2a76b9719d911017c592"
+    assert len(out["sha"][0]) == 64 and out["sha"][1] is None
+    assert out["ba"] == [8, 2] and out["bo"] == [14, 11] and out["bx"] == [6, 9]
+    assert out["sl"] == [48, 40]
+    # hex/base64 roundtrip
+    rt = df.select(col("b").binary.encode_hex().binary.decode_hex().alias("r")).to_pydict()
+    assert rt["r"] == [b"\x01\xff", b""]
+
+
+def test_function_breadth_json_map_temporal_strings():
+    import datetime
+
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    df = dt.from_pydict({
+        "j": ['{"a": {"b": [10, 20]}}', '{"a": {}}', None],
+        "d": [datetime.date(2024, 2, 5), datetime.date(2023, 7, 1), None],
+        "s": ["kitten", "saturday", None],
+    })
+    out = df.select(
+        col("j").json.query("$.a.b[1]").alias("jq"),
+        col("d").dt.quarter().alias("q"),
+        col("d").dt.is_leap_year().alias("ly"),
+        col("d").dt.days_in_month().alias("dim"),
+        col("s").str.title().alias("t"),
+        col("s").str.levenshtein("sitting").alias("lev"),
+        col("s").str.jaccard_similarity("saturday").alias("jac"),
+    ).to_pydict()
+    assert out["jq"] == ["20", None, None]
+    assert out["q"] == [1, 3, None]
+    assert out["ly"] == [True, False, None]
+    assert out["dim"] == [29, 31, None]
+    assert out["t"] == ["Kitten", "Saturday", None]
+    assert out["lev"] == [3, 6, None]
+    assert out["jac"][1] == 1.0 and out["jac"][2] is None
+
+
+def test_function_breadth_coalesce_and_to_json():
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    df = dt.from_pydict({"a": [None, 2, None], "b": [10, 20, None], "c": [1, 1, 1]})
+    out = df.select(
+        col("a")._fn("coalesce", col("b"), col("c")).alias("co"),
+        col("a")._fn("to_json").alias("tj"),
+    ).to_pydict()
+    assert out["co"] == [10, 2, 1]
+    assert out["tj"] == [None, "2", None]
