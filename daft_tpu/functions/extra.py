@@ -18,16 +18,15 @@ import hashlib
 import json as _json
 from typing import List
 
-import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..core.series import Series, _combine
 from ..datatype import DataType
-from .registry import (_binary_arrow, _pc1, _rt_const, _rt_same, register)
+from .registry import (_binary_arrow, _dt1, _rt_const, _rt_same, register)
 
 
-def _value_map(fn, out_dtype: DataType, out_pa_type=None):
+def _value_map(fn, out_dtype: DataType):
     """Lift a per-value python function (None-safe) to a host kernel."""
 
     def host(args: List[Series], kwargs) -> Series:
@@ -43,7 +42,7 @@ def _value_map(fn, out_dtype: DataType, out_pa_type=None):
 # ===================================================================================
 
 register("binary_length", _rt_const(DataType.uint64()),
-         _pc1(pc.binary_length, out_dt=DataType.uint64()))
+         _dt1(pc.binary_length, DataType.uint64()))
 register("binary_concat", _rt_same,
          _binary_arrow(lambda a, b: pc.binary_join_element_wise(a, b, b"")))
 
@@ -96,7 +95,12 @@ for _algo in ("md5", "sha1", "sha256", "sha512"):
 register("bitwise_and", _rt_same, _binary_arrow(pc.bit_wise_and))
 register("bitwise_or", _rt_same, _binary_arrow(pc.bit_wise_or))
 register("bitwise_xor", _rt_same, _binary_arrow(pc.bit_wise_xor))
-register("bitwise_not", _rt_same, _pc1(pc.bit_wise_not))
+def _bitwise_not(args, kwargs):
+    s0 = args[0]
+    return Series(s0.name, s0.dtype, _combine(pc.bit_wise_not(s0.to_arrow())))
+
+
+register("bitwise_not", _rt_same, _bitwise_not)
 register("shift_left", _rt_same, _binary_arrow(pc.shift_left))
 register("shift_right", _rt_same, _binary_arrow(pc.shift_right))
 
@@ -106,9 +110,9 @@ register("shift_right", _rt_same, _binary_arrow(pc.shift_right))
 # ===================================================================================
 
 register("dt_quarter", _rt_const(DataType.uint32()),
-         _pc1(pc.quarter, out_dt=DataType.uint32()))
+         _dt1(pc.quarter, DataType.uint32()))
 register("dt_is_leap_year", _rt_const(DataType.bool()),
-         _pc1(pc.is_leap_year, out_dt=DataType.bool()))
+         _dt1(pc.is_leap_year, DataType.bool()))
 
 
 def _dt_days_in_month(args, kwargs):
@@ -189,6 +193,16 @@ register("to_json", _rt_const(DataType.string()), _to_json)
 # map (reference: daft-functions-map map_get)
 # ===================================================================================
 
+def _map_value_dtype(dt: DataType, key) -> DataType:
+    if dt.kind == "map":
+        return dt.params[1]  # (key, value) dtypes
+    if dt.kind == "struct":
+        for name, fdt in dt.struct_fields:
+            if name == key:
+                return fdt
+    return DataType.string()
+
+
 def _map_get(args, kwargs):
     s = args[0]
     key = kwargs["key"]
@@ -200,18 +214,13 @@ def _map_get(args, kwargs):
             out.append(v.get(key))
         else:  # arrow maps decode as [(k, val), ...]
             out.append(next((val for k, val in v if k == key), None))
-    return Series.from_pylist(out, s.name)
+    # dtype from the input type, NOT value inference: an all-missing morsel
+    # must still produce the planned dtype so per-morsel results concat
+    return Series.from_pylist(out, s.name, dtype=_map_value_dtype(s.dtype, key))
 
 
 def _rt_map_value(fields, kwargs):
-    dt = fields[0].dtype
-    if dt.kind == "map":
-        return dt.params[1]  # (key, value) dtypes
-    if dt.kind == "struct":
-        for name, fdt in dt.struct_fields:
-            if name == kwargs.get("key"):
-                return fdt
-    return DataType.string()
+    return _map_value_dtype(fields[0].dtype, kwargs.get("key"))
 
 
 register("map_get", _rt_map_value, _map_get)

@@ -535,6 +535,8 @@ class AzureBlobSource(ObjectSource):
             raise ObjectSourceError(
                 "azure: set AZURE_STORAGE_ACCOUNT or AzureConfig.endpoint_url")
 
+    split = staticmethod(_split_bucket)
+
     def _do(self, fn):
         return with_retries(fn, self.cfg.max_retries, self.cfg.retry_initial_backoff_ms)
 
@@ -543,11 +545,6 @@ class AzureBlobSource(ObjectSource):
         if not sas or self.cfg.anonymous:
             return url
         return url + ("&" if "?" in url else "?") + sas
-
-    @staticmethod
-    def split(path: str) -> Tuple[str, str]:
-        parts = path.split("/", 1)
-        return parts[0], parts[1] if len(parts) > 1 else ""
 
     def get(self, path: str, range: Optional[Tuple[int, int]] = None) -> bytes:
         container, blob = self.split(path)
@@ -634,7 +631,8 @@ def resolve_source(path: str, config: Optional[IOConfig] = None
                 "hf:// paths do not support globs; name the file explicitly")
         base = os.environ.get("DAFT_TPU_HF_ENDPOINT", "https://huggingface.co")
         prefix = "" if kind == "models" else f"{kind}/"
-        return HTTPSource(config), f"{base}/{prefix}{repo}/resolve/main/{file_path}"
+        quoted = "/".join(urllib.parse.quote(seg) for seg in file_path.split("/"))
+        return HTTPSource(config), f"{base}/{prefix}{repo}/resolve/main/{quoted}"
     if path.startswith("http://") or path.startswith("https://"):
         return HTTPSource(config), path
     if path.startswith("file://"):

@@ -21,22 +21,51 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 
 _HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
 <style>
-body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+body{font-family:monospace;margin:1.5em;background:#111;color:#ddd}
 table{border-collapse:collapse;width:100%%}
-td,th{border:1px solid #333;padding:4px 8px;text-align:left}
-th{background:#222}.ok{color:#7c7}.err{color:#e77}
+td,th{border:1px solid #333;padding:4px 8px;text-align:left;vertical-align:top}
+th{background:#222}.ok{color:#7c7}.err{color:#e77}.run{color:#cc7}
+tr.q{cursor:pointer} tr.q:hover{background:#1a1a2a}
+pre{background:#181820;padding:8px;overflow-x:auto;border:1px solid #333}
+.bar{background:#357;display:inline-block;height:10px;vertical-align:middle}
+#detail{margin-top:1em} .counters span{margin-right:2em;color:#9cf}
+h2,h3{color:#eee}
 </style></head><body>
-<h2>daft_tpu — recent queries</h2><div id="t"></div>
+<h2>daft_tpu — live queries</h2>
+<div class="counters" id="eng"></div>
+<div id="t"></div><div id="detail"></div>
 <script>
+let selected = null;
+function esc(x){ return String(x ?? '').replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;'); }
 async function refresh(){
-  const qs = await (await fetch('/api/queries')).json();
-  let h = '<table><tr><th>id</th><th>status</th><th>rows</th><th>seconds</th><th>top operators (rows / self ms)</th></tr>';
+  const [qs, eng] = await Promise.all([
+    (await fetch('/api/queries')).json(), (await fetch('/api/engine')).json()]);
+  document.getElementById('eng').innerHTML =
+    Object.entries(eng).map(([k,v])=>`<span>${k}: ${v}</span>`).join('');
+  let h = '<table><tr><th>id</th><th>status</th><th>rows</th><th>seconds</th><th>top operators</th></tr>';
   for (const q of qs){
-    const ops = (q.operators||[]).slice(0,4).map(o=>`${o.name}: ${o.rows_out} / ${(o.seconds*1000).toFixed(1)}ms`).join('<br>');
-    h += `<tr><td>${q.query_id}</td><td class="${q.error?'err':'ok'}">${q.error||(q.done?'done':'running')}</td>`+
+    const ops = (q.operators||[]).slice(0,3).map(o=>`${esc(o.name)}: ${o.rows_out}r / ${(o.seconds*1000).toFixed(1)}ms`).join('<br>');
+    const st = q.error ? 'err' : (q.done ? 'ok' : 'run');
+    h += `<tr class="q" onclick="show('${esc(q.query_id)}')"><td>${esc(q.query_id)}</td>`+
+         `<td class="${st}">${esc(q.error)||(q.done?'done':'running')}</td>`+
          `<td>${q.rows??''}</td><td>${q.seconds?.toFixed?.(3)??''}</td><td>${ops}</td></tr>`;
   }
   document.getElementById('t').innerHTML = h + '</table>';
+  if (selected) show(selected, true);
+}
+async function show(id, silent){
+  selected = id;
+  const q = await (await fetch('/api/query/'+id)).json();
+  if (q.error_404){ if(!silent) document.getElementById('detail').innerHTML=''; return; }
+  const maxs = Math.max(1e-9, ...(q.operators||[]).map(o=>o.seconds));
+  const rows = (q.operators||[]).map(o=>
+    `<tr><td>${esc(o.name)}</td><td>${o.rows_out}</td><td>${o.batches}</td>`+
+    `<td>${(o.seconds*1000).toFixed(1)}ms <span class="bar" style="width:${(120*o.seconds/maxs)|0}px"></span></td></tr>`).join('');
+  document.getElementById('detail').innerHTML =
+    `<h3>query ${esc(id)}</h3>`+
+    `<table><tr><th>operator</th><th>rows out</th><th>batches</th><th>self time</th></tr>${rows}</table>`+
+    `<h3>physical plan (execution DAG)</h3><pre>${esc(q.physical_plan)||'(pending)'}</pre>`+
+    `<h3>logical plan</h3><pre>${esc(q.plan)}</pre>`;
 }
 refresh(); setInterval(refresh, 1000);
 </script></body></html>"""
@@ -84,6 +113,11 @@ class DashboardState(Subscriber):
         with self._lock:
             return [dict(r) for r in self._queries]
 
+    def query(self, query_id: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._by_id.get(query_id)
+            return dict(rec) if rec is not None else None
+
 
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
@@ -92,6 +126,23 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path.startswith("/api/queries"):
             body = json.dumps(self.server.state.snapshot(), default=str).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/query/"):
+            qid = self.path.rsplit("/", 1)[1]
+            rec = self.server.state.query(qid)
+            body = json.dumps(rec if rec is not None else {"error_404": True},
+                              default=str).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/engine"):
+            from ..ops import counters
+
+            body = json.dumps({
+                "device_stage_batches": counters.device_stage_batches,
+                "device_grouped_batches": counters.device_grouped_batches,
+                "device_join_batches": counters.device_join_batches,
+                "mesh_grouped_runs": counters.mesh_grouped_runs,
+                "device_stage_runs": counters.device_stage_runs,
+            }).encode()
             ctype = "application/json"
         elif self.path == "/" or self.path.startswith("/index"):
             body = _HTML.encode()

@@ -224,3 +224,40 @@ def test_otlp_subscriber_exports_span_tree():
                    for s in children)
     finally:
         srv.shutdown()
+
+
+def test_dashboard_detail_and_engine_endpoints():
+    """Per-query DAG detail (/api/query/{id}) and live engine counters
+    (/api/engine) — the reference dashboard's live query-DAG surface
+    (daft-dashboard/src/lib.rs)."""
+    import json as _json
+    import urllib.request
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.observability.dashboard import launch
+
+    dash = launch()
+    try:
+        df = daft_tpu.from_pydict({"a": list(range(50))})
+        df.where(col("a") > 5).groupby(col("a") % 3).agg(
+            col("a").sum().alias("s")).to_pydict()
+        with urllib.request.urlopen(dash.url + "/api/queries", timeout=5) as r:
+            queries = _json.loads(r.read())
+        assert queries and queries[0]["done"]
+        qid = queries[0]["query_id"]
+        with urllib.request.urlopen(dash.url + f"/api/query/{qid}", timeout=5) as r:
+            detail = _json.loads(r.read())
+        assert detail["query_id"] == qid
+        assert "physical_plan" in detail and detail["operators"]
+        assert any(o["rows_out"] > 0 for o in detail["operators"])
+        with urllib.request.urlopen(dash.url + "/api/engine", timeout=5) as r:
+            eng = _json.loads(r.read())
+        assert "device_join_batches" in eng
+        with urllib.request.urlopen(dash.url + "/", timeout=5) as r:
+            html = r.read().decode()
+        assert "physical plan" in html and "/api/engine" in html
+        with urllib.request.urlopen(dash.url + "/api/query/nope", timeout=5) as r:
+            assert _json.loads(r.read())["error_404"] is True
+    finally:
+        dash.shutdown()
