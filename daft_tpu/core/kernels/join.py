@@ -229,12 +229,21 @@ class ProbeTable:
             codes = codes.copy()
             codes[rnull] = -1  # any-null build rows never match
 
+        from ...native import native_bucket_build
+
         G = int(codes.max(initial=-1)) + 1
-        pos = codes >= 0
-        self._counts = np.ascontiguousarray(
-            np.bincount(codes[pos], minlength=max(G, 1)), dtype=np.int64)
-        self._starts = np.ascontiguousarray(
-            np.concatenate([[0], np.cumsum(self._counts)[:-1]]), dtype=np.int64)
+        built = native_bucket_build(codes, G)
+        if built is not None:
+            self._counts, self._starts = built
+            if G == 0:
+                self._counts = np.zeros(1, dtype=np.int64)
+                self._starts = np.zeros(1, dtype=np.int64)
+        else:
+            pos = codes >= 0
+            self._counts = np.ascontiguousarray(
+                np.bincount(codes[pos], minlength=max(G, 1)), dtype=np.int64)
+            self._starts = np.ascontiguousarray(
+                np.concatenate([[0], np.cumsum(self._counts)[:-1]]), dtype=np.int64)
         self._num_codes = G
         # bucket rows (the argsort) are only needed for inner/left row fills —
         # built lazily so semi/anti joins never pay for them
@@ -246,12 +255,19 @@ class ProbeTable:
         if self._bucket_rows is None:
             with self._rows_lock:
                 if self._bucket_rows is None:
+                    from ...native import native_bucket_scatter
+
                     codes = self._joint_codes
-                    pos = codes >= 0
-                    pcodes = codes[pos]
-                    rows = np.nonzero(pos)[0].astype(np.int64)
-                    order = np.argsort(pcodes, kind="stable")
-                    self._bucket_rows = np.ascontiguousarray(rows[order], dtype=np.int64)
+                    total = int(self._counts.sum())
+                    rows = native_bucket_scatter(codes, self._num_codes,
+                                                 self._starts, total)
+                    if rows is None:
+                        pos = codes >= 0
+                        pcodes = codes[pos]
+                        rows = np.nonzero(pos)[0].astype(np.int64)
+                        order = np.argsort(pcodes, kind="stable")
+                        rows = rows[order]
+                    self._bucket_rows = np.ascontiguousarray(rows, dtype=np.int64)
         return self._bucket_rows
 
     def probe_codes(self, left_keys: list) -> Tuple[np.ndarray, np.ndarray]:

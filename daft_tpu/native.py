@@ -98,6 +98,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.probe_fill.restype = None
     lib.probe_fill.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p,
                                i64p, i64p]
+    lib.bucket_build.restype = None
+    lib.bucket_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.bucket_scatter.restype = None
+    lib.bucket_scatter.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
     _LIB = lib
     return _LIB
 
@@ -273,3 +277,34 @@ def native_i64_map_lookup(slot_keys: np.ndarray, slot_vals: np.ndarray, cap: int
                        int(cap), _p(vals, ctypes.c_int64), len(vals),
                        _p(out, ctypes.c_int64))
     return out[:len(vals)]
+
+
+def native_bucket_build(codes: np.ndarray, num_codes: int) -> Optional[tuple]:
+    """(counts, offsets) per joint code in one C pass — the ProbeTable build
+    side of native_probe. codes < 0 are skipped. None if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.int64)
+    g = max(int(num_codes), 1)
+    counts = np.empty(g, dtype=np.int64)
+    offsets = np.empty(g, dtype=np.int64)
+    lib.bucket_build(_p(codes, ctypes.c_int64), len(codes), g,
+                     _p(counts, ctypes.c_int64), _p(offsets, ctypes.c_int64))
+    return counts[:num_codes] if num_codes else counts[:0], \
+        offsets[:num_codes] if num_codes else offsets[:0]
+
+
+def native_bucket_scatter(codes: np.ndarray, num_codes: int,
+                          offsets: np.ndarray, total: int) -> Optional[np.ndarray]:
+    """Stable counting-sort scatter of row ids into buckets (row order preserved
+    within a bucket), or None. O(n + num_codes), replaces np.argsort."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    rows = np.empty(max(int(total), 1), dtype=np.int64)
+    lib.bucket_scatter(_p(codes, ctypes.c_int64), len(codes), max(int(num_codes), 1),
+                       _p(offsets, ctypes.c_int64), _p(rows, ctypes.c_int64))
+    return rows[:total]

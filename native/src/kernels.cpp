@@ -326,4 +326,32 @@ void probe_fill(const int64_t* lcodes, int64_t nl, int64_t num_codes,
   }
 }
 
+// One-pass bucket build for ProbeTable: per-code counts + exclusive prefix
+// offsets. codes < 0 (null / unmatchable) are skipped. Replaces the Python
+// np.bincount + np.cumsum pair, which allocates and scans the full code
+// domain twice for dense join keys.
+void bucket_build(const int64_t* codes, int64_t n, int64_t num_codes,
+                  int64_t* counts /* size num_codes */,
+                  int64_t* offsets /* size num_codes */) {
+  memset(counts, 0, sizeof(int64_t) * num_codes);
+  for (int64_t i = 0; i < n; i++) {
+    if (codes[i] >= 0) counts[codes[i]]++;
+  }
+  int64_t acc = 0;
+  for (int64_t g = 0; g < num_codes; g++) {
+    offsets[g] = acc;
+    acc += counts[g];
+  }
+}
+
+// Stable counting-sort scatter of build rows into their buckets — O(n + G),
+// replaces the O(n log n) np.argsort in ProbeTable._ensure_bucket_rows.
+void bucket_scatter(const int64_t* codes, int64_t n, int64_t num_codes,
+                    const int64_t* offsets, int64_t* rows /* size sum(counts) */) {
+  std::vector<int64_t> cursor(offsets, offsets + num_codes);
+  for (int64_t i = 0; i < n; i++) {
+    if (codes[i] >= 0) rows[cursor[codes[i]]++] = i;
+  }
+}
+
 }  // extern "C"
