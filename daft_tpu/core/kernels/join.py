@@ -270,6 +270,50 @@ class ProbeTable:
                     self._bucket_rows = np.ascontiguousarray(rows, dtype=np.int64)
         return self._bucket_rows
 
+    def _probe_fused(self, left_keys: list, how: str):
+        """Single-int64-key fast path: C does value->code->match-count in one
+        pass (native probe_lookup_count_*), skipping the per-step numpy sweeps
+        of probe_codes. Returns None when the shape doesn't qualify and the
+        general path must run."""
+        if (len(self._lookups) != 1 or self.null_equals_null
+                or self._lookups[0][0] not in ("dense", "hashmap")):
+            return None
+        from ...native import native_probe_fill, native_probe_lookup_count
+
+        ls = left_keys[0]
+        target = self._dtypes[0]
+        if ls.dtype != target:
+            ls = ls.cast(target)
+        kind, vals, valid = canonical_key_values(ls)
+        if kind not in ("num", "hash"):
+            return None
+        vals = vals.astype(np.int64, copy=False)
+        vmask = None if valid.all() else valid
+        res = native_probe_lookup_count(vals, vmask, self._lookups[0],
+                                        self._counts, self._num_codes)
+        if res is None:
+            return None
+        codes, l_match, total = res
+        if how in ("semi", "anti"):
+            keep = l_match > 0 if how == "semi" else l_match == 0
+            lidx = np.nonzero(keep)[0].astype(np.int64)
+            return lidx, np.full(len(lidx), -1, dtype=np.int64)
+        bucket_rows = self._ensure_bucket_rows()
+        filled = native_probe_fill(codes, self._num_codes, self._starts,
+                                   self._counts, bucket_rows, total)
+        if filled is None:
+            return None
+        matched_l, matched_r = filled
+        if how == "inner":
+            return matched_l, matched_r
+        if how == "left":
+            unmatched_l = np.nonzero(l_match == 0)[0].astype(np.int64)
+            lidx = np.concatenate([matched_l, unmatched_l])
+            ridx = np.concatenate([matched_r,
+                                   np.full(len(unmatched_l), -1, dtype=np.int64)])
+            return lidx, ridx
+        return None
+
     def probe_codes(self, left_keys: list) -> Tuple[np.ndarray, np.ndarray]:
         """Map probe-side key columns into the build side's joint code space.
         Returns (codes, any_null_mask); negative codes never match."""
@@ -327,6 +371,9 @@ class ProbeTable:
     def probe(self, left_keys: list, how: str) -> Tuple[np.ndarray, np.ndarray]:
         from ...native import native_probe
 
+        fused = self._probe_fused(left_keys, how)
+        if fused is not None:
+            return fused
         lcodes, _ = self.probe_codes(left_keys)
         nl = len(lcodes)
         G = self._num_codes

@@ -534,21 +534,24 @@ def _assemble_join(left: RecordBatch, right: RecordBatch, lidx: np.ndarray,
     if how in ("semi", "anti"):
         return left.take(lidx)
 
+    # prepare each side's index array ONCE (-1 -> null), instead of per column
+    lprep = _prepare_take_index(lidx)
+    rprep = _prepare_take_index(ridx)
     cols: List[Series] = []
     for s in left.columns:
-        cols.append(_take_optional(s, lidx))
+        cols.append(s.take(lprep))
     for s in right.columns:
         if s.name in merged_keys:
             continue
         name = right_rename.get(s.name, s.name)
-        cols.append(_take_optional(s, ridx).rename(name))
+        cols.append(s.take(rprep).rename(name))
 
     # outer joins: merged key columns must be coalesced from both sides
     if how in ("outer", "right"):
         for li, (le, re) in enumerate(zip(left_on, right_on)):
             if re.name() in merged_keys:
                 lpos = _find_col(cols, le.name(), output_schema)
-                rk = _take_optional(rkeys[li].rename(le.name()), ridx)
+                rk = rkeys[li].rename(le.name()).take(rprep)
                 if how == "right":
                     merged = rk
                 else:
@@ -600,6 +603,24 @@ class JoinProbe:
                               self.right_on, self.how, self.output_schema,
                               self.merged_keys, self.right_rename)
 
+    def probe_filtered(self, raw: RecordBatch, sel: np.ndarray) -> RecordBatch:
+        """Fused filter+probe (late materialization): `sel` selects the rows of
+        `raw` that passed an upstream filter. Only the join-key columns are
+        gathered through `sel`; every other column is gathered ONCE with the
+        composed final indices instead of once by the filter and again by the
+        join. Requires key exprs to be plain column refs (the executor checks),
+        so key values taken through `sel` equal filter-then-eval. Output is
+        identical to probe(raw.take(sel))."""
+        sel_arr = pa.array(sel.astype(np.int64, copy=False))
+        lkeys = [raw.get_column(e.name()).take(sel_arr) for e in self.left_on]
+        lidx, ridx = self.table.probe(lkeys, self.how)
+        # inner/left/semi/anti never emit -1 on the probe side, so composing
+        # through sel is a plain gather
+        final_l = sel[lidx] if len(lidx) else lidx.astype(np.int64)
+        return _assemble_join(raw, self.right, final_l, ridx, [], self.left_on,
+                              self.right_on, self.how, self.output_schema,
+                              self.merged_keys, self.right_rename)
+
 
 def _find_col(cols: List[Series], name: str, schema: Schema) -> int:
     for i, c in enumerate(cols):
@@ -608,14 +629,19 @@ def _find_col(cols: List[Series], name: str, schema: Schema) -> int:
     raise KeyError(name)
 
 
+def _prepare_take_index(idx: np.ndarray) -> pa.Array:
+    """Arrow index array where idx == -1 becomes null (take yields null).
+    Built once per join side; every column's take reuses it."""
+    idx = idx.astype(np.int64, copy=False)
+    if len(idx) and (idx < 0).any():
+        return pc.if_else(pa.array(idx >= 0), pa.array(idx),
+                          pa.nulls(len(idx), pa.int64()))
+    return pa.array(idx)
+
+
 def _take_optional(s: Series, idx: np.ndarray) -> Series:
     """take() where idx == -1 produces null."""
-    if len(idx) and (idx < 0).any():
-        arr = pa.array(idx.astype(np.int64))
-        arr = pc.if_else(pa.array(idx >= 0), arr, pa.nulls(len(idx), pa.int64()))
-        taken = s.to_arrow().take(arr)
-        return Series.from_arrow(taken, s.name)
-    return s.take(idx)
+    return s.take(_prepare_take_index(idx))
 
 
 def cross_join(left: RecordBatch, right: RecordBatch, output_schema: Schema,

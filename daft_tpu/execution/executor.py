@@ -1190,11 +1190,36 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
                                   node.schema, node.merged_keys, node.right_rename,
                                   node.null_equals_null, node.left.schema)
 
+            # Filter->probe fusion (late materialization): when the probe child
+            # is a filter and the keys are plain column refs, stream the RAW
+            # batches, turn the mask into a selection vector, and let the probe
+            # gather non-key columns once via composed indices instead of
+            # filter-take + join-take (reference: the Rust engine's selection-
+            # vector-carrying morsels serve the same purpose).
+            probe_child = node.left
+            fused_pred = None
+            if (isinstance(probe_child, pp.PhysFilter)
+                    and all(isinstance(e, ColumnRef) for e in node.left_on)):
+                fused_pred = probe_child.predicate
+                probe_child = probe_child.input
+
             def _probe(part, _i):
-                outs = [probe.probe(b) for b in part.batches if b.num_rows]
+                outs = []
+                for b in part.batches:
+                    if not b.num_rows:
+                        continue
+                    if fused_pred is None:
+                        outs.append(probe.probe(b))
+                        continue
+                    mask = eval_expression(b, fused_pred)
+                    sel = _selection_vector(b, mask)
+                    if sel is None:  # non-arrow mask: materialize + plain probe
+                        outs.append(probe.probe(b.filter_by_mask(mask)))
+                    elif len(sel):
+                        outs.append(probe.probe_filtered(b, sel))
                 return MicroPartition(node.schema, outs or [RecordBatch.empty(node.schema)])
 
-            yield from _map_op(_exec(node.left), _probe)
+            yield from _map_op(_exec(probe_child), _probe)
             return
         # right/outer need the full left side to find unmatched build rows
         # exactly once — admit it against the budget too
@@ -1239,6 +1264,23 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
     finally:
         spr.delete()
         spl.delete()
+
+
+def _selection_vector(b, mask):
+    """Row indices where mask is true (nulls drop, matching filter_by_mask);
+    scalar masks broadcast. None when the mask isn't arrow-backed."""
+    if len(mask) == 1 and b.num_rows != 1:
+        val = mask.to_pylist()[0]
+        return np.arange(b.num_rows, dtype=np.int64) if val \
+            else np.empty(0, dtype=np.int64)
+    if mask._pyobjs is not None:
+        return None
+    import pyarrow.compute as pc
+
+    arr = mask._arrow
+    if arr.null_count:
+        arr = pc.fill_null(arr, False)
+    return np.flatnonzero(arr.to_numpy(zero_copy_only=False)).astype(np.int64)
 
 
 def _filter_part(part: MicroPartition, predicate: Expression) -> MicroPartition:

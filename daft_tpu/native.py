@@ -100,6 +100,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                i64p, i64p]
     lib.bucket_build.restype = None
     lib.bucket_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.probe_lookup_count_hash.restype = ctypes.c_int64
+    lib.probe_lookup_count_hash.argtypes = [i64p, u8p, ctypes.c_int64, i64p, i64p,
+                                            ctypes.c_int64, i64p, ctypes.c_int64,
+                                            i64p, i64p]
+    lib.probe_lookup_count_dense.restype = ctypes.c_int64
+    lib.probe_lookup_count_dense.argtypes = [i64p, u8p, ctypes.c_int64,
+                                             ctypes.c_int64, ctypes.c_int64, i64p,
+                                             ctypes.c_int64, i64p, i64p]
     lib.bucket_scatter.restype = None
     lib.bucket_scatter.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
     _LIB = lib
@@ -308,3 +316,52 @@ def native_bucket_scatter(codes: np.ndarray, num_codes: int,
     lib.bucket_scatter(_p(codes, ctypes.c_int64), len(codes), max(int(num_codes), 1),
                        _p(offsets, ctypes.c_int64), _p(rows, ctypes.c_int64))
     return rows[:total]
+
+
+def native_probe_lookup_count(vals: np.ndarray, valid: Optional[np.ndarray],
+                              lookup, bucket_counts: np.ndarray,
+                              num_codes: int) -> Optional[tuple]:
+    """Fused single-i64-key probe: value -> build joint code -> match count in
+    one C pass. lookup is ProbeTable's ("dense", lo, hi) or ("hashmap", hm)
+    descriptor. Returns (codes, l_match_counts, total) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    n = len(vals)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vp = _p(valid, ctypes.c_uint8)
+    codes = np.empty(max(n, 1), dtype=np.int64)
+    l_match = np.empty(max(n, 1), dtype=np.int64)
+    if lookup[0] == "dense":
+        total = lib.probe_lookup_count_dense(
+            _p(vals, ctypes.c_int64), vp, n, int(lookup[1]), int(lookup[2]),
+            _p(bucket_counts, ctypes.c_int64), int(num_codes),
+            _p(codes, ctypes.c_int64), _p(l_match, ctypes.c_int64))
+    else:
+        slot_keys, slot_vals, cap = lookup[1]
+        total = lib.probe_lookup_count_hash(
+            _p(vals, ctypes.c_int64), vp, n, _p(slot_keys, ctypes.c_int64),
+            _p(slot_vals, ctypes.c_int64), int(cap),
+            _p(bucket_counts, ctypes.c_int64), int(num_codes),
+            _p(codes, ctypes.c_int64), _p(l_match, ctypes.c_int64))
+    return codes[:n], l_match[:n], int(total)
+
+
+def native_probe_fill(codes: np.ndarray, num_codes: int, bucket_offsets: np.ndarray,
+                      bucket_counts: np.ndarray, bucket_rows: np.ndarray,
+                      total: int) -> Optional[tuple]:
+    """probe_fill only (match total already known from the fused count pass)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.int64)
+    out_l = np.empty(max(total, 1), dtype=np.int64)
+    out_r = np.empty(max(total, 1), dtype=np.int64)
+    lib.probe_fill(_p(codes, ctypes.c_int64), len(codes), int(num_codes),
+                   _p(bucket_offsets, ctypes.c_int64), _p(bucket_counts, ctypes.c_int64),
+                   _p(bucket_rows, ctypes.c_int64), _p(out_l, ctypes.c_int64),
+                   _p(out_r, ctypes.c_int64))
+    return out_l[:total], out_r[:total]

@@ -472,14 +472,16 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         # hold), swap sides so the small side builds, restoring the original
         # column order with a Project.
         if plan.how == "inner" and plan.strategy is None and not right_rename:
-            from ..config import execution_config
             from ..expressions import col as _col
             from .stats import estimate_bytes
 
             lb = estimate_bytes(plan.left)
             rb = estimate_bytes(plan.right)
-            threshold = (config or execution_config()).broadcast_join_size_bytes
-            if lb is not None and rb is not None and lb <= threshold and lb < rb / 2:
+            # build on the smaller side unconditionally (no absolute size cap:
+            # the build side is fully materialized either way, so picking the
+            # smaller one strictly reduces memory AND build time; the 2x
+            # hysteresis avoids churn on near-equal estimates)
+            if lb is not None and rb is not None and lb < rb / 2:
                 swapped = lp.Join(plan.right, plan.left, plan.right_on, plan.left_on,
                                   "inner")
                 s_merged, s_rename = swapped.output_naming()

@@ -326,6 +326,53 @@ void probe_fill(const int64_t* lcodes, int64_t nl, int64_t num_codes,
   }
 }
 
+// Fused single-int64-key probe lookups: map probe values straight to build
+// joint codes AND count matches in ONE pass, instead of the Python chain of
+// lookup -> -1/-2 fixup writes -> probe_count (each a full O(n) sweep).
+// valid may be null (all rows valid); invalid rows never match. Returns total
+// match count; codes_out feeds probe_fill.
+int64_t probe_lookup_count_hash(const int64_t* vals, const uint8_t* valid,
+                                int64_t n, const int64_t* slot_keys,
+                                const int64_t* slot_vals, int64_t cap,
+                                const int64_t* bucket_counts, int64_t num_codes,
+                                int64_t* codes_out, int64_t* l_match) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t code = -1;
+    if (!valid || valid[i]) {
+      const int64_t v = vals[i];
+      uint64_t h = mix64((uint64_t)v) & mask;
+      while (slot_vals[h] != -1) {
+        if (slot_keys[h] == v) { code = slot_vals[h]; break; }
+        h = (h + 1) & mask;
+      }
+    }
+    codes_out[i] = code;
+    const int64_t m = (code >= 0 && code < num_codes) ? bucket_counts[code] : 0;
+    l_match[i] = m;
+    total += m;
+  }
+  return total;
+}
+
+// Same fusion for dense-domain keys (code = value - lo).
+int64_t probe_lookup_count_dense(const int64_t* vals, const uint8_t* valid,
+                                 int64_t n, int64_t lo, int64_t hi,
+                                 const int64_t* bucket_counts, int64_t num_codes,
+                                 int64_t* codes_out, int64_t* l_match) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t code = -1;
+    if ((!valid || valid[i]) && vals[i] >= lo && vals[i] <= hi) code = vals[i] - lo;
+    codes_out[i] = code;
+    const int64_t m = (code >= 0 && code < num_codes) ? bucket_counts[code] : 0;
+    l_match[i] = m;
+    total += m;
+  }
+  return total;
+}
+
 // One-pass bucket build for ProbeTable: per-code counts + exclusive prefix
 // offsets. codes < 0 (null / unmatchable) are skipped. Replaces the Python
 // np.bincount + np.cumsum pair, which allocates and scans the full code
