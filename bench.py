@@ -11,7 +11,7 @@ scaled to one chip.
 Environment knobs:
     BENCH_SF=10           scale factor (default 1; SF10 ~60M lineitem rows)
     BENCH_QUERIES=1,..,22 query subset (default the 9-query headline set)
-    BENCH_REPS=2          timed repetitions (best-of; tunnel jitter guard)
+    BENCH_REPS=3          timed repetitions (best-of; tunnel jitter guard)
 
 The run reports which engine paths actually executed: device_batches counts
 real XLA dispatches of the TPU agg/join stages (ops/counters.py), so a number
@@ -32,7 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SF = float(os.environ.get("BENCH_SF", 1.0))
 BASELINE_ROWS_PER_SEC = 50e6
 QUERIES = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,4,5,6,10,12,14,19").split(",")]
-REPS = int(os.environ.get("BENCH_REPS", 2))
+REPS = int(os.environ.get("BENCH_REPS", 3))
 
 
 def main() -> None:

@@ -161,6 +161,14 @@ class RecordBatch:
     def head(self, n: int) -> "RecordBatch":
         return self.slice(0, n)
 
+    def select(self, names: List[str]) -> "RecordBatch":
+        """Zero-copy column subset in the given order."""
+        from ..schema import Schema
+
+        cols = [self._columns[self._schema.index_of(n)] for n in names]
+        return RecordBatch(Schema([self._schema[n] for n in names]), cols,
+                           self._num_rows)
+
     def take(self, indices) -> "RecordBatch":
         if isinstance(indices, np.ndarray):
             indices = Series.from_numpy(indices, "idx")

@@ -146,7 +146,8 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
 
     if isinstance(node, pp.PhysFilter):
         yield from _map_op(_exec(node.input),
-                           lambda part, _i: _filter_part(part, node.predicate))
+                           lambda part, _i: _filter_part(part, node.predicate,
+                                                         node.keep, node.schema))
         return
 
     if isinstance(node, pp.PhysLimit):
@@ -1198,9 +1199,11 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
             # vector-carrying morsels serve the same purpose).
             probe_child = node.left
             fused_pred = None
+            fused_keep = None
             if (isinstance(probe_child, pp.PhysFilter)
                     and all(isinstance(e, ColumnRef) for e in node.left_on)):
                 fused_pred = probe_child.predicate
+                fused_keep = probe_child.keep
                 probe_child = probe_child.input
 
             def _probe(part, _i):
@@ -1213,10 +1216,11 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
                         continue
                     mask = eval_expression(b, fused_pred)
                     sel = _selection_vector(b, mask)
+                    braw = b if fused_keep is None else b.select(fused_keep)
                     if sel is None:  # non-arrow mask: materialize + plain probe
-                        outs.append(probe.probe(b.filter_by_mask(mask)))
+                        outs.append(probe.probe(braw.filter_by_mask(mask)))
                     elif len(sel):
-                        outs.append(probe.probe_filtered(b, sel))
+                        outs.append(probe.probe_filtered(braw, sel))
                 return MicroPartition(node.schema, outs or [RecordBatch.empty(node.schema)])
 
             yield from _map_op(_exec(probe_child), _probe)
@@ -1283,16 +1287,23 @@ def _selection_vector(b, mask):
     return np.flatnonzero(arr.to_numpy(zero_copy_only=False)).astype(np.int64)
 
 
-def _filter_part(part: MicroPartition, predicate: Expression) -> MicroPartition:
+def _filter_part(part: MicroPartition, predicate: Expression,
+                 keep=None, out_schema=None) -> MicroPartition:
+    """keep: late materialization — the mask is computed over the full batch,
+    but only these columns are gathered into the output (the rest exist solely
+    for the predicate)."""
+    schema = out_schema if keep is not None else part.schema
     batches = []
     for b in part.batches:
         mask = eval_expression(b, predicate)
+        if keep is not None:
+            b = b.select(keep)
         if len(mask) == 1 and b.num_rows != 1:
             val = mask.to_pylist()[0]
             batches.append(b if val else b.head(0))
         else:
             batches.append(b.filter_by_mask(mask))
-    return MicroPartition(part.schema, batches or [RecordBatch.empty(part.schema)])
+    return MicroPartition(schema, batches or [RecordBatch.empty(schema)])
 
 
 def _gather(node: pp.PhysicalPlan, schema) -> RecordBatch:

@@ -207,7 +207,10 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
     fact_base, fact_filters = strip_filters(rels[fact_i])
     conjuncts.extend(fact_filters)
 
-    col_side: Dict[str, str] = {c: "fact" for c in rels[fact_i].schema.column_names()}
+    # column availability comes from the filter-stripped bases: keep-carrying
+    # Filters narrow their output schema, but their predicates are lifted into
+    # device conjuncts here, so the base's full column set is what's in play
+    col_side: Dict[str, str] = {c: "fact" for c in fact_base.schema.column_names()}
     available = dict(col_side)
 
     # grow the dim tree from the fact over unique-key edges
@@ -218,7 +221,7 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
     while pending and progress:
         progress = False
         for pi, (ri, rel) in enumerate(pending):
-            rel_cols = set(rel.schema.column_names())
+            rel_cols = set(strip_filters(rel)[0].schema.column_names())
             edge = None
             for ci, (a, b) in enumerate(remaining_conds):
                 if a in available and b in rel_cols:
@@ -235,7 +238,7 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
             name = f"d{len(dims)}"
             dims.append(DimSpec(base=base, filters=filters, key_col=dim_key,
                                 parent=(available[avail_col], avail_col), name=name))
-            for c in rel.schema.column_names():
+            for c in base.schema.column_names():
                 col_side[c] = name
                 available[c] = name
             pending.pop(pi)
@@ -249,8 +252,9 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
     # from the host's bit-canonicalized key equality)
     def _intish(colname: str) -> bool:
         for r in rels:
-            if colname in r.schema.column_names():
-                dt = r.schema[colname].dtype
+            rs = strip_filters(r)[0].schema
+            if colname in rs.column_names():
+                dt = rs[colname].dtype
                 return (dt.is_integer() or dt.is_temporal() or dt.is_boolean())
         return False
 
@@ -261,11 +265,12 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
             return None
         conjuncts.append(BinaryOp("eq", ColumnRef(a), ColumnRef(b)))
 
-    # joined schema over original (globally unique) names
-    fields: List[Field] = list(rels[fact_i].schema.fields)
+    # joined schema over original (globally unique) names — filter-stripped
+    # bases again, so lifted predicates' columns stay resolvable
+    fields: List[Field] = list(fact_base.schema.fields)
     for i, r in enumerate(rels):
         if i != fact_i:
-            fields.extend(r.schema.fields)
+            fields.extend(strip_filters(r)[0].schema.fields)
     schema = Schema(fields)
 
     # hoist maximal single-dim subexpressions to synthetic host-evaluated

@@ -198,21 +198,28 @@ class UDFProject(LogicalPlan):
 
 
 class Filter(LogicalPlan):
-    def __init__(self, input: LogicalPlan, predicate: Expression):
+    def __init__(self, input: LogicalPlan, predicate: Expression,
+                 keep: Optional[List[str]] = None):
+        """keep: optional output-column subset (set by the column-pruning pass
+        when downstream needs fewer columns than the predicate reads) — the
+        executor then materializes only these columns after the mask."""
         super().__init__()
         self.input = input
         self.predicate = predicate
+        self.keep = keep
 
     def children(self):
         return [self.input]
 
     def with_children(self, children):
-        return Filter(children[0], self.predicate)
+        return Filter(children[0], self.predicate, self.keep)
 
     def _compute_schema(self) -> Schema:
         dt = self.predicate.get_type(self.input.schema)
         if not dt.is_boolean() and not dt.is_null():
             raise ValueError(f"filter predicate must be boolean, got {dt}")
+        if self.keep is not None:
+            return Schema([self.input.schema[c] for c in self.keep])
         return self.input.schema
 
     def describe(self) -> str:

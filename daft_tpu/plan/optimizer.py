@@ -641,7 +641,18 @@ def _prune(node: lp.LogicalPlan, needed: Optional[List[str]]) -> lp.LogicalPlan:
     if isinstance(node, lp.Filter):
         child = _prune(node.input, None if needed is None
                        else _ordered_union(needed, _refs([node.predicate])))
-        return lp.Filter(child, node.predicate)
+        # downstream needs fewer columns than the predicate reads: mark the
+        # filter to materialize only those (predicate-only columns are masked
+        # over but never gathered into the output)
+        keep = None
+        if needed is not None:
+            names = child.schema.column_names()
+            k = [c for c in names if c in set(needed)]
+            if not k:
+                k = names[:1]
+            if len(k) < len(names):
+                keep = k
+        return lp.Filter(child, node.predicate, keep)
 
     if isinstance(node, (lp.Limit, lp.Offset, lp.Sample, lp.IntoBatches, lp.IntoPartitions)):
         return node.with_children([_prune(node.input, needed)])

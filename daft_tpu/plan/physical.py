@@ -84,9 +84,11 @@ class UDFProject(_Unary):
 
 
 class PhysFilter(_Unary):
-    def __init__(self, input: PhysicalPlan, predicate: Expression, schema: Schema):
+    def __init__(self, input: PhysicalPlan, predicate: Expression, schema: Schema,
+                 keep=None):
         super().__init__(input, schema)
         self.predicate = predicate
+        self.keep = keep  # output-column subset (late materialization)
 
 
 class PhysLimit(_Unary):
@@ -370,7 +372,8 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         return UDFProject(translate(plan.input, config), plan.udf_expr, plan.passthrough, plan.schema)
 
     if isinstance(plan, lp.Filter):
-        return PhysFilter(translate(plan.input, config), plan.predicate, plan.schema)
+        return PhysFilter(translate(plan.input, config), plan.predicate, plan.schema,
+                          plan.keep)
 
     if isinstance(plan, lp.Limit):
         return PhysLimit(translate(plan.input, config), plan.limit, 0, plan.schema)
