@@ -186,7 +186,7 @@ class ProbeTable:
                     uniq = np.unique(vv)
                     hm = native_i64_map_build(uniq) if len(uniq) else None
                     if hm is not None:
-                        codes = native_i64_map_lookup(hm[0], hm[1], hm[2], vals)
+                        codes = native_i64_map_lookup(hm[0], hm[1], vals)
                         self._lookups.append(("hashmap", hm))
                     else:
                         codes = np.searchsorted(uniq, vals).astype(np.int64, copy=False) \
@@ -336,7 +336,7 @@ class ProbeTable:
 
                 hm = lookup[1]
                 vals = vals.astype(np.int64, copy=False)
-                codes = native_i64_map_lookup(hm[0], hm[1], hm[2], vals)
+                codes = native_i64_map_lookup(hm[0], hm[1], vals)
                 codes[codes == -1] = -2
             elif lookup[0] == "sorted":
                 uniq = lookup[1]

@@ -91,10 +91,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
                               i64p, i64p, i64p, i64p]
     lib.probe_count.restype = ctypes.c_int64
     lib.probe_count.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
-    lib.i64_map_build.restype = None
-    lib.i64_map_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
-    lib.i64_map_lookup.restype = None
-    lib.i64_map_lookup.argtypes = [i64p, i64p, ctypes.c_int64, i64p, ctypes.c_int64, i64p]
+    lib.i64_pairmap_build.restype = None
+    lib.i64_pairmap_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.i64_pairmap_lookup.restype = None
+    lib.i64_pairmap_lookup.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64, i64p]
+    lib.probe_lookup_count_pair.restype = ctypes.c_int64
+    lib.probe_lookup_count_pair.argtypes = [i64p, u8p, ctypes.c_int64, i64p,
+                                            ctypes.c_int64, i64p, ctypes.c_int64,
+                                            i64p, i64p]
     lib.probe_fill.restype = None
     lib.probe_fill.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p,
                                i64p, i64p]
@@ -255,9 +259,10 @@ def native_probe(lcodes: np.ndarray, num_codes: int, bucket_offsets: np.ndarray,
 
 
 def native_i64_map_build(keys: np.ndarray) -> Optional[tuple]:
-    """Open-addressing hash map over unique int64 keys -> their positions.
-    Returns (slot_keys, slot_vals, cap) or None. Read-only after build, so
-    lookups are safe from concurrent pool threads."""
+    """Open-addressing hash map over unique int64 keys -> their positions, in
+    an interleaved (key, val) pair layout so a probe touches ONE cache line.
+    Returns (slots, cap) or None. Read-only after build, so lookups are safe
+    from concurrent pool threads."""
     lib = get_lib()
     if lib is None:
         return None
@@ -266,14 +271,13 @@ def native_i64_map_build(keys: np.ndarray) -> Optional[tuple]:
     cap = 1
     while cap < max(2 * n, 16):
         cap <<= 1
-    slot_keys = np.empty(cap, dtype=np.int64)
-    slot_vals = np.full(cap, -1, dtype=np.int64)
-    lib.i64_map_build(_p(keys, ctypes.c_int64), n, cap,
-                      _p(slot_keys, ctypes.c_int64), _p(slot_vals, ctypes.c_int64))
-    return slot_keys, slot_vals, cap
+    slots = np.empty(2 * cap, dtype=np.int64)
+    slots[1::2] = -1
+    lib.i64_pairmap_build(_p(keys, ctypes.c_int64), n, cap, _p(slots, ctypes.c_int64))
+    return slots, cap
 
 
-def native_i64_map_lookup(slot_keys: np.ndarray, slot_vals: np.ndarray, cap: int,
+def native_i64_map_lookup(slots: np.ndarray, cap: int,
                           vals: np.ndarray) -> Optional[np.ndarray]:
     """Positions of vals in the map's key set (-1 for absent), or None."""
     lib = get_lib()
@@ -281,9 +285,9 @@ def native_i64_map_lookup(slot_keys: np.ndarray, slot_vals: np.ndarray, cap: int
         return None
     vals = np.ascontiguousarray(vals, dtype=np.int64)
     out = np.empty(max(len(vals), 1), dtype=np.int64)
-    lib.i64_map_lookup(_p(slot_keys, ctypes.c_int64), _p(slot_vals, ctypes.c_int64),
-                       int(cap), _p(vals, ctypes.c_int64), len(vals),
-                       _p(out, ctypes.c_int64))
+    lib.i64_pairmap_lookup(_p(slots, ctypes.c_int64), int(cap),
+                           _p(vals, ctypes.c_int64), len(vals),
+                           _p(out, ctypes.c_int64))
     return out[:len(vals)]
 
 
@@ -341,10 +345,9 @@ def native_probe_lookup_count(vals: np.ndarray, valid: Optional[np.ndarray],
             _p(bucket_counts, ctypes.c_int64), int(num_codes),
             _p(codes, ctypes.c_int64), _p(l_match, ctypes.c_int64))
     else:
-        slot_keys, slot_vals, cap = lookup[1]
-        total = lib.probe_lookup_count_hash(
-            _p(vals, ctypes.c_int64), vp, n, _p(slot_keys, ctypes.c_int64),
-            _p(slot_vals, ctypes.c_int64), int(cap),
+        slots, cap = lookup[1]
+        total = lib.probe_lookup_count_pair(
+            _p(vals, ctypes.c_int64), vp, n, _p(slots, ctypes.c_int64), int(cap),
             _p(bucket_counts, ctypes.c_int64), int(num_codes),
             _p(codes, ctypes.c_int64), _p(l_match, ctypes.c_int64))
     return codes[:n], l_match[:n], int(total)

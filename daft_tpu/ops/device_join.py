@@ -441,7 +441,7 @@ def unique_key_index(dim_key_series, probe_vals: np.ndarray,
             rows = np.nonzero(valid)[0][order] if len(su) else np.empty(0, np.int64)
             idx = np.where(hit, rows[pos_c] if len(su) else -1, -1)
         else:
-            pos = native_i64_map_lookup(hm[0], hm[1], hm[2], pv)
+            pos = native_i64_map_lookup(hm[0], hm[1], pv)
             rows = np.nonzero(valid)[0]
             if len(rows) == 0:
                 idx = np.full(len(pv), -1, dtype=np.int64)

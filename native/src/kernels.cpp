@@ -307,6 +307,66 @@ void i64_map_lookup(const int64_t* slot_keys, const int64_t* slot_vals, int64_t 
   }
 }
 
+// Interleaved (key,val) pair layout: one cache line serves both the key check
+// and the value read, halving the random accesses per probe vs the split
+// slot_keys/slot_vals arrays above. slots[2h] = key, slots[2h+1] = val
+// (-1 = empty). keys must be unique; slots pre-filled with val = -1.
+void i64_pairmap_build(const int64_t* keys, int64_t n, int64_t cap, int64_t* slots) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = mix64((uint64_t)keys[i]) & mask;
+    while (slots[2 * h + 1] != -1) h = (h + 1) & mask;
+    slots[2 * h] = keys[i];
+    slots[2 * h + 1] = i;
+  }
+}
+
+void i64_pairmap_lookup(const int64_t* slots, int64_t cap,
+                        const int64_t* vals, int64_t n, int64_t* out) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  const int64_t D = 24;
+  for (int64_t i = 0; i < n; i++) {
+    if (i + D < n)
+      __builtin_prefetch(&slots[2 * (mix64((uint64_t)vals[i + D]) & mask)], 0, 1);
+    uint64_t h = mix64((uint64_t)vals[i]) & mask;
+    int64_t r = -1;
+    while (slots[2 * h + 1] != -1) {
+      if (slots[2 * h] == vals[i]) { r = slots[2 * h + 1]; break; }
+      h = (h + 1) & mask;
+    }
+    out[i] = r;
+  }
+}
+
+// Fused pairmap lookup + match count (pair-layout variant of
+// probe_lookup_count_hash).
+int64_t probe_lookup_count_pair(const int64_t* vals, const uint8_t* valid,
+                                int64_t n, const int64_t* slots, int64_t cap,
+                                const int64_t* bucket_counts, int64_t num_codes,
+                                int64_t* codes_out, int64_t* l_match) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  const int64_t D = 24;
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (i + D < n && (!valid || valid[i + D]))
+      __builtin_prefetch(&slots[2 * (mix64((uint64_t)vals[i + D]) & mask)], 0, 1);
+    int64_t code = -1;
+    if (!valid || valid[i]) {
+      const int64_t v = vals[i];
+      uint64_t h = mix64((uint64_t)v) & mask;
+      while (slots[2 * h + 1] != -1) {
+        if (slots[2 * h] == v) { code = slots[2 * h + 1]; break; }
+        h = (h + 1) & mask;
+      }
+    }
+    codes_out[i] = code;
+    const int64_t m = (code >= 0 && code < num_codes) ? bucket_counts[code] : 0;
+    l_match[i] = m;
+    total += m;
+  }
+  return total;
+}
+
 // Emit matched pairs from prebuilt buckets (left-major; build rows in
 // original order within a key — bucket_rows is stable-sorted by code).
 void probe_fill(const int64_t* lcodes, int64_t nl, int64_t num_codes,
