@@ -63,6 +63,7 @@ _SERIES_AGG = {
     "bool_and": lambda s: s.bool_and(),
     "bool_or": lambda s: s.bool_or(),
     "list": lambda s: s.agg_list(),
+    "set": lambda s: s.agg_set(),
     "concat": lambda s: s.agg_concat(),
     "approx_count_distinct": lambda s: s.approx_count_distinct(),
 }
@@ -322,8 +323,25 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
         out = Series.from_pylist(rows, s.name, out_dt)
         return out.take(_invert_to_group_order(seg_gid, num_groups))
 
-    if op in ("list", "concat"):
+    if op in ("list", "set", "concat"):
         taken = s.take(order)
+        if op == "set":
+            py = taken.to_pylist()
+            bounds = list(starts) + [len(order)]
+            rows = []
+            for g in range(num_groups):
+                seen: set = set()
+                vals: list = []
+                for v in py[bounds[g]:bounds[g + 1]]:
+                    if v is None:
+                        continue
+                    k = v if not isinstance(v, (list, dict)) else repr(v)
+                    if k not in seen:
+                        seen.add(k)
+                        vals.append(v)
+                rows.append(vals)
+            out = Series.from_pylist(rows, s.name, DataType.list(s.dtype))
+            return out.take(_invert_to_group_order(seg_gid, num_groups))
         if op == "list":
             offsets = np.concatenate([starts, [len(order)]]).astype(np.int32) if num_groups else np.zeros(1, np.int32)
             values = taken.to_arrow()

@@ -709,6 +709,20 @@ class Series:
                 out.extend(v)
         return Series.from_pylist([out], self._name, self._dtype)
 
+    def agg_set(self) -> "Series":
+        """Distinct values as one list, first-occurrence order, nulls dropped
+        (reference: daft agg_set / list_agg_distinct semantics)."""
+        seen = set()
+        out: list = []
+        for v in self.to_pylist():
+            if v is None:
+                continue
+            k = v if not isinstance(v, (list, dict)) else repr(v)
+            if k not in seen:
+                seen.add(k)
+                out.append(v)
+        return Series.from_pylist([out], self._name, DataType.list(self._dtype))
+
     def approx_count_distinct(self) -> "Series":
         from .kernels.sketches import hll_count_distinct
 

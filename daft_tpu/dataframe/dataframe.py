@@ -35,6 +35,38 @@ class DataFrame:
             return self._preview_string()
         return f"DataFrame(schema={self.schema}, not materialized)"
 
+    @property
+    def columns(self) -> List[Expression]:
+        """Columns as a list of Expressions (reference: DataFrame.columns)."""
+        return [col(f.name) for f in self.schema]
+
+    def metrics(self):
+        """Per-operator execution metrics of the materialized plan as a
+        RecordBatch (reference: DataFrame.metrics). Runs the plan under a
+        stats collector if it has not been materialized with one."""
+        from ..core.recordbatch import RecordBatch
+        from ..observability.runtime_stats import (StatsCollector,
+                                                   current_collector,
+                                                   set_collector)
+        from ..runners import get_or_create_runner
+
+        collector = StatsCollector()
+        prev = current_collector()
+        set_collector(collector)
+        try:
+            for _ in get_or_create_runner().run_iter(self._builder):
+                pass
+        finally:
+            set_collector(prev)
+        rows: Dict[str, list] = {"operator": [], "rows_out": [], "batches": [],
+                                 "self_time_s": []}
+        for s in collector.finish():
+            rows["operator"].append(s.name)
+            rows["rows_out"].append(s.rows_out)
+            rows["batches"].append(s.batches_out)
+            rows["self_time_s"].append(s.seconds)
+        return RecordBatch.from_pydict(rows)
+
     def explain(self, show_all: bool = False) -> str:
         s = "== Unoptimized Logical Plan ==\n" + self._builder.plan.display()
         if show_all:
@@ -192,6 +224,70 @@ class DataFrame:
                          right_on=[col(n) for n in names], how="semi",
                          null_equals_null=True).distinct()
 
+    def union_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Distinct union with columns matched by name (reference:
+        DataFrame.union_by_name); columns absent on one side fill with nulls."""
+        return self.union_all_by_name(other).distinct()
+
+    def union_all_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Union keeping duplicates, columns matched by name; missing columns
+        become nulls (reference: DataFrame.union_all_by_name)."""
+        from ..expressions import lit
+
+        all_names = list(self.column_names)
+        for n in other.column_names:
+            if n not in all_names:
+                all_names.append(n)
+
+        def conform(df: "DataFrame") -> "DataFrame":
+            have = set(df.column_names)
+            exprs = []
+            for n in all_names:
+                if n in have:
+                    exprs.append(col(n))
+                else:
+                    dtype = (other if df is self else self).schema[n].dtype
+                    exprs.append(lit(None).cast(dtype).alias(n))
+            return df.select(*exprs)
+
+        return conform(self).concat(conform(other))
+
+    def intersect_all(self, other: "DataFrame") -> "DataFrame":
+        """INTERSECT ALL: multiset intersection — each row kept min(l, r)
+        times. Row-numbering each duplicate within its key group turns the
+        multiset op into a plain semi join on (columns..., occurrence#)."""
+        return self._multiset_setop(other, "semi")
+
+    def except_all(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT ALL: multiset difference — each row kept max(l - r, 0) times."""
+        return self._multiset_setop(other, "anti")
+
+    def _multiset_setop(self, other: "DataFrame", how: str) -> "DataFrame":
+        from ..functions import row_number
+        from ..window import Window
+
+        names = list(self.column_names)
+        w = Window().partition_by(*names).order_by(names[0])
+        rn = "__occurrence__"
+        left = self.with_column(rn, row_number().over(w))
+        right = other.with_column(rn, row_number().over(w))
+        keys = [col(n) for n in names] + [col(rn)]
+        return left.join(right, left_on=keys, right_on=keys, how=how,
+                         null_equals_null=True).select(*[col(n) for n in names])
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataFrame":
+        """Randomly reorder rows (reference: DataFrame.shuffle — a global sort
+        on a random key)."""
+        import random as _random
+
+        from ..expressions import lit
+
+        rng_seed = seed if seed is not None else _random.randrange(2 ** 31)
+        tmp = "__shuffle_key__"
+        keyed = self.with_column(tmp, (col(self.column_names[0]).hash(seed=rng_seed)
+                                       if self.column_names else lit(0)))
+        return keyed.sort(tmp).exclude(tmp)
+
     def except_distinct(self, other: "DataFrame") -> "DataFrame":
         """EXCEPT DISTINCT: rows of self absent from other (NULLs match NULLs,
         per SQL set-op semantics)."""
@@ -230,6 +326,40 @@ class DataFrame:
 
     def count_rows(self) -> int:
         return self.count().to_pydict()["count"][0]
+
+    def stddev(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).stddev() for c in cols])
+
+    def var(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).var() for c in cols])
+
+    def skew(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).skew() for c in cols])
+
+    def any_value(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).any_value() for c in cols])
+
+    def agg_list(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[AggExpr("list", _to_expr(c)) for c in cols])
+
+    list_agg = agg_list
+
+    def agg_set(self, *cols: ColumnInput) -> "DataFrame":
+        """Distinct values per column as lists (reference: DataFrame.agg_set)."""
+        return self.agg(*[AggExpr("set", _to_expr(c)) for c in cols])
+
+    list_agg_distinct = agg_set
+
+    def agg_concat(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[AggExpr("concat", _to_expr(c)) for c in cols])
+
+    def string_agg(self, *cols: ColumnInput, delimiter: str = "") -> "DataFrame":
+        """Concatenate string values into one string per column (reference:
+        DataFrame.string_agg); implemented as list-agg + list.join."""
+        names = [_to_expr(c).name() for c in cols]
+        out = self.agg(*[AggExpr("list", _to_expr(c)).alias(n)
+                         for c, n in zip(cols, names)])
+        return out.select(*[col(n).list.join(delimiter).alias(n) for n in names])
 
     def __len__(self) -> int:
         return self.count_rows()
@@ -413,6 +543,105 @@ class DataFrame:
 
         return self._write(_SinkWriteInfo(sink))
 
+    def write_deltalake(self, table_path: str, mode: str = "append",
+                        partition_cols: Optional[List[str]] = None) -> "DataFrame":
+        """Write as a Delta Lake table: parquet data files + a JSON
+        transaction-log commit (reference: DataFrame.write_deltalake)."""
+        from ..io.delta import write_deltalake
+
+        return write_deltalake(self, table_path, mode, partition_cols)
+
+    def write_sql(self, table_name: str, connection,
+                  mode: str = "append") -> "DataFrame":
+        """Write rows into a SQL table through a DB-API connection or a
+        zero-arg connection factory (reference: DataFrame.write_sql via
+        SQLAlchemy; here plain DB-API keeps it dependency-free — sqlite3
+        from the stdlib works out of the box)."""
+        from ..io.sql_writer import write_sql
+
+        return write_sql(self, table_name, connection, mode)
+
+    def write_lance(self, uri: str, mode: str = "create", **kwargs) -> "DataFrame":
+        """Write a Lance dataset (requires the `lance` package, like the
+        reference's DataFrame.write_lance)."""
+        try:
+            import lance
+        except ImportError as e:
+            raise ImportError(
+                "write_lance requires the 'lance' package (pip install pylance)"
+            ) from e
+        table = self.to_arrow()
+        lance.write_dataset(table, uri, mode=mode, **kwargs)
+        import daft_tpu
+
+        return daft_tpu.from_pydict({"uri": [uri], "rows": [table.num_rows]})
+
+    def write_huggingface(self, repo_id: str, **kwargs) -> "DataFrame":
+        """Push to a HuggingFace dataset repo (requires `huggingface_hub`,
+        like the reference's DataFrame.write_huggingface)."""
+        try:
+            from huggingface_hub import HfApi  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "write_huggingface requires the 'huggingface_hub' package"
+            ) from e
+        raise NotImplementedError(
+            "huggingface_hub is available but this build has no network egress; "
+            "use write_parquet + huggingface_hub.upload_file")
+
+    def skip_existing(self, existing_path, key_column: Union[str, List[str]],
+                      file_format: str = "parquet") -> "DataFrame":
+        """Drop rows whose key already appears in previously-written output
+        (reference: DataFrame.skip_existing — resume semantics for bulk
+        writes). Reads only the key column(s) from existing_path."""
+        import daft_tpu
+
+        keys = [key_column] if isinstance(key_column, str) else list(key_column)
+        paths = existing_path if isinstance(existing_path, list) else [existing_path]
+        readers = {"parquet": daft_tpu.read_parquet, "csv": daft_tpu.read_csv,
+                   "json": daft_tpu.read_json}
+        if file_format not in readers:
+            raise ValueError(f"unsupported file_format {file_format!r}")
+        import glob as _glob
+        import os as _os
+
+        existing = None
+        for p in paths:
+            if _os.path.isdir(p):
+                ext = "json" if file_format == "json" else file_format
+                files = sorted(_glob.glob(_os.path.join(p, f"**/*.{ext}"),
+                                          recursive=True))
+            else:
+                files = [p] if _os.path.exists(p) else []
+            for fp in files:
+                part = readers[file_format](fp).select(*[col(k) for k in keys])
+                existing = part if existing is None else existing.concat(part)
+        if existing is None:
+            return self
+        kexprs = [col(k) for k in keys]
+        return self.join(existing.distinct(), left_on=kexprs, right_on=kexprs,
+                         how="anti")
+
+    # ---- external-framework conversions -------------------------------------------
+    def to_ray_dataset(self):
+        """Convert to a Ray Dataset (requires `ray`, like the reference's
+        DataFrame.to_ray_dataset)."""
+        try:
+            import ray.data
+        except ImportError as e:
+            raise ImportError("to_ray_dataset requires the 'ray' package") from e
+        return ray.data.from_arrow(self.to_arrow())
+
+    def to_dask_dataframe(self, npartitions: Optional[int] = None):
+        """Convert to a Dask DataFrame (requires `dask`, like the reference's
+        DataFrame.to_dask_dataframe)."""
+        try:
+            import dask.dataframe as dd
+        except ImportError as e:
+            raise ImportError("to_dask_dataframe requires the 'dask' package") from e
+        return dd.from_pandas(self.to_pandas(),
+                              npartitions=npartitions or max(self.num_partitions(), 1))
+
     def _write(self, info) -> "DataFrame":
         return DataFrame(self._builder.write(info)).collect()
 
@@ -456,6 +685,41 @@ class GroupedDataFrame:
 
     def agg_concat(self, *cols: ColumnInput) -> DataFrame:
         return self.agg(*[AggExpr("concat", _to_expr(c)) for c in cols])
+
+    def agg_set(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[AggExpr("set", _to_expr(c)) for c in cols])
+
+    list_agg = agg_list
+    list_agg_distinct = agg_set
+
+    def stddev(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).stddev() for c in cols])
+
+    def var(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).var() for c in cols])
+
+    def skew(self, *cols: ColumnInput) -> DataFrame:
+        return self.agg(*[_to_expr(c).skew() for c in cols])
+
+    def string_agg(self, *cols: ColumnInput, delimiter: str = "") -> DataFrame:
+        names = [_to_expr(c).name() for c in cols]
+        gnames = [e.name() for e in self._group_by]
+        out = self.agg(*[AggExpr("list", _to_expr(c)).alias(n)
+                         for c, n in zip(cols, names)])
+        from ..expressions import col as _col
+
+        keep = [_col(n) for n in gnames]
+        keep += [_col(n).list.join(delimiter).alias(n) for n in names]
+        return out.select(*keep)
+
+    def map_groups(self, udf_expr: Expression) -> DataFrame:
+        """Apply a UDF to each group's rows; the UDF may emit any number of
+        rows per group (reference: GroupedDataFrame.map_groups)."""
+        from ..plan import logical as lp
+
+        df = self._df
+        plan = lp.MapGroups(df._builder.plan, self._group_by, udf_expr)
+        return df._next(df._builder._next(plan))
 
 
 def _flatten_aggs(aggs) -> List[Expression]:

@@ -257,6 +257,10 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
+    if isinstance(node, pp.PhysMapGroups):
+        yield _exec_map_groups(node)
+        return
+
     if isinstance(node, (pp.DeviceFilterAgg, pp.DeviceGroupedAgg)):
         yield _exec_device_agg(node)
         return
@@ -1268,6 +1272,45 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
     finally:
         spr.delete()
         spl.delete()
+
+
+def _exec_map_groups(node) -> MicroPartition:
+    """Group rows by the keys, evaluate the UDF expression over each group's
+    rows, replicate the group's key values per emitted row (reference:
+    ray runner's partition-wise map_groups; one group may emit any number
+    of rows, e.g. 1 for a reduction UDF)."""
+    from ..core.kernels.groupby import make_groups
+    from ..core.series import Series
+
+    batch = _gather(node.input, node.input.schema)
+    if batch.num_rows == 0:
+        return MicroPartition(node.schema, [RecordBatch.empty(node.schema)])
+    key_series = [eval_expression(batch, e) for e in node.groupby]
+    first_idx, group_ids, _counts = make_groups(key_series)
+    num_groups = len(first_idx)
+    order = np.argsort(group_ids, kind="stable")
+    sorted_gids = group_ids[order]
+    bounds = np.concatenate([[0], np.flatnonzero(np.diff(sorted_gids)) + 1,
+                             [len(order)]]).astype(np.int64)
+
+    out_vals: List[Series] = []
+    rows_per_group: List[int] = []
+    for g in range(num_groups):
+        seg = order[bounds[g]:bounds[g + 1]]
+        sub = batch.take(seg)
+        res = eval_expression(sub, node.udf_expr)
+        out_vals.append(res)
+        rows_per_group.append(len(res))
+
+    udf_col = Series.concat(out_vals) if out_vals else None
+    reps = np.repeat(np.arange(num_groups, dtype=np.int64),
+                     np.asarray(rows_per_group, dtype=np.int64))
+    key_rows = [ks.take(first_idx).take(reps) for ks in key_series]
+    cols = key_rows + ([udf_col] if udf_col is not None else [])
+    out = RecordBatch(node.schema, [c.cast(f.dtype) if c.dtype != f.dtype else c
+                                    for c, f in zip(cols, node.schema.fields)],
+                      int(reps.shape[0]))
+    return MicroPartition(node.schema, [out])
 
 
 def _selection_vector(b, mask):

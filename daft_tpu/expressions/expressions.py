@@ -322,6 +322,10 @@ class Expression:
     def agg_list(self):
         return AggExpr("list", self)
 
+    def agg_set(self) -> "AggExpr":
+        """Distinct values as a list (reference: Expression.agg_set)."""
+        return AggExpr("set", self)
+
     def agg_concat(self):
         return AggExpr("concat", self)
 
@@ -658,7 +662,7 @@ class Function(Expression):
 
 _AGG_OPS = {
     "sum", "mean", "min", "max", "count", "count_distinct", "any_value", "stddev",
-    "var", "skew", "bool_and", "bool_or", "list", "concat", "approx_count_distinct",
+    "var", "skew", "bool_and", "bool_or", "list", "set", "concat", "approx_count_distinct",
     "approx_percentile",
 }
 
@@ -695,7 +699,7 @@ class AggExpr(Expression):
             return Field(f.name, f.dtype)
         if op in ("bool_and", "bool_or"):
             return Field(f.name, DataType.bool())
-        if op == "list":
+        if op in ("list", "set"):
             return Field(f.name, DataType.list(f.dtype))
         if op == "concat":
             if not f.dtype.is_list():

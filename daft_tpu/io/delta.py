@@ -281,3 +281,143 @@ def _pruned(pvals: Dict[str, Any], conjuncts: List[tuple]) -> bool:
         except TypeError:
             continue
     return False
+
+
+# ======================================================================================
+# Write path
+# ======================================================================================
+
+
+def _dtype_to_delta_type(dt: DataType) -> Any:
+    """Inverse of _delta_type_to_dtype for schemaString emission."""
+    if dt.is_struct():
+        return {"type": "struct",
+                "fields": [{"name": n, "type": _dtype_to_delta_type(t),
+                            "nullable": True, "metadata": {}}
+                           for n, t in dt.struct_fields()]}
+    if dt.is_list():
+        return {"type": "array", "elementType": _dtype_to_delta_type(dt.inner),
+                "containsNull": True}
+    if dt.is_decimal():
+        prec, sc = dt.params
+        return f"decimal({prec},{sc})"
+    simple = {
+        DataType.string(): "string", DataType.int64(): "long",
+        DataType.int32(): "integer", DataType.int16(): "short",
+        DataType.int8(): "byte", DataType.float32(): "float",
+        DataType.float64(): "double", DataType.bool(): "boolean",
+        DataType.binary(): "binary", DataType.date(): "date",
+    }
+    if dt in simple:
+        return simple[dt]
+    if dt.is_temporal():
+        return "timestamp"
+    raise NotImplementedError(f"cannot map {dt} to a delta type")
+
+
+def write_deltalake(df, table_path: str, mode: str = "append",
+                    partition_cols: Optional[List[str]] = None):
+    """Write a DataFrame as a Delta Lake table (reference:
+    DataFrame.write_deltalake via the deltalake package; here the protocol is
+    emitted directly — parquet data files + JSON transaction-log commit that
+    read_deltalake() and any standard Delta reader replays).
+
+    mode: "append" | "overwrite" | "error" | "ignore".
+    Returns a DataFrame of the written file paths and row counts.
+    """
+    import time as _time
+    import uuid as _uuid
+
+    import pyarrow.parquet as pq
+
+    from .. import api as _api
+
+    log_dir = os.path.join(table_path, "_delta_log")
+    exists = os.path.isdir(log_dir)
+    if exists:
+        if mode == "error":
+            raise FileExistsError(f"delta table already exists: {table_path}")
+        if mode == "ignore":
+            return _api.from_pydict({"path": [], "rows": []})
+    os.makedirs(log_dir, exist_ok=True)
+
+    parts = list(partition_cols or [])
+    schema = df.schema
+    for p in parts:
+        if p not in schema.column_names():
+            raise ValueError(f"partition column {p!r} not in schema")
+
+    versions = [int(n.split(".")[0]) for n in os.listdir(log_dir)
+                if n.endswith(".json") and n.split(".")[0].isdigit()]
+    version = (max(versions) + 1) if versions else 0
+
+    actions: List[dict] = []
+    now_ms = int(_time.time() * 1000)
+    if version == 0:
+        schema_string = json.dumps({
+            "type": "struct",
+            "fields": [{"name": f.name, "type": _dtype_to_delta_type(f.dtype),
+                        "nullable": True, "metadata": {}} for f in schema],
+        })
+        actions.append({"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}})
+        actions.append({"metaData": {
+            "id": str(_uuid.uuid4()), "format": {"provider": "parquet", "options": {}},
+            "schemaString": schema_string, "partitionColumns": parts,
+            "configuration": {}, "createdTime": now_ms,
+        }})
+    if mode == "overwrite" and exists:
+        state = _replay_log(table_path)
+        for path in state.files:
+            actions.append({"remove": {"path": path, "deletionTimestamp": now_ms,
+                                       "dataChange": True}})
+
+    import pyarrow as pa
+
+    table = df.to_arrow()
+    written_paths: List[str] = []
+    written_rows: List[int] = []
+
+    def _fmt_pv(v: Any) -> Optional[str]:
+        if v is None:
+            return None
+        return str(v)
+
+    def _write_one(tbl, pvals: Dict[str, str], subdir: str) -> None:
+        data_tbl = tbl.drop_columns(parts) if parts else tbl
+        fname = f"part-{version:05d}-{_uuid.uuid4().hex}.parquet"
+        rel = os.path.join(subdir, fname) if subdir else fname
+        abs_path = os.path.join(table_path, rel)
+        os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+        pq.write_table(data_tbl, abs_path)
+        actions.append({"add": {
+            "path": rel.replace(os.sep, "/"), "partitionValues": pvals,
+            "size": os.path.getsize(abs_path), "modificationTime": now_ms,
+            "dataChange": True,
+        }})
+        written_paths.append(rel)
+        written_rows.append(data_tbl.num_rows)
+
+    if not parts:
+        _write_one(table, {}, "")
+    else:
+        import pyarrow.compute as _pc
+
+        keys = [table.column(p) for p in parts]
+        combo = table.group_by(parts).aggregate([]).to_pylist()
+        for row in combo:
+            mask = None
+            for p in parts:
+                m = _pc.equal(table.column(p), pa.scalar(row[p])) if row[p] is not None \
+                    else _pc.is_null(table.column(p))
+                mask = m if mask is None else _pc.and_(mask, m)
+            sub = table.filter(mask)
+            pvals = {p: _fmt_pv(row[p]) for p in parts}
+            subdir = "/".join(f"{p}={pvals[p] if pvals[p] is not None else '__HIVE_DEFAULT_PARTITION__'}"
+                              for p in parts)
+            _write_one(sub, pvals, subdir)
+
+    with open(os.path.join(log_dir, f"{version:020d}.json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+
+    return _api.from_pydict({"path": written_paths, "rows": written_rows})

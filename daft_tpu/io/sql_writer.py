@@ -1,0 +1,90 @@
+"""SQL table writer over plain DB-API connections.
+
+Reference parity: DataFrame.write_sql (daft/dataframe/dataframe.py) — the
+reference routes through SQLAlchemy; here any PEP 249 connection (or a zero-arg
+factory returning one) works, which keeps the path dependency-free: stdlib
+sqlite3 satisfies it out of the box, and psycopg2 / mysqlclient / duckdb
+connections plug in unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..datatype import DataType
+
+
+def _sql_type(dt: DataType) -> str:
+    if dt.is_integer() or dt.is_boolean():
+        return "BIGINT"
+    if dt.is_floating() or dt.is_decimal():
+        return "DOUBLE PRECISION"
+    if dt.is_temporal():
+        return "TIMESTAMP"
+    if dt.is_binary():
+        return "BLOB"
+    return "TEXT"
+
+
+def _quote(name: str) -> str:
+    return '"' + name.replace('"', '""') + '"'
+
+
+def write_sql(df, table_name: str, connection, mode: str = "append"):
+    """mode: "append" (create if absent), "overwrite" (drop + recreate),
+    "error" (fail if the table exists). Returns a DataFrame with the row
+    count written."""
+    # a live connection has .cursor(); anything else is a zero-arg factory
+    conn = connection if hasattr(connection, "cursor") else connection()
+    cur = conn.cursor()
+    schema = df.schema
+    cols = schema.column_names()
+    qtable = _quote(table_name)
+
+    ddl_cols = ", ".join(f"{_quote(f.name)} {_sql_type(f.dtype)}" for f in schema)
+    if mode == "overwrite":
+        cur.execute(f"DROP TABLE IF EXISTS {qtable}")
+        cur.execute(f"CREATE TABLE {qtable} ({ddl_cols})")
+    elif mode == "error":
+        cur.execute(f"CREATE TABLE {qtable} ({ddl_cols})")
+    else:  # append
+        cur.execute(f"CREATE TABLE IF NOT EXISTS {qtable} ({ddl_cols})")
+
+    placeholder = ", ".join(["?"] * len(cols))
+    paramstyle = getattr(_module_of(conn), "paramstyle", "qmark")
+    if paramstyle in ("format", "pyformat"):
+        placeholder = ", ".join(["%s"] * len(cols))
+    insert = (f"INSERT INTO {qtable} ({', '.join(_quote(c) for c in cols)}) "
+              f"VALUES ({placeholder})")
+
+    total = 0
+    data = df.to_pydict()
+    rows = list(zip(*[_plainify(data[c]) for c in cols])) if cols else []
+    if rows:
+        cur.executemany(insert, rows)
+        total = len(rows)
+    conn.commit()
+
+    import daft_tpu
+
+    return daft_tpu.from_pydict({"table": [table_name], "rows": [total]})
+
+
+def _module_of(conn) -> Any:
+    import sys
+
+    mod = type(conn).__module__.split(".")[0]
+    return sys.modules.get(mod)
+
+
+def _plainify(values: list) -> list:
+    """DB-API drivers reject numpy scalars and nested values; stringify the
+    exotic ones."""
+    out = []
+    for v in values:
+        if hasattr(v, "item"):
+            v = v.item()
+        if isinstance(v, (list, dict, tuple, set, bytes)) and not isinstance(v, bytes):
+            v = repr(v)
+        out.append(v)
+    return out

@@ -434,6 +434,35 @@ class TopN(LogicalPlan):
 # ======================================================================================
 
 
+class MapGroups(LogicalPlan):
+    """Apply a UDF expression to each group's rows; output = group keys
+    (replicated per emitted row) + the UDF's column (reference:
+    GroupedDataFrame.map_groups, daft/dataframe/dataframe.py)."""
+
+    def __init__(self, input: LogicalPlan, groupby: List[Expression],
+                 udf_expr: Expression):
+        super().__init__()
+        self.input = input
+        self.groupby = list(groupby)
+        self.udf_expr = udf_expr
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, children):
+        return MapGroups(children[0], self.groupby, self.udf_expr)
+
+    def _compute_schema(self) -> Schema:
+        in_schema = self.input.schema
+        fields = [e.to_field(in_schema) for e in self.groupby]
+        fields.append(self.udf_expr.to_field(in_schema))
+        return Schema(fields)
+
+    def describe(self) -> str:
+        g = ", ".join(e.name() for e in self.groupby)
+        return f"MapGroups[groupby=({g}) udf={self.udf_expr.name()}]"
+
+
 class Aggregate(LogicalPlan):
     def __init__(self, input: LogicalPlan, groupby: List[Expression], aggregations: List[Expression]):
         super().__init__()

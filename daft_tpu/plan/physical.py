@@ -160,6 +160,14 @@ class HashAggregate(_Unary):
         self.aggregations = aggregations
 
 
+class PhysMapGroups(_Unary):
+    def __init__(self, input: PhysicalPlan, groupby: List[Expression],
+                 udf_expr: Expression, schema: Schema):
+        super().__init__(input, schema)
+        self.groupby = groupby
+        self.udf_expr = udf_expr
+
+
 class DeviceFilterAgg(_Unary):
     """Fused (optional filter)+ungrouped-agg stage eligible for the JAX device.
 
@@ -450,6 +458,10 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         if plan.groupby:
             return HashAggregate(child, plan.groupby, plan.aggregations, plan.schema)
         return UngroupedAggregate(child, plan.aggregations, plan.schema)
+
+    if isinstance(plan, lp.MapGroups):
+        return PhysMapGroups(translate(plan.input, config), plan.groupby,
+                             plan.udf_expr, plan.schema)
 
     if isinstance(plan, lp.Distinct):
         return Dedup(translate(plan.input, config), plan.on, plan.schema)

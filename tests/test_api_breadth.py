@@ -1,0 +1,151 @@
+"""DataFrame API breadth: agg variants, multiset set-ops, by-name unions,
+shuffle, map_groups, delta/SQL writers, skip_existing.
+
+Reference parity: daft/dataframe/dataframe.py (agg_set, string_agg,
+union_by_name, except_all/intersect_all, shuffle, map_groups,
+write_deltalake, write_sql, skip_existing).
+"""
+
+import os
+import sqlite3
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+
+@pytest.fixture
+def df():
+    return daft_tpu.from_pydict({
+        "g": ["a", "a", "b", "b", "b"],
+        "v": [1, 1, 3, 4, 4],
+        "s": ["p", "q", "r", "s", "t"],
+    })
+
+
+def test_agg_set_grouped(df):
+    out = df.groupby("g").agg_set("v").sort("g").to_pydict()
+    assert out == {"g": ["a", "b"], "v": [[1], [3, 4]]}
+
+
+def test_agg_set_global(df):
+    assert df.agg_set("v").to_pydict() == {"v": [[1, 3, 4]]}
+
+
+def test_list_agg_distinct_alias(df):
+    assert df.list_agg_distinct("v").to_pydict() == {"v": [[1, 3, 4]]}
+
+
+def test_string_agg(df):
+    assert df.string_agg("s", delimiter=",").to_pydict() == {"s": ["p,q,r,s,t"]}
+    out = df.groupby("g").string_agg("s", delimiter="|").sort("g").to_pydict()
+    assert out == {"g": ["a", "b"], "s": ["p|q", "r|s|t"]}
+
+
+def test_global_stat_shortcuts(df):
+    assert df.var("v").to_pydict()["v"][0] == pytest.approx(1.84)
+    assert df.stddev("v").to_pydict()["v"][0] == pytest.approx(1.84 ** 0.5)
+    assert df.any_value("g").to_pydict()["g"][0] in ("a", "b")
+
+
+def test_columns_property(df):
+    assert [e.name() for e in df.columns] == ["g", "v", "s"]
+
+
+def test_union_all_by_name():
+    d1 = daft_tpu.from_pydict({"x": [1], "y": [4]})
+    d2 = daft_tpu.from_pydict({"y": [6], "z": ["a"]})
+    out = d1.union_all_by_name(d2).sort("y").to_pydict()
+    assert out == {"x": [1, None], "y": [4, 6], "z": [None, "a"]}
+
+
+def test_union_by_name_dedupes():
+    d1 = daft_tpu.from_pydict({"x": [1, 1]})
+    d2 = daft_tpu.from_pydict({"x": [1, 2]})
+    assert sorted(d1.union_by_name(d2).to_pydict()["x"]) == [1, 2]
+
+
+def test_except_all_multiset():
+    l = daft_tpu.from_pydict({"x": [1, 1, 1, 2]})
+    r = daft_tpu.from_pydict({"x": [1, 2, 3]})
+    assert sorted(l.except_all(r).to_pydict()["x"]) == [1, 1]
+
+
+def test_intersect_all_multiset():
+    l = daft_tpu.from_pydict({"x": [1, 1, 1, 2]})
+    r = daft_tpu.from_pydict({"x": [1, 1, 3]})
+    assert sorted(l.intersect_all(r).to_pydict()["x"]) == [1, 1]
+
+
+def test_shuffle_preserves_rows(df):
+    out = df.shuffle(seed=7).to_pydict()
+    assert sorted(out["v"]) == [1, 1, 3, 4, 4]
+
+
+def test_map_groups(df):
+    from daft_tpu.udf import udf
+
+    @udf(return_dtype=daft_tpu.DataType.int64())
+    def group_sum(v):
+        return [sum(v.to_pylist())]
+
+    out = df.groupby("g").map_groups(group_sum(col("v"))).sort("g").to_pydict()
+    assert out == {"g": ["a", "b"], "v": [2, 11]}
+
+
+def test_map_groups_multi_row(df):
+    from daft_tpu.udf import udf
+
+    @udf(return_dtype=daft_tpu.DataType.int64())
+    def twice_sorted(v):
+        vals = sorted(v.to_pylist())
+        return vals[:2]
+
+    out = df.groupby("g").map_groups(twice_sorted(col("v"))).sort(["g", "v"]).to_pydict()
+    assert out == {"g": ["a", "a", "b", "b"], "v": [1, 1, 3, 4]}
+
+
+def test_metrics(df):
+    m = df.where(col("v") > 1).metrics().to_pydict()
+    assert "operator" in m and len(m["operator"]) >= 1
+
+
+def test_write_sql_roundtrip(df):
+    conn = sqlite3.connect(":memory:")
+    res = df.write_sql("t1", conn).to_pydict()
+    assert res["rows"] == [5]
+    assert len(conn.execute("SELECT * FROM t1").fetchall()) == 5
+    df.write_sql("t1", conn, mode="overwrite")
+    assert len(conn.execute("SELECT * FROM t1").fetchall()) == 5
+
+
+def test_write_deltalake_roundtrip(tmp_path, df):
+    tp = str(tmp_path / "tbl")
+    df.write_deltalake(tp)
+    assert daft_tpu.read_deltalake(tp).count_rows() == 5
+    df.write_deltalake(tp, mode="append")
+    assert daft_tpu.read_deltalake(tp).count_rows() == 10
+    df.write_deltalake(tp, mode="overwrite")
+    assert daft_tpu.read_deltalake(tp).count_rows() == 5
+    with pytest.raises(FileExistsError):
+        df.write_deltalake(tp, mode="error")
+
+
+def test_write_deltalake_partitioned(tmp_path, df):
+    tp = str(tmp_path / "ptbl")
+    df.write_deltalake(tp, partition_cols=["g"])
+    back = daft_tpu.read_deltalake(tp).sort("v").to_pydict()
+    assert back["g"] == ["a", "a", "b", "b", "b"]
+    # partition pruning path still yields correct subsets
+    sub = daft_tpu.read_deltalake(tp).where(col("g") == "b").to_pydict()
+    assert sorted(sub["v"]) == [3, 4, 4]
+
+
+def test_skip_existing(tmp_path, df):
+    pdir = str(tmp_path / "prev")
+    os.makedirs(pdir)
+    daft_tpu.from_pydict({"v": [1, 3], "g": ["a", "b"], "s": ["p", "r"]}) \
+        .write_parquet(pdir)
+    rem = df.skip_existing(pdir, "v")
+    assert sorted(rem.to_pydict()["v"]) == [4, 4]
