@@ -147,3 +147,21 @@ def sql_expr(text: str) -> Expression:
     from .sql import sql_expr as _sql_expr
 
     return _sql_expr(text)
+
+
+def load_extension(path: str):
+    """Load a native extension module (stable C ABI over the Arrow C Data
+    Interface — see native/include/daft_tpu_ext.h) and register its scalar
+    functions (reference: daft-ext module loading)."""
+    from .ext import load_extension as _load
+
+    return _load(path)
+
+
+def call_function(name: str, *args, **kwargs) -> Expression:
+    """Call a registered scalar function (built-in or extension-provided) as
+    an expression."""
+    from .expressions.expressions import Function
+    from .plan.builder import _to_expr
+
+    return Function(name, [_to_expr(a) for a in args], kwargs or None)

@@ -1,0 +1,234 @@
+"""Stable native extension ABI: load C/C++ modules that register scalar
+functions.
+
+Reference parity: src/daft-ext/src/abi/mod.rs (FFI_Module /
+FFI_ScalarFunction / FFI_SessionContext over the Arrow C Data Interface) and
+session.rs (define_function wiring). The contract lives in
+native/include/daft_tpu_ext.h; a module shared library exports
+
+    DaftTpuModule daft_tpu_module_magic(void);
+
+`load_extension(path)` loads it with ctypes, validates the ABI version, and
+registers each function the module defines into the engine's scalar-function
+registry — after which `daft_tpu.functions.call("name", args...)` and SQL can
+use it like any built-in. Arrays cross the boundary zero-copy via pyarrow's
+Arrow C Data Interface export/import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+import pyarrow as pa
+
+from .core.series import Series
+from .datatype import DataType, Field
+
+DAFT_TPU_ABI_VERSION = 1
+
+
+class _ArrowSchema(ctypes.Structure):
+    pass
+
+
+class _ArrowArray(ctypes.Structure):
+    pass
+
+
+_ArrowSchema._fields_ = [
+    ("format", ctypes.c_char_p),
+    ("name", ctypes.c_char_p),
+    ("metadata", ctypes.c_char_p),
+    ("flags", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("children", ctypes.POINTER(ctypes.POINTER(_ArrowSchema))),
+    ("dictionary", ctypes.POINTER(_ArrowSchema)),
+    ("release", ctypes.c_void_p),
+    ("private_data", ctypes.c_void_p),
+]
+
+_ArrowArray._fields_ = [
+    ("length", ctypes.c_int64),
+    ("null_count", ctypes.c_int64),
+    ("offset", ctypes.c_int64),
+    ("n_buffers", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("buffers", ctypes.POINTER(ctypes.c_void_p)),
+    ("children", ctypes.POINTER(ctypes.POINTER(_ArrowArray))),
+    ("dictionary", ctypes.POINTER(_ArrowArray)),
+    ("release", ctypes.c_void_p),
+    ("private_data", ctypes.c_void_p),
+]
+
+_NAME_FN = ctypes.CFUNCTYPE(ctypes.c_char_p, ctypes.c_void_p)
+_RET_FIELD_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(_ArrowSchema), ctypes.c_size_t,
+    ctypes.POINTER(_ArrowSchema), ctypes.POINTER(ctypes.c_char_p))
+_CALL_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(_ArrowArray),
+    ctypes.POINTER(_ArrowSchema), ctypes.c_size_t, ctypes.POINTER(_ArrowArray),
+    ctypes.POINTER(_ArrowSchema), ctypes.POINTER(ctypes.c_char_p))
+_FINI_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class _ScalarFunction(ctypes.Structure):
+    _fields_ = [
+        ("ctx", ctypes.c_void_p),
+        ("name", _NAME_FN),
+        ("get_return_field", _RET_FIELD_FN),
+        ("call", _CALL_FN),
+        ("fini", _FINI_FN),
+    ]
+
+
+_DEFINE_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _ScalarFunction)
+
+
+class _SessionContext(ctypes.Structure):
+    _fields_ = [
+        ("ctx", ctypes.c_void_p),
+        ("define_function", _DEFINE_FN),
+    ]
+
+
+_INIT_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(_SessionContext))
+_FREE_STRING_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+
+
+class _Module(ctypes.Structure):
+    _fields_ = [
+        ("abi_version", ctypes.c_uint32),
+        ("name", ctypes.c_char_p),
+        ("init", _INIT_FN),
+        ("free_string", _FREE_STRING_FN),
+    ]
+
+
+class ExtensionFunction:
+    """Host-side wrapper of one module function: evaluates by exporting the
+    argument arrays through the Arrow C Data Interface, calling the module,
+    and importing the result array. Registered into the scalar registry so
+    expressions and SQL can call it."""
+
+    def __init__(self, vtable: _ScalarFunction, module: "Extension"):
+        self._vt = vtable
+        self._module = module
+        self.name = vtable.name(vtable.ctx).decode()
+
+    def _err(self, errmsg: ctypes.c_char_p) -> str:
+        msg = errmsg.value.decode() if errmsg.value else "unknown extension error"
+        # let the module reclaim its allocation
+        self._module._mod.free_string(errmsg)
+        return msg
+
+    def return_field(self, fields: List[Field]) -> DataType:
+        schemas = (_ArrowSchema * max(len(fields), 1))()
+        holders = []
+        for i, f in enumerate(fields):
+            pa_field = pa.field(f.name, f.dtype.to_arrow())
+            holders.append(pa_field)
+            pa_field._export_to_c(ctypes.addressof(schemas[i]))
+        ret = _ArrowSchema()
+        errmsg = ctypes.c_char_p()
+        rc = self._vt.get_return_field(self._vt.ctx, schemas, len(fields),
+                                       ctypes.byref(ret), ctypes.byref(errmsg))
+        for i in range(len(fields)):
+            _release_schema(schemas[i])
+        if rc != 0:
+            raise ValueError(f"{self.name}: {self._err(errmsg)}")
+        out = pa.Field._import_from_c(ctypes.addressof(ret))
+        return DataType.from_arrow(out.type)
+
+    def __call__(self, series_args: List[Series], kwargs) -> Series:
+        n = len(series_args)
+        arrays = (_ArrowArray * max(n, 1))()
+        schemas = (_ArrowSchema * max(n, 1))()
+        for i, s in enumerate(series_args):
+            arr = s.to_arrow()
+            if hasattr(arr, "combine_chunks"):
+                arr = arr.combine_chunks()
+            arr._export_to_c(ctypes.addressof(arrays[i]),
+                             ctypes.addressof(schemas[i]))
+        ret_array = _ArrowArray()
+        ret_schema = _ArrowSchema()
+        errmsg = ctypes.c_char_p()
+        rc = self._vt.call(self._vt.ctx, arrays, schemas, n,
+                           ctypes.byref(ret_array), ctypes.byref(ret_schema),
+                           ctypes.byref(errmsg))
+        for i in range(n):
+            _release_array(arrays[i])
+            _release_schema(schemas[i])
+        if rc != 0:
+            raise ValueError(f"{self.name}: {self._err(errmsg)}")
+        out = pa.Array._import_from_c(ctypes.addressof(ret_array),
+                                      ctypes.addressof(ret_schema))
+        name = series_args[0].name if series_args else self.name
+        return Series.from_arrow(out, name)
+
+
+def _release_schema(s: _ArrowSchema) -> None:
+    if s.release:
+        ctypes.CFUNCTYPE(None, ctypes.POINTER(_ArrowSchema))(s.release)(ctypes.byref(s))
+
+
+def _release_array(a: _ArrowArray) -> None:
+    if a.release:
+        ctypes.CFUNCTYPE(None, ctypes.POINTER(_ArrowArray))(a.release)(ctypes.byref(a))
+
+
+class Extension:
+    """One loaded module: name, functions, and the underlying CDLL."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lib = ctypes.CDLL(path)
+        magic = getattr(self._lib, "daft_tpu_module_magic", None)
+        if magic is None:
+            raise ValueError(f"{path}: not a daft_tpu extension "
+                             f"(missing daft_tpu_module_magic)")
+        magic.restype = _Module
+        self._mod = magic()
+        if self._mod.abi_version != DAFT_TPU_ABI_VERSION:
+            raise ValueError(
+                f"{path}: ABI version {self._mod.abi_version} != "
+                f"host {DAFT_TPU_ABI_VERSION}")
+        self.name = self._mod.name.decode()
+        self.functions: Dict[str, ExtensionFunction] = {}
+
+        # host session vtable handed to the module's init()
+        def _define(_ctx, fn_vtable) -> int:
+            try:
+                # copy the struct: the parameter is only alive during the call
+                vt = _ScalarFunction()
+                ctypes.memmove(ctypes.byref(vt), ctypes.byref(fn_vtable),
+                               ctypes.sizeof(_ScalarFunction))
+                f = ExtensionFunction(vt, self)
+                self.functions[f.name] = f
+                return 0
+            except Exception:
+                return 1
+
+        self._define_cb = _DEFINE_FN(_define)  # keep alive
+        self._session = _SessionContext(ctx=None, define_function=self._define_cb)
+        rc = self._mod.init(ctypes.byref(self._session))
+        if rc != 0:
+            raise ValueError(f"{path}: module init failed ({rc})")
+
+
+def load_extension(path: str) -> Extension:
+    """Load a native extension module and register its scalar functions into
+    the engine registry (reference: daft-ext module loading + session
+    define_function)."""
+    ext = Extension(path)
+    from .functions.registry import register
+
+    for fname, f in ext.functions.items():
+        def _rt(fields, kwargs, _f=f):
+            return _f.return_field(fields)
+
+        def _host(series_list, kwargs, _f=f):
+            return _f(series_list, kwargs)
+
+        register(fname, _rt, _host)
+    return ext

@@ -1,0 +1,224 @@
+// Example daft_tpu extension module (reference parity: the reference's
+// daft-ext template cdylibs). Registers two scalar functions:
+//
+//   ext_double(x: float64|int64) -> same   — multiplies by 2
+//   ext_add(x, y: float64) -> float64      — elementwise sum
+//
+// Build:
+//   g++ -O2 -shared -fPIC -I../include example_ext.cpp -o libexample_ext.so
+//
+// Data crosses the boundary via the Arrow C Data Interface; this module
+// allocates its own result buffers and hands them to the host with a release
+// callback (the host — pyarrow — calls it when the array is dropped).
+
+#include "../include/daft_tpu_ext.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace {
+
+char* dup_cstr(const std::string& s) {
+  char* out = (char*)std::malloc(s.size() + 1);
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// ---- minimal Arrow C struct builders ------------------------------------------
+
+struct OwnedArray {
+  void* validity;
+  void* data;
+  const void* buffers[2];
+};
+
+void release_array(struct ArrowArray* a) {
+  if (!a || !a->release) return;
+  OwnedArray* o = (OwnedArray*)a->private_data;
+  if (o) {
+    std::free(o->validity);
+    std::free(o->data);
+    delete o;
+  }
+  a->release = nullptr;
+}
+
+void release_schema(struct ArrowSchema* s) {
+  if (!s || !s->release) return;
+  std::free((void*)s->format);
+  std::free((void*)s->name);
+  s->release = nullptr;
+}
+
+void make_schema(struct ArrowSchema* out, const char* format, const char* name) {
+  std::memset(out, 0, sizeof(*out));
+  out->format = dup_cstr(format);
+  out->name = dup_cstr(name ? name : "");
+  out->flags = ARROW_FLAG_NULLABLE;
+  out->release = release_schema;
+}
+
+// primitive array with optional validity bitmap (both module-allocated)
+void make_array(struct ArrowArray* out, int64_t length, int64_t null_count,
+                void* validity, void* data) {
+  std::memset(out, 0, sizeof(*out));
+  OwnedArray* o = new OwnedArray();
+  o->validity = validity;
+  o->data = data;
+  o->buffers[0] = validity;
+  o->buffers[1] = data;
+  out->length = length;
+  out->null_count = null_count;
+  out->n_buffers = 2;
+  out->buffers = o->buffers;
+  out->private_data = o;
+  out->release = release_array;
+}
+
+bool fmt_is(const struct ArrowSchema* s, const char* f) {
+  return s->format && std::strcmp(s->format, f) == 0;
+}
+
+void* copy_validity(const struct ArrowArray* a) {
+  if (!a->buffers || !a->buffers[0]) return nullptr;
+  size_t nbytes = (size_t)((a->length + a->offset + 7) / 8);
+  void* out = std::malloc(nbytes);
+  std::memcpy(out, a->buffers[0], nbytes);
+  return out;
+}
+
+// ---- ext_double ----------------------------------------------------------------
+
+const char* double_name(const void*) { return "ext_double"; }
+
+int double_ret_field(const void*, const struct ArrowSchema* args, size_t argc,
+                     struct ArrowSchema* ret, char** errmsg) {
+  if (argc != 1 || !(fmt_is(&args[0], "g") || fmt_is(&args[0], "l"))) {
+    *errmsg = dup_cstr("ext_double expects one float64 or int64 argument");
+    return 1;
+  }
+  make_schema(ret, args[0].format, "ext_double");
+  return 0;
+}
+
+int double_call(const void*, const struct ArrowArray* args,
+                const struct ArrowSchema* schemas, size_t argc,
+                struct ArrowArray* ret_array, struct ArrowSchema* ret_schema,
+                char** errmsg) {
+  if (argc != 1) {
+    *errmsg = dup_cstr("ext_double expects one argument");
+    return 1;
+  }
+  const struct ArrowArray* a = &args[0];
+  const int64_t n = a->length;
+  const bool is_float = fmt_is(&schemas[0], "g");
+  void* data = std::malloc((size_t)n * 8);
+  if (is_float) {
+    const double* in = (const double*)a->buffers[1] + a->offset;
+    double* out = (double*)data;
+    for (int64_t i = 0; i < n; i++) out[i] = in[i] * 2.0;
+  } else {
+    const int64_t* in = (const int64_t*)a->buffers[1] + a->offset;
+    int64_t* out = (int64_t*)data;
+    for (int64_t i = 0; i < n; i++) out[i] = in[i] * 2;
+  }
+  // validity: reuse input bitmap (copied; offsets folded by re-reading bits)
+  void* validity = nullptr;
+  int64_t null_count = a->null_count;
+  if (a->buffers && a->buffers[0]) {
+    const uint8_t* vin = (const uint8_t*)a->buffers[0];
+    uint8_t* vout = (uint8_t*)std::malloc((size_t)((n + 7) / 8));
+    std::memset(vout, 0, (size_t)((n + 7) / 8));
+    for (int64_t i = 0; i < n; i++) {
+      int64_t j = i + a->offset;
+      if (vin[j >> 3] & (1 << (j & 7))) vout[i >> 3] |= (1 << (i & 7));
+    }
+    validity = vout;
+  }
+  make_array(ret_array, n, null_count, validity, data);
+  make_schema(ret_schema, schemas[0].format, "ext_double");
+  return 0;
+}
+
+void noop_fini(void*) {}
+
+// ---- ext_add -------------------------------------------------------------------
+
+const char* add_name(const void*) { return "ext_add"; }
+
+int add_ret_field(const void*, const struct ArrowSchema* args, size_t argc,
+                  struct ArrowSchema* ret, char** errmsg) {
+  if (argc != 2 || !fmt_is(&args[0], "g") || !fmt_is(&args[1], "g")) {
+    *errmsg = dup_cstr("ext_add expects two float64 arguments");
+    return 1;
+  }
+  make_schema(ret, "g", "ext_add");
+  return 0;
+}
+
+int add_call(const void*, const struct ArrowArray* args,
+             const struct ArrowSchema* schemas, size_t argc,
+             struct ArrowArray* ret_array, struct ArrowSchema* ret_schema,
+             char** errmsg) {
+  if (argc != 2 || args[0].length != args[1].length) {
+    *errmsg = dup_cstr("ext_add expects two equal-length float64 arrays");
+    return 1;
+  }
+  const int64_t n = args[0].length;
+  const size_t nbytes_bitmap = (size_t)((n > 0 ? n + 7 : 8) / 8);
+  const double* x = (const double*)args[0].buffers[1] + args[0].offset;
+  const double* y = (const double*)args[1].buffers[1] + args[1].offset;
+  double* out = (double*)std::malloc((size_t)(n > 0 ? n : 1) * 8);
+  for (int64_t i = 0; i < n; i++) out[i] = x[i] + y[i];
+  // null if either input is null: AND the bitmaps
+  void* validity = nullptr;
+  int64_t null_count = 0;
+  if ((args[0].buffers && args[0].buffers[0]) || (args[1].buffers && args[1].buffers[0])) {
+    uint8_t* vout = (uint8_t*)std::malloc(nbytes_bitmap);
+    std::memset(vout, 0xFF, nbytes_bitmap);
+    for (int64_t i = 0; i < n; i++) {
+      bool ok = true;
+      for (int k = 0; k < 2; k++) {
+        const struct ArrowArray* a = &args[k];
+        if (a->buffers && a->buffers[0]) {
+          int64_t j = i + a->offset;
+          const uint8_t* v = (const uint8_t*)a->buffers[0];
+          if (!(v[j >> 3] & (1 << (j & 7)))) ok = false;
+        }
+      }
+      if (!ok) {
+        vout[i >> 3] &= ~(1 << (i & 7));
+        null_count++;
+      }
+    }
+    validity = vout;
+  }
+  make_array(ret_array, n, null_count, validity, out);
+  make_schema(ret_schema, "g", "ext_add");
+  return 0;
+}
+
+// ---- module entry --------------------------------------------------------------
+
+int module_init(DaftTpuSessionContext* session) {
+  DaftTpuScalarFunction f1 = {nullptr, double_name, double_ret_field, double_call,
+                              noop_fini};
+  if (session->define_function(session->ctx, f1) != 0) return 1;
+  DaftTpuScalarFunction f2 = {nullptr, add_name, add_ret_field, add_call, noop_fini};
+  if (session->define_function(session->ctx, f2) != 0) return 1;
+  return 0;
+}
+
+void module_free_string(char* s) { std::free(s); }
+
+}  // namespace
+
+extern "C" DaftTpuModule daft_tpu_module_magic(void) {
+  DaftTpuModule m;
+  m.abi_version = DAFT_TPU_ABI_VERSION;
+  m.name = "example_ext";
+  m.init = module_init;
+  m.free_string = module_free_string;
+  return m;
+}

@@ -1,0 +1,117 @@
+/* daft_tpu stable extension ABI (version 1).
+ *
+ * Reference parity: src/daft-ext/src/abi/mod.rs — the reference defines a
+ * repr(C) contract (FFI_Module / FFI_ScalarFunction / FFI_SessionContext)
+ * that extension cdylibs implement; functions exchange data through the
+ * Arrow C Data Interface. This header is the same contract expressed as a
+ * plain C header: a module shared library exports
+ *
+ *     DaftTpuModule daft_tpu_module_magic(void);
+ *
+ * and the host (daft_tpu/ext.py) loads it, checks the ABI version, calls
+ * init() with a session vtable, and registers every function the module
+ * defines into the engine's scalar-function registry. All array data crosses
+ * the boundary as Arrow C Data Interface structs — zero copies, zero
+ * dependencies on the host's internals.
+ */
+
+#ifndef DAFT_TPU_EXT_H
+#define DAFT_TPU_EXT_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define DAFT_TPU_ABI_VERSION 1
+#define DAFT_TPU_MODULE_MAGIC_SYMBOL "daft_tpu_module_magic"
+
+/* ---- Arrow C Data Interface (standard definition) --------------------------- */
+
+#ifndef ARROW_C_DATA_INTERFACE
+#define ARROW_C_DATA_INTERFACE
+
+#define ARROW_FLAG_DICTIONARY_ORDERED 1
+#define ARROW_FLAG_NULLABLE 2
+#define ARROW_FLAG_MAP_KEYS_SORTED 4
+
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+
+#endif /* ARROW_C_DATA_INTERFACE */
+
+/* ---- scalar function vtable ------------------------------------------------- */
+
+/* The host calls through these pointers; ctx is module-owned and opaque.
+ * Error contract: non-zero return + *errmsg set to a message the host frees
+ * via DaftTpuModule.free_string. */
+typedef struct DaftTpuScalarFunction {
+  const void* ctx;
+
+  /* Null-terminated UTF-8 function name; borrows from ctx, valid until fini. */
+  const char* (*name)(const void* ctx);
+
+  /* Output field for the given input fields (Arrow C schemas). */
+  int (*get_return_field)(const void* ctx, const struct ArrowSchema* args,
+                          size_t args_count, struct ArrowSchema* ret,
+                          char** errmsg);
+
+  /* Evaluate on Arrow arrays; writes the result array + schema. */
+  int (*call)(const void* ctx, const struct ArrowArray* args,
+              const struct ArrowSchema* args_schemas, size_t args_count,
+              struct ArrowArray* ret_array, struct ArrowSchema* ret_schema,
+              char** errmsg);
+
+  /* Free all module-side resources for this function. */
+  void (*fini)(void* ctx);
+} DaftTpuScalarFunction;
+
+/* ---- host session ----------------------------------------------------------- */
+
+typedef struct DaftTpuSessionContext {
+  void* ctx; /* host-owned, opaque */
+
+  /* Register a function; the host takes ownership of the vtable on success. */
+  int (*define_function)(void* ctx, DaftTpuScalarFunction function);
+} DaftTpuSessionContext;
+
+/* ---- module entry ----------------------------------------------------------- */
+
+typedef struct DaftTpuModule {
+  uint32_t abi_version; /* must equal DAFT_TPU_ABI_VERSION */
+  const char* name;     /* static, null-terminated */
+  int (*init)(DaftTpuSessionContext* session);
+  void (*free_string)(char* s);
+} DaftTpuModule;
+
+/* Every module exports: DaftTpuModule daft_tpu_module_magic(void); */
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DAFT_TPU_EXT_H */

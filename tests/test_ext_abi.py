@@ -1,0 +1,65 @@
+"""Native extension ABI: load a C++ module, register + evaluate its functions.
+
+Reference parity: src/daft-ext/src/abi/mod.rs (FFI_Module / FFI_ScalarFunction
+over the Arrow C Data Interface) — the contract here is
+native/include/daft_tpu_ext.h, loaded by daft_tpu/ext.py.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ext_path(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    out = str(tmp_path_factory.mktemp("ext") / "libexample_ext.so")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", f"-I{REPO}/native/include",
+         f"{REPO}/native/ext_example/example_ext.cpp", "-o", out],
+        check=True, capture_output=True)
+    return out
+
+
+def test_load_and_call(ext_path):
+    ext = daft_tpu.load_extension(ext_path)
+    assert ext.name == "example_ext"
+    assert set(ext.functions) == {"ext_double", "ext_add"}
+
+    df = daft_tpu.from_pydict({"x": [1.0, 2.0, None], "y": [10.0, 20.0, 30.0]})
+    out = df.select(
+        daft_tpu.call_function("ext_double", col("x")),
+        daft_tpu.call_function("ext_add", col("x"), col("y")).alias("s"),
+    ).to_pydict()
+    assert out["x"] == [2.0, 4.0, None]
+    assert out["s"] == [11.0, 22.0, None]
+
+
+def test_int_path_and_schema(ext_path):
+    daft_tpu.load_extension(ext_path)
+    df = daft_tpu.from_pydict({"i": [3, 4]})
+    q = df.select(daft_tpu.call_function("ext_double", col("i")))
+    assert q.schema["i"].dtype == daft_tpu.DataType.int64()
+    assert q.to_pydict()["i"] == [6, 8]
+
+
+def test_module_error_surface(ext_path):
+    daft_tpu.load_extension(ext_path)
+    df = daft_tpu.from_pydict({"s": ["a"]})
+    with pytest.raises(ValueError, match="ext_double"):
+        df.select(daft_tpu.call_function("ext_double", col("s"))).to_pydict()
+
+
+def test_bad_library_rejected(tmp_path):
+    p = tmp_path / "not_a_module.so"
+    p.write_bytes(b"not elf")
+    with pytest.raises((OSError, ValueError)):
+        daft_tpu.load_extension(str(p))
