@@ -12,6 +12,8 @@ Environment knobs:
     BENCH_SF=10           scale factor (default 1; SF10 ~60M lineitem rows)
     BENCH_QUERIES=1,..,22 query subset (default the 9-query headline set)
     BENCH_REPS=3          timed repetitions (best-of; tunnel jitter guard)
+    BENCH_SUITE=tpcds     run the TPC-DS store-sales suite instead of TPC-H
+                          (benchmarking/tpcds; default queries 3,7,19,42,52,55,96)
 
 The run reports which engine paths actually executed: device_batches counts
 real XLA dispatches of the TPU agg/join stages (ops/counters.py), so a number
@@ -31,18 +33,27 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SF = float(os.environ.get("BENCH_SF", 1.0))
 BASELINE_ROWS_PER_SEC = 50e6
-QUERIES = [int(x) for x in os.environ.get("BENCH_QUERIES", "1,3,4,5,6,10,12,14,19").split(",")]
+SUITE = os.environ.get("BENCH_SUITE", "tpch")
+_DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,42,52,55,96"}
+QUERIES = [int(x) for x in os.environ.get(
+    "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",")]
 REPS = int(os.environ.get("BENCH_REPS", 3))
 
 
 def main() -> None:
-    from benchmarking.tpch.datagen import load_dataframes
-    from benchmarking.tpch.queries import ALL_QUERIES
+    if SUITE == "tpcds":
+        from benchmarking.tpcds.datagen import load_dataframes
+        from benchmarking.tpcds.queries import ALL_QUERIES
+        fact = "store_sales"
+    else:
+        from benchmarking.tpch.datagen import load_dataframes
+        from benchmarking.tpch.queries import ALL_QUERIES
+        fact = "lineitem"
 
     from daft_tpu.ops import counters
 
     tables = {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
-    n_lineitem = tables["lineitem"].count_rows()
+    n_lineitem = tables[fact].count_rows()
 
     # warmup (compile caches, device column residency, key dictionaries)
     for q in QUERIES:
@@ -63,7 +74,7 @@ def main() -> None:
 
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
-        "metric": f"tpch_sf{SF}_{len(QUERIES)}q_rows_per_sec",
+        "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
@@ -72,7 +83,7 @@ def main() -> None:
                            + counters.device_join_batches),
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
         "sf": SF,
-        "lineitem_rows": n_lineitem,
+        "fact_rows": n_lineitem,
     }))
 
 

@@ -1,0 +1,222 @@
+"""TPC-DS data generation (synthetic, dsdgen-free) for the store_sales channel.
+
+Reference parity: benchmarking/tpcds/datagen.py (which shells out to DuckDB's
+dsdgen). Here the store_sales star (fact + 8 dimensions) is synthesized with
+deterministic numpy RNG following the public TPC-DS schema and value domains —
+row counts scale with SF like the spec (store_sales ~= 2.88M rows * SF,
+item 18k, customer 100k, store 12/SF1). Not bit-identical to dsdgen, but
+schema- and distribution-faithful enough for correctness cross-checks (the
+tests recompute every query in pandas) and throughput benchmarks.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+EPOCH = datetime.date(1970, 1, 1)
+
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women"]
+CLASSES = ["accent", "classical", "dresses", "fiction", "fragrances",
+           "infants", "pants", "portable", "reference", "rock"]
+GENDERS = ["M", "F"]
+MARITAL = ["M", "S", "D", "W", "U"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+             "Advanced Degree", "Unknown"]
+BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000", "0-500", "Unknown"]
+CREDIT = ["Low Risk", "High Risk", "Good", "Unknown"]
+STORE_NAMES = ["ought", "able", "ese", "anti", "cally", "ation", "eing", "bar"]
+STATES = ["TN", "GA", "AL", "SC", "NC", "KY", "VA", "FL", "MS", "LA"]
+CHANNELS = ["N", "Y"]
+
+
+def _money(rng, n, lo=0.5, hi=200.0):
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+
+    # ---- date_dim: 1998-01-01 .. 2002-12-31 (TPC-DS's active window) ------------
+    d0 = datetime.date(1998, 1, 1)
+    n_dates = (datetime.date(2002, 12, 31) - d0).days + 1
+    dates = [d0 + datetime.timedelta(days=i) for i in range(n_dates)]
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(n_dates, dtype=np.int64) + 2_450_000),
+        "d_date": pa.array(dates, pa.date32()),
+        "d_year": pa.array(np.array([d.year for d in dates], np.int32)),
+        "d_moy": pa.array(np.array([d.month for d in dates], np.int32)),
+        "d_dom": pa.array(np.array([d.day for d in dates], np.int32)),
+        "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in dates], np.int32)),
+        "d_day_name": pa.array([d.strftime("%A") for d in dates]),
+    })
+
+    # ---- time_dim: 86400 seconds ------------------------------------------------
+    secs = np.arange(86400, dtype=np.int64)
+    time_dim = pa.table({
+        "t_time_sk": secs,
+        "t_hour": (secs // 3600).astype(np.int32),
+        "t_minute": ((secs // 60) % 60).astype(np.int32),
+        "t_second": (secs % 60).astype(np.int32),
+    })
+
+    # ---- item -------------------------------------------------------------------
+    # dsdgen keeps item at 18k for SF1 and never below 2k at tiny SFs (the
+    # dimension does not scale linearly with the fact table)
+    n_item = max(int(18_000 * min(sf, 1.0) + 2_000 * max(sf - 1.0, 0)), 2_000)
+    isk = np.arange(n_item, dtype=np.int64) + 1
+    brand_id = rng.integers(1, 1001, n_item).astype(np.int32)
+    cat_id = rng.integers(0, len(CATEGORIES), n_item)
+    class_id = rng.integers(0, len(CLASSES), n_item)
+    item = pa.table({
+        "i_item_sk": isk,
+        "i_item_id": pa.array([f"AAAAAAAA{k:08d}" for k in isk]),
+        "i_brand_id": brand_id,
+        "i_brand": pa.array([f"brand#{b}" for b in brand_id]),
+        "i_class_id": pa.array((class_id + 1).astype(np.int32)),
+        "i_class": pa.array([CLASSES[c] for c in class_id]),
+        "i_category_id": pa.array((cat_id + 1).astype(np.int32)),
+        "i_category": pa.array([CATEGORIES[c] for c in cat_id]),
+        "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int32),
+        "i_manager_id": rng.integers(1, 101, n_item).astype(np.int32),
+        "i_current_price": _money(rng, n_item, 0.09, 99.99),
+    })
+
+    # ---- customer_demographics (fixed 1.92M in spec; scaled down) ---------------
+    n_cd = max(int(19_200 * max(sf, 0.01)), 500)
+    cd = pa.table({
+        "cd_demo_sk": np.arange(n_cd, dtype=np.int64) + 1,
+        "cd_gender": pa.array([GENDERS[i] for i in rng.integers(0, 2, n_cd)]),
+        "cd_marital_status": pa.array([MARITAL[i] for i in rng.integers(0, len(MARITAL), n_cd)]),
+        "cd_education_status": pa.array([EDUCATION[i] for i in rng.integers(0, len(EDUCATION), n_cd)]),
+        "cd_purchase_estimate": rng.integers(500, 10_000, n_cd).astype(np.int32),
+        "cd_credit_rating": pa.array([CREDIT[i] for i in rng.integers(0, len(CREDIT), n_cd)]),
+        "cd_dep_count": rng.integers(0, 7, n_cd).astype(np.int32),
+    })
+
+    # ---- household_demographics -------------------------------------------------
+    n_hd = 7_200
+    hd = pa.table({
+        "hd_demo_sk": np.arange(n_hd, dtype=np.int64) + 1,
+        "hd_income_band_sk": rng.integers(1, 21, n_hd).astype(np.int64),
+        "hd_buy_potential": pa.array([BUY_POTENTIAL[i] for i in rng.integers(0, len(BUY_POTENTIAL), n_hd)]),
+        "hd_dep_count": rng.integers(0, 10, n_hd).astype(np.int32),
+        "hd_vehicle_count": rng.integers(-1, 5, n_hd).astype(np.int32),
+    })
+
+    # ---- customer_address --------------------------------------------------------
+    n_ca = max(int(50_000 * sf), 200)
+    zips = rng.integers(10_000, 99_999, n_ca)
+    ca = pa.table({
+        "ca_address_sk": np.arange(n_ca, dtype=np.int64) + 1,
+        "ca_city": pa.array([f"city_{i}" for i in rng.integers(0, 600, n_ca)]),
+        "ca_state": pa.array([STATES[i] for i in rng.integers(0, len(STATES), n_ca)]),
+        "ca_zip": pa.array([f"{z:05d}" for z in zips]),
+        "ca_country": pa.array(["United States"] * n_ca),
+        "ca_gmt_offset": np.full(n_ca, -5.0),
+    })
+
+    # ---- customer ----------------------------------------------------------------
+    n_cust = max(int(100_000 * sf), 300)
+    csk = np.arange(n_cust, dtype=np.int64) + 1
+    customer = pa.table({
+        "c_customer_sk": csk,
+        "c_customer_id": pa.array([f"AAAAAAAA{k:08d}" for k in csk]),
+        "c_current_cdemo_sk": rng.integers(1, n_cd + 1, n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, n_hd + 1, n_cust).astype(np.int64),
+        "c_current_addr_sk": rng.integers(1, n_ca + 1, n_cust).astype(np.int64),
+        "c_first_name": pa.array([f"first{i}" for i in rng.integers(0, 5_000, n_cust)]),
+        "c_last_name": pa.array([f"last{i}" for i in rng.integers(0, 6_000, n_cust)]),
+        "c_birth_year": rng.integers(1924, 1993, n_cust).astype(np.int32),
+    })
+
+    # ---- store -------------------------------------------------------------------
+    n_store = max(int(12 * max(sf, 0.25)), 3)
+    szips = rng.integers(10_000, 99_999, n_store)
+    store = pa.table({
+        "s_store_sk": np.arange(n_store, dtype=np.int64) + 1,
+        "s_store_id": pa.array([f"AAAAAAAA{k:08d}" for k in range(1, n_store + 1)]),
+        "s_store_name": pa.array([STORE_NAMES[i % len(STORE_NAMES)] for i in range(n_store)]),
+        "s_state": pa.array([STATES[i] for i in rng.integers(0, len(STATES), n_store)]),
+        "s_zip": pa.array([f"{z:05d}" for z in szips]),
+        "s_gmt_offset": np.full(n_store, -5.0),
+    })
+
+    # ---- promotion ---------------------------------------------------------------
+    n_promo = max(int(300 * max(sf, 0.1)), 30)
+    promotion = pa.table({
+        "p_promo_sk": np.arange(n_promo, dtype=np.int64) + 1,
+        "p_promo_id": pa.array([f"AAAAAAAA{k:08d}" for k in range(1, n_promo + 1)]),
+        "p_channel_email": pa.array([CHANNELS[i] for i in rng.integers(0, 2, n_promo)]),
+        "p_channel_event": pa.array([CHANNELS[i] for i in rng.integers(0, 2, n_promo)]),
+        "p_channel_tv": pa.array([CHANNELS[i] for i in rng.integers(0, 2, n_promo)]),
+    })
+
+    # ---- store_sales fact --------------------------------------------------------
+    n_ss = int(2_880_000 * sf)
+    qty = rng.integers(1, 101, n_ss).astype(np.int32)
+    list_price = _money(rng, n_ss, 1.0, 200.0)
+    sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n_ss), 2)
+    wholesale = np.round(list_price * rng.uniform(0.3, 0.7, n_ss), 2)
+    store_sales = pa.table({
+        "ss_sold_date_sk": (rng.integers(0, n_dates, n_ss) + 2_450_000).astype(np.int64),
+        "ss_sold_time_sk": rng.integers(0, 86_400, n_ss).astype(np.int64),
+        "ss_item_sk": rng.integers(1, n_item + 1, n_ss).astype(np.int64),
+        "ss_customer_sk": rng.integers(1, n_cust + 1, n_ss).astype(np.int64),
+        "ss_cdemo_sk": rng.integers(1, n_cd + 1, n_ss).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(1, n_hd + 1, n_ss).astype(np.int64),
+        "ss_addr_sk": rng.integers(1, n_ca + 1, n_ss).astype(np.int64),
+        "ss_store_sk": rng.integers(1, n_store + 1, n_ss).astype(np.int64),
+        "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss).astype(np.int64),
+        "ss_ticket_number": rng.integers(1, max(n_ss // 10, 2), n_ss).astype(np.int64),
+        "ss_quantity": qty,
+        "ss_wholesale_cost": wholesale,
+        "ss_list_price": list_price,
+        "ss_sales_price": sales_price,
+        "ss_coupon_amt": np.round(rng.uniform(0, 500, n_ss) * (rng.random(n_ss) < 0.2), 2),
+        "ss_ext_sales_price": np.round(sales_price * qty, 2),
+        "ss_ext_list_price": np.round(list_price * qty, 2),
+        "ss_ext_wholesale_cost": np.round(wholesale * qty, 2),
+        "ss_net_profit": np.round((sales_price - wholesale) * qty, 2),
+    })
+
+    return {
+        "date_dim": date_dim, "time_dim": time_dim, "item": item,
+        "customer_demographics": cd, "household_demographics": hd,
+        "customer_address": ca, "customer": customer, "store": store,
+        "promotion": promotion, "store_sales": store_sales,
+    }
+
+
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_cache")
+
+
+def cached_tables(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
+    """Parquet-cached generation (same scheme as benchmarking/tpch/datagen.py)."""
+    import pyarrow.parquet as pq
+
+    key = f"sf{sf}_seed{seed}"
+    d = os.path.join(_CACHE_DIR, key)
+    names = ["date_dim", "time_dim", "item", "customer_demographics",
+             "household_demographics", "customer_address", "customer", "store",
+             "promotion", "store_sales"]
+    if os.path.isdir(d) and all(
+            os.path.exists(os.path.join(d, f"{n}.parquet")) for n in names):
+        return {n: pq.read_table(os.path.join(d, f"{n}.parquet")) for n in names}
+    tables = generate(sf, seed)
+    os.makedirs(d, exist_ok=True)
+    for n, t in tables.items():
+        pq.write_table(t, os.path.join(d, f"{n}.parquet"))
+    return tables
+
+
+def load_dataframes(sf: float = 0.01, seed: int = 0):
+    """Tables as in-memory daft_tpu DataFrames."""
+    import daft_tpu as dt
+
+    return {name: dt.from_arrow(t) for name, t in cached_tables(sf, seed).items()}

@@ -1,0 +1,129 @@
+"""TPC-DS store-sales-channel queries as daft_tpu dataframe programs.
+
+Reference parity: benchmarking/tpcds/queries/*.sql (the official texts; the
+numbered functions here implement the same semantics over the synthetic
+tables from datagen.py). The set covers the star-join + aggregate shapes
+(q3/q42/q52/q55), multi-dimension filters (q7), and selective count joins
+(q96) that dominate the store_sales channel.
+"""
+
+from __future__ import annotations
+
+from daft_tpu import col
+
+
+def q3(t):
+    """queries/03.sql: brand revenue by year for one manufacturer in November."""
+    return (t["store_sales"]
+            .join(t["date_dim"].where(col("d_moy") == 11),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"].where(col("i_manufact_id") == 128),
+                  left_on="ss_item_sk", right_on="i_item_sk")
+            .groupby("d_year", "i_brand", "i_brand_id")
+            .agg(col("ss_ext_sales_price").sum().alias("sum_agg"))
+            .sort(["d_year", "sum_agg", "i_brand_id"], desc=[False, True, False])
+            .limit(100)
+            .select("d_year", col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "sum_agg"))
+
+
+def q7(t):
+    """queries/07.sql: average sales stats by item for one demographic slice."""
+    cd = t["customer_demographics"].where(
+        (col("cd_gender") == "M") & (col("cd_marital_status") == "S")
+        & (col("cd_education_status") == "College"))
+    promo = t["promotion"].where(
+        (col("p_channel_email") == "N") | (col("p_channel_event") == "N"))
+    return (t["store_sales"]
+            .join(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+            .join(t["date_dim"].where(col("d_year") == 2000),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+            .join(promo, left_on="ss_promo_sk", right_on="p_promo_sk")
+            .groupby("i_item_id")
+            .agg(col("ss_quantity").mean().alias("agg1"),
+                 col("ss_list_price").mean().alias("agg2"),
+                 col("ss_coupon_amt").mean().alias("agg3"),
+                 col("ss_sales_price").mean().alias("agg4"))
+            .sort("i_item_id")
+            .limit(100))
+
+
+def q19(t):
+    """queries/19.sql: brand revenue where customer and store zips differ."""
+    return (t["store_sales"]
+            .join(t["date_dim"].where((col("d_moy") == 11) & (col("d_year") == 1998)),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"].where(col("i_manager_id") == 8),
+                  left_on="ss_item_sk", right_on="i_item_sk")
+            .join(t["customer"], left_on="ss_customer_sk", right_on="c_customer_sk")
+            .join(t["customer_address"], left_on="c_current_addr_sk",
+                  right_on="ca_address_sk")
+            .join(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+            .where(col("ca_zip").str.left(5) != col("s_zip").str.left(5))
+            .groupby("i_brand", "i_brand_id", "i_manufact_id")
+            .agg(col("ss_ext_sales_price").sum().alias("ext_price"))
+            .sort(["ext_price", "i_brand", "i_brand_id", "i_manufact_id"],
+                  desc=[True, False, False, False])
+            .limit(100)
+            .select(col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "i_manufact_id", "ext_price"))
+
+
+def q42(t):
+    """queries/42.sql: category revenue for manager 1, Nov 2000."""
+    return (t["store_sales"]
+            .join(t["date_dim"].where((col("d_moy") == 11) & (col("d_year") == 2000)),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"].where(col("i_manager_id") == 1),
+                  left_on="ss_item_sk", right_on="i_item_sk")
+            .groupby("d_year", "i_category_id", "i_category")
+            .agg(col("ss_ext_sales_price").sum().alias("total"))
+            .sort(["total", "d_year", "i_category_id", "i_category"],
+                  desc=[True, False, False, False])
+            .limit(100))
+
+
+def q52(t):
+    """queries/52.sql: brand revenue for manager 1, Nov 2000."""
+    return (t["store_sales"]
+            .join(t["date_dim"].where((col("d_moy") == 11) & (col("d_year") == 2000)),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"].where(col("i_manager_id") == 1),
+                  left_on="ss_item_sk", right_on="i_item_sk")
+            .groupby("d_year", "i_brand", "i_brand_id")
+            .agg(col("ss_ext_sales_price").sum().alias("ext_price"))
+            .sort(["d_year", "ext_price", "i_brand_id"], desc=[False, True, False])
+            .limit(100)
+            .select("d_year", col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "ext_price"))
+
+
+def q55(t):
+    """queries/55.sql: brand revenue for manager 28, Nov 1999."""
+    return (t["store_sales"]
+            .join(t["date_dim"].where((col("d_moy") == 11) & (col("d_year") == 1999)),
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .join(t["item"].where(col("i_manager_id") == 28),
+                  left_on="ss_item_sk", right_on="i_item_sk")
+            .groupby("i_brand", "i_brand_id")
+            .agg(col("ss_ext_sales_price").sum().alias("ext_price"))
+            .sort(["ext_price", "i_brand_id"], desc=[True, False])
+            .limit(100)
+            .select(col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "ext_price"))
+
+
+def q96(t):
+    """queries/96.sql: count of evening sales for one store/demographic."""
+    return (t["store_sales"]
+            .join(t["time_dim"].where((col("t_hour") == 20) & (col("t_minute") >= 30)),
+                  left_on="ss_sold_time_sk", right_on="t_time_sk")
+            .join(t["household_demographics"].where(col("hd_dep_count") == 7),
+                  left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+            .join(t["store"].where(col("s_store_name") == "ese"),
+                  left_on="ss_store_sk", right_on="s_store_sk")
+            .count())
+
+
+ALL_QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 96: q96}

@@ -1,0 +1,168 @@
+"""TPC-DS store-sales channel: every query cross-checked cell-by-cell against
+an independent pandas computation over the same synthetic tables.
+
+Reference parity: benchmarking/tpcds/ (the reference validates against DuckDB
+answers; here pandas is the independent oracle).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarking.tpcds.datagen import cached_tables, load_dataframes
+from benchmarking.tpcds.queries import ALL_QUERIES
+
+SF = 0.05
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
+
+
+@pytest.fixture(scope="module")
+def pdt():
+    return {k: t.to_pandas() for k, t in cached_tables(sf=SF, seed=0).items()}
+
+
+def _check(out_dict, expected_df):
+    got = pd.DataFrame(out_dict).reset_index(drop=True)
+    exp = expected_df.reset_index(drop=True)
+    assert list(got.columns) == list(exp.columns)
+    assert len(got) == len(exp)
+    for c in exp.columns:
+        if exp[c].dtype.kind == "f":
+            assert np.allclose(got[c].astype(float), exp[c].astype(float),
+                               rtol=1e-9, atol=1e-6, equal_nan=True), c
+        else:
+            assert got[c].tolist() == exp[c].tolist(), c
+
+
+def test_q3(tables, pdt):
+    m = pdt["store_sales"].merge(
+        pdt["date_dim"][pdt["date_dim"].d_moy == 11],
+        left_on="ss_sold_date_sk", right_on="d_date_sk").merge(
+        pdt["item"][pdt["item"].i_manufact_id == 128],
+        left_on="ss_item_sk", right_on="i_item_sk")
+    exp = (m.groupby(["d_year", "i_brand", "i_brand_id"], as_index=False)
+           .agg(sum_agg=("ss_ext_sales_price", "sum"))
+           .sort_values(["d_year", "sum_agg", "i_brand_id"],
+                        ascending=[True, False, True], kind="stable")
+           .head(100)
+           .rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+           [["d_year", "brand_id", "brand", "sum_agg"]])
+    assert len(exp) > 0, "q3 selects nothing at this SF; raise SF"
+    _check(ALL_QUERIES[3](tables).to_pydict(), exp)
+
+
+def test_q7(tables, pdt):
+    cd = pdt["customer_demographics"]
+    cd = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+            & (cd.cd_education_status == "College")]
+    promo = pdt["promotion"]
+    promo = promo[(promo.p_channel_email == "N") | (promo.p_channel_event == "N")]
+    m = (pdt["store_sales"]
+         .merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2000],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(promo, left_on="ss_promo_sk", right_on="p_promo_sk"))
+    exp = (m.groupby("i_item_id", as_index=False)
+           .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+           .sort_values("i_item_id", kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[7](tables).to_pydict(), exp)
+
+
+def test_q19(tables, pdt):
+    dd = pdt["date_dim"]
+    m = (pdt["store_sales"]
+         .merge(dd[(dd.d_moy == 11) & (dd.d_year == 1998)],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["item"][pdt["item"].i_manager_id == 8],
+                left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(pdt["customer"], left_on="ss_customer_sk", right_on="c_customer_sk")
+         .merge(pdt["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+         .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    m = m[m.ca_zip.str[:5] != m.s_zip.str[:5]]
+    exp = (m.groupby(["i_brand", "i_brand_id", "i_manufact_id"], as_index=False)
+           .agg(ext_price=("ss_ext_sales_price", "sum"))
+           .sort_values(["ext_price", "i_brand", "i_brand_id", "i_manufact_id"],
+                        ascending=[False, True, True, True], kind="stable")
+           .head(100)
+           .rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+           [["brand_id", "brand", "i_manufact_id", "ext_price"]])
+    assert len(exp) > 0
+    _check(ALL_QUERIES[19](tables).to_pydict(), exp)
+
+
+def test_q42(tables, pdt):
+    dd = pdt["date_dim"]
+    m = (pdt["store_sales"]
+         .merge(dd[(dd.d_moy == 11) & (dd.d_year == 2000)],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["item"][pdt["item"].i_manager_id == 1],
+                left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = (m.groupby(["d_year", "i_category_id", "i_category"], as_index=False)
+           .agg(total=("ss_ext_sales_price", "sum"))
+           .sort_values(["total", "d_year", "i_category_id", "i_category"],
+                        ascending=[False, True, True, True], kind="stable")
+           .head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[42](tables).to_pydict(), exp)
+
+
+def test_q52_q55(tables, pdt):
+    dd = pdt["date_dim"]
+    m52 = (pdt["store_sales"]
+           .merge(dd[(dd.d_moy == 11) & (dd.d_year == 2000)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(pdt["item"][pdt["item"].i_manager_id == 1],
+                  left_on="ss_item_sk", right_on="i_item_sk"))
+    exp52 = (m52.groupby(["d_year", "i_brand", "i_brand_id"], as_index=False)
+             .agg(ext_price=("ss_ext_sales_price", "sum"))
+             .sort_values(["d_year", "ext_price", "i_brand_id"],
+                          ascending=[True, False, True], kind="stable")
+             .head(100)
+             .rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+             [["d_year", "brand_id", "brand", "ext_price"]])
+    assert len(exp52) > 0
+    _check(ALL_QUERIES[52](tables).to_pydict(), exp52)
+
+    m55 = (pdt["store_sales"]
+           .merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(pdt["item"][pdt["item"].i_manager_id == 28],
+                  left_on="ss_item_sk", right_on="i_item_sk"))
+    exp55 = (m55.groupby(["i_brand", "i_brand_id"], as_index=False)
+             .agg(ext_price=("ss_ext_sales_price", "sum"))
+             .sort_values(["ext_price", "i_brand_id"],
+                          ascending=[False, True], kind="stable")
+             .head(100)
+             .rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+             [["brand_id", "brand", "ext_price"]])
+    assert len(exp55) > 0
+    _check(ALL_QUERIES[55](tables).to_pydict(), exp55)
+
+
+def test_q96(tables, pdt):
+    td = pdt["time_dim"]
+    hd = pdt["household_demographics"]
+    st = pdt["store"]
+    m = (pdt["store_sales"]
+         .merge(td[(td.t_hour == 20) & (td.t_minute >= 30)],
+                left_on="ss_sold_time_sk", right_on="t_time_sk")
+         .merge(hd[hd.hd_dep_count == 7], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+         .merge(st[st.s_store_name == "ese"], left_on="ss_store_sk",
+                right_on="s_store_sk"))
+    got = ALL_QUERIES[96](tables).to_pydict()
+    assert got["count"][0] == len(m)
+    assert len(m) > 0
