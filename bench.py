@@ -11,7 +11,7 @@ scaled to one chip.
 Environment knobs:
     BENCH_SF=10           scale factor (default 1; SF10 ~60M lineitem rows)
     BENCH_QUERIES=1,..,22 query subset (default the 9-query headline set)
-    BENCH_REPS=3          timed repetitions (best-of; tunnel jitter guard)
+    BENCH_REPS=5          timed repetitions (best-of; tunnel jitter guard)
     BENCH_SUITE=tpcds     run the TPC-DS store-sales suite instead of TPC-H
                           (benchmarking/tpcds; default queries 3,7,19,42,52,55,96)
 
@@ -37,7 +37,7 @@ SUITE = os.environ.get("BENCH_SUITE", "tpch")
 _DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,42,52,55,96"}
 QUERIES = [int(x) for x in os.environ.get(
     "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",")]
-REPS = int(os.environ.get("BENCH_REPS", 3))
+REPS = int(os.environ.get("BENCH_REPS", 5))
 
 
 def main() -> None:

@@ -149,6 +149,9 @@ class ProbeTable:
 
         self.null_equals_null = null_equals_null
         self.n_right = len(right_keys[0]) if right_keys else 0
+        self._single_vals = None   # raw int64 build values (single-key case)
+        self._single_valid = None
+        self._direct = None        # unique-key direct lookup, built lazily
         self._dtypes = []
         self._kinds = []
         self._lookups = []  # per col: ("dense", lo, hi) | ("sorted", uniq) | ("index", pd.Index) | ("null",)
@@ -166,7 +169,10 @@ class ProbeTable:
                 self._lookups.append(("null",))
             elif kind in ("num", "hash"):
                 vals = vals.astype(np.int64, copy=False)
-                vv = vals[valid] if not valid.all() else vals
+                all_valid = valid.all()
+                vv = vals[valid] if not all_valid else vals
+                if len(right_keys) == 1 and all_valid:
+                    self._single_vals = vv
                 lo = int(vv.min()) if len(vv) else 0
                 hi = int(vv.max()) if len(vv) else -1
                 domain = hi - lo + 1
@@ -234,7 +240,7 @@ class ProbeTable:
         G = int(codes.max(initial=-1)) + 1
         built = native_bucket_build(codes, G)
         if built is not None:
-            self._counts, self._starts = built
+            self._counts, self._starts, self.max_count = built
             if G == 0:
                 self._counts = np.zeros(1, dtype=np.int64)
                 self._starts = np.zeros(1, dtype=np.int64)
@@ -244,6 +250,7 @@ class ProbeTable:
                 np.bincount(codes[pos], minlength=max(G, 1)), dtype=np.int64)
             self._starts = np.ascontiguousarray(
                 np.concatenate([[0], np.cumsum(self._counts)[:-1]]), dtype=np.int64)
+            self.max_count = int(self._counts.max(initial=0))
         self._num_codes = G
         # bucket rows (the argsort) are only needed for inner/left row fills —
         # built lazily so semi/anti joins never pay for them
@@ -269,6 +276,68 @@ class ProbeTable:
                         rows = rows[order]
                     self._bucket_rows = np.ascontiguousarray(rows, dtype=np.int64)
         return self._bucket_rows
+
+    def _ensure_direct(self):
+        """Unique-build-key direct lookup (value -> build row in ONE random
+        access): a dense row table or a value->row pairmap. Built lazily on
+        the first qualifying probe; None when the shape doesn't qualify."""
+        if self._direct is None:
+            from ...native import get_lib, native_i64_map_build
+
+            lk = self._lookups[0]
+            if lk[0] == "dense":
+                lo, hi = lk[1], lk[2]
+                codes = self._joint_codes
+                table = np.full(hi - lo + 1, -1, dtype=np.int64)
+                pos = codes >= 0
+                table[codes[pos]] = np.flatnonzero(pos)
+                self._direct = ("dense", lo, hi, np.ascontiguousarray(table))
+            elif lk[0] == "hashmap" and self._single_vals is not None \
+                    and get_lib() is not None:
+                hm = native_i64_map_build(self._single_vals)
+                self._direct = ("pairmap", hm[0], hm[1])
+            else:
+                self._direct = ("none",)
+        return None if self._direct[0] == "none" else self._direct
+
+    def _probe_unique(self, left_keys: list, how: str):
+        """max_count == 1 fast path: one access per probe row, no bucket
+        CSR walk. Same match set and output order as the general path."""
+        if (self.max_count != 1 or len(self._lookups) != 1
+                or self.null_equals_null
+                or self._lookups[0][0] not in ("dense", "hashmap")
+                or how not in ("inner", "left", "semi", "anti")):
+            return None
+        direct = self._ensure_direct()
+        if direct is None:
+            return None
+        from ...native import native_probe_unique
+
+        ls = left_keys[0]
+        target = self._dtypes[0]
+        if ls.dtype != target:
+            ls = ls.cast(target)
+        kind, vals, valid = canonical_key_values(ls)
+        if kind not in ("num", "hash"):
+            return None
+        vals = vals.astype(np.int64, copy=False)
+        vmask = None if valid.all() else valid
+        res = native_probe_unique(vals, vmask, direct)
+        if res is None:
+            return None
+        ridx_full, ml, mr = res
+        if how == "inner":
+            return ml, mr
+        if how == "semi":
+            return ml, np.full(len(ml), -1, dtype=np.int64)
+        if how == "anti":
+            lidx = np.flatnonzero(ridx_full < 0).astype(np.int64)
+            return lidx, np.full(len(lidx), -1, dtype=np.int64)
+        # left: matched pairs first, then unmatched left rows (general-path order)
+        unmatched_l = np.flatnonzero(ridx_full < 0).astype(np.int64)
+        lidx = np.concatenate([ml, unmatched_l])
+        ridx = np.concatenate([mr, np.full(len(unmatched_l), -1, dtype=np.int64)])
+        return lidx, ridx
 
     def _probe_fused(self, left_keys: list, how: str):
         """Single-int64-key fast path: C does value->code->match-count in one
@@ -371,6 +440,9 @@ class ProbeTable:
     def probe(self, left_keys: list, how: str) -> Tuple[np.ndarray, np.ndarray]:
         from ...native import native_probe
 
+        uniq = self._probe_unique(left_keys, how)
+        if uniq is not None:
+            return uniq
         fused = self._probe_fused(left_keys, how)
         if fused is not None:
             return fused

@@ -102,8 +102,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.probe_fill.restype = None
     lib.probe_fill.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p,
                                i64p, i64p]
-    lib.bucket_build.restype = None
+    lib.bucket_build.restype = ctypes.c_int64
     lib.bucket_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.probe_unique_pair.restype = ctypes.c_int64
+    lib.probe_unique_pair.argtypes = [i64p, u8p, ctypes.c_int64, i64p,
+                                      ctypes.c_int64, i64p, i64p, i64p]
+    lib.probe_unique_dense.restype = ctypes.c_int64
+    lib.probe_unique_dense.argtypes = [i64p, u8p, ctypes.c_int64, ctypes.c_int64,
+                                       ctypes.c_int64, i64p, i64p, i64p, i64p]
     lib.probe_lookup_count_hash.restype = ctypes.c_int64
     lib.probe_lookup_count_hash.argtypes = [i64p, u8p, ctypes.c_int64, i64p, i64p,
                                             ctypes.c_int64, i64p, ctypes.c_int64,
@@ -292,8 +298,10 @@ def native_i64_map_lookup(slots: np.ndarray, cap: int,
 
 
 def native_bucket_build(codes: np.ndarray, num_codes: int) -> Optional[tuple]:
-    """(counts, offsets) per joint code in one C pass — the ProbeTable build
-    side of native_probe. codes < 0 are skipped. None if lib unavailable."""
+    """(counts, offsets, max_count) per joint code in one C pass — the
+    ProbeTable build side of native_probe. codes < 0 are skipped.
+    max_count == 1 signals unique build keys (direct-lookup joins legal).
+    None if lib unavailable."""
     lib = get_lib()
     if lib is None:
         return None
@@ -301,10 +309,10 @@ def native_bucket_build(codes: np.ndarray, num_codes: int) -> Optional[tuple]:
     g = max(int(num_codes), 1)
     counts = np.empty(g, dtype=np.int64)
     offsets = np.empty(g, dtype=np.int64)
-    lib.bucket_build(_p(codes, ctypes.c_int64), len(codes), g,
-                     _p(counts, ctypes.c_int64), _p(offsets, ctypes.c_int64))
+    mx = lib.bucket_build(_p(codes, ctypes.c_int64), len(codes), g,
+                          _p(counts, ctypes.c_int64), _p(offsets, ctypes.c_int64))
     return counts[:num_codes] if num_codes else counts[:0], \
-        offsets[:num_codes] if num_codes else offsets[:0]
+        offsets[:num_codes] if num_codes else offsets[:0], int(mx)
 
 
 def native_bucket_scatter(codes: np.ndarray, num_codes: int,
@@ -368,3 +376,35 @@ def native_probe_fill(codes: np.ndarray, num_codes: int, bucket_offsets: np.ndar
                    _p(bucket_rows, ctypes.c_int64), _p(out_l, ctypes.c_int64),
                    _p(out_r, ctypes.c_int64))
     return out_l[:total], out_r[:total]
+
+
+def native_probe_unique(vals: np.ndarray, valid: Optional[np.ndarray],
+                        direct) -> Optional[tuple]:
+    """Unique-build-key probe: one random access per row. `direct` is
+    ("pairmap", slots, cap) over value -> build row, or
+    ("dense", lo, hi, row_of_code). Returns (ridx_full, matched_l, matched_r)
+    or None if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    n = len(vals)
+    vp = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vp = _p(valid, ctypes.c_uint8)
+    ridx_full = np.empty(max(n, 1), dtype=np.int64)
+    out_l = np.empty(max(n, 1), dtype=np.int64)
+    out_r = np.empty(max(n, 1), dtype=np.int64)
+    if direct[0] == "pairmap":
+        m = lib.probe_unique_pair(_p(vals, ctypes.c_int64), vp, n,
+                                  _p(direct[1], ctypes.c_int64), int(direct[2]),
+                                  _p(ridx_full, ctypes.c_int64),
+                                  _p(out_l, ctypes.c_int64), _p(out_r, ctypes.c_int64))
+    else:
+        m = lib.probe_unique_dense(_p(vals, ctypes.c_int64), vp, n,
+                                   int(direct[1]), int(direct[2]),
+                                   _p(direct[3], ctypes.c_int64),
+                                   _p(ridx_full, ctypes.c_int64),
+                                   _p(out_l, ctypes.c_int64), _p(out_r, ctypes.c_int64))
+    return ridx_full[:n], out_l[:m], out_r[:m]

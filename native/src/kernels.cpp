@@ -458,18 +458,79 @@ int64_t probe_lookup_count_dense(const int64_t* vals, const uint8_t* valid,
 // offsets. codes < 0 (null / unmatchable) are skipped. Replaces the Python
 // np.bincount + np.cumsum pair, which allocates and scans the full code
 // domain twice for dense join keys.
-void bucket_build(const int64_t* codes, int64_t n, int64_t num_codes,
-                  int64_t* counts /* size num_codes */,
-                  int64_t* offsets /* size num_codes */) {
+int64_t bucket_build(const int64_t* codes, int64_t n, int64_t num_codes,
+                     int64_t* counts /* size num_codes */,
+                     int64_t* offsets /* size num_codes */) {
   memset(counts, 0, sizeof(int64_t) * num_codes);
   for (int64_t i = 0; i < n; i++) {
     if (codes[i] >= 0) counts[codes[i]]++;
   }
-  int64_t acc = 0;
+  int64_t acc = 0, mx = 0;
   for (int64_t g = 0; g < num_codes; g++) {
     offsets[g] = acc;
     acc += counts[g];
+    if (counts[g] > mx) mx = counts[g];
   }
+  return mx;  // max bucket size: 1 => unique build keys => direct-lookup joins
+}
+
+// Unique-build-key probe: ONE random access per probe row. slots is a
+// (key, build_row) pairmap over ALL valid build rows (legal only when keys
+// are unique — bucket_build reported max count 1). Writes the full per-row
+// build-row array (-1 = no match) AND the compacted matched (l, r) pairs in
+// the same pass; returns the match count. This replaces the general
+// lookup -> counts -> offsets -> bucket_rows chain (3-4 dependent random
+// accesses per row) for the dimension-join shape where keys are unique.
+int64_t probe_unique_pair(const int64_t* vals, const uint8_t* valid, int64_t n,
+                          const int64_t* slots, int64_t cap,
+                          int64_t* ridx_full, int64_t* out_l, int64_t* out_r) {
+  const uint64_t mask = (uint64_t)cap - 1;
+  const int64_t D = 24;
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (i + D < n && (!valid || valid[i + D]))
+      __builtin_prefetch(&slots[2 * (mix64((uint64_t)vals[i + D]) & mask)], 0, 1);
+    int64_t r = -1;
+    if (!valid || valid[i]) {
+      const int64_t v = vals[i];
+      uint64_t h = mix64((uint64_t)v) & mask;
+      while (slots[2 * h + 1] != -1) {
+        if (slots[2 * h] == v) { r = slots[2 * h + 1]; break; }
+        h = (h + 1) & mask;
+      }
+    }
+    ridx_full[i] = r;
+    if (r >= 0) {
+      out_l[m] = i;
+      out_r[m] = r;
+      m++;
+    }
+  }
+  return m;
+}
+
+// Dense-domain variant: row_of_code[v - lo] is the build row (-1 = absent).
+int64_t probe_unique_dense(const int64_t* vals, const uint8_t* valid, int64_t n,
+                           int64_t lo, int64_t hi, const int64_t* row_of_code,
+                           int64_t* ridx_full, int64_t* out_l, int64_t* out_r) {
+  const int64_t D = 24;
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (i + D < n) {
+      const int64_t vp = vals[i + D];
+      if (vp >= lo && vp <= hi) __builtin_prefetch(&row_of_code[vp - lo], 0, 1);
+    }
+    int64_t r = -1;
+    if ((!valid || valid[i]) && vals[i] >= lo && vals[i] <= hi)
+      r = row_of_code[vals[i] - lo];
+    ridx_full[i] = r;
+    if (r >= 0) {
+      out_l[m] = i;
+      out_r[m] = r;
+      m++;
+    }
+  }
+  return m;
 }
 
 // Stable counting-sort scatter of build rows into their buckets — O(n + G),
