@@ -183,14 +183,16 @@ class RecordBatch:
         if self._columns and self._num_rows >= 65_536 and mask._pyobjs is None:
             import pyarrow.compute as pc
 
-            # null mask entries drop (like null_selection_behavior="drop");
-            # fill first so pyarrow hands back a typed bool buffer, not objects
-            arr = mask._arrow
-            if arr.null_count:
-                arr = pc.fill_null(arr, False)
-            keep = arr.to_numpy(zero_copy_only=False)
-            if np.count_nonzero(keep) <= self._num_rows // 2:
-                idx = np.flatnonzero(keep)
+            from ..native import native_mask_indices
+
+            # null mask entries drop (like null_selection_behavior="drop")
+            idx = native_mask_indices(mask._arrow)
+            if idx is None:
+                arr = mask._arrow
+                if arr.null_count:
+                    arr = pc.fill_null(arr, False)
+                idx = np.flatnonzero(arr.to_numpy(zero_copy_only=False))
+            if len(idx) <= self._num_rows // 2:
                 cols = [c.take(idx) for c in self._columns]
                 return RecordBatch(self._schema, cols, len(idx))
         cols = [c.filter(mask) for c in self._columns]

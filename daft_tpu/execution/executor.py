@@ -1322,9 +1322,14 @@ def _selection_vector(b, mask):
             else np.empty(0, dtype=np.int64)
     if mask._pyobjs is not None:
         return None
-    import pyarrow.compute as pc
+    from ..native import native_mask_indices
 
     arr = mask._arrow
+    idx = native_mask_indices(arr)
+    if idx is not None:
+        return idx
+    import pyarrow.compute as pc
+
     if arr.null_count:
         arr = pc.fill_null(arr, False)
     return np.flatnonzero(arr.to_numpy(zero_copy_only=False)).astype(np.int64)

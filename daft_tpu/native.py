@@ -104,6 +104,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                i64p, i64p]
     lib.bucket_build.restype = ctypes.c_int64
     lib.bucket_build.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+    lib.bool_mask_indices.restype = ctypes.c_int64
+    lib.bool_mask_indices.argtypes = [u8p, u8p, ctypes.c_int64, ctypes.c_int64, i64p]
     lib.probe_unique_pair.restype = ctypes.c_int64
     lib.probe_unique_pair.argtypes = [i64p, u8p, ctypes.c_int64, i64p,
                                       ctypes.c_int64, i64p, i64p, i64p]
@@ -408,3 +410,31 @@ def native_probe_unique(vals: np.ndarray, valid: Optional[np.ndarray],
                                    _p(ridx_full, ctypes.c_int64),
                                    _p(out_l, ctypes.c_int64), _p(out_r, ctypes.c_int64))
     return ridx_full[:n], out_l[:m], out_r[:m]
+
+
+def native_mask_indices(arr) -> Optional[np.ndarray]:
+    """Selection vector (int64 row indices) of a pyarrow BooleanArray in one
+    word-wise C pass over the bitmaps; nulls drop. None if lib unavailable or
+    the array isn't a plain boolean array."""
+    import pyarrow as pa
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    if isinstance(arr, pa.ChunkedArray):
+        if arr.num_chunks == 1:
+            arr = arr.chunk(0)
+        else:
+            arr = arr.combine_chunks()
+    if not isinstance(arr, pa.BooleanArray):
+        return None
+    bufs = arr.buffers()
+    if len(bufs) != 2 or bufs[1] is None:
+        return None
+    bits = ctypes.cast(bufs[1].address, ctypes.POINTER(ctypes.c_uint8))
+    validity = ctypes.cast(bufs[0].address, ctypes.POINTER(ctypes.c_uint8)) \
+        if bufs[0] is not None else None
+    out = np.empty(max(len(arr), 1), dtype=np.int64)
+    m = lib.bool_mask_indices(bits, validity, arr.offset, len(arr),
+                              _p(out, ctypes.c_int64))
+    return out[:m]

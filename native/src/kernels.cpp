@@ -307,6 +307,49 @@ void i64_map_lookup(const int64_t* slot_keys, const int64_t* slot_vals, int64_t 
   }
 }
 
+// Arrow boolean bitmap -> selection vector in one word-wise pass (replaces
+// the Python fill_null -> to_numpy(bytes) -> flatnonzero chain, which
+// materializes a byte mask and scans twice). Emits row indices where
+// value bit is set AND validity bit (if present) is set; returns the count.
+int64_t bool_mask_indices(const uint8_t* bits, const uint8_t* validity,
+                          int64_t offset, int64_t n, int64_t* out) {
+  int64_t m = 0;
+  int64_t i = 0;
+  // head: unaligned bits until offset+i is a multiple of 64
+  while (i < n && ((offset + i) & 63) != 0) {
+    const int64_t j = offset + i;
+    bool v = bits[j >> 3] & (1u << (j & 7));
+    if (v && validity) v = validity[j >> 3] & (1u << (j & 7));
+    if (v) out[m++] = i;
+    i++;
+  }
+  // body: 64 rows per iteration, iterate set bits only
+  while (i + 64 <= n) {
+    const int64_t w = (offset + i) >> 6;
+    uint64_t word;
+    memcpy(&word, ((const uint64_t*)bits) + w, 8);
+    if (validity) {
+      uint64_t vw;
+      memcpy(&vw, ((const uint64_t*)validity) + w, 8);
+      word &= vw;
+    }
+    while (word) {
+      out[m++] = i + __builtin_ctzll(word);
+      word &= word - 1;
+    }
+    i += 64;
+  }
+  // tail
+  while (i < n) {
+    const int64_t j = offset + i;
+    bool v = bits[j >> 3] & (1u << (j & 7));
+    if (v && validity) v = validity[j >> 3] & (1u << (j & 7));
+    if (v) out[m++] = i;
+    i++;
+  }
+  return m;
+}
+
 // Interleaved (key,val) pair layout: one cache line serves both the key check
 // and the value read, halving the random accesses per probe vs the split
 // slot_keys/slot_vals arrays above. slots[2h] = key, slots[2h+1] = val
