@@ -63,6 +63,7 @@ _SERIES_AGG = {
     "bool_and": lambda s: s.bool_and(),
     "bool_or": lambda s: s.bool_or(),
     "list": lambda s: s.agg_list(),
+    "product": lambda s: s.product(),
     "set": lambda s: s.agg_set(),
     "concat": lambda s: s.agg_concat(),
     "approx_count_distinct": lambda s: s.approx_count_distinct(),
@@ -89,6 +90,8 @@ def ungrouped_agg(batch: RecordBatch, aggs: Sequence[Expression]) -> RecordBatch
             res = s.any_value(inner.params.get("ignore_nulls", False))
         elif op in ("stddev", "var"):
             res = getattr(s, op)(ddof=inner.params.get("ddof", 0))
+        elif op == "string_agg":
+            res = s.string_agg(inner.params.get("delimiter", ""))
         elif op == "approx_percentile":
             res = s.approx_percentile(inner.params["percentiles"],
                                       inner.params.get("alpha", 0.01))
@@ -276,6 +279,27 @@ def _grouped_agg_one(s: Series, agg: AggExpr, order: np.ndarray, starts: np.ndar
         else:
             cnt = np.zeros(num_groups, np.int64)
         return Series.from_numpy(cnt.astype(np.uint64), s.name, DataType.uint64())
+
+    if op == "product":
+        vals = s.to_numpy()[order]
+        num = vals.astype(np.float64) if out_dtype.is_floating() else vals.astype(np.int64)
+        filled = np.where(valid, num, num.dtype.type(1))
+        res = np.multiply.reduceat(filled, starts) if num_groups else np.empty(0, filled.dtype)
+        res = unseg(res)
+        vc = unseg(valid_counts)
+        out = Series.from_numpy(res, s.name, out_dtype)
+        return out.with_validity(vc > 0)
+
+    if op == "string_agg":
+        delim = agg.params.get("delimiter", "")
+        py = s.take(order).to_pylist()
+        bounds = list(starts) + [len(order)]
+        rows = []
+        for g in range(num_groups):
+            vals_g = [v for v in py[bounds[g]:bounds[g + 1]] if v is not None]
+            rows.append(delim.join(vals_g) if vals_g else None)
+        out = Series.from_pylist(rows, s.name, DataType.string())
+        return out.take(_invert_to_group_order(seg_gid, num_groups))
 
     if op in ("bool_and", "bool_or"):
         vals = s.to_numpy()[order]

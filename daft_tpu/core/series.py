@@ -625,6 +625,37 @@ class Series:
         v = pc.sum(self._arrow).as_py()
         return self._scalar(v, out_dt)
 
+    def product(self) -> "Series":
+        """Product of valid values; null when no valid values (reference:
+        Expression.product)."""
+        self._require_arrow("product")
+        out_dt = _agg_sum_dtype(self._dtype)
+        valid = self.validity_numpy()
+        if not valid.any():
+            return Series.full_null(self._name, out_dt, 1)
+        vals = self.to_numpy()[valid]
+        if out_dt.is_floating():
+            v = float(np.prod(vals.astype(np.float64)))
+        else:
+            v = int(np.prod(vals.astype(np.int64)))
+        return self._scalar(v, out_dt)
+
+    def string_agg(self, delimiter: str = "") -> "Series":
+        """Join valid string values with the delimiter (reference:
+        Expression.string_agg)."""
+        vals = [v for v in self.to_pylist() if v is not None]
+        return self._scalar(delimiter.join(vals) if vals else None, DataType.string())
+
+    def with_validity(self, valid: np.ndarray) -> "Series":
+        """Replace the validity mask (rows where valid is False become null)."""
+        if self._pyobjs is not None:
+            return Series(self._name, self._dtype, None,
+                          [v if k else None for v, k in zip(self._pyobjs, valid)])
+        arr = self._arrow
+        out = pc.if_else(pa.array(np.asarray(valid, dtype=bool)), arr,
+                         pa.nulls(len(self), arr.type if not isinstance(arr, pa.ChunkedArray) else arr.type))
+        return Series(self._name, self._dtype, _combine(out))
+
     def mean(self) -> "Series":
         self._require_arrow("mean")
         v = pc.mean(self._arrow).as_py() if len(self._arrow) else None

@@ -111,7 +111,24 @@ class DataFrame:
 
     # ---- transforms --------------------------------------------------------------
     def select(self, *columns: ColumnInput) -> "DataFrame":
-        return self._next(self._builder.select(_to_exprs(columns)))
+        exprs = _to_exprs(columns)
+        # expand unnest() markers into one column per struct field
+        from ..expressions.expressions import Unnest
+
+        if any(isinstance(e, Unnest) for e in exprs):
+            schema = self.schema
+            expanded = []
+            for e in exprs:
+                if isinstance(e, Unnest):
+                    dt = e.child.to_field(schema).dtype
+                    if not dt.is_struct():
+                        raise ValueError(f"unnest() requires a struct column, got {dt}")
+                    for fname, _ft in dt.struct_fields:
+                        expanded.append(e.child.struct.get(fname).alias(fname))
+                else:
+                    expanded.append(e)
+            exprs = expanded
+        return self._next(self._builder.select(exprs))
 
     def with_column(self, name: str, expr: ColumnInput) -> "DataFrame":
         return self.with_columns({name: expr})

@@ -127,6 +127,10 @@ def eval_expression(batch, expr: Expression) -> Series:
     if isinstance(expr, UdfCall):
         args = [eval_expression(batch, a) for a in expr.args]
         return expr.eval_host(args, batch.num_rows)
+    if hasattr(expr, "_resolve"):
+        # dtype-dispatched flat-API nodes (Expression.length/get/contains/...)
+        # bind to a concrete namespace op once the input schema is known
+        return eval_expression(batch, expr._resolve(batch.schema))
     raise ValueError(f"cannot evaluate expression node {type(expr).__name__}")
 
 

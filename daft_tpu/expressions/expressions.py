@@ -662,7 +662,8 @@ class Function(Expression):
 
 _AGG_OPS = {
     "sum", "mean", "min", "max", "count", "count_distinct", "any_value", "stddev",
-    "var", "skew", "bool_and", "bool_or", "list", "set", "concat", "approx_count_distinct",
+    "var", "skew", "bool_and", "bool_or", "list", "set", "concat", "product",
+    "string_agg", "approx_count_distinct",
     "approx_percentile",
 }
 
@@ -687,7 +688,7 @@ class AggExpr(Expression):
     def to_field(self, schema: Schema) -> Field:
         f = self.child.to_field(schema)
         op = self.op
-        if op == "sum":
+        if op in ("sum", "product"):
             from ..core.series import _agg_sum_dtype
 
             return Field(f.name, _agg_sum_dtype(f.dtype))
@@ -699,6 +700,8 @@ class AggExpr(Expression):
             return Field(f.name, f.dtype)
         if op in ("bool_and", "bool_or"):
             return Field(f.name, DataType.bool())
+        if op == "string_agg":
+            return Field(f.name, DataType.string())
         if op in ("list", "set"):
             return Field(f.name, DataType.list(f.dtype))
         if op == "concat":
@@ -1262,3 +1265,468 @@ class JsonNamespace(_Namespace):
 
     def query(self, path: str):
         return self._e._fn("json_query", path=path)
+
+
+# ======================================================================================
+# Flat top-level API (reference: daft/expressions/expressions.py exposes the
+# namespace operations directly on Expression as well — upper() == str.upper(),
+# day() == dt.day(), list_sum() == list.sum(), ... — so both call styles work)
+# ======================================================================================
+
+_FLAT_NAMESPACE_ALIASES = {
+    # name -> (namespace attr, namespace method)
+    "capitalize": ("str", "capitalize"), "count_matches": ("str", "count_matches"),
+    "endswith": ("str", "endswith"), "find": ("str", "find"),
+    "ilike": ("str", "ilike"), "left": ("str", "left"),
+    "like": ("str", "like"), "lower": ("str", "lower"),
+    "lpad": ("str", "lpad"), "lstrip": ("str", "lstrip"),
+    "lengths_bytes": ("str", "length_bytes"), "length_bytes": ("str", "length_bytes"),
+    "normalize": ("str", "normalize"), "repeat": ("str", "repeat"),
+    "replace": ("str", "replace"), "reverse": ("str", "reverse"),
+    "right": ("str", "right"), "rpad": ("str", "rpad"),
+    "rstrip": ("str", "rstrip"), "split": ("str", "split"),
+    "startswith": ("str", "startswith"), "strip": ("str", "strip"),
+    "substr": ("str", "substr"), "upper": ("str", "upper"),
+    "to_date": ("str", "to_date"), "to_datetime": ("str", "to_datetime"),
+    "jaccard_similarity": ("str", "jaccard_similarity"),
+    "regexp": ("str", "match"), "regexp_extract": ("str", "extract"),
+    "regexp_extract_all": ("str", "extract_all"),
+    "date": ("dt", "date"), "day": ("dt", "day"),
+    "day_of_month": ("dt", "day_of_month"), "day_of_week": ("dt", "day_of_week"),
+    "day_of_year": ("dt", "day_of_year"), "hour": ("dt", "hour"),
+    "microsecond": ("dt", "microsecond"), "millisecond": ("dt", "millisecond"),
+    "minute": ("dt", "minute"), "month": ("dt", "month"),
+    "quarter": ("dt", "quarter"), "second": ("dt", "second"),
+    "time": ("dt", "time"), "week_of_year": ("dt", "week_of_year"),
+    "year": ("dt", "year"), "strftime": ("dt", "strftime"),
+    "to_unix_epoch": ("dt", "to_unix_epoch"), "date_trunc": ("dt", "truncate"),
+    "fill_nan": ("float", "fill_nan"), "is_inf": ("float", "is_inf"),
+    "is_nan": ("float", "is_nan"), "not_nan": ("float", "not_nan"),
+    "list_contains": ("list", "contains"), "list_count": ("list", "count"),
+    "list_distinct": ("list", "distinct"), "list_join": ("list", "join"),
+    "list_max": ("list", "max"), "list_mean": ("list", "mean"),
+    "list_min": ("list", "min"), "list_sort": ("list", "sort"),
+    "list_sum": ("list", "sum"), "value_counts": ("list", "value_counts"),
+    "chunk": ("list", "chunk"),
+    "cosine_distance": ("embedding", "cosine_distance"),
+    "euclidean_distance": ("embedding", "euclidean_distance"),
+    "dot_product": ("embedding", "dot"),
+    "crop": ("image", "crop"), "resize": ("image", "resize"),
+    "convert_image": ("image", "to_mode"), "encode_image": ("image", "encode"),
+    "decode_image": ("image", "decode"), "image_to_tensor": ("image", "to_fixed_shape"),
+    "download": ("url", "download"), "upload": ("url", "upload"),
+    "map_get": ("map", "get"), "jq": ("json", "query"),
+}
+
+_FLAT_REGISTRY_FNS = [
+    # direct registry calls: name -> registered function
+    "arccosh", "arcsinh", "arctanh", "arctan2", "cbrt", "cosh", "sinh", "tanh",
+    "cot", "sec", "csc", "degrees", "radians", "expm1", "log1p",
+    "to_camel_case", "to_snake_case", "to_kebab_case", "to_title_case",
+    "to_upper_camel_case", "to_upper_snake_case", "to_upper_kebab_case",
+    "parse_url", "shift_left", "shift_right",
+    "total_days", "total_hours", "total_minutes", "total_seconds",
+    "total_milliseconds", "total_microseconds", "total_nanoseconds",
+    "unix_date", "image_height", "image_width", "image_channel", "image_hash",
+]
+
+
+def _install_flat_api():
+    def make_ns_alias(ns_attr, meth):
+        def flat(self, *args, **kwargs):
+            return getattr(getattr(self, ns_attr), meth)(*args, **kwargs)
+
+        flat.__name__ = meth
+        flat.__qualname__ = f"Expression.{meth}"
+        flat.__doc__ = f"Alias of Expression.{ns_attr}.{meth}() (flat reference API)."
+        return flat
+
+    for name, (ns_attr, meth) in _FLAT_NAMESPACE_ALIASES.items():
+        if not hasattr(Expression, name):
+            setattr(Expression, name, make_ns_alias(ns_attr, meth))
+
+    def make_registry_call(fname):
+        def flat(self, *args, **kwargs):
+            return self._fn(fname, *args, **kwargs)
+
+        flat.__name__ = fname
+        flat.__qualname__ = f"Expression.{fname}"
+        flat.__doc__ = f"Scalar function {fname!r} from the registry (flat API)."
+        return flat
+
+    for fname in _FLAT_REGISTRY_FNS:
+        if not hasattr(Expression, fname):
+            setattr(Expression, fname, make_registry_call(fname))
+
+
+_install_flat_api()
+
+
+def _flat_length(self):
+    """Dtype-dispatched length: list length for lists, codepoint length for
+    strings, byte length for binary (reference flat Expression.length)."""
+    return _TypeDispatch(self, {"list": ("list", "length"),
+                                "string": ("str", "length"),
+                                "binary": ("binary", "length")}, "length")
+
+
+def _flat_get(self, key_or_index, default=None):
+    """Dtype-dispatched get: list index / map key / struct field."""
+    return _TypeDispatch(self, {"list": ("list", "get"), "map": ("map", "get"),
+                                "struct": ("struct", "get")}, "get",
+                         key_or_index)
+
+
+def _flat_contains(self, item):
+    """Dtype-dispatched contains: list membership or substring match."""
+    return _TypeDispatch(self, {"list": ("list", "contains"),
+                                "string": ("str", "contains")}, "contains", item)
+
+
+def _flat_slice(self, start, end=None):
+    """Dtype-dispatched slice: list or binary slice."""
+    return _TypeDispatch(self, {"list": ("list", "slice"),
+                                "binary": ("binary", "slice")}, "slice", start, end)
+
+
+def _flat_concat(self, other):
+    """Dtype-dispatched concat: string or binary elementwise concat."""
+    return _TypeDispatch(self, {"string": ("str", "concat"),
+                                "binary": ("binary", "concat")}, "concat", other)
+
+
+class _TypeDispatch(Expression):
+    """Defers namespace selection until the input dtype is known (to_field
+    binds it); evaluation rewrites to the concrete namespace expression."""
+
+    def __init__(self, child: Expression, table, opname, *args):
+        self.child = child
+        self.table = table
+        self.opname = opname
+        self.args = args
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return _TypeDispatch(children[0], self.table, self.opname, *self.args)
+
+    def _resolve(self, schema: Schema) -> Expression:
+        dt = self.child.to_field(schema).dtype
+        if dt.is_list():
+            kind = "list"
+        elif dt.is_string():
+            kind = "string"
+        elif dt.is_binary():
+            kind = "binary"
+        elif dt.is_map():
+            kind = "map"
+        elif dt.is_struct():
+            kind = "struct"
+        else:
+            kind = dt.kind
+        hit = self.table.get(kind)
+        if hit is None:
+            raise ValueError(
+                f"{self.opname}() does not support dtype {dt}; "
+                f"supported kinds: {sorted(self.table)}")
+        ns_attr, meth = hit
+        args = [a for a in self.args if a is not None] if self.opname == "slice" \
+            else list(self.args)
+        return getattr(getattr(self.child, ns_attr), meth)(*args)
+
+    def to_field(self, schema: Schema) -> Field:
+        return self._resolve(schema).to_field(schema)
+
+    def __repr__(self):
+        return f"{self.child!r}.{self.opname}({', '.join(map(repr, self.args))})"
+
+
+Expression.length = _flat_length
+Expression.get = _flat_get
+Expression.contains = _flat_contains
+Expression.slice = _flat_slice
+Expression.concat = _flat_concat
+
+
+def _flat_coalesce(self, *others):
+    """First non-null across self and others (reference Expression.coalesce)."""
+    return self._fn("coalesce", *others)
+
+
+def _flat_pow(self, exponent):
+    return self ** exponent
+
+
+def _flat_negate(self):
+    return -self
+
+
+def _flat_ln(self):
+    return self.log()
+
+
+def _flat_approx_percentiles(self, percentiles, alpha: float = 0.01):
+    return self.approx_percentile(percentiles, alpha)
+
+
+Expression.coalesce = _flat_coalesce
+Expression.pow = _flat_pow
+Expression.power = _flat_pow
+Expression.negate = _flat_negate
+Expression.ln = _flat_ln
+Expression.approx_percentiles = _flat_approx_percentiles
+
+
+def _flat_is_column(self) -> bool:
+    return isinstance(self, ColumnRef)
+
+
+def _flat_is_literal(self) -> bool:
+    return isinstance(self, Literal)
+
+
+def _flat_as_py(self):
+    """Literal's python value (reference Expression.as_py)."""
+    if not isinstance(self, Literal):
+        raise ValueError("as_py() requires a literal expression")
+    return self.value
+
+
+def _flat_column_name(self):
+    return self.name()
+
+
+Expression.is_column = _flat_is_column
+Expression.is_literal = _flat_is_literal
+Expression.as_py = _flat_as_py
+Expression.column_name = _flat_column_name
+
+
+def _flat_serialize(self, format: str = "json"):
+    return self._fn("serialize", format=format)
+
+
+def _flat_deserialize(self, format: str = "json", dtype=None):
+    return self._fn("deserialize", format=format, dtype=dtype)
+
+
+def _flat_try_deserialize(self, format: str = "json", dtype=None):
+    return self._fn("try_deserialize", format=format, dtype=dtype)
+
+
+def _flat_compress(self, codec: str = "gzip"):
+    return self._fn("compress", codec=codec)
+
+
+def _flat_decompress(self, codec: str = "gzip"):
+    return self._fn("decompress", codec=codec)
+
+
+def _flat_try_compress(self, codec: str = "gzip"):
+    return self._fn("try_compress", codec=codec)
+
+
+def _flat_try_decompress(self, codec: str = "gzip"):
+    return self._fn("try_decompress", codec=codec)
+
+
+def _flat_replace_time_zone(self, tz=None):
+    return self._fn("replace_time_zone", tz=tz)
+
+
+def _flat_convert_time_zone(self, tz: str):
+    return self._fn("convert_time_zone", tz=tz)
+
+
+def _flat_nanosecond(self):
+    return self._fn("dt_nanosecond")
+
+
+Expression.serialize = _flat_serialize
+Expression.deserialize = _flat_deserialize
+Expression.try_deserialize = _flat_try_deserialize
+Expression.compress = _flat_compress
+Expression.decompress = _flat_decompress
+Expression.try_compress = _flat_try_compress
+Expression.try_decompress = _flat_try_decompress
+Expression.replace_time_zone = _flat_replace_time_zone
+Expression.convert_time_zone = _flat_convert_time_zone
+Expression.nanosecond = _flat_nanosecond
+
+
+def _flat_bitwise_and(self, other):
+    return self._fn("bitwise_and", other)
+
+
+def _flat_bitwise_or(self, other):
+    return self._fn("bitwise_or", other)
+
+
+def _flat_bitwise_xor(self, other):
+    return self._fn("bitwise_xor", other)
+
+
+Expression.bitwise_and = _flat_bitwise_and
+Expression.bitwise_or = _flat_bitwise_or
+Expression.bitwise_xor = _flat_bitwise_xor
+
+
+def _flat_product(self):
+    """Product aggregation (reference: Expression.product)."""
+    return AggExpr("product", self)
+
+
+def _flat_string_agg(self, delimiter: str = ""):
+    """Join string values into one string (reference: Expression.string_agg)."""
+    return AggExpr("string_agg", self, {"delimiter": delimiter})
+
+
+def _flat_list_agg(self):
+    return AggExpr("list", self)
+
+
+def _flat_list_agg_distinct(self):
+    return AggExpr("set", self)
+
+
+def _flat_regexp_count(self, pattern):
+    """Count regex matches (reference: Expression.regexp_count)."""
+    return self.str.extract_all(pattern).list.length()
+
+
+def _flat_regexp_replace(self, pattern, replacement):
+    return self.str.replace(pattern, replacement, regex=True)
+
+
+def _flat_regexp_split(self, pattern):
+    return self.str.split(pattern, regex=True)
+
+
+def _flat_cosine_similarity(self, other):
+    from .expressions import Literal as _Lit  # self-module; kept explicit
+
+    return 1.0 - self.embedding.cosine_distance(other)
+
+
+def _flat_encode(self, codec: str = "utf-8"):
+    return self._fn("codec_encode", codec=codec)
+
+
+def _flat_decode(self, codec: str = "utf-8"):
+    return self._fn("codec_decode", codec=codec)
+
+
+def _flat_try_encode(self, codec: str = "utf-8"):
+    return self._fn("try_codec_encode", codec=codec)
+
+
+def _flat_try_decode(self, codec: str = "utf-8"):
+    return self._fn("try_codec_decode", codec=codec)
+
+
+def _flat_list_append(self, other):
+    return self._fn("list_append", other)
+
+
+def _flat_list_bool_and(self):
+    return self._fn("list_bool_and")
+
+
+def _flat_list_bool_or(self):
+    return self._fn("list_bool_or")
+
+
+def _flat_image_mode(self):
+    return self._fn("image_mode")
+
+
+def _flat_image_attribute(self, name: str):
+    table = {"height": "image_height", "width": "image_width",
+             "channel": "image_channel", "mode": "image_mode"}
+    if name not in table:
+        raise ValueError(f"unknown image attribute {name!r}; known: {sorted(table)}")
+    return self._fn(table[name])
+
+
+Expression.product = _flat_product
+Expression.string_agg = _flat_string_agg
+Expression.list_agg = _flat_list_agg
+Expression.list_agg_distinct = _flat_list_agg_distinct
+Expression.regexp_count = _flat_regexp_count
+Expression.regexp_replace = _flat_regexp_replace
+Expression.regexp_split = _flat_regexp_split
+Expression.cosine_similarity = _flat_cosine_similarity
+Expression.encode = _flat_encode
+Expression.decode = _flat_decode
+Expression.try_encode = _flat_try_encode
+Expression.try_decode = _flat_try_decode
+Expression.list_append = _flat_list_append
+Expression.list_bool_and = _flat_list_bool_and
+Expression.list_bool_or = _flat_list_bool_or
+Expression.image_mode = _flat_image_mode
+Expression.image_attribute = _flat_image_attribute
+
+
+class Unnest(Expression):
+    """Marker expanded by DataFrame.select into one column per struct field
+    (reference: Expression.unnest / col("s").unnest() wildcard expansion)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    def name(self) -> str:
+        return self.child.name()
+
+    def children(self):
+        return [self.child]
+
+    def with_children(self, children):
+        return Unnest(children[0])
+
+    def to_field(self, schema: Schema) -> Field:
+        raise ValueError("unnest() can only be used directly inside select()")
+
+
+def _flat_unnest(self):
+    return Unnest(self)
+
+
+Expression.unnest = _flat_unnest
+
+
+def _flat_partition_days(self):
+    return self._fn("partition_days")
+
+
+def _flat_partition_hours(self):
+    return self._fn("partition_hours")
+
+
+def _flat_partition_months(self):
+    return self._fn("partition_months")
+
+
+def _flat_partition_years(self):
+    return self._fn("partition_years")
+
+
+def _flat_partition_iceberg_bucket(self, n: int):
+    """Iceberg bucket transform: murmur3_32-based bucket id (iceberg spec)."""
+    return self._fn("partition_iceberg_bucket", n=n)
+
+
+def _flat_partition_iceberg_truncate(self, w: int):
+    """Iceberg truncate transform (int floor-to-width / string prefix)."""
+    return self._fn("partition_iceberg_truncate", w=w)
+
+
+Expression.partition_days = _flat_partition_days
+Expression.partition_hours = _flat_partition_hours
+Expression.partition_months = _flat_partition_months
+Expression.partition_years = _flat_partition_years
+Expression.partition_iceberg_bucket = _flat_partition_iceberg_bucket
+Expression.partition_iceberg_truncate = _flat_partition_iceberg_truncate

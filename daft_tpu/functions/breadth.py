@@ -1,0 +1,654 @@
+"""Second function-registry breadth wave: math long-tail, string case
+conversions, URL parsing, compression, serialization, timezone ops, duration
+totals, and image accessors.
+
+Reference parity: daft-functions numeric long-tail (cot/sec/csc, inverse
+hyperbolics, atan2), daft-functions-utf8 case conversions
+(src/daft-functions-utf8), daft-functions-uri (parse_url), the
+compress/decompress + serialize/deserialize expression families
+(daft/expressions/expressions.py), daft-functions-temporal timezone ops and
+duration total_* accessors, and daft-image accessor kernels.
+"""
+
+from __future__ import annotations
+
+import bz2 as _bz2
+import gzip as _gzip
+import json as _json
+import re as _re
+import zlib as _zlib
+from typing import List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..core.series import Series, _combine
+from ..datatype import DataType, Field
+from .extra import _value_map
+from .registry import (_binary_arrow, _np1, _rt_const, _rt_float, _rt_same,
+                       register)
+
+# ===================================================================================
+# math long-tail (reference: daft-functions numeric crates)
+# ===================================================================================
+
+register("arccosh", _rt_float, _np1(np.arccosh))
+register("arcsinh", _rt_float, _np1(np.arcsinh))
+register("arctanh", _rt_float, _np1(np.arctanh))
+register("cot", _rt_float, _np1(lambda v: 1.0 / np.tan(v)))
+register("sec", _rt_float, _np1(lambda v: 1.0 / np.cos(v)))
+register("csc", _rt_float, _np1(lambda v: 1.0 / np.sin(v)))
+
+
+def _atan2_host(args: List[Series], kwargs) -> Series:
+    y, x = args[0], args[1]
+    yv = y.to_numpy().astype(np.float64)
+    xv = x.to_numpy().astype(np.float64)
+    if len(xv) == 1 and len(yv) != 1:
+        xv = np.broadcast_to(xv, yv.shape)
+    with np.errstate(all="ignore"):
+        out = np.arctan2(yv, xv)
+    arr = pa.array(out)
+    valid = y.validity_numpy()
+    xvalid = x.validity_numpy()
+    if len(xvalid) == len(valid):
+        valid = valid & xvalid
+    if not valid.all():
+        arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), arr.type))
+    return Series(y.name, DataType.float64(), _combine(arr))
+
+
+register("arctan2", _rt_const(DataType.float64()), _atan2_host)
+
+# ===================================================================================
+# string case conversions (reference: src/daft-functions-utf8 casing)
+# ===================================================================================
+
+_WORD_RE = _re.compile(r"[A-Za-z0-9]+")
+
+
+def _words(v: str) -> List[str]:
+    # split camelCase / PascalCase / snake / kebab / spaces into word runs
+    spaced = _re.sub(r"([a-z0-9])([A-Z])", r"\1 \2", v)
+    spaced = _re.sub(r"([A-Z]+)([A-Z][a-z])", r"\1 \2", spaced)
+    return [w.lower() for w in _WORD_RE.findall(spaced)]
+
+
+def _case_fn(joiner):
+    def conv(v: str, kwargs) -> str:
+        return joiner(_words(v))
+
+    return conv
+
+
+register("to_snake_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: "_".join(ws)), DataType.string()))
+register("to_kebab_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: "-".join(ws)), DataType.string()))
+register("to_camel_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: (ws[0] + "".join(w.title() for w in ws[1:]))
+                             if ws else ""), DataType.string()))
+register("to_upper_camel_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: "".join(w.title() for w in ws)),
+                    DataType.string()))
+register("to_upper_snake_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: "_".join(w.upper() for w in ws)),
+                    DataType.string()))
+register("to_upper_kebab_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: "-".join(w.upper() for w in ws)),
+                    DataType.string()))
+register("to_title_case", _rt_const(DataType.string()),
+         _value_map(_case_fn(lambda ws: " ".join(w.title() for w in ws)),
+                    DataType.string()))
+
+# ===================================================================================
+# URL parsing (reference: daft-functions-uri / Expression.parse_url)
+# ===================================================================================
+
+_URL_STRUCT = DataType.struct({
+    "scheme": DataType.string(), "username": DataType.string(),
+    "password": DataType.string(), "host": DataType.string(),
+    "port": DataType.int32(), "path": DataType.string(),
+    "query": DataType.string(), "fragment": DataType.string(),
+})
+
+
+def _parse_url(v: str, kwargs):
+    from urllib.parse import urlsplit
+
+    try:
+        u = urlsplit(v)
+    except ValueError:
+        return None
+    return {
+        "scheme": u.scheme or None, "username": u.username,
+        "password": u.password, "host": u.hostname,
+        "port": u.port, "path": u.path or None,
+        "query": u.query or None, "fragment": u.fragment or None,
+    }
+
+
+register("parse_url", _rt_const(_URL_STRUCT), _value_map(_parse_url, _URL_STRUCT))
+
+# ===================================================================================
+# compression (reference: Expression.compress/decompress; codecs gzip/zlib/bz2)
+# ===================================================================================
+
+_CODECS = {
+    "gzip": (_gzip.compress, _gzip.decompress),
+    "zlib": (_zlib.compress, _zlib.decompress),
+    "deflate": (_zlib.compress, _zlib.decompress),
+    "bz2": (_bz2.compress, _bz2.decompress),
+}
+
+
+def _compress(v, kwargs):
+    codec = kwargs.get("codec", "gzip")
+    if codec not in _CODECS:
+        raise ValueError(f"unknown codec {codec!r}; supported: {sorted(_CODECS)}")
+    data = v.encode() if isinstance(v, str) else v
+    return _CODECS[codec][0](data)
+
+
+def _decompress(v, kwargs):
+    codec = kwargs.get("codec", "gzip")
+    if codec not in _CODECS:
+        raise ValueError(f"unknown codec {codec!r}; supported: {sorted(_CODECS)}")
+    return _CODECS[codec][1](v)
+
+
+def _try(fn):
+    def wrapped(v, kwargs):
+        try:
+            return fn(v, kwargs)
+        except ValueError:
+            raise
+        except Exception:
+            return None
+
+    return wrapped
+
+
+register("compress", _rt_const(DataType.binary()),
+         _value_map(_compress, DataType.binary()))
+register("decompress", _rt_const(DataType.binary()),
+         _value_map(_decompress, DataType.binary()))
+register("try_compress", _rt_const(DataType.binary()),
+         _value_map(_try(_compress), DataType.binary()))
+register("try_decompress", _rt_const(DataType.binary()),
+         _value_map(_try(_decompress), DataType.binary()))
+
+# ===================================================================================
+# serialization (reference: Expression.serialize/deserialize, format="json")
+# ===================================================================================
+
+
+def _serialize(v, kwargs):
+    fmt = kwargs.get("format", "json")
+    if fmt != "json":
+        raise ValueError(f"unsupported serialize format {fmt!r} (supported: json)")
+    return _json.dumps(v, default=str)
+
+
+register("serialize", _rt_const(DataType.string()),
+         _value_map(_serialize, DataType.string()))
+
+
+def _rt_deserialize(fields, kwargs):
+    dt = kwargs.get("dtype")
+    return dt if dt is not None else DataType.string()
+
+
+def _deserialize_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    fmt = kwargs.get("format", "json")
+    if fmt != "json":
+        raise ValueError(f"unsupported deserialize format {fmt!r} (supported: json)")
+    dt = kwargs.get("dtype") or DataType.string()
+    strict = kwargs.get("strict", True)
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            out.append(_json.loads(v))
+        except Exception:
+            if strict:
+                raise
+            out.append(None)
+    return Series.from_pylist(out, s.name, dtype=dt)
+
+
+register("deserialize", _rt_deserialize, _deserialize_host)
+register("try_deserialize", _rt_deserialize,
+         lambda a, k: _deserialize_host(a, {**k, "strict": False}))
+
+# ===================================================================================
+# timezone ops (reference: daft-functions-temporal tz handling)
+# ===================================================================================
+
+
+def _rt_replace_tz(fields, kwargs):
+    dt = fields[0].dtype
+    return DataType.timestamp(dt.params[0] if dt.params else "us", kwargs.get("tz"))
+
+
+def _replace_tz_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    tz = kwargs.get("tz")
+    arr = s.to_arrow()
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    unit = s.dtype.params[0] if s.dtype.params else "us"
+    if pa.types.is_timestamp(arr.type) and arr.type.tz is not None:
+        # drop or swap the zone WITHOUT changing the wall-clock reading
+        local = arr.cast(pa.timestamp(unit))  # instant -> utc wall time? no:
+        # pyarrow cast tz-aware -> naive keeps the UTC instant; to keep local
+        # wall time, render via strftime-free path: use assume_timezone inverse
+        local = pc.local_timestamp(arr)
+        arr = local
+    if tz is None:
+        out = arr
+    else:
+        out = pc.assume_timezone(arr, tz, ambiguous="earliest",
+                                 nonexistent="earliest")
+    return Series(s.name, DataType.from_arrow(out.type), _combine(out))
+
+
+register("replace_time_zone", _rt_replace_tz, _replace_tz_host)
+
+
+def _rt_convert_tz(fields, kwargs):
+    dt = fields[0].dtype
+    return DataType.timestamp(dt.params[0] if dt.params else "us", kwargs.get("tz"))
+
+
+def _convert_tz_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    tz = kwargs.get("tz")
+    arr = s.to_arrow()
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    if not pa.types.is_timestamp(arr.type) or arr.type.tz is None:
+        raise ValueError("convert_time_zone requires a timezone-aware timestamp; "
+                         "use replace_time_zone on naive timestamps")
+    out = arr.cast(pa.timestamp(arr.type.unit, tz))
+    return Series(s.name, DataType.from_arrow(out.type), _combine(out))
+
+
+register("convert_time_zone", _rt_convert_tz, _convert_tz_host)
+
+# ===================================================================================
+# duration totals (reference: Expression.total_seconds etc. over duration dtype)
+# ===================================================================================
+
+_UNIT_NS = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}
+
+
+def _total_host(target_ns: int):
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        if s.dtype.kind != "duration":
+            raise ValueError(f"total_* requires a duration column, got {s.dtype}")
+        unit = s.dtype.params[0] if s.dtype.params else "us"
+        scale = _UNIT_NS[unit]
+        vals = s.to_numpy().astype(np.int64)
+        out = vals * scale // target_ns
+        arr = pa.array(out)
+        valid = s.validity_numpy()
+        if not valid.all():
+            arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), arr.type))
+        return Series(s.name, DataType.int64(), _combine(arr))
+
+    return host
+
+
+for _name, _ns in [("total_days", 86_400_000_000_000),
+                   ("total_hours", 3_600_000_000_000),
+                   ("total_minutes", 60_000_000_000),
+                   ("total_seconds", 1_000_000_000),
+                   ("total_milliseconds", 1_000_000),
+                   ("total_microseconds", 1_000),
+                   ("total_nanoseconds", 1)]:
+    register(_name, _rt_const(DataType.int64()), _total_host(_ns))
+
+# ===================================================================================
+# image accessors (reference: daft-image attribute kernels)
+# ===================================================================================
+
+
+def _image_accessor(attr_index: int):
+    """attr: 0=height, 1=width, 2=channels (image struct carries h/w/c)."""
+
+    def host(args: List[Series], kwargs) -> Series:
+        from ..core.kernels.image import unpack_images
+
+        out = [None if im is None else int(im.shape[attr_index])
+               for im, _mode in unpack_images(args[0])]
+        return Series.from_pylist(out, args[0].name, dtype=DataType.uint32())
+
+    return host
+
+
+register("image_height", _rt_const(DataType.uint32()), _image_accessor(0))
+register("image_width", _rt_const(DataType.uint32()), _image_accessor(1))
+
+
+def _image_channel_host(args: List[Series], kwargs) -> Series:
+    from ..core.kernels.image import unpack_images
+
+    out = [None if im is None else (1 if im.ndim == 2 else int(im.shape[2]))
+           for im, _mode in unpack_images(args[0])]
+    return Series.from_pylist(out, args[0].name, dtype=DataType.uint32())
+
+
+register("image_channel", _rt_const(DataType.uint32()), _image_channel_host)
+
+
+def _image_hash_host(args: List[Series], kwargs) -> Series:
+    """Perceptual average-hash (aHash, 8x8 grayscale) as a hex string."""
+    from ..core.kernels.image import unpack_images
+
+    out = []
+    for im, _mode in unpack_images(args[0]):
+        if im is None:
+            out.append(None)
+            continue
+        a = im.astype(np.float64)
+        if a.ndim == 3:
+            a = a.mean(axis=2)
+        h, w = a.shape
+        ys = (np.arange(8) * h // 8)
+        xs = (np.arange(8) * w // 8)
+        small = a[ys][:, xs]
+        bits = (small > small.mean()).flatten()
+        val = 0
+        for b in bits:
+            val = (val << 1) | int(b)
+        out.append(f"{val:016x}")
+    return Series.from_pylist(out, args[0].name, dtype=DataType.string())
+
+
+register("image_hash", _rt_const(DataType.string()), _image_hash_host)
+
+# ===================================================================================
+# misc: unix_date, nanosecond, product aggregation support helpers
+# ===================================================================================
+
+register("unix_date", _rt_const(DataType.int64()),
+         lambda a, k: _unix_date_host(a))
+
+
+def _unix_date_host(args: List[Series]) -> Series:
+    s = args[0]
+    arr = s.to_arrow()
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    days = arr.cast(pa.date32()).cast(pa.int32()).cast(pa.int64())
+    return Series(s.name, DataType.int64(), _combine(days))
+
+
+def _nanosecond_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    arr = s.to_arrow()
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    # sub-second remainder in nanoseconds (our timestamps are us-precision)
+    us = pc.microsecond(arr)
+    ns = pc.multiply(us.cast(pa.int64()), pa.scalar(1000, pa.int64()))
+    return Series(s.name, DataType.int64(), _combine(ns))
+
+
+register("dt_nanosecond", _rt_const(DataType.int64()), _nanosecond_host)
+
+
+# ===================================================================================
+# list long-tail (reference: daft-functions-list append/bool aggregates)
+# ===================================================================================
+
+
+def _list_append_host(args: List[Series], kwargs) -> Series:
+    s, v = args[0], args[1]
+    vv = v.to_pylist()
+    if len(vv) == 1 and len(s) != 1:
+        vv = vv * len(s)
+    out = [(None if lst is None else list(lst) + [item])
+           for lst, item in zip(s.to_pylist(), vv)]
+    return Series.from_pylist(out, s.name, dtype=s.dtype)
+
+
+register("list_append", _rt_same, _list_append_host)
+
+
+def _list_bool(op_all: bool):
+    def host(args: List[Series], kwargs) -> Series:
+        out = []
+        for lst in args[0].to_pylist():
+            if lst is None:
+                out.append(None)
+                continue
+            vals = [bool(v) for v in lst if v is not None]
+            if not vals:
+                out.append(None)
+            else:
+                out.append(all(vals) if op_all else any(vals))
+        return Series.from_pylist(out, args[0].name, dtype=DataType.bool())
+
+    return host
+
+
+register("list_bool_and", _rt_const(DataType.bool()), _list_bool(True))
+register("list_bool_or", _rt_const(DataType.bool()), _list_bool(False))
+
+# ===================================================================================
+# charset/codec encode/decode (reference: Expression.encode/decode families)
+# ===================================================================================
+
+_TEXT_CODECS = {"utf-8", "utf8", "ascii", "latin-1"}
+
+
+def _encode(v, kwargs):
+    codec = kwargs.get("codec", "utf-8")
+    if codec in _TEXT_CODECS:
+        return v.encode(codec) if isinstance(v, str) else v
+    if codec == "base64":
+        import base64
+
+        return base64.b64encode(v.encode() if isinstance(v, str) else v)
+    if codec == "hex":
+        data = v.encode() if isinstance(v, str) else v
+        return data.hex().encode()
+    if codec in _CODECS:
+        return _compress(v, {"codec": codec})
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def _decode(v, kwargs):
+    codec = kwargs.get("codec", "utf-8")
+    if codec in _TEXT_CODECS:
+        return v.decode(codec) if isinstance(v, (bytes, bytearray)) else v
+    if codec == "base64":
+        import base64
+
+        return base64.b64decode(v)
+    if codec == "hex":
+        return bytes.fromhex(v.decode() if isinstance(v, (bytes, bytearray)) else v)
+    if codec in _CODECS:
+        return _decompress(v, {"codec": codec})
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def _rt_codec_encode(fields, kwargs):
+    codec = kwargs.get("codec", "utf-8")
+    return DataType.binary()
+
+
+def _rt_codec_decode(fields, kwargs):
+    codec = kwargs.get("codec", "utf-8")
+    return DataType.string() if codec in _TEXT_CODECS else DataType.binary()
+
+
+register("codec_encode", _rt_codec_encode, _value_map(_encode, DataType.binary()))
+register("try_codec_encode", _rt_codec_encode,
+         _value_map(_try(_encode), DataType.binary()))
+
+
+def _decode_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    codec = kwargs.get("codec", "utf-8")
+    dt = DataType.string() if codec in _TEXT_CODECS else DataType.binary()
+    strict = kwargs.get("strict", True)
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            out.append(_decode(v, kwargs))
+        except ValueError:
+            raise
+        except Exception:
+            if strict:
+                raise
+            out.append(None)
+    return Series.from_pylist(out, s.name, dtype=dt)
+
+
+register("codec_decode", _rt_codec_decode, _decode_host)
+register("try_codec_decode", _rt_codec_decode,
+         lambda a, k: _decode_host(a, {**k, "strict": False}))
+
+# ===================================================================================
+# iceberg partition transforms (reference: Expression.partition_* over the
+# iceberg spec: bucket = murmur3_32, truncate, and temporal projections)
+# ===================================================================================
+
+
+def _murmur3_32(data: bytes, seed: int = 0) -> int:
+    """Iceberg's bucket hash (murmur3 x86 32-bit, public algorithm)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[n - n % 4:]
+    if tail:
+        k = int.from_bytes(tail.ljust(4, b"\0"), "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def _iceberg_bucket_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    n = kwargs["n"]
+    dt = s.dtype
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        if dt.is_integer():
+            data = int(v).to_bytes(8, "little", signed=True)
+        elif dt.is_string():
+            data = v.encode()
+        elif dt.is_binary():
+            data = v
+        else:
+            raise ValueError(f"iceberg_bucket unsupported for {dt}")
+        out.append((_murmur3_32(data) & 0x7FFFFFFF) % n)
+    return Series.from_pylist(out, s.name, dtype=DataType.int32())
+
+
+register("partition_iceberg_bucket", _rt_const(DataType.int32()),
+         _iceberg_bucket_host)
+
+
+def _iceberg_truncate_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    w = kwargs["w"]
+    dt = s.dtype
+    if dt.is_integer():
+        vals = s.to_numpy().astype(np.int64)
+        out_np = vals - (((vals % w) + w) % w)
+        arr = pa.array(out_np)
+        valid = s.validity_numpy()
+        if not valid.all():
+            arr = pc.if_else(pa.array(valid), arr, pa.nulls(len(arr), arr.type))
+        return Series(s.name, DataType.int64(), _combine(arr))
+    if dt.is_string():
+        return Series.from_pylist(
+            [None if v is None else v[:w] for v in s.to_pylist()],
+            s.name, dtype=DataType.string())
+    raise ValueError(f"iceberg_truncate unsupported for {dt}")
+
+
+register("partition_iceberg_truncate", _rt_same, _iceberg_truncate_host)
+
+
+def _partition_temporal(unit: str):
+    def host(args: List[Series], kwargs) -> Series:
+        s = args[0]
+        arr = s.to_arrow()
+        if hasattr(arr, "combine_chunks"):
+            arr = arr.combine_chunks()
+        days = arr.cast(pa.date32()).cast(pa.int32())
+        if unit == "days":
+            out = days
+        else:
+            import datetime as _dtmod
+
+            py = arr.cast(pa.date32()).to_pylist()
+            if unit == "months":
+                out = pa.array([None if d is None else (d.year - 1970) * 12 + d.month - 1
+                                for d in py], pa.int32())
+            elif unit == "years":
+                out = pa.array([None if d is None else d.year - 1970 for d in py],
+                               pa.int32())
+            else:  # hours (timestamps only)
+                us = arr.cast(pa.timestamp("us")).cast(pa.int64())
+                out = pc.divide(us, pa.scalar(3_600_000_000, pa.int64())).cast(pa.int32())
+        return Series(s.name, DataType.int32(), _combine(out))
+
+    return host
+
+
+for _u in ("days", "hours", "months", "years"):
+    register(f"partition_{_u}", _rt_const(DataType.int32()), _partition_temporal(_u))
+
+# ===================================================================================
+# image mode/attribute accessors
+# ===================================================================================
+
+
+def _image_mode_host(args: List[Series], kwargs) -> Series:
+    from ..core.kernels.image import unpack_images
+
+    out = []
+    for im, mode in unpack_images(args[0]):
+        if im is None:
+            out.append(None)
+        else:
+            out.append(str(mode) if mode is not None else
+                       ("L" if im.ndim == 2 or im.shape[2] == 1 else
+                        "RGB" if im.shape[2] == 3 else "RGBA"))
+    return Series.from_pylist(out, args[0].name, dtype=DataType.string())
+
+
+register("image_mode", _rt_const(DataType.string()), _image_mode_host)

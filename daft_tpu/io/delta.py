@@ -294,7 +294,7 @@ def _dtype_to_delta_type(dt: DataType) -> Any:
         return {"type": "struct",
                 "fields": [{"name": n, "type": _dtype_to_delta_type(t),
                             "nullable": True, "metadata": {}}
-                           for n, t in dt.struct_fields()]}
+                           for n, t in dt.struct_fields]}
     if dt.is_list():
         return {"type": "array", "elementType": _dtype_to_delta_type(dt.inner),
                 "containsNull": True}

@@ -110,6 +110,9 @@ def split_aggs(aggs: List[Expression]) -> Optional[AggSplit]:
             m3 = (col(cu) / col(c)) - 3 * m * (col(q) / col(c)) + 2 * m * m * m
             # zero variance → undefined skew (one-phase kernel nulls it)
             projection.append((sd > 0).if_else(m3 / (sd ** 3), lit(None)).alias(out_name))
+        elif op == "product":
+            n = add(AggExpr("product", child), "product")
+            projection.append(col(n).alias(out_name))
         elif op == "list":
             n = add(AggExpr("list", child), "concat")
             projection.append(col(n).alias(out_name))

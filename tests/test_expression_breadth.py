@@ -1,0 +1,177 @@
+"""Flat Expression API + function-registry breadth wave 2.
+
+Reference parity: daft/expressions/expressions.py flat methods (upper/day/
+list_sum/... exposed top-level), math long-tail, case conversions, parse_url,
+compress/serialize families, tz ops, duration totals, iceberg partition
+transforms, product/string_agg aggregations, unnest.
+"""
+
+import datetime
+import math
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+
+
+@pytest.fixture
+def df():
+    return daft_tpu.from_pydict({
+        "s": ["helloWorld", "a_b"], "n": [1.0, 2.0], "i": [5, 9],
+        "l": [[1, 2], [3]], "b": [b"xy", b"z"],
+    })
+
+
+def test_flat_namespace_aliases(df):
+    out = df.select(col("s").upper().alias("u"), col("s").left(3).alias("l3"),
+                    col("n").is_nan().alias("nn")).to_pydict()
+    assert out == {"u": ["HELLOWORLD", "A_B"], "l3": ["hel", "a_b"],
+                   "nn": [False, False]}
+
+
+def test_math_long_tail(df):
+    out = df.select(col("n").cosh().alias("ch"), col("n").arcsinh().alias("ash"),
+                    col("n").sec().alias("sec"),
+                    col("n").arctan2(lit(1.0)).alias("at2")).to_pydict()
+    assert out["ch"][0] == pytest.approx(math.cosh(1.0))
+    assert out["ash"][1] == pytest.approx(math.asinh(2.0))
+    assert out["sec"][0] == pytest.approx(1 / math.cos(1.0))
+    assert out["at2"][1] == pytest.approx(math.atan2(2.0, 1.0))
+
+
+def test_case_conversions():
+    df = daft_tpu.from_pydict({"s": ["helloWorldFoo", "SOME_value", "kebab-case"]})
+    assert df.select(col("s").to_snake_case()).to_pydict()["s"] == \
+        ["hello_world_foo", "some_value", "kebab_case"]
+    assert df.select(col("s").to_camel_case()).to_pydict()["s"] == \
+        ["helloWorldFoo", "someValue", "kebabCase"]
+    assert df.select(col("s").to_upper_kebab_case()).to_pydict()["s"] == \
+        ["HELLO-WORLD-FOO", "SOME-VALUE", "KEBAB-CASE"]
+
+
+def test_dtype_dispatch(df):
+    out = df.select(col("l").length().alias("ll"), col("s").length().alias("sl"),
+                    col("b").length().alias("bl"), col("l").get(0).alias("g"),
+                    col("s").contains("ello").alias("sc"),
+                    col("l").contains(3).alias("lc")).to_pydict()
+    assert out == {"ll": [2, 1], "sl": [10, 3], "bl": [2, 1], "g": [1, 3],
+                   "sc": [True, False], "lc": [False, True]}
+
+
+def test_dtype_dispatch_unsupported(df):
+    with pytest.raises(ValueError, match="does not support"):
+        df.select(col("n").get(0)).to_pydict()
+
+
+def test_parse_url():
+    df = daft_tpu.from_pydict({"u": ["https://u:p@h.io:8080/a?q=1#f"]})
+    v = df.select(col("u").parse_url()).to_pydict()["u"][0]
+    assert v["scheme"] == "https" and v["host"] == "h.io" and v["port"] == 8080
+    assert v["path"] == "/a" and v["query"] == "q=1" and v["fragment"] == "f"
+
+
+def test_compress_roundtrip(df):
+    for codec in ("gzip", "zlib", "bz2"):
+        out = df.select(col("s").compress(codec).decompress(codec)
+                        .decode("utf-8").alias("rt")).to_pydict()
+        assert out["rt"] == ["helloWorld", "a_b"]
+
+
+def test_try_decompress_null_on_garbage():
+    df = daft_tpu.from_pydict({"b": [b"not gzip"]})
+    assert df.select(col("b").try_decompress("gzip")).to_pydict()["b"] == [None]
+
+
+def test_serialize_deserialize():
+    df = daft_tpu.from_pydict({"j": ['{"a": 5}']})
+    dt = daft_tpu.DataType.struct({"a": daft_tpu.DataType.int64()})
+    assert df.select(col("j").deserialize(dtype=dt)).to_pydict()["j"] == [{"a": 5}]
+    df2 = daft_tpu.from_pydict({"x": [{"a": 1}]})
+    assert df2.select(col("x").serialize()).to_pydict()["x"] == ['{"a": 1}']
+
+
+def test_timezone_ops():
+    df = daft_tpu.from_pydict({"t": [datetime.datetime(2024, 1, 1, 12, 0)]})
+    aware = df.select(col("t").replace_time_zone(tz="UTC"))
+    v = aware.to_pydict()["t"][0]
+    assert v.utcoffset() == datetime.timedelta(0)
+    conv = aware.select(col("t").convert_time_zone("America/New_York")).to_pydict()["t"][0]
+    assert conv.hour == 7  # UTC noon == 7am EST
+
+
+def test_duration_totals():
+    df = daft_tpu.from_pydict({"d": [datetime.timedelta(days=1, hours=2)]})
+    out = df.select(col("d").total_hours().alias("h"),
+                    col("d").total_seconds().alias("s")).to_pydict()
+    assert out == {"h": [26], "s": [26 * 3600]}
+
+
+def test_iceberg_partition_transforms():
+    df = daft_tpu.from_pydict({"i": [34], "s": ["iceberg"],
+                               "d": [datetime.date(2024, 3, 1)]})
+    out = df.select(col("i").partition_iceberg_bucket(n=16).alias("b"),
+                    col("s").partition_iceberg_truncate(w=3).alias("t"),
+                    col("d").partition_months().alias("m"),
+                    col("d").partition_years().alias("y")).to_pydict()
+    # iceberg spec test vector: murmur3_32(int 34) = 2017239379; 2017239379 % 16 = 3
+    assert out["b"] == [2017239379 % 16]
+    assert out["t"] == ["ice"]
+    assert out["m"] == [(2024 - 1970) * 12 + 2]
+    assert out["y"] == [54]
+
+
+def test_product_agg():
+    df = daft_tpu.from_pydict({"g": ["a", "a", "b"], "x": [2, 3, 4],
+                               "f": [0.5, 4.0, None]})
+    assert df.agg(col("x").product()).to_pydict() == {"x": [24]}
+    out = df.groupby("g").agg(col("x").product().alias("p"),
+                              col("f").product().alias("fp")).sort("g").to_pydict()
+    assert out["p"] == [6, 4]
+    assert out["fp"] == [2.0, None]
+
+
+def test_string_agg_expression():
+    df = daft_tpu.from_pydict({"g": ["a", "a", "b"], "s": ["x", None, "z"]})
+    assert df.agg(col("s").string_agg("-")).to_pydict() == {"s": ["x-z"]}
+    out = df.groupby("g").agg(col("s").string_agg("|")).sort("g").to_pydict()
+    assert out["s"] == ["x", "z"]
+
+
+def test_unnest():
+    df = daft_tpu.from_pydict({"st": [{"u": 1, "v": "m"}, {"u": 2, "v": "n"}]})
+    assert df.select(col("st").unnest()).to_pydict() == {"u": [1, 2], "v": ["m", "n"]}
+
+
+def test_list_extras(df):
+    out = df.select(col("l").list_append(lit(9)).alias("ap")).to_pydict()
+    assert out["ap"] == [[1, 2, 9], [3, 9]]
+    df2 = daft_tpu.from_pydict({"bl": [[True, True], [True, False], [None, None]]})
+    assert df2.select(col("bl").list_bool_and()).to_pydict()["bl"] == [True, False, None]
+    assert df2.select(col("bl").list_bool_or()).to_pydict()["bl"] == [True, True, None]
+
+
+def test_regexp_variants():
+    df = daft_tpu.from_pydict({"s": ["aXbXc"]})
+    assert df.select(col("s").regexp_split("X")).to_pydict()["s"] == [["a", "b", "c"]]
+    assert df.select(col("s").regexp_replace("[abc]", "_")).to_pydict()["s"] == ["_X_X_"]
+    assert df.select(col("s").regexp_count("[abc]")).to_pydict()["s"] == [3]
+
+
+def test_image_accessors():
+    import numpy as np
+
+    from daft_tpu.core.kernels.image import build_image_series
+
+    imgs = [np.zeros((4, 6, 3), np.uint8), None]
+    s = build_image_series("im", imgs, ["RGB", None])
+    from daft_tpu.api import _from_partitions
+
+    schema = daft_tpu.Schema([s.field()])
+    df = _from_partitions(
+        [daft_tpu.MicroPartition(schema, [daft_tpu.RecordBatch(schema, [s])])],
+        schema)
+    out = df.select(col("im").image_attribute("height").alias("h"),
+                    col("im").image_attribute("width").alias("w"),
+                    col("im").image_mode().alias("m")).to_pydict()
+    assert out == {"h": [4, None], "w": [6, None], "m": ["RGB", None]}
