@@ -568,6 +568,14 @@ class DataFrame:
 
         return write_deltalake(self, table_path, mode, partition_cols)
 
+    def write_iceberg(self, table_path: str, mode: str = "append",
+                      partition_cols: Optional[List[str]] = None) -> "DataFrame":
+        """Write as an Iceberg v2 table: parquet data files + Avro manifests +
+        metadata JSON (reference: DataFrame.write_iceberg via pyiceberg)."""
+        from ..io.iceberg import write_iceberg
+
+        return write_iceberg(self, table_path, mode, partition_cols)
+
     def write_sql(self, table_name: str, connection,
                   mode: str = "append") -> "DataFrame":
         """Write rows into a SQL table through a DB-API connection or a

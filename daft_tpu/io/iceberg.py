@@ -303,3 +303,236 @@ def _parquet_task(path: str, columns, arrow_filter, out_schema: Schema,
     return ScanTask(read=read, schema=out_schema, size_bytes=size_bytes,
                     num_rows=num_rows, filters_applied=arrow_filter is not None,
                     limit_applied=False, source_label=path)
+
+
+# ======================================================================================
+# Write path
+# ======================================================================================
+
+
+def _dtype_to_icetype(dt: DataType) -> Any:
+    if dt.is_struct():
+        return {"type": "struct",
+                "fields": [{"id": 1000 + i, "name": n, "required": False,
+                            "type": _dtype_to_icetype(t)}
+                           for i, (n, t) in enumerate(dt.struct_fields)]}
+    if dt.is_list():
+        return {"type": "list", "element-id": 1100, "element-required": False,
+                "element": _dtype_to_icetype(dt.inner)}
+    if dt.is_decimal():
+        p, s = dt.params
+        return f"decimal({p},{s})"
+    simple = {
+        DataType.bool(): "boolean", DataType.int32(): "int",
+        DataType.int64(): "long", DataType.float32(): "float",
+        DataType.float64(): "double", DataType.string(): "string",
+        DataType.binary(): "binary", DataType.date(): "date",
+    }
+    if dt in simple:
+        return simple[dt]
+    if dt.kind == "timestamp":
+        return "timestamptz" if len(dt.params) > 1 and dt.params[1] else "timestamp"
+    if dt.is_integer():
+        return "long"
+    raise NotImplementedError(f"cannot map {dt} to an iceberg type")
+
+
+def _ice_avro_partition_fields(schema: Schema, partition_cols: List[str]):
+    """Avro record fields for the manifest partition tuple (identity spec)."""
+    amap = {"int64": "long", "int32": "int", "string": "string", "bool": "boolean",
+            "float64": "double", "float32": "float", "date": "int"}
+    out = []
+    for name in partition_cols:
+        kind = schema[name].dtype.kind
+        at = amap.get(kind, "long" if schema[name].dtype.is_integer() else "string")
+        out.append({"name": name, "type": ["null", at], "default": None})
+    return out
+
+
+def write_iceberg(df, table_path: str, mode: str = "append",
+                  partition_cols: Optional[List[str]] = None):
+    """Write a DataFrame as an Iceberg v2 table (reference:
+    DataFrame.write_iceberg via pyiceberg; here the spec is emitted directly —
+    parquet data files, Avro manifest + manifest list, table metadata JSON —
+    in the same layout read_iceberg() and pyiceberg parse).
+
+    mode: "append" | "overwrite" | "error" | "ignore".
+    Partitioning: identity transforms over partition_cols.
+    """
+    import time as _time
+    import uuid as _uuid
+
+    import pyarrow as pa
+    import pyarrow.compute as pc_
+    import pyarrow.parquet as pq
+
+    from .. import api as _api
+    from .avro import write_container
+
+    meta_dir = os.path.join(table_path, "metadata")
+    data_dir = os.path.join(table_path, "data")
+    exists = os.path.isdir(meta_dir) and any(
+        n.endswith(".metadata.json") for n in os.listdir(meta_dir)) \
+        if os.path.isdir(meta_dir) else False
+    if exists and mode == "error":
+        raise FileExistsError(f"iceberg table already exists: {table_path}")
+    if exists and mode == "ignore":
+        return _api.from_pydict({"path": [], "rows": []})
+    os.makedirs(meta_dir, exist_ok=True)
+    os.makedirs(data_dir, exist_ok=True)
+
+    schema = df.schema
+    parts = list(partition_cols or [])
+    for p in parts:
+        if p not in schema.column_names():
+            raise ValueError(f"partition column {p!r} not in schema")
+
+    now_ms = int(_time.time() * 1000)
+    snapshot_id = now_ms * 1000 + int.from_bytes(os.urandom(2), "little") % 1000
+
+    # prior state (append keeps old manifests; overwrite drops them)
+    version = 0
+    prior_manifests: List[dict] = []
+    prior_meta: Optional[dict] = None
+    if exists:
+        prior_meta = _load_table_metadata(table_path)
+        version = int(prior_meta.get("_version", 0)) + 1 \
+            if "_version" in prior_meta else _next_metadata_version(meta_dir)
+        if mode == "append":
+            cur = next((s for s in prior_meta.get("snapshots", [])
+                        if s.get("snapshot-id") == prior_meta.get("current-snapshot-id")),
+                       None)
+            if cur and "manifest-list" in cur:
+                ml = _resolve_path(table_path, prior_meta.get("location", ""),
+                                   cur["manifest-list"])
+                _s, prior_manifests = read_container(open(ml, "rb").read())
+    else:
+        version = 1
+
+    # ---- data files ----------------------------------------------------------------
+    table = df.to_arrow()
+    files: List[dict] = []  # (path, rows, size, partition record)
+
+    def _write_file(tbl, pvals: Dict[str, Any]) -> None:
+        # partition columns stay IN the data files (like pyiceberg's writer);
+        # the partition record exists for manifest-level pruning only
+        fname = f"{_uuid.uuid4().hex}.parquet"
+        fpath = os.path.join(data_dir, fname)
+        pq.write_table(tbl, fpath)
+        files.append({"path": f"{table_path}/data/{fname}", "rows": tbl.num_rows,
+                      "size": os.path.getsize(fpath), "partition": pvals})
+
+    if not parts:
+        _write_file(table, {})
+    else:
+        combos = table.group_by(parts).aggregate([]).to_pylist()
+        for row in combos:
+            mask = None
+            for p in parts:
+                m = pc_.equal(table.column(p), pa.scalar(row[p])) \
+                    if row[p] is not None else pc_.is_null(table.column(p))
+                mask = m if mask is None else pc_.and_(mask, m)
+            _write_file(table.filter(mask), {p: row[p] for p in parts})
+
+    # ---- manifest (avro) -----------------------------------------------------------
+    part_fields = _ice_avro_partition_fields(schema, parts)
+    data_file_schema = {
+        "type": "record", "name": "r2", "fields": [
+            {"name": "content", "type": "int"},
+            {"name": "file_path", "type": "string"},
+            {"name": "file_format", "type": "string"},
+            {"name": "partition",
+             "type": {"type": "record", "name": "r102", "fields": part_fields}},
+            {"name": "record_count", "type": "long"},
+            {"name": "file_size_in_bytes", "type": "long"},
+        ]}
+    entry_schema = {
+        "type": "record", "name": "manifest_entry", "fields": [
+            {"name": "status", "type": "int"},
+            {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+            {"name": "data_file", "type": data_file_schema},
+        ]}
+    manifest_name = f"{_uuid.uuid4().hex}-m0.avro"
+    manifest_path = os.path.join(meta_dir, manifest_name)
+    entries = [{"status": 1, "snapshot_id": snapshot_id,
+                "data_file": {"content": 0, "file_path": f["path"],
+                              "file_format": "PARQUET",
+                              "partition": f["partition"],
+                              "record_count": f["rows"],
+                              "file_size_in_bytes": f["size"]}}
+               for f in files]
+    write_container(manifest_path, entry_schema, entries)
+
+    # ---- manifest list (avro) --------------------------------------------------------
+    ml_schema = {
+        "type": "record", "name": "manifest_file", "fields": [
+            {"name": "manifest_path", "type": "string"},
+            {"name": "manifest_length", "type": "long"},
+            {"name": "partition_spec_id", "type": "int"},
+            {"name": "content", "type": "int"},
+            {"name": "added_snapshot_id", "type": "long"},
+        ]}
+    ml_records = [{"manifest_path": f"{table_path}/metadata/{manifest_name}",
+                   "manifest_length": os.path.getsize(manifest_path),
+                   "partition_spec_id": 0, "content": 0,
+                   "added_snapshot_id": snapshot_id}]
+    for pm in prior_manifests:
+        ml_records.append({
+            "manifest_path": pm["manifest_path"],
+            "manifest_length": pm.get("manifest_length", 0),
+            "partition_spec_id": pm.get("partition_spec_id", 0),
+            "content": pm.get("content", 0),
+            "added_snapshot_id": pm.get("added_snapshot_id", snapshot_id)})
+    ml_name = f"snap-{snapshot_id}-1-{_uuid.uuid4().hex}.avro"
+    write_container(os.path.join(meta_dir, ml_name), ml_schema, ml_records)
+
+    # ---- table metadata json ---------------------------------------------------------
+    fields = [{"id": i + 1, "name": f.name, "required": False,
+               "type": _dtype_to_icetype(f.dtype)}
+              for i, f in enumerate(schema)]
+    name_to_id = {f["name"]: f["id"] for f in fields}
+    spec_fields = [{"name": p, "transform": "identity",
+                    "source-id": name_to_id[p], "field-id": 1000 + i}
+                   for i, p in enumerate(parts)]
+    snapshots = []
+    if prior_meta is not None and mode == "append":
+        snapshots = list(prior_meta.get("snapshots", []))
+    snapshots.append({"snapshot-id": snapshot_id, "timestamp-ms": now_ms,
+                      "manifest-list": f"{table_path}/metadata/{ml_name}",
+                      "summary": {"operation": "append" if mode == "append"
+                                  else "overwrite"},
+                      "schema-id": 0})
+    meta = {
+        "format-version": 2,
+        "table-uuid": str(_uuid.uuid4()) if prior_meta is None
+        else prior_meta.get("table-uuid", str(_uuid.uuid4())),
+        "location": table_path,
+        "last-sequence-number": len(snapshots),
+        "last-updated-ms": now_ms,
+        "last-column-id": len(fields),
+        "schemas": [{"type": "struct", "schema-id": 0, "fields": fields}],
+        "current-schema-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": spec_fields}],
+        "default-spec-id": 0,
+        "last-partition-id": 1000 + len(spec_fields),
+        "properties": {},
+        "current-snapshot-id": snapshot_id,
+        "snapshots": snapshots,
+        "snapshot-log": [], "metadata-log": [],
+    }
+    with open(os.path.join(meta_dir, f"v{version}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write(str(version))
+
+    return _api.from_pydict({"path": [f["path"] for f in files],
+                             "rows": [f["rows"] for f in files]})
+
+
+def _next_metadata_version(meta_dir: str) -> int:
+    best = 0
+    for n in os.listdir(meta_dir):
+        m = re.match(r"v(\d+)\.metadata\.json$", n)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1

@@ -149,3 +149,25 @@ def test_skip_existing(tmp_path, df):
         .write_parquet(pdir)
     rem = df.skip_existing(pdir, "v")
     assert sorted(rem.to_pydict()["v"]) == [4, 4]
+
+
+def test_write_iceberg_roundtrip(tmp_path, df):
+    tp = str(tmp_path / "ice")
+    res = df.write_iceberg(tp).to_pydict()
+    assert sum(res["rows"]) == 5
+    assert daft_tpu.read_iceberg(tp).count_rows() == 5
+    df.write_iceberg(tp, mode="append")
+    assert daft_tpu.read_iceberg(tp).count_rows() == 10
+    df.write_iceberg(tp, mode="overwrite")
+    assert daft_tpu.read_iceberg(tp).count_rows() == 5
+    with pytest.raises(FileExistsError):
+        df.write_iceberg(tp, mode="error")
+
+
+def test_write_iceberg_partitioned(tmp_path, df):
+    tp = str(tmp_path / "icep")
+    df.write_iceberg(tp, partition_cols=["g"])
+    back = daft_tpu.read_iceberg(tp)
+    assert back.count_rows() == 5
+    sub = back.where(col("g") == "b").to_pydict()
+    assert sorted(sub["v"]) == [3, 4, 4]
