@@ -165,3 +165,11 @@ def call_function(name: str, *args, **kwargs) -> Expression:
     from .plan.builder import _to_expr
 
     return Function(name, [_to_expr(a) for a in args], kwargs or None)
+
+
+def file(path_expr, io_config=None) -> Expression:
+    """Build a lazy File column from path/URL strings (reference:
+    daft.functions.file)."""
+    from .plan.builder import _to_expr
+
+    return _to_expr(path_expr)._fn("file", io_config=io_config)

@@ -1730,3 +1730,24 @@ Expression.partition_months = _flat_partition_months
 Expression.partition_years = _flat_partition_years
 Expression.partition_iceberg_bucket = _flat_partition_iceberg_bucket
 Expression.partition_iceberg_truncate = _flat_partition_iceberg_truncate
+
+
+def _flat_file_path(self):
+    """Path/URL of a file column's reference (reference: Expression.file_path)."""
+    return self._fn("file_path")
+
+
+def _flat_file_size(self, io_config=None):
+    """Size in bytes, stat'ed lazily through the IO layer (reference:
+    Expression.file_size)."""
+    return self._fn("file_size", io_config=io_config)
+
+
+def _flat_file_read(self, offset: int = 0, length=None, io_config=None):
+    """Range-read a file column's bytes (reference: daft-file ranged reads)."""
+    return self._fn("file_read", offset=offset, length=length, io_config=io_config)
+
+
+Expression.file_path = _flat_file_path
+Expression.file_size = _flat_file_size
+Expression.file_read = _flat_file_read

@@ -652,3 +652,70 @@ def _image_mode_host(args: List[Series], kwargs) -> Series:
 
 
 register("image_mode", _rt_const(DataType.string()), _image_mode_host)
+
+
+# ===================================================================================
+# File type (reference: daft-file/src/functions.rs — file/file_path/file_size
+# over the lazy File dtype; bytes move only when read)
+# ===================================================================================
+
+
+def _file_host(args: List[Series], kwargs) -> Series:
+    s = args[0]
+    out = [None if v is None else {"path": v, "data": None} for v in s.to_pylist()]
+    return Series.from_pylist(out, s.name, dtype=DataType.file())
+
+
+register("file", _rt_const(DataType.file()), _file_host)
+
+
+def _file_path_host(args: List[Series], kwargs) -> Series:
+    out = [None if v is None else v.get("path") for v in args[0].to_pylist()]
+    return Series.from_pylist(out, args[0].name, dtype=DataType.string())
+
+
+register("file_path", _rt_const(DataType.string()), _file_path_host)
+
+
+def _file_size_host(args: List[Series], kwargs) -> Series:
+    from ..filetype import File
+
+    io_config = kwargs.get("io_config")
+    out = []
+    for v in args[0].to_pylist():
+        if v is None:
+            out.append(None)
+        elif v.get("data") is not None:
+            out.append(len(v["data"]))
+        else:
+            out.append(File(v["path"], io_config).size())
+    return Series.from_pylist(out, args[0].name, dtype=DataType.int64())
+
+
+register("file_size", _rt_const(DataType.int64()), _file_size_host)
+
+
+def _file_read_host(args: List[Series], kwargs) -> Series:
+    from ..filetype import File
+
+    io_config = kwargs.get("io_config")
+    offset = kwargs.get("offset", 0)
+    length = kwargs.get("length")
+    out = []
+    for v in args[0].to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        if v.get("data") is not None:
+            data = v["data"]
+            out.append(data[offset:offset + length] if length is not None
+                       else data[offset:])
+            continue
+        with File(v["path"], io_config).open() as f:
+            if offset:
+                f.seek(offset)
+            out.append(f.read(length if length is not None else -1))
+    return Series.from_pylist(out, args[0].name, dtype=DataType.binary())
+
+
+register("file_read", _rt_const(DataType.binary()), _file_read_host)

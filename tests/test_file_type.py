@@ -1,0 +1,64 @@
+"""Lazy File type: ranged reads, file()/file_path/file_size/file_read.
+
+Reference parity: src/daft-file/ (lazy handle + ranged reads) and
+daft/file/file.py (File python surface).
+"""
+
+import os
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.filetype import File
+
+
+@pytest.fixture
+def paths(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("hello world")
+    p2 = tmp_path / "b.bin"
+    p2.write_bytes(bytes(range(100)))
+    return str(p1), str(p2)
+
+
+def test_file_object_lazy_ranged(paths):
+    p1, p2 = paths
+    f = File(p1)
+    assert f.size() == 11
+    assert f.name == "a.txt"
+    assert f.mime_type() == "text/plain"
+    with f.open() as h:
+        assert h.seekable() and h.readable() and not h.writable()
+        h.seek(6)
+        assert h.read(5) == b"world"
+        assert h.tell() == 11
+        assert h.read() == b""
+        h.seek(-5, os.SEEK_END)
+        assert h.read() == b"world"
+
+
+def test_file_to_tempfile(paths):
+    p1, _ = paths
+    with File(p1).to_tempfile() as tmp:
+        assert open(tmp.name, "rb").read() == b"hello world"
+
+
+def test_file_column_expressions(paths):
+    p1, p2 = paths
+    df = daft_tpu.from_pydict({"p": [p1, p2, None]})
+    fdf = df.select(daft_tpu.file(col("p")).alias("f"))
+    assert fdf.schema["f"].dtype == daft_tpu.DataType.file()
+    out = fdf.select(col("f").file_path().alias("path"),
+                     col("f").file_size().alias("sz"),
+                     col("f").file_read(offset=1, length=3).alias("c")).to_pydict()
+    assert out["path"] == [p1, p2, None]
+    assert out["sz"] == [11, 100, None]
+    assert out["c"] == [b"ell", bytes([1, 2, 3]), None]
+
+
+def test_file_read_whole(paths):
+    p1, _ = paths
+    df = daft_tpu.from_pydict({"p": [p1]})
+    out = df.select(daft_tpu.file(col("p")).file_read()).to_pydict()
+    assert out["p"] == [b"hello world"]
