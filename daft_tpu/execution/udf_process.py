@@ -180,6 +180,85 @@ class UdfProcessPool:
         self.alive = True
         atexit.register(self.shutdown)
 
+    def run_batch_routed(self, arg_series: List[Any], kwargs: dict,
+                         num_rows: int, prefix_len: int):
+        """Prefix-affinity dispatch (reference: the vLLM pipeline node's
+        prefix-aware routed actor pool, src/daft-distributed/src/pipeline_node/
+        vllm.rs): rows whose first `prefix_len` chars of the FIRST argument
+        match route to the same replica, so each replica's KV/prompt cache
+        keeps serving its prefix family. Sub-batches run on their replicas
+        CONCURRENTLY; results reassemble in input row order."""
+        import numpy as np
+
+        n_workers = len(self.workers)
+        if n_workers <= 1 or num_rows <= 1:
+            return self.run_batch(arg_series, kwargs, num_rows)
+        keys = arg_series[0].to_pylist()
+        assign = np.asarray(
+            [hash((k or "")[:prefix_len]) % n_workers for k in keys],
+            dtype=np.int64)
+        groups = [np.flatnonzero(assign == w) for w in range(n_workers)]
+        from concurrent.futures import ThreadPoolExecutor
+
+        def run_one(w: int, rows: np.ndarray):
+            sub = [s.take(rows) for s in arg_series]
+            return self._dispatch(w, sub, kwargs, len(rows))
+
+        with ThreadPoolExecutor(max_workers=n_workers) as ex:
+            futures = {w: ex.submit(run_one, w, rows)
+                       for w, rows in enumerate(groups) if len(rows)}
+            payloads = {w: f.result() for w, f in futures.items()}
+        # reassemble: payload is an arrow array (batch fn) or a list (row fn)
+        first = next(iter(payloads.values()))
+        if isinstance(first, list):
+            out: List[Any] = [None] * num_rows
+            for w, rows in enumerate(groups):
+                if not len(rows):
+                    continue
+                for j, r in enumerate(rows):
+                    out[int(r)] = payloads[w][j]
+            return out
+        import pyarrow as pa
+
+        chunks = []
+        order = []
+        for w, rows in enumerate(groups):
+            if not len(rows):
+                continue
+            arr = payloads[w]
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            chunks.append(arr)
+            order.append(rows)
+        combined = pa.concat_arrays(chunks) if len(chunks) > 1 else chunks[0]
+        perm = np.concatenate(order)
+        inv = np.empty(num_rows, dtype=np.int64)
+        inv[perm] = np.arange(num_rows)
+        return combined.take(pa.array(inv))
+
+    def _dispatch(self, i: int, arg_series: List[Any], kwargs: dict,
+                  num_rows: int):
+        p, conn = self.workers[i]
+        with self._locks[i]:
+            if p is not None and p.poll() is not None:
+                raise RuntimeError(f"UDF worker process for {self.func.name!r} died")
+            try:
+                conn.send((
+                    [s.to_arrow() for s in arg_series],
+                    [s.name for s in arg_series],
+                    kwargs,
+                    num_rows,
+                ))
+                status, payload = conn.recv()
+            except (EOFError, BrokenPipeError, ConnectionError, OSError) as e:
+                self.shutdown()
+                raise RuntimeError(
+                    f"UDF worker for {self.func.name!r} died mid-batch "
+                    f"(crash in the UDF or native code?): {e}") from e
+        if status == "err":
+            raise RuntimeError(f"UDF {self.func.name!r} failed in worker:\n{payload}")
+        return payload
+
     def run_batch(self, arg_series: List[Any], kwargs: dict, num_rows: int):
         """Dispatch one batch to a worker; returns arrow array (batch fn) or
         a python list of results (row fn)."""

@@ -11,9 +11,10 @@ from ..udf.udf import Func
 
 
 def _batch_func(fn, name: str, return_dtype: DataType, max_concurrency=None,
-                use_process: bool = False) -> Func:
+                use_process: bool = False, route_prefix_len=None) -> Func:
     return Func(fn=fn, return_dtype=return_dtype, is_batch=True, name=name,
-                max_concurrency=max_concurrency, use_process=use_process)
+                max_concurrency=max_concurrency, use_process=use_process,
+                route_prefix_len=route_prefix_len)
 
 
 def embed_text(expr: Expression, provider: str = "transformers",
@@ -100,7 +101,8 @@ def embed_image(expr: Expression, provider: str = "dummy",
 
 def llm_generate(expr: Expression, provider: str = "dummy",
                  model: Optional[str] = None, max_concurrency: int = 1,
-                 use_process: bool = False, **options) -> Expression:
+                 use_process: bool = False, prefix_routing: bool = True,
+                 route_prefix_len: int = 128, **options) -> Expression:
     """LLM generation operator (reference: the VLLMExpr first-class operator +
     actor pool, daft-dsl expr/mod.rs:311). Runs the provider's prompter as a
     batched stateful operator: the optimizer's split-UDF rule isolates it into
@@ -122,5 +124,9 @@ def llm_generate(expr: Expression, provider: str = "dummy",
         out = [next(it) if m else None for m in mask]
         return Series.from_pylist(out, s.name, DataType.string())
 
-    return _batch_func(run, "llm_generate", DataType.string(),
-                       max_concurrency=max_concurrency, use_process=use_process)(expr)
+    return _batch_func(
+        run, "llm_generate", DataType.string(),
+        max_concurrency=max_concurrency, use_process=use_process,
+        route_prefix_len=(route_prefix_len
+                          if prefix_routing and max_concurrency > 1 else None),
+    )(expr)

@@ -55,7 +55,12 @@ class UdfCall(Expression):
         if f.use_process:
             from ..execution.udf_process import get_pool
 
-            payload = get_pool(f).run_batch(arg_series, self.kwargs, num_rows)
+            pool = get_pool(f)
+            if f.route_prefix_len is not None:
+                payload = pool.run_batch_routed(arg_series, self.kwargs, num_rows,
+                                                f.route_prefix_len)
+            else:
+                payload = pool.run_batch(arg_series, self.kwargs, num_rows)
             if f.is_batch:
                 out = Series.from_arrow(payload, out_name)
                 if out.dtype != f.return_dtype:

@@ -26,6 +26,9 @@ class Func:
     max_concurrency: Optional[int] = None
     use_process: bool = False
     name: str = "udf"
+    # prefix-affinity routing for replicated stateful operators (vLLM-style):
+    # rows sharing the first N chars of the first argument go to one replica
+    route_prefix_len: Optional[int] = None
 
     def __call__(self, *args, **kwargs):
         from .expr import UdfCall

@@ -283,3 +283,22 @@ def test_openai_classifier_routes_through_prompts():
         assert out == ["spam"]
     finally:
         mock.close()
+
+
+def test_llm_generate_prefix_routed_process_pool():
+    """vLLM-style prefix-affinity routing: rows sharing a prompt prefix land on
+    one replica; outputs come back in input row order (reference:
+    src/daft-distributed/src/pipeline_node/vllm.rs prefix-routed actor pool)."""
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.functions import llm_generate
+
+    prompts = [f"family-{i % 3}: question {i}" for i in range(12)]
+    df = daft_tpu.from_pydict({"p": prompts})
+    out = df.select(llm_generate(col("p"), provider="dummy", max_concurrency=3,
+                                 use_process=True, route_prefix_len=9)
+                    .alias("r")).to_pydict()
+    assert len(out["r"]) == 12
+    # dummy prompter echoes deterministically — row order must be preserved
+    for i, r in enumerate(out["r"]):
+        assert f"question {i}" in r, (i, r)
