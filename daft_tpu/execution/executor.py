@@ -715,8 +715,8 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
                                          resolve_key_series)
 
         key_series = resolve_key_series(batch, stage.groupby, batch.num_rows)
-        cap_est = _pad_groups(min(max(estimate_key_cardinality(key_series), 1),
-                                  2 * MAX_MATMUL_SEGMENTS))
+        card = max(estimate_key_cardinality(key_series), 1)
+        cap_est = _pad_groups(min(card, 2 * MAX_MATMUL_SEGMENTS))
         if stage.dict_keys:
             # dictionary builds are cached per Series -> amortized like uploads
             dict_rows = sum(
@@ -726,9 +726,18 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
         else:
             # host-mode keys re-factorize on every run: full price, no amortization
             factorize_cost_rows = batch.num_rows
-        dev_cost = costmodel.device_grouped_cost(
-            cal, rows, nonres // amort, n_mm=len(stage._mm_specs), n_ext=len(stage._ext_specs),
-            n_sct=len(stage._sct_specs), cap=cap_est, factorize_rows=factorize_cost_rows)
+        if card > MAX_MATMUL_SEGMENTS:
+            # sort-based segmented-reduction path prices by n log n, not cells
+            n_planes = (len(stage._mm_specs) + len(stage._ext_specs)
+                        + len(stage._sct_specs))
+            dev_cost = costmodel.device_grouped_sort_cost(
+                cal, rows, nonres // amort, n_planes=n_planes,
+                factorize_rows=factorize_cost_rows)
+        else:
+            dev_cost = costmodel.device_grouped_cost(
+                cal, rows, nonres // amort, n_mm=len(stage._mm_specs),
+                n_ext=len(stage._ext_specs), n_sct=len(stage._sct_specs),
+                cap=cap_est, factorize_rows=factorize_cost_rows)
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=True,
             has_predicate=node.predicate is not None)

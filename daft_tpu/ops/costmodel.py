@@ -120,6 +120,21 @@ def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
             + factorize_rows / cal.host_factorize_rate)
 
 
+def device_grouped_sort_cost(cal: Calibration, rows: int, nonresident_bytes: int,
+                             n_planes: int, factorize_rows: int) -> float:
+    """High-cardinality path (grouped_stage._build_sorted): argsort + one
+    segmented scan per plane — O(n log n) sort plus O(n) per plane, no
+    one-hot cells."""
+    import math
+
+    logn = max(math.log2(max(rows, 2)), 1.0)
+    return (cal.rtt_s
+            + nonresident_bytes / cal.h2d_bytes_per_s
+            + rows * logn / cal.mm_plane_rows_per_s      # bitonic sort passes
+            + rows * max(n_planes, 1) / cal.mm_plane_rows_per_s
+            + factorize_rows / cal.host_factorize_rate)
+
+
 def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                           n_partials: int) -> float:
     return (cal.rtt_s

@@ -279,3 +279,68 @@ def test_high_cardinality_groupby_falls_back_to_host():
     with execution_config_ctx(device_mode="off"):
         host_out = q(df).to_pydict()
     assert dev_out == host_out
+
+
+def test_high_cardinality_grouped_agg_sort_path():
+    """cap > MAX_MATMUL_SEGMENTS groupbys run on device via the sort-based
+    segmented-reduction path (r3 VERDICT item #3: the 4096-segment ceiling),
+    matching the host result exactly."""
+    rng = np.random.default_rng(7)
+    n = 200_000
+    n_groups = 20_000  # > MAX_MATMUL_SEGMENTS
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, n_groups, n).tolist(),
+        "v": rng.uniform(0, 100, n).tolist(),
+        "q": rng.integers(0, 1000, n).tolist(),
+    })
+
+    def q(d):
+        return (d.groupby("k")
+                .agg(col("v").sum().alias("sv"),
+                     col("q").sum().alias("sq"),
+                     col("q").max().alias("mq"),
+                     col("v").count().alias("cv"))
+                .sort("k"))
+
+    with execution_config_ctx(device_mode="off"):
+        host = q(df).to_pydict()
+    counters.reset()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    assert counters.device_grouped_batches > 0, "sort path never dispatched"
+    assert dev_out["k"] == host["k"]
+    assert dev_out["sq"] == host["sq"]
+    assert dev_out["mq"] == host["mq"]
+    assert dev_out["cv"] == host["cv"]
+    np.testing.assert_allclose(dev_out["sv"], host["sv"], rtol=1e-6)
+
+
+def test_sort_path_with_predicate_and_nulls():
+    rng = np.random.default_rng(3)
+    n = 60_000
+    vals = rng.uniform(0, 10, n)
+    v = [None if i % 17 == 0 else float(vals[i]) for i in range(n)]
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, 9000, n).tolist(),
+        "v": v,
+        "w": rng.uniform(0, 1, n).tolist(),
+    })
+
+    def q(d):
+        return (d.where(col("w") < 0.8)
+                .groupby("k")
+                .agg(col("v").sum().alias("s"), col("v").count().alias("c"),
+                     col("v").min().alias("mn"))
+                .sort("k"))
+
+    with execution_config_ctx(device_mode="off"):
+        host = q(df).to_pydict()
+    with execution_config_ctx(device_mode="on"):
+        dev_out = q(df).to_pydict()
+    assert dev_out["k"] == host["k"]
+    assert dev_out["c"] == host["c"]
+    np.testing.assert_allclose(np.array(dev_out["s"], dtype=float),
+                               np.array(host["s"], dtype=float),
+                               rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(np.array(dev_out["mn"], dtype=float),
+                               np.array(host["mn"], dtype=float), rtol=1e-12)
