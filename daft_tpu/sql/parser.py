@@ -149,6 +149,8 @@ class TableFactor:
     name: Optional[str] = None          # table name
     subquery: Optional["Select"] = None
     alias: Optional[str] = None
+    values: Optional[list] = None       # VALUES rows (lists of Expressions)
+    col_names: Optional[list] = None    # alias column list: x(a, b)
 
 
 @dataclasses.dataclass
@@ -174,6 +176,7 @@ class Select:
     joins: List[JoinClause] = dataclasses.field(default_factory=list)
     where: Optional[Expression] = None
     group_by: List[Any] = dataclasses.field(default_factory=list)  # Expression | int position
+    grouping_sets: Optional[List[List[Any]]] = None  # ROLLUP/CUBE/GROUPING SETS
     having: Optional[Expression] = None
     order_by: List[OrderItem] = dataclasses.field(default_factory=list)
     limit: Optional[int] = None
@@ -488,6 +491,23 @@ class Parser:
         fname = name_tok.upper()
         self.expect("punct", "(")
 
+        if fname == "EXTRACT":
+            # EXTRACT(unit FROM expr) — special syntactic form (reference:
+            # sqlparser Expr::Extract)
+            unit = self.next().upper()
+            self.expect_kw("FROM")
+            src = self.parse_expr()
+            self.expect("punct", ")")
+            table = {"YEAR": "year", "MONTH": "month", "DAY": "day",
+                     "HOUR": "hour", "MINUTE": "minute", "SECOND": "second",
+                     "QUARTER": "quarter", "WEEK": "week_of_year",
+                     "DOY": "day_of_year", "DOW": "day_of_week",
+                     "MILLISECOND": "millisecond", "MICROSECOND": "microsecond"}
+            if unit not in table:
+                raise ValueError(f"EXTRACT unit {unit!r} not supported; "
+                                 f"known: {sorted(table)}")
+            return getattr(src.dt, table[unit])()
+
         distinct = False
         star = False
         args: List[Expression] = []
@@ -687,13 +707,16 @@ class Parser:
             sel.where = self.parse_expr()
         if self.eat_kw("GROUP"):
             self.expect_kw("BY")
-            while True:
-                if self.at("number"):
-                    sel.group_by.append(int(self.next().value))
-                else:
-                    sel.group_by.append(self.parse_expr())
-                if not self.eat("punct", ","):
-                    break
+            if self.at_kw("ROLLUP", "CUBE", "GROUPING"):
+                sel.grouping_sets = self._parse_grouping_sets()
+            else:
+                while True:
+                    if self.at("number"):
+                        sel.group_by.append(int(self.next().value))
+                    else:
+                        sel.group_by.append(self.parse_expr())
+                    if not self.eat("punct", ","):
+                        break
         if self.eat_kw("HAVING"):
             sel.having = self.parse_expr()
         return sel
@@ -746,14 +769,25 @@ class Parser:
 
     def _parse_table_factor(self) -> TableFactor:
         if self.eat("punct", "("):
+            if self.at_kw("VALUES"):
+                self.next()
+                rows = []
+                while True:
+                    self.expect("punct", "(")
+                    row = [self.parse_expr()]
+                    while self.eat("punct", ","):
+                        row.append(self.parse_expr())
+                    self.expect("punct", ")")
+                    rows.append(row)
+                    if not self.eat("punct", ","):
+                        break
+                self.expect("punct", ")")
+                alias, col_names = self._parse_alias_with_columns()
+                return TableFactor(values=rows, alias=alias, col_names=col_names)
             sub = self._parse_select()
             self.expect("punct", ")")
-            alias = None
-            if self.eat_kw("AS"):
-                alias = self.next().value
-            elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP:
-                alias = self.next().value
-            return TableFactor(subquery=sub, alias=alias)
+            alias, col_names = self._parse_alias_with_columns()
+            return TableFactor(subquery=sub, alias=alias, col_names=col_names)
         name = self.expect("ident").value
         # dotted table names (catalog.schema.table)
         while self.eat("punct", "."):
@@ -764,6 +798,59 @@ class Parser:
         elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP:
             alias = self.next().value
         return TableFactor(name=name, alias=alias)
+
+    def _parse_grouping_sets(self):
+        """ROLLUP(a, b) / CUBE(a, b) / GROUPING SETS ((a), (a, b), ()) →
+        a list of grouping-key lists (reference: sqlparser GroupByExpr)."""
+        kw = self.next().upper()
+        if kw == "GROUPING":
+            self.expect_kw("SETS")
+            self.expect("punct", "(")
+            sets = []
+            while True:
+                self.expect("punct", "(")
+                cur = []
+                if not self.at("punct", ")"):
+                    cur.append(self.parse_expr())
+                    while self.eat("punct", ","):
+                        cur.append(self.parse_expr())
+                self.expect("punct", ")")
+                sets.append(cur)
+                if not self.eat("punct", ","):
+                    break
+            self.expect("punct", ")")
+            return sets
+        self.expect("punct", "(")
+        keys = [self.parse_expr()]
+        while self.eat("punct", ","):
+            keys.append(self.parse_expr())
+        self.expect("punct", ")")
+        if kw == "ROLLUP":
+            return [keys[:i] for i in range(len(keys), -1, -1)]
+        if kw == "CUBE":
+            import itertools as _it
+
+            sets = []
+            for r in range(len(keys), -1, -1):
+                for combo in _it.combinations(range(len(keys)), r):
+                    sets.append([keys[i] for i in combo])
+            return sets
+        raise ValueError(f"unexpected grouping keyword {kw}")
+
+    def _parse_alias_with_columns(self):
+        """[AS] name [(col, col, ...)] after a parenthesized table factor."""
+        alias = None
+        col_names = None
+        if self.eat_kw("AS"):
+            alias = self.next().value
+        elif self.peek().kind == "ident" and self.peek().upper() not in _KEYWORDS_STOP:
+            alias = self.next().value
+        if alias is not None and self.eat("punct", "("):
+            col_names = [self.expect("ident").value]
+            while self.eat("punct", ","):
+                col_names.append(self.expect("ident").value)
+            self.expect("punct", ")")
+        return alias, col_names
 
     def _try_parse_join(self) -> Optional[JoinClause]:
         kind = None

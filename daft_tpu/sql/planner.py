@@ -75,8 +75,31 @@ class SQLPlanner:
         raise ValueError(f"unknown table {name!r}")
 
     def _plan_factor(self, f: TableFactor, scope: Scope):
+        if f.values is not None:
+            import daft_tpu as dt
+            from ..expressions.expressions import Literal
+
+            ncols = len(f.values[0]) if f.values else 0
+            names = f.col_names or [f"column{i + 1}" for i in range(ncols)]
+            if any(len(r) != ncols for r in f.values):
+                raise ValueError("VALUES rows have inconsistent arity")
+            data = {}
+            for i, n in enumerate(names):
+                cells = []
+                for r in f.values:
+                    e = r[i]
+                    if not isinstance(e, Literal):
+                        raise ValueError("VALUES cells must be literals")
+                    cells.append(e.value)
+                data[n] = cells
+            df = dt.from_pydict(data)
+            scope.add(f.alias, df.column_names)
+            return df
         if f.subquery is not None:
             df = SQLPlanner(self.bindings, self.cte_frames, session=self.session).plan(f.subquery)
+            if f.col_names:
+                df = df.select(*[col(c).alias(n)
+                                 for c, n in zip(df.column_names, f.col_names)])
             scope.add(f.alias, df.column_names)
             return df
         df = self._resolve_table(f.name)
@@ -345,6 +368,15 @@ class SQLPlanner:
 
         for op, rhs in sel.set_ops:
             rdf = planner._plan_core(rhs)
+            # SQL set ops align columns by POSITION: rename the right side's
+            # columns to the left side's names (reference: set_expr planning)
+            lnames, rnames = df.column_names, rdf.column_names
+            if len(lnames) != len(rnames):
+                raise ValueError(
+                    f"set operation arms have {len(lnames)} vs {len(rnames)} columns")
+            if lnames != rnames:
+                rdf = rdf.select(*[col(rn).alias(ln)
+                                   for ln, rn in zip(lnames, rnames)])
             if op == "union_all":
                 df = df.concat(rdf)
             elif op == "union":
@@ -388,7 +420,9 @@ class SQLPlanner:
                 items.append(SelectItem(self._resolve_expr(it.expr, scope), it.alias))
 
         has_agg = any(self._contains_agg(it.expr) for it in items)
-        if sel.group_by or has_agg or (sel.having is not None):
+        if sel.grouping_sets is not None:
+            df = self._plan_grouping_sets(df, sel, items, scope)
+        elif sel.group_by or has_agg or (sel.having is not None):
             df = self._plan_aggregate(df, sel, items, scope)
         else:
             # ORDER BY may reference source columns dropped by the projection:
@@ -552,6 +586,40 @@ class SQLPlanner:
         return [e]
 
     # ---- aggregation --------------------------------------------------------------
+    def _plan_grouping_sets(self, df, sel: Select, items: List[SelectItem],
+                            scope: Scope):
+        """ROLLUP / CUBE / GROUPING SETS: one grouped aggregate per key set,
+        null-filling grouping columns absent from a set, unioned by name
+        (reference: the sqlparser GroupByExpr lowering)."""
+        import dataclasses as _dc
+
+        from ..expressions import lit as _lit
+
+        all_key_reprs = set()
+        for ks in sel.grouping_sets:
+            for k in ks:
+                all_key_reprs.add(repr(self._resolve_expr(k, scope)))
+
+        out = None
+        for ks in sel.grouping_sets:
+            resolved = [self._resolve_expr(k, scope) for k in ks]
+            kreprs = {repr(k) for k in resolved}
+            sub_items = []
+            for it in items:
+                r = repr(it.expr)
+                if r in all_key_reprs and r not in kreprs:
+                    name = it.alias or it.expr.name()
+                    dtype = it.expr.to_field(df.schema).dtype
+                    sub_items.append(SelectItem(_lit(None).cast(dtype).alias(name),
+                                                it.alias or name))
+                else:
+                    sub_items.append(it)
+            sub_sel = _dc.replace(sel, group_by=list(resolved), grouping_sets=None,
+                                  order_by=[], limit=None, offset=None, set_ops=[])
+            part = self._plan_aggregate(df, sub_sel, sub_items, scope)
+            out = part if out is None else out.union_all_by_name(part)
+        return out
+
     def _plan_aggregate(self, df, sel: Select, items: List[SelectItem], scope: Scope):
         # resolve group-by entries (positions refer to select items)
         group_exprs: List[Expression] = []

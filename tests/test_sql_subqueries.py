@@ -1,0 +1,62 @@
+
+
+class TestSqlBreadthWave2:
+    """ROLLUP/CUBE/GROUPING SETS, VALUES, EXTRACT, positional set-op alignment
+    (reference: sqlparser GroupByExpr / Values / Extract lowering)."""
+
+    def _t(self):
+        import daft_tpu
+
+        return daft_tpu.from_pydict(
+            {"g": ["a", "a", "b"], "v": [1, 2, 3], "d": ["x", "y", "x"]})
+
+    def test_rollup(self):
+        import daft_tpu
+
+        out = daft_tpu.sql(
+            "SELECT g, SUM(v) s FROM t GROUP BY ROLLUP(g) ORDER BY s, g",
+            t=self._t()).to_pydict()
+        assert out == {"g": ["a", "b", None], "s": [3, 3, 6]}
+
+    def test_cube_row_count(self):
+        import daft_tpu
+
+        out = daft_tpu.sql(
+            "SELECT g, d, SUM(v) s FROM t GROUP BY CUBE(g, d)",
+            t=self._t()).to_pydict()
+        # (g,d): 3 combos; (g): 2; (d): 2; (): 1
+        assert len(out["s"]) == 8
+        assert sum(1 for g, d in zip(out["g"], out["d"])
+                   if g is None and d is None) == 1
+
+    def test_grouping_sets(self):
+        import daft_tpu
+
+        out = daft_tpu.sql(
+            "SELECT g, SUM(v) s FROM t GROUP BY GROUPING SETS ((g), ()) "
+            "ORDER BY s, g", t=self._t()).to_pydict()
+        assert out == {"g": ["a", "b", None], "s": [3, 3, 6]}
+
+    def test_values_clause(self):
+        import daft_tpu
+
+        out = daft_tpu.sql(
+            "SELECT n * 2 AS n2, s FROM (VALUES (1,'a'),(2,'b')) AS x(n, s) "
+            "ORDER BY n2", t=self._t()).to_pydict()
+        assert out == {"n2": [2, 4], "s": ["a", "b"]}
+
+    def test_extract(self):
+        import daft_tpu
+
+        out = daft_tpu.sql(
+            "SELECT EXTRACT(YEAR FROM DATE '2024-03-02') y, "
+            "EXTRACT(MONTH FROM DATE '2024-03-02') m", t=self._t()).to_pydict()
+        assert out["y"] == [2024] and out["m"] == [3]
+
+    def test_setop_positional_alignment(self):
+        import daft_tpu
+
+        out = daft_tpu.sql("SELECT v FROM t EXCEPT SELECT 1", t=self._t()).to_pydict()
+        assert sorted(out["v"]) == [2, 3]
+        out2 = daft_tpu.sql("SELECT v FROM t UNION SELECT 99", t=self._t()).to_pydict()
+        assert sorted(out2["v"]) == [1, 2, 3, 99]
