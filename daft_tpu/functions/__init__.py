@@ -7,3 +7,5 @@ __all__ = [
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
     "embed_text", "embed_image", "classify_text", "prompt", "llm_generate",
 ]
+from ..filetype import DaftFile, File
+from .media import run_process

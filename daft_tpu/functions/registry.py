@@ -1127,3 +1127,4 @@ register("url_upload", _rt_const(DataType.string()), _url_upload)
 # breadth modules register on import (binary/crypto/bitwise/json/map/...)
 from . import extra  # noqa: E402,F401  (registration side effects)
 from . import breadth  # noqa: E402,F401  (registration side effects)
+from . import media  # noqa: E402,F401  (registration side effects)

@@ -1,0 +1,80 @@
+"""Audio / process function namespaces (reference: daft/functions/audio.py,
+process.py). WAV decode is native (stdlib wave); non-WAV and video gate on
+their optional packages like the reference."""
+
+import io
+import wave
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+
+def _make_wav(path, sr=8000, seconds=0.1, channels=1):
+    n = int(sr * seconds)
+    t = np.arange(n) / sr
+    samples = (np.sin(2 * np.pi * 440 * t) * 32000).astype("<i2")
+    if channels == 2:
+        samples = np.repeat(samples, 2)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(samples.tobytes())
+    return str(path)
+
+
+def test_audio_metadata(tmp_path):
+    p = _make_wav(tmp_path / "t.wav", sr=8000, channels=2)
+    df = daft_tpu.from_pydict({"p": [p, None]})
+    out = df.select(daft_tpu.file(col("p"))
+                    ._fn("audio_metadata").alias("m")).to_pydict()
+    m = out["m"][0]
+    assert m["sample_rate"] == 8000 and m["channels"] == 2
+    assert m["format"] == "WAV" and m["subtype"] == "PCM_16"
+    assert m["frames"] == pytest.approx(800.0)
+    assert out["m"][1] is None
+
+
+def test_audio_resample(tmp_path):
+    p = _make_wav(tmp_path / "t.wav", sr=8000)
+    df = daft_tpu.from_pydict({"p": [p]})
+    out = df.select(daft_tpu.file(col("p"))
+                    ._fn("audio_resample", sample_rate=4000).alias("a")).to_pydict()
+    arr = out["a"][0]
+    assert arr.shape == (400, 1)
+    assert np.abs(arr).max() <= 1.0
+
+
+def test_run_process():
+    from daft_tpu.functions import run_process
+
+    df = daft_tpu.from_pydict({"a": ["hello", "daft"]})
+    out = df.select(run_process(["echo", col("a")]).alias("o")).to_pydict()
+    assert [v.strip() for v in out["o"]] == ["hello", "daft"]
+
+
+def test_run_process_shell_and_dtype():
+    from daft_tpu.functions import run_process
+
+    df = daft_tpu.from_pydict({"x": ["a b c"]})
+    out = df.select(run_process("echo " + col("x") + " | wc -w", shell=True,
+                                return_dtype=daft_tpu.DataType.int64())
+                    .alias("n")).to_pydict()
+    assert out["n"] == [3]
+
+
+def test_run_process_on_error_null():
+    from daft_tpu.functions import run_process
+
+    df = daft_tpu.from_pydict({"x": ["zz"]})
+    out = df.select(run_process(["false"], on_error="ignore").alias("o")).to_pydict()
+    assert out["o"] == [None]
+
+
+def test_video_gated():
+    df = daft_tpu.from_pydict({"p": ["x.mp4"]})
+    with pytest.raises((ImportError, Exception)):
+        df.select(daft_tpu.file(col("p"))._fn("video_metadata")).to_pydict()
