@@ -30,7 +30,8 @@ from .worker import WorkerPool
 class DistributedRunner(Runner):
     def __init__(self, num_workers: int = 4, n_partitions: Optional[int] = None,
                  slots_per_worker: int = 1, shuffle_dir: Optional[str] = None,
-                 shuffle_transport: str = "local"):
+                 shuffle_transport: str = "local",
+                 max_workers: Optional[int] = None):
         """shuffle_transport: "local" (reduce tasks read the shared shuffle
         directory — single-host fast path) or "socket" (reduce tasks fetch
         partitions from the HMAC-authenticated ShuffleFetchServer, the
@@ -38,6 +39,7 @@ class DistributedRunner(Runner):
         if shuffle_transport not in ("local", "socket"):
             raise ValueError(f"unknown shuffle transport {shuffle_transport!r}")
         self.num_workers = num_workers
+        self.max_workers = max_workers
         self.n_partitions = n_partitions or num_workers
         self.slots_per_worker = slots_per_worker
         self.shuffle_transport = shuffle_transport
@@ -48,7 +50,8 @@ class DistributedRunner(Runner):
 
     def _ensure_pool(self) -> WorkerPool:
         if self._pool is None:
-            self._pool = WorkerPool(self.num_workers, self.slots_per_worker)
+            self._pool = WorkerPool(self.num_workers, self.slots_per_worker,
+                                    max_workers=self.max_workers)
             if self._shuffle_dir is None:
                 self._shuffle_dir = tempfile.mkdtemp(prefix="daft_tpu_shuffle_")
             if self.shuffle_transport == "socket" and self._fetch_server is None:

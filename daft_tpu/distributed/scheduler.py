@@ -67,6 +67,26 @@ class Scheduler:
     def pending_count(self) -> int:
         return len(self._heap)
 
+    def get_autoscaling_request(self) -> Optional[List[SubPlanTask]]:
+        """Pending tasks to justify scale-up, or None (reference:
+        default.rs get_autoscaling_request/needs_autoscaling). Triggers when
+        pending demand exceeds total capacity by the threshold factor
+        (DAFT_TPU_AUTOSCALING_THRESHOLD, default 1.25 like the reference)."""
+        import os
+
+        if not self._heap:
+            return None
+        if not self._workers:
+            return [t for _p, _s, t in self._heap]
+        try:
+            threshold = float(os.environ.get("DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25))
+        except ValueError:
+            threshold = 1.25
+        total_capacity = sum(w.total_slots for w in self._workers.values())
+        if len(self._heap) > total_capacity * threshold:
+            return [t for _p, _s, t in self._heap]
+        return None
+
     def schedule(self) -> List[Tuple[SubPlanTask, str]]:
         """Assign as many pending tasks as current capacity allows.
 

@@ -173,7 +173,8 @@ class WorkerPool:
     """
 
     def __init__(self, num_workers: int, slots_per_worker: int = 1,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 max_workers: Optional[int] = None):
         sock = os.path.join(tempfile.gettempdir(),
                             f"daft_tpu_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock")
         # HMAC-authenticated socket: only processes holding the per-pool
@@ -185,11 +186,35 @@ class WorkerPool:
         from ..utils.sockets import DeadlineAcceptor
 
         acceptor = DeadlineAcceptor(self._listener)
+        # kept for elastic scale-up (reference: autoscaling scheduler hook)
+        self._sock = sock
+        self._env = env
+        self._acceptor = acceptor
+        self._slots_per_worker = slots_per_worker
+        # default: fixed-size pool (scale-up is an explicit opt-in via
+        # max_workers > num_workers, mirroring how the reference only scales
+        # when the runtime honors the scheduler's autoscaling request)
+        self.max_workers = max_workers if max_workers is not None else num_workers
+        self._next_worker_id = num_workers
         self.workers: Dict[str, WorkerProcess] = {}
         for i in range(num_workers):
             wid = f"worker-{i}"
             self.workers[wid] = WorkerProcess(wid, acceptor, sock,
                                               slots_per_worker, env=env)
+
+    def scale_up(self, n: int = 1) -> List[str]:
+        """Spawn up to n extra workers (bounded by max_workers); returns the
+        new worker ids. The local realization of the reference's autoscaling
+        request path (default.rs get_autoscaling_request -> runtime scale-up)."""
+        added = []
+        while n > 0 and len(self.workers) < self.max_workers:
+            wid = f"worker-{self._next_worker_id}"
+            self._next_worker_id += 1
+            self.workers[wid] = WorkerProcess(wid, self._acceptor, self._sock,
+                                              self._slots_per_worker, env=self._env)
+            added.append(wid)
+            n -= 1
+        return added
 
     def run_tasks(self, tasks: List[SubPlanTask]) -> Dict[str, TaskResult]:
         from .scheduler import Scheduler
@@ -208,6 +233,14 @@ class WorkerPool:
                 excluded_workers=task.excluded_workers + (w.worker_id,)))
 
         while len(results) < len(expected):
+            # elastic scale-up: when queued demand exceeds capacity by the
+            # autoscaling threshold, grow the pool toward max_workers
+            want = sched.get_autoscaling_request()
+            if want:
+                deficit = (len(want) - sum(
+                    ws.available_slots for ws in sched.snapshots()))
+                for wid in self.scale_up(max(deficit, 1)):
+                    sched.add_worker(wid, self._slots_per_worker)
             assignments = sched.schedule()
             for task, wid in assignments:
                 w = self.workers[wid]

@@ -268,3 +268,46 @@ def test_mesh_grouped_agg_empty_after_filter():
         out = (df.where(col("w") > 100).groupby("k")
                .agg(col("v").sum().alias("s")).to_pydict())
     assert out == {"k": [], "s": []}
+
+
+def test_autoscaling_scale_up():
+    """Pending demand beyond capacity * threshold grows the pool toward
+    max_workers (reference: scheduler/default.rs get_autoscaling_request)."""
+    from daft_tpu.distributed.scheduler import Scheduler
+    from daft_tpu.distributed.worker import SubPlanTask
+
+    sched = Scheduler({"w0": 1})
+    assert sched.get_autoscaling_request() is None
+    for i in range(4):
+        sched.submit(SubPlanTask(task_id=f"t{i}", plan_blob=b"", strategy=None,
+                                 priority=0))
+    req = sched.get_autoscaling_request()
+    assert req is not None and len(req) == 4
+    # with ample capacity no request fires
+    sched2 = Scheduler({"w0": 8})
+    sched2.submit(SubPlanTask(task_id="t", plan_blob=b"", strategy=None,
+                              priority=0))
+    assert sched2.get_autoscaling_request() is None
+
+
+def test_autoscaling_pool_grows():
+    """A pool with max_workers > num_workers spawns extra workers when the
+    task queue exceeds capacity, and completes all tasks."""
+    import daft_tpu
+    from daft_tpu.distributed.runner import DistributedRunner
+
+    from daft_tpu import col
+
+    runner = DistributedRunner(num_workers=1, n_partitions=6, max_workers=3)
+    try:
+        n = 20_000
+        left = daft_tpu.from_pydict({"id": list(range(n)), "v": list(range(n))})
+        right = daft_tpu.from_pydict({"id": list(range(0, n, 2)),
+                                      "w": list(range(0, n, 2))})
+        q = left.join(right, on="id", how="inner")
+        parts = runner.run(q._builder)
+        total = sum(p.num_rows for p in parts)
+        assert total == n // 2
+        assert len(runner._pool.workers) > 1, "pool never scaled up"
+    finally:
+        runner.shutdown()
