@@ -38,11 +38,18 @@ class Scheduler:
     """
 
     def __init__(self, workers: Dict[str, int]):
+        import os
+
         self._workers: Dict[str, WorkerSnapshot] = {
             wid: WorkerSnapshot(wid, slots) for wid, slots in workers.items()
         }
         self._heap: List[Tuple[int, int, SubPlanTask]] = []
         self._seq = itertools.count()
+        try:
+            self._autoscaling_threshold = float(
+                os.environ.get("DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25))
+        except ValueError:
+            self._autoscaling_threshold = 1.25
 
     # ---- worker lifecycle ----------------------------------------------------
     def add_worker(self, worker_id: str, slots: int) -> None:
@@ -67,25 +74,23 @@ class Scheduler:
     def pending_count(self) -> int:
         return len(self._heap)
 
-    def get_autoscaling_request(self) -> Optional[List[SubPlanTask]]:
-        """Pending tasks to justify scale-up, or None (reference:
-        default.rs get_autoscaling_request/needs_autoscaling). Triggers when
-        pending demand exceeds total capacity by the threshold factor
-        (DAFT_TPU_AUTOSCALING_THRESHOLD, default 1.25 like the reference)."""
-        import os
-
+    def needs_autoscaling(self) -> bool:
+        """True when pending demand exceeds total capacity by the threshold
+        factor (DAFT_TPU_AUTOSCALING_THRESHOLD, default 1.25 — reference:
+        default.rs needs_autoscaling). Cheap: called every dispatch loop."""
         if not self._heap:
-            return None
+            return False
         if not self._workers:
-            return [t for _p, _s, t in self._heap]
-        try:
-            threshold = float(os.environ.get("DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25))
-        except ValueError:
-            threshold = 1.25
+            return True
         total_capacity = sum(w.total_slots for w in self._workers.values())
-        if len(self._heap) > total_capacity * threshold:
-            return [t for _p, _s, t in self._heap]
-        return None
+        return len(self._heap) > total_capacity * self._autoscaling_threshold
+
+    def get_autoscaling_request(self) -> Optional[List[SubPlanTask]]:
+        """Pending tasks justifying scale-up, or None (reference:
+        default.rs get_autoscaling_request)."""
+        if not self.needs_autoscaling():
+            return None
+        return [t for _p, _s, t in self._heap]
 
     def schedule(self) -> List[Tuple[SubPlanTask, str]]:
         """Assign as many pending tasks as current capacity allows.

@@ -203,15 +203,24 @@ class WorkerPool:
                                               slots_per_worker, env=env)
 
     def scale_up(self, n: int = 1) -> List[str]:
-        """Spawn up to n extra workers (bounded by max_workers); returns the
-        new worker ids. The local realization of the reference's autoscaling
-        request path (default.rs get_autoscaling_request -> runtime scale-up)."""
+        """Spawn up to n extra workers (bounded by max_workers over ALIVE
+        workers, so crashed workers free headroom); returns the new worker
+        ids. Spawn failures are non-fatal — the pool keeps serving with what
+        it has. The local realization of the reference's autoscaling request
+        path (default.rs get_autoscaling_request -> runtime scale-up)."""
         added = []
-        while n > 0 and len(self.workers) < self.max_workers:
+        while n > 0 and sum(1 for w in self.workers.values()
+                            if w.alive) < self.max_workers:
             wid = f"worker-{self._next_worker_id}"
             self._next_worker_id += 1
-            self.workers[wid] = WorkerProcess(wid, self._acceptor, self._sock,
-                                              self._slots_per_worker, env=self._env)
+            try:
+                self.workers[wid] = WorkerProcess(
+                    wid, self._acceptor, self._sock,
+                    self._slots_per_worker, env=self._env)
+            except Exception:
+                # a failed spawn (resource limits — exactly when demand
+                # spikes) must not abort the stage the existing pool can run
+                break
             added.append(wid)
             n -= 1
         return added
@@ -234,12 +243,11 @@ class WorkerPool:
 
         while len(results) < len(expected):
             # elastic scale-up: when queued demand exceeds capacity by the
-            # autoscaling threshold, grow the pool toward max_workers
-            want = sched.get_autoscaling_request()
-            if want:
-                deficit = (len(want) - sum(
-                    ws.available_slots for ws in sched.snapshots()))
-                for wid in self.scale_up(max(deficit, 1)):
+            # autoscaling threshold, grow the pool toward max_workers — ONE
+            # worker per dispatch loop, so result polling of busy workers is
+            # never starved behind a burst of blocking spawns
+            if sched.needs_autoscaling():
+                for wid in self.scale_up(1):
                     sched.add_worker(wid, self._slots_per_worker)
             assignments = sched.schedule()
             for task, wid in assignments:
