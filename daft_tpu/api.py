@@ -128,6 +128,14 @@ def read_deltalake(table_path: str) -> DataFrame:
 read_delta_lake = read_deltalake
 
 
+def read_hudi(table_path: str) -> DataFrame:
+    """Read an Apache Hudi copy-on-write table (timeline replay + latest
+    file slices per file group — io/hudi.py; reference: daft/io/hudi)."""
+    from .io.hudi import HudiScanOperator
+
+    return DataFrame(LogicalPlanBuilder.from_scan(HudiScanOperator(table_path)))
+
+
 def from_glob_path(path: str) -> DataFrame:
     from .io.glob_files import GlobPathScanOperator
 

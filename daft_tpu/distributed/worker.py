@@ -270,13 +270,19 @@ class WorkerPool:
                         raise RuntimeError(
                             f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}")
                     results[res.task_id] = res
-                if not w.alive and w.inflight:
-                    # worker died mid-task: re-queue its tasks elsewhere
+                if not w.alive:
+                    # worker died: re-queue its tasks elsewhere and DROP the
+                    # entry (leaving it would leak its fd and pay a poll
+                    # error every loop; scale_up counts alive workers so the
+                    # slot frees for a replacement)
                     sched.remove_worker(w.worker_id)
-                    for t in list(w.inflight.values()):
-                        _requeue_elsewhere(w, t)
-                    w.inflight.clear()
-                    progressed = True
+                    if w.inflight:
+                        for t in list(w.inflight.values()):
+                            _requeue_elsewhere(w, t)
+                        w.inflight.clear()
+                        progressed = True
+                    w.stop()
+                    self.workers.pop(w.worker_id, None)
                     if not any(ww.alive for ww in self.workers.values()):
                         raise RuntimeError("all workers died")
             if not progressed and sched.pending_count() and not any(

@@ -1,0 +1,176 @@
+"""Apache Hudi copy-on-write table read support.
+
+Reference parity: daft/io/hudi/ (HudiScanOperator + the pyhudi mini-client:
+timeline.py loads completed commit instants from .hoodie/, filegroup.py keeps
+file slices per file group and serves the latest, table.py walks partitions).
+The protocol is implemented directly:
+
+    {table}/.hoodie/hoodie.properties        table config (partition fields)
+    {table}/.hoodie/{instant}.commit         completed write commits (JSON)
+    {table}/{partition}/{fileId}_{writeToken}_{instant}.parquet   base files
+
+Snapshot read = for every file group (fileId within a partition), the base
+file with the newest commit time that is <= the latest COMPLETED instant —
+uncommitted/inflight writes are invisible. Merge-on-read tables (log files)
+raise clearly rather than returning wrong answers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from ..schema import Schema
+from .scan import Pushdowns, ScanOperator, ScanTask
+
+_BASE_FILE_RE = re.compile(r"^(?P<fid>[^_]+)_(?P<token>[^_]+)_(?P<instant>[^.]+)\.parquet$")
+
+
+def _load_properties(table_path: str) -> Dict[str, str]:
+    path = os.path.join(table_path, ".hoodie", "hoodie.properties")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"not a hudi table (no .hoodie/hoodie.properties): {table_path}")
+    props: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            k, _, v = line.partition("=")
+            props[k.strip()] = v.strip()
+    return props
+
+
+def _completed_instants(table_path: str):
+    """(sorted completed instant timestamps, replaced file-group ids).
+    Reference: timeline.py _load_completed_commit_instants — only bare
+    `.commit` / `.replacecommit` files count; `.requested` / `.inflight`
+    are pending. Replacecommits (clustering / insert_overwrite) contribute
+    partitionToReplaceFileIds: those file groups are dead to snapshot reads."""
+    hoodie = os.path.join(table_path, ".hoodie")
+    out = []
+    replaced = set()  # (partition_path, file_id)
+    for n in os.listdir(hoodie):
+        if n.endswith(".commit"):
+            out.append(n[: -len(".commit")])
+        elif n.endswith(".replacecommit"):
+            out.append(n[: -len(".replacecommit")])
+            try:
+                with open(os.path.join(hoodie, n)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = {}
+            for part, fids in (meta.get("partitionToReplaceFileIds") or {}).items():
+                for fid in fids:
+                    replaced.add((part, fid))
+    return sorted(out), replaced
+
+
+def _partition_dirs(table_path: str) -> List[str]:
+    """Relative partition paths: every directory (or the root) holding base
+    files, skipping the .hoodie metadata tree."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(table_path):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".hoodie")]
+        if any(_BASE_FILE_RE.match(n) for n in filenames):
+            rel = os.path.relpath(dirpath, table_path)
+            out.append("" if rel == "." else rel)
+    return sorted(out)
+
+
+class HudiScanOperator(ScanOperator):
+    """Snapshot reader over a local/posix Hudi CoW table."""
+
+    def __init__(self, table_path: str):
+        import pyarrow.parquet as pq
+
+        self.table_path = table_path
+        self.props = _load_properties(table_path)
+        table_type = self.props.get("hoodie.table.type", "COPY_ON_WRITE")
+        if table_type != "COPY_ON_WRITE":
+            raise NotImplementedError(
+                f"hudi table type {table_type} is not supported (CoW only)")
+        self._instants, self._replaced = _completed_instants(table_path)
+        self._files = self._latest_file_slices()
+        if not self._files:
+            raise ValueError(f"hudi table has no committed base files: {table_path}")
+        arrow_schema = pq.read_schema(self._files[0])
+        self._schema = Schema.from_arrow(arrow_schema)
+
+    def _latest_file_slices(self) -> List[str]:
+        """One base file per file group: the newest committed slice
+        (reference: filegroup.py get_latest_file_slice)."""
+        if not self._instants:
+            return []
+        committed = set(self._instants)
+        chosen: Dict[tuple, tuple] = {}  # (partition, fileId) -> (instant, path)
+        for part in _partition_dirs(self.table_path):
+            pdir = os.path.join(self.table_path, part) if part else self.table_path
+            for n in os.listdir(pdir):
+                if n.endswith(".log") or ".log." in n:
+                    raise NotImplementedError(
+                        "hudi merge-on-read log files are not supported")
+                m = _BASE_FILE_RE.match(n)
+                if m is None:
+                    continue
+                if m.group("instant") not in committed:
+                    continue  # uncommitted write: invisible to snapshot reads
+                if (part, m.group("fid")) in self._replaced:
+                    continue  # clustered/overwritten file group: superseded
+                key = (part, m.group("fid"))
+                cur = chosen.get(key)
+                if cur is None or m.group("instant") > cur[0]:
+                    chosen[key] = (m.group("instant"), os.path.join(pdir, n))
+        return [p for _i, p in sorted(chosen.values())]
+
+    def name(self) -> str:
+        return f"HudiScan({self.props.get('hoodie.table.name', self.table_path)})"
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def can_absorb_select(self) -> bool:
+        return True
+
+    def can_absorb_filter(self) -> bool:
+        return True
+
+    def can_absorb_limit(self) -> bool:
+        return False
+
+    def to_scan_tasks(self, pushdowns: Pushdowns) -> List[ScanTask]:
+        from .parquet import _expr_to_arrow_filter
+
+        columns = pushdowns.columns
+        out_schema = Schema([self._schema[c] for c in columns]) \
+            if columns is not None else self._schema
+        arrow_filter = _expr_to_arrow_filter(pushdowns.filters) \
+            if pushdowns.filters is not None else None
+
+        tasks: List[ScanTask] = []
+        for path in self._files:
+            tasks.append(self._task(path, columns, arrow_filter, out_schema))
+        return tasks
+
+    def _task(self, path: str, columns, arrow_filter, out_schema: Schema) -> ScanTask:
+        from .parquet import _make_reader
+
+        # reuse the parquet reader (morsel-streamed, remote-capable) rather
+        # than materializing a whole base file per task
+        return ScanTask(read=_make_reader(path, columns, arrow_filter, None,
+                                          out_schema),
+                        schema=out_schema,
+                        size_bytes=os.path.getsize(path), num_rows=None,
+                        filters_applied=arrow_filter is not None,
+                        limit_applied=False, source_label=path)
+
+    def approx_num_rows(self, pushdowns: Pushdowns) -> Optional[float]:
+        try:
+            import pyarrow.parquet as pq
+
+            total = sum(pq.read_metadata(p).num_rows for p in self._files)
+            return float(total)
+        except Exception:
+            return None
