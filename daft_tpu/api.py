@@ -128,6 +128,15 @@ def read_deltalake(table_path: str) -> DataFrame:
 read_delta_lake = read_deltalake
 
 
+def read_sql(sql_query: str, connection, partition_col=None,
+             num_partitions: int = 1) -> DataFrame:
+    """Read the result of a SQL query over a DB-API connection (reference:
+    daft.read_sql); stdlib sqlite3 works out of the box."""
+    from .io.sql_writer import read_sql as _read
+
+    return _read(sql_query, connection, partition_col, num_partitions)
+
+
 def read_hudi(table_path: str) -> DataFrame:
     """Read an Apache Hudi copy-on-write table (timeline replay + latest
     file slices per file group — io/hudi.py; reference: daft/io/hudi)."""

@@ -88,3 +88,43 @@ def _plainify(values: list) -> list:
             v = repr(v)
         out.append(v)
     return out
+
+
+def read_sql(sql: str, connection, partition_col=None, num_partitions: int = 1):
+    """Run a SQL query through a DB-API connection (or zero-arg factory) and
+    return the result as arrow data (reference: daft.read_sql via ConnectorX/
+    SQLAlchemy; plain DB-API keeps it dependency-free). With partition_col +
+    num_partitions > 1, the query is split into range partitions like the
+    reference's partitioned reads."""
+    conn = connection if hasattr(connection, "cursor") else connection()
+
+    def _fetch(q: str):
+        cur = conn.cursor()
+        cur.execute(q)
+        cols = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+        return {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+
+    import daft_tpu
+
+    if partition_col is None or num_partitions <= 1:
+        return daft_tpu.from_pydict(_fetch(sql))
+
+    bounds = _fetch(f"SELECT MIN({partition_col}) lo, MAX({partition_col}) hi "
+                    f"FROM ({sql}) __b__")
+    lo, hi = bounds["lo"][0], bounds["hi"][0]
+    if lo is None:
+        return daft_tpu.from_pydict(_fetch(sql))
+    step = (hi - lo) / num_partitions
+    parts = []
+    for i in range(num_partitions):
+        a = lo + step * i
+        b = hi if i == num_partitions - 1 else lo + step * (i + 1)
+        op = "<=" if i == num_partitions - 1 else "<"
+        parts.append(daft_tpu.from_pydict(_fetch(
+            f"SELECT * FROM ({sql}) __p__ WHERE {partition_col} >= {a} "
+            f"AND {partition_col} {op} {b}")))
+    out = parts[0]
+    for p in parts[1:]:
+        out = out.concat(p)
+    return out

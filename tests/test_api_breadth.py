@@ -171,3 +171,14 @@ def test_write_iceberg_partitioned(tmp_path, df):
     assert back.count_rows() == 5
     sub = back.where(col("g") == "b").to_pydict()
     assert sorted(sub["v"]) == [3, 4, 4]
+
+
+def test_read_sql_roundtrip(df):
+    conn = sqlite3.connect(":memory:")
+    df.write_sql("src", conn)
+    back = daft_tpu.read_sql("SELECT g, v FROM src", conn).sort(["g", "v"]).to_pydict()
+    assert back["v"] == [1, 1, 3, 4, 4]
+    # partitioned range read
+    back2 = daft_tpu.read_sql("SELECT g, v FROM src", conn,
+                              partition_col="v", num_partitions=2)
+    assert sorted(back2.to_pydict()["v"]) == [1, 1, 3, 4, 4]
