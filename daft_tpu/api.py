@@ -190,3 +190,27 @@ def file(path_expr, io_config=None) -> Expression:
     from .plan.builder import _to_expr
 
     return _to_expr(path_expr)._fn("file", io_config=io_config)
+
+
+def from_files(path: str, io_config=None) -> DataFrame:
+    """List files matching a glob into a DataFrame with lazy File references
+    (reference: daft.from_files — path/size columns + a file handle column).
+    Columns: path (string), size (int64), file (File)."""
+    from .expressions import col as _col
+
+    df = from_glob_path(path)
+    return df.with_columns({
+        "file": file(_col("path"), io_config=io_config),
+    })
+
+
+def read_lance(uri: str, **kwargs) -> DataFrame:
+    """Read a Lance dataset (requires the `lance` package, like the
+    reference's daft.read_lance)."""
+    try:
+        import lance
+    except ImportError as e:
+        raise ImportError("read_lance requires the 'lance' package "
+                          "(pip install pylance)") from e
+    ds = lance.dataset(uri, **kwargs)
+    return from_arrow(ds.to_table())

@@ -57,8 +57,8 @@ class Session:
 
 class FilesystemCatalog:
     """Concrete catalog over a directory tree: {root}/{namespace...}/{table},
-    each table directory an Iceberg (metadata/) or Delta (_delta_log/) table,
-    auto-detected per load. Reference parity: daft/catalog/__iceberg.py
+    each table directory an Iceberg (metadata/), Delta (_delta_log/) or Hudi
+    (.hoodie/) table, auto-detected per load. Reference parity: daft/catalog/__iceberg.py
     IcebergCatalog.load_table + daft/catalog/__init__.py Catalog protocol.
 
         session.attach_catalog(FilesystemCatalog("/warehouse", name="wh"))
@@ -92,7 +92,9 @@ class FilesystemCatalog:
             return daft_tpu.read_iceberg(d)
         if os.path.isdir(os.path.join(d, "_delta_log")):
             return daft_tpu.read_deltalake(d)
-        raise ValueError(f"{d} is neither an Iceberg nor a Delta table")
+        if os.path.isdir(os.path.join(d, ".hoodie")):
+            return daft_tpu.read_hudi(d)
+        raise ValueError(f"{d} is not an Iceberg/Delta/Hudi table")
 
     def list_tables(self, pattern: Optional[str] = None) -> List[str]:
         import os
@@ -100,11 +102,12 @@ class FilesystemCatalog:
         out = []
         for dirpath, dirnames, _files in os.walk(self.root):
             base = os.path.basename(dirpath)
-            if base in ("metadata", "_delta_log"):
+            if base in ("metadata", "_delta_log", ".hoodie"):
                 dirnames.clear()
                 continue
             if os.path.isdir(os.path.join(dirpath, "metadata")) or \
-                    os.path.isdir(os.path.join(dirpath, "_delta_log")):
+                    os.path.isdir(os.path.join(dirpath, "_delta_log")) or \
+                    os.path.isdir(os.path.join(dirpath, ".hoodie")):
                 rel = os.path.relpath(dirpath, self.root)
                 name = rel.replace(os.sep, ".")
                 if pattern is None or pattern in name:

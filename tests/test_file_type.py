@@ -62,3 +62,18 @@ def test_file_read_whole(paths):
     df = daft_tpu.from_pydict({"p": [p1]})
     out = df.select(daft_tpu.file(col("p")).file_read()).to_pydict()
     assert out["p"] == [b"hello world"]
+
+
+def test_from_files(tmp_path, paths):
+    import daft_tpu
+
+    out = daft_tpu.from_files(str(tmp_path / "*.txt")).to_pydict()
+    assert "file" in out and "path" in out and "size" in out
+    assert out["size"] == [11]
+
+
+def test_read_lance_gated():
+    import daft_tpu
+
+    with pytest.raises(ImportError, match="lance"):
+        daft_tpu.read_lance("/nonexistent")
