@@ -144,3 +144,34 @@ def test_join_matches_manual(case):
                           key=lambda r: (r[1], (r[2] is None, r[2])))
         expect_rows.sort(key=lambda r: (r[1], (r[2] is None, r[2])))
         assert got_rows == expect_rows
+
+
+class TestProbePathEquivalence:
+    """The ProbeTable fast paths (unique-key direct lookup, fused single-key
+    C probe) must produce exactly the general join_indices match set/order."""
+
+    @given(
+        build=st.lists(st.integers(-5, 40) | st.none(), min_size=0, max_size=30),
+        probe=st.lists(st.integers(-5, 40) | st.none(), min_size=0, max_size=60),
+        how=st.sampled_from(["inner", "left", "semi", "anti"]),
+        unique=st.booleans(),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_probe_matches_join_indices(self, build, probe, how, unique):
+        import numpy as np
+
+        from daft_tpu.core.kernels.join import ProbeTable, join_indices
+        from daft_tpu.core.series import Series
+        from daft_tpu.datatype import DataType
+
+        if unique:
+            seen = set()
+            build = [b for b in build
+                     if not (b in seen or (b is not None and seen.add(b)))]
+        bs = Series.from_pylist(build, "k", dtype=DataType.int64())
+        ps = Series.from_pylist(probe, "k", dtype=DataType.int64())
+        expect = join_indices([ps], [bs], how=how)
+        table = ProbeTable([bs], [DataType.int64()], null_equals_null=False)
+        got = table.probe([ps], how)
+        np.testing.assert_array_equal(got[0], expect[0], err_msg=f"{how} lidx")
+        np.testing.assert_array_equal(got[1], expect[1], err_msg=f"{how} ridx")
