@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SF = float(os.environ.get("BENCH_SF", 1.0))
 BASELINE_ROWS_PER_SEC = 50e6
 SUITE = os.environ.get("BENCH_SUITE", "tpch")
-_DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,42,52,55,96"}
+_DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,33,42,52,55,56,96"}
 QUERIES = [int(x) for x in os.environ.get(
     "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",")]
 REPS = int(os.environ.get("BENCH_REPS", 5))

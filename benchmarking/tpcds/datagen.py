@@ -82,6 +82,9 @@ def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
         "i_class": pa.array([CLASSES[c] for c in class_id]),
         "i_category_id": pa.array((cat_id + 1).astype(np.int32)),
         "i_category": pa.array([CATEGORIES[c] for c in cat_id]),
+        "i_color": pa.array([["slate","blanched","burnished","powder","ghost",
+                              "peach","salmon","mint","azure","rose"][i]
+                             for i in rng.integers(0, 10, n_item)]),
         "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int32),
         "i_manager_id": rng.integers(1, 101, n_item).astype(np.int32),
         "i_current_price": _money(rng, n_item, 0.09, 99.99),
@@ -185,11 +188,33 @@ def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
         "ss_net_profit": np.round((sales_price - wholesale) * qty, 2),
     })
 
+    # ---- catalog_sales / web_sales facts (the other two sales channels;
+    # ~1.44M / ~0.72M rows per SF like the spec's 2:1:0.5 channel ratios) ------
+    def _channel(prefix: str, n_rows: int) -> pa.Table:
+        q = rng.integers(1, 101, n_rows).astype(np.int32)
+        lp = _money(rng, n_rows, 1.0, 200.0)
+        sp = np.round(lp * rng.uniform(0.2, 1.0, n_rows), 2)
+        return pa.table({
+            f"{prefix}_sold_date_sk": (rng.integers(0, n_dates, n_rows)
+                                       + 2_450_000).astype(np.int64),
+            f"{prefix}_item_sk": rng.integers(1, n_item + 1, n_rows).astype(np.int64),
+            f"{prefix}_bill_customer_sk": rng.integers(1, n_cust + 1, n_rows).astype(np.int64),
+            f"{prefix}_bill_addr_sk": rng.integers(1, n_ca + 1, n_rows).astype(np.int64),
+            f"{prefix}_quantity": q,
+            f"{prefix}_list_price": lp,
+            f"{prefix}_sales_price": sp,
+            f"{prefix}_ext_sales_price": np.round(sp * q, 2),
+        })
+
+    catalog_sales = _channel("cs", int(1_440_000 * sf))
+    web_sales = _channel("ws", int(720_000 * sf))
+
     return {
         "date_dim": date_dim, "time_dim": time_dim, "item": item,
         "customer_demographics": cd, "household_demographics": hd,
         "customer_address": ca, "customer": customer, "store": store,
         "promotion": promotion, "store_sales": store_sales,
+        "catalog_sales": catalog_sales, "web_sales": web_sales,
     }
 
 
@@ -204,7 +229,7 @@ def cached_tables(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
     d = os.path.join(_CACHE_DIR, key)
     names = ["date_dim", "time_dim", "item", "customer_demographics",
              "household_demographics", "customer_address", "customer", "store",
-             "promotion", "store_sales"]
+             "promotion", "store_sales", "catalog_sales", "web_sales"]
     if os.path.isdir(d) and all(
             os.path.exists(os.path.join(d, f"{n}.parquet")) for n in names):
         return {n: pq.read_table(os.path.join(d, f"{n}.parquet")) for n in names}

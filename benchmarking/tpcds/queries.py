@@ -127,3 +127,56 @@ def q96(t):
 
 
 ALL_QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 96: q96}
+
+
+def _three_channel_total(t, key_col: str, item_filter, d_year: int, d_moy: int):
+    """Shared shape of q33/q56: per-channel revenue for a filtered item set in
+    one month, restricted to ca_gmt_offset = -5, summed across channels."""
+    from daft_tpu import col
+
+    wanted = (t["item"].where(item_filter).select(key_col).distinct())
+    dd = t["date_dim"].where((col("d_year") == d_year) & (col("d_moy") == d_moy))
+    ca = t["customer_address"].where(col("ca_gmt_offset") == -5.0)
+
+    def channel(fact: str, prefix: str):
+        return (t[fact]
+                .join(dd, left_on=f"{prefix}_sold_date_sk", right_on="d_date_sk")
+                .join(ca, left_on=(f"{prefix}_addr_sk" if prefix == "ss"
+                                   else f"{prefix}_bill_addr_sk"),
+                      right_on="ca_address_sk")
+                .join(t["item"], left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+                .join(wanted, left_on=key_col, right_on=key_col, how="semi")
+                .groupby(key_col)
+                .agg(col(f"{prefix}_ext_sales_price").sum().alias("total_sales")))
+
+    ss = channel("store_sales", "ss")
+    cs = channel("catalog_sales", "cs")
+    ws = channel("web_sales", "ws")
+    return (ss.concat(cs).concat(ws)
+            .groupby(key_col)
+            .agg(col("total_sales").sum().alias("total_sales"))
+            .sort(["total_sales", key_col])
+            .limit(100))
+
+
+def q33(t):
+    """queries/33.sql: Electronics revenue by manufacturer across all three
+    sales channels, May 1998."""
+    from daft_tpu import col
+
+    return _three_channel_total(t, "i_manufact_id",
+                                col("i_category") == "Electronics", 1998, 5)
+
+
+def q56(t):
+    """queries/56.sql: colored-item revenue by item id across all three
+    sales channels, Feb 2001."""
+    from daft_tpu import col
+
+    return _three_channel_total(
+        t, "i_item_id",
+        col("i_color").is_in(["slate", "blanched", "burnished"]), 2001, 2)
+
+
+ALL_QUERIES[33] = q33
+ALL_QUERIES[56] = q56

@@ -166,3 +166,42 @@ def test_q96(tables, pdt):
     got = ALL_QUERIES[96](tables).to_pydict()
     assert got["count"][0] == len(m)
     assert len(m) > 0
+
+
+def _three_channel_expected(pdt, key, item_mask, d_year, d_moy):
+    dd = pdt["date_dim"]
+    dd = dd[(dd.d_year == d_year) & (dd.d_moy == d_moy)]
+    ca = pdt["customer_address"]
+    ca = ca[ca.ca_gmt_offset == -5.0]
+    wanted = set(pdt["item"][item_mask][key])
+    frames = []
+    for fact, prefix, addr in (("store_sales", "ss", "ss_addr_sk"),
+                               ("catalog_sales", "cs", "cs_bill_addr_sk"),
+                               ("web_sales", "ws", "ws_bill_addr_sk")):
+        m = (pdt[fact]
+             .merge(dd, left_on=f"{prefix}_sold_date_sk", right_on="d_date_sk")
+             .merge(ca, left_on=addr, right_on="ca_address_sk")
+             .merge(pdt["item"], left_on=f"{prefix}_item_sk", right_on="i_item_sk"))
+        m = m[m[key].isin(wanted)]
+        frames.append(m.groupby(key, as_index=False)
+                      .agg(total_sales=(f"{prefix}_ext_sales_price", "sum")))
+    allf = pd.concat(frames)
+    return (allf.groupby(key, as_index=False)
+            .agg(total_sales=("total_sales", "sum"))
+            .sort_values(["total_sales", key], kind="stable")
+            .head(100)[[key, "total_sales"]])
+
+
+def test_q33(tables, pdt):
+    exp = _three_channel_expected(pdt, "i_manufact_id",
+                                  pdt["item"].i_category == "Electronics", 1998, 5)
+    assert len(exp) > 0
+    _check(ALL_QUERIES[33](tables).to_pydict(), exp)
+
+
+def test_q56(tables, pdt):
+    exp = _three_channel_expected(
+        pdt, "i_item_id",
+        pdt["item"].i_color.isin(["slate", "blanched", "burnished"]), 2001, 2)
+    assert len(exp) > 0
+    _check(ALL_QUERIES[56](tables).to_pydict(), exp)
