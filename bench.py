@@ -63,13 +63,24 @@ def main() -> None:
     # best-of-N timed repetitions: the tunneled device's d2h round trip
     # occasionally spikes 5-10x, which is link jitter, not engine throughput
     per_query = {q: float("inf") for q in QUERIES}
+    q_device = {q: 0 for q in QUERIES}     # device dispatches, total across reps
+    q_reject = {}                          # why a query stayed on host (first seen)
     elapsed = float("inf")
     for _ in range(REPS):
         t0 = time.perf_counter()
         for q in QUERIES:
+            counters.reset()
             tq = time.perf_counter()
             ALL_QUERIES[q](tables).to_pydict()
             per_query[q] = min(per_query[q], time.perf_counter() - tq)
+            # grouped + ungrouped stage batches count each dispatch exactly
+            # once (join/topn counters overlay the same dispatches)
+            rep_batches = (counters.device_grouped_batches
+                           + counters.device_stage_batches)
+            q_device[q] += rep_batches
+            if rep_batches == 0 and counters.rejections and q not in q_reject:
+                q_reject[q] = max(counters.rejections,
+                                  key=counters.rejections.get)
         elapsed = min(elapsed, time.perf_counter() - t0)
 
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
@@ -78,10 +89,10 @@ def main() -> None:
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
-        "device_batches": (counters.device_grouped_batches
-                           + counters.device_stage_batches
-                           + counters.device_join_batches),
+        "device_batches": sum(q_device.values()),
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
+        "per_query_device": {f"q{q}": q_device[q] for q in QUERIES},
+        "host_reasons": {f"q{q}": r for q, r in sorted(q_reject.items())},
         "sf": SF,
         "fact_rows": n_lineitem,
     }))

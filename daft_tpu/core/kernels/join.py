@@ -280,24 +280,29 @@ class ProbeTable:
     def _ensure_direct(self):
         """Unique-build-key direct lookup (value -> build row in ONE random
         access): a dense row table or a value->row pairmap. Built lazily on
-        the first qualifying probe; None when the shape doesn't qualify."""
+        the first qualifying probe; None when the shape doesn't qualify.
+        Double-checked under _rows_lock like _ensure_bucket_rows — concurrent
+        pool threads would otherwise build the dense table twice."""
         if self._direct is None:
-            from ...native import get_lib, native_i64_map_build
+            with self._rows_lock:
+                if self._direct is None:
+                    from ...native import get_lib, native_i64_map_build
 
-            lk = self._lookups[0]
-            if lk[0] == "dense":
-                lo, hi = lk[1], lk[2]
-                codes = self._joint_codes
-                table = np.full(hi - lo + 1, -1, dtype=np.int64)
-                pos = codes >= 0
-                table[codes[pos]] = np.flatnonzero(pos)
-                self._direct = ("dense", lo, hi, np.ascontiguousarray(table))
-            elif lk[0] == "hashmap" and self._single_vals is not None \
-                    and get_lib() is not None:
-                hm = native_i64_map_build(self._single_vals)
-                self._direct = ("pairmap", hm[0], hm[1])
-            else:
-                self._direct = ("none",)
+                    lk = self._lookups[0]
+                    if lk[0] == "dense":
+                        lo, hi = lk[1], lk[2]
+                        codes = self._joint_codes
+                        table = np.full(hi - lo + 1, -1, dtype=np.int64)
+                        pos = codes >= 0
+                        table[codes[pos]] = np.flatnonzero(pos)
+                        self._direct = ("dense", lo, hi,
+                                        np.ascontiguousarray(table))
+                    elif lk[0] == "hashmap" and self._single_vals is not None \
+                            and get_lib() is not None:
+                        hm = native_i64_map_build(self._single_vals)
+                        self._direct = ("pairmap", hm[0], hm[1])
+                    else:
+                        self._direct = ("none",)
         return None if self._direct[0] == "none" else self._direct
 
     def _probe_unique(self, left_keys: list, how: str):

@@ -269,6 +269,10 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield _exec_device_join_agg(node)
         return
 
+    if isinstance(node, pp.DeviceJoinTopN):
+        yield _exec_device_join_topn(node)
+        return
+
     if isinstance(node, pp.Dedup):
         # streaming dedup, keep-first: each batch dedups internally, then drops
         # rows whose keys were already seen — probed against an amortized
@@ -501,66 +505,13 @@ def _exec_device_join_agg(node) -> MicroPartition:
     """Run a DeviceJoinAgg node: the gather-join device program, or the
     untouched host plan (config off, small input, or runtime DeviceFallback).
     """
-    from ..config import execution_config
-    from ..ops.device_join import (DeviceJoinGroupedRun, DeviceJoinUngroupedRun,
-                                   _JoinContext, build_join_stage)
-    from ..ops.grouped_stage import DeviceFallback
+    from ..ops.device_join import DeviceJoinGroupedRun, DeviceJoinUngroupedRun
 
-    cfg = execution_config()
+    def make_run(stage, grouped, ctx):
+        return (DeviceJoinGroupedRun(stage, ctx) if grouped
+                else DeviceJoinUngroupedRun(stage, ctx))
 
-    def _host() -> MicroPartition:
-        parts = list(_exec(node.host_plan))
-        batch = _concat_parts(parts, node.schema)
-        return MicroPartition(node.schema, [batch])
-
-    # Device joins move per-query dim-sized arrays (codes, visibility, match
-    # sets) host->device. On a locally attached TPU those transfers are
-    # microseconds; over a tunneled device EACH pays the link round trip
-    # (~50-90ms measured), which dwarfs the compute. So "auto" requires an
-    # explicit opt-in (DAFT_TPU_JOIN_DEVICE=1) — the bench-honest default —
-    # while device_mode="on" always exercises the path (tests do).
-    import os
-
-    use_device = cfg.device_mode == "on" or (
-        cfg.device_mode == "auto"
-        and os.environ.get("DAFT_TPU_JOIN_DEVICE") == "1")
-    raw_stream = None      # the closeable generator (cancellation must reach it)
-    fact_stream = None
-    if use_device and cfg.device_mode == "auto":
-        import jax
-
-        if jax.default_backend() in ("cpu",):
-            use_device = False
-        else:
-            raw_stream = _exec(node.fact)
-            first = next(raw_stream, None)
-            if first is not None:
-                fact_stream = itertools.chain([first], raw_stream)
-                use_device = first.num_rows >= cfg.device_min_rows
-            else:
-                fact_stream = raw_stream
-    if not use_device:
-        if raw_stream is not None:
-            raw_stream.close()
-        return _host()
-
-    try:
-        stage, grouped = build_join_stage(node.spec)
-        if stage is None:
-            if raw_stream is not None:
-                raw_stream.close()
-            return _host()
-        dim_batches = {}
-        for name, plan in node.dim_plans:
-            dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
-        ctx = _JoinContext(node.spec, dim_batches)
-        run = DeviceJoinGroupedRun(stage, ctx) if grouped \
-            else DeviceJoinUngroupedRun(stage, ctx)
-        if fact_stream is None:
-            raw_stream = fact_stream = _exec(node.fact)
-        for part in fact_stream:
-            for b in part.batches:
-                run.feed_batch(b)
+    def assemble(run, stage, grouped):
         if grouped:
             key_rows, results = run.finalize()
             return _grouped_output(node.schema, node.spec.groupby,
@@ -574,10 +525,213 @@ def _exec_device_join_agg(node) -> MicroPartition:
             cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
         out = RecordBatch(node.schema, cols, 1)
         return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
-    except DeviceFallback:
-        if raw_stream is not None:
-            raw_stream.close()
+
+    return _run_device_join(node, "join agg", make_run, assemble,
+                            grouped_required=False, topn=False)
+
+
+def _exec_device_join_topn(node) -> MicroPartition:
+    """Run a DeviceJoinTopN node: the fused join+agg+sort+limit device
+    program, or the untouched host plan (config off, cost model, or runtime
+    DeviceFallback)."""
+    from ..ops.device_join import DeviceJoinTopNRun
+
+    def make_run(stage, grouped, ctx):
+        return DeviceJoinTopNRun(stage, ctx, node.topn)
+
+    def assemble(run, stage, grouped):
+        key_rows, results = run.finalize_topn()
+        from ..core.series import Series
+
+        cols = []
+        for f, (kind, idx) in zip(node.schema, node.out_map):
+            if kind == "group":
+                cols.append(Series.from_pylist([k[idx] for k in key_rows],
+                                               f.name, dtype=f.dtype))
+            else:
+                vals, valid = results[idx]
+                data = [v.item() if ok else None
+                        for v, ok in zip(vals, valid)]
+                cols.append(Series.from_pylist(data, f.name, dtype=f.dtype))
+        out = RecordBatch(node.schema, cols, len(key_rows))
+        return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+    return _run_device_join(node, "join topn", make_run, assemble,
+                            grouped_required=True, topn=True)
+
+
+def _run_device_join(node, label: str, make_run, assemble,
+                     grouped_required: bool, topn: bool) -> MicroPartition:
+    """Shared driver for the device join nodes: mode/backend gates, dim
+    materialization, the cost-model decision (dims first — the joined group
+    cardinality is sampled through the real join indices), feed, assembly,
+    and host fallback with a recorded reason. Steady-state per-query device
+    traffic is tiny (gathers read resident planes; every dim-sized upload is
+    series_keyed-cached), so the decision weighs the amortized upload and
+    factorize investment + one d2h round trip against host probe+agg passes.
+    """
+    from ..config import execution_config
+    from ..ops import counters as _counters
+    from ..ops.device_join import _JoinContext, build_join_stage
+    from ..ops.grouped_stage import DeviceFallback
+
+    cfg = execution_config()
+
+    def _host() -> MicroPartition:
+        parts = list(_exec(node.host_plan))
+        batch = _concat_parts(parts, node.schema)
+        return MicroPartition(node.schema, [batch])
+
+    if cfg.device_mode == "off":
+        # config may have changed between translation (which gated capture)
+        # and lazy execution — the off switch must hold at run time too
         return _host()
+    if cfg.device_mode == "auto":
+        import jax
+
+        if jax.default_backend() in ("cpu",):
+            _counters.reject("cost", f"{label}: cpu backend")
+            return _host()
+
+    # config/spec-only check BEFORE any subtree executes (the fallback path
+    # must not pay a fact peek just to learn the stage can't build)
+    stage, grouped = build_join_stage(node.spec)
+    if stage is None or (grouped_required and not grouped):
+        return _host()
+
+    raw_stream = _exec(node.fact)  # closeable generator (cancellation target)
+    try:
+        first = next(raw_stream, None)
+        if first is None:
+            raw_stream.close()
+            return _host()
+        fact_stream = itertools.chain([first], raw_stream)
+        if cfg.device_mode == "auto" and first.num_rows < cfg.device_min_rows:
+            _counters.reject("cost", f"{label}: below device_min_rows",
+                             f"({first.num_rows} rows)")
+            raw_stream.close()
+            return _host()
+        dim_batches = {}
+        for name, plan in node.dim_plans:
+            dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
+        ctx = _JoinContext(node.spec, dim_batches)
+        if cfg.device_mode == "auto":
+            batch0 = next((b for b in first.batches if b.num_rows > 0), None)
+            if batch0 is None or not _join_device_wins(
+                    node, ctx, batch0, first.num_rows, grouped, stage,
+                    topn=topn, label=label):
+                raw_stream.close()
+                return _host()
+        run = make_run(stage, grouped, ctx)
+        for part in fact_stream:
+            for b in part.batches:
+                run.feed_batch(b)
+        return assemble(run, stage, grouped)
+    except DeviceFallback as e:
+        _counters.reject("runtime", f"{label}: device fallback", str(e))
+        raw_stream.close()
+        return _host()
+
+
+def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
+                      topn: bool = False, label: str = "join agg") -> bool:
+    """Cost-model decision for a DeviceJoinAgg node (see ops/costmodel.py).
+
+    One-time investments (fact column uploads, index planes, joined-key
+    factorize) amortize over device_amortize_runs when the fact source is a
+    resident in-memory table — they are all series_keyed-cached, so reps pay
+    only dispatches + one fetch."""
+    from ..config import execution_config
+    from ..ops import costmodel, counters as _counters
+    from ..ops.device_join import DeviceJoinGroupedRun, estimate_joined_cardinality
+    from ..ops.grouped_stage import MAX_MATMUL_SEGMENTS, _pad_groups
+    from ..ops.stage import pad_bucket
+
+    spec = node.spec
+    cal = costmodel.calibrate()
+    bucket = pad_bucket(batch.num_rows)
+    amort = max(execution_config().device_amortize_runs, 1) \
+        if _resident_source_rec(node.fact) else 1
+
+    # The HOST plan pushes the lifted conjuncts back below the join, so its
+    # probe/agg passes see only the filtered stream; the device program sees
+    # every row (filters are masks). Price them accordingly.
+    host_rows = rows
+    if spec.predicate is not None:
+        from ..plan.stats import selectivity
+
+        host_rows = max(int(rows * min(selectivity(spec.predicate), 1.0)), 1)
+
+    fact_cols = [c for c in stage._input_cols
+                 if spec.col_side.get(c) == "fact" and c not in spec.fact_synthetic]
+    dim_cols = [c for c in stage._input_cols
+                if spec.col_side.get(c) not in ("fact", None)]
+    nonres = sum(batch.num_rows * 5 for c in fact_cols
+                 if not batch.get_column(c).is_device_resident(bucket, f32=True))
+    nonres += len(spec.dims) * bucket * 4      # padded per-dim index planes
+    n_gathers = len(dim_cols) + len(spec.dims)  # value planes + visibility
+
+    if grouped:
+        import math
+
+        from ..ops.device_join import DeviceJoinTopNRun
+
+        ceiling = DeviceJoinTopNRun.max_segments if topn \
+            else DeviceJoinGroupedRun.max_segments
+        card = estimate_joined_cardinality(ctx, batch, stage.groupby)
+        cap_est = _pad_groups(min(max(card, 1), 2 * ceiling))
+        if cap_est > ceiling:
+            _counters.reject("cost", f"{label}: est group count over ceiling",
+                             f"({card} > {ceiling})")
+            return False
+        n_mm = len(stage._mm_specs)
+        n_ext = len(stage._ext_specs)
+        n_sct = len(stage._sct_specs)
+        if topn:
+            k_total = node.topn.offset + node.topn.limit
+            fetch = k_total * (n_mm + n_ext + n_sct + 1) * 8
+        else:
+            fetch = cap_est * (n_mm + n_ext + n_sct) * 8
+        nonres += bucket * 4                   # codes plane (host-factorize case)
+        dev_cost = costmodel.device_join_agg_cost(
+            cal, rows, nonres // amort, n_gathers, n_mm, n_ext, n_sct,
+            cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS)
+        if topn:
+            # device multi-key sort over the cap-length planes
+            nkeys = len(node.topn.keys) + 2
+            dev_cost += (cap_est * max(math.log2(max(cap_est, 2)), 1.0)
+                         * nkeys / cal.mm_plane_rows_per_s)
+        host_cost = costmodel.host_join_agg_cost(
+            cal, host_rows, len(spec.dims), len(stage.aggs), True, False)
+        if spec.predicate is not None:
+            host_cost += rows / cal.host_agg_rate  # filter pass over the full stream
+        if topn:
+            # host additionally sorts the aggregate's output rows
+            host_cost += (card * max(math.log2(max(card, 2)), 1.0)
+                          / cal.host_agg_rate)
+    else:
+        fetch = 256 * max(len(stage.aggs), 1)
+        dev_cost = costmodel.device_join_agg_cost(
+            cal, rows, nonres // amort, n_gathers, max(len(stage.aggs), 1),
+            0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS)
+        host_cost = costmodel.host_join_agg_cost(
+            cal, host_rows, len(spec.dims), len(stage.aggs), False, False)
+        if spec.predicate is not None:
+            host_cost += rows / cal.host_agg_rate  # filter pass over the full stream
+    if dev_cost >= host_cost:
+        _counters.reject("cost", f"{label}: host wins cost model",
+                         f"(host {host_cost*1e3:.0f}ms vs device "
+                         f"{dev_cost*1e3:.0f}ms est)")
+        return False
+    return True
+
+
+def _resident_source_rec(n) -> bool:
+    """True if every leaf under `n` is an in-memory scan (resident table)."""
+    kids = n.children()
+    if not kids:
+        return isinstance(n, pp.InMemoryScan)
+    return all(_resident_source_rec(k) for k in kids)
 
 
 def _grouped_output(schema, groupby, aggregations, key_rows, results) -> MicroPartition:

@@ -188,14 +188,22 @@ class UdfProcessPool:
         match route to the same replica, so each replica's KV/prompt cache
         keeps serving its prefix family. Sub-batches run on their replicas
         CONCURRENTLY; results reassemble in input row order."""
+        import zlib
+
         import numpy as np
 
         n_workers = len(self.workers)
         if n_workers <= 1 or num_rows <= 1:
             return self.run_batch(arg_series, kwargs, num_rows)
         keys = arg_series[0].to_pylist()
+        # crc32: a STABLE hash — builtin hash() is salted per process
+        # (PYTHONHASHSEED), which would re-shuffle prefix->replica affinity on
+        # every driver restart and lose long-lived replicas' KV caches. str()
+        # coerces non-string first args (ints, dates) instead of raising.
         assign = np.asarray(
-            [hash((k or "")[:prefix_len]) % n_workers for k in keys],
+            [zlib.crc32(str(k if k is not None else "")[:prefix_len]
+                        .encode("utf-8", "surrogatepass")) % n_workers
+             for k in keys],
             dtype=np.int64)
         groups = [np.flatnonzero(assign == w) for w in range(n_workers)]
         from concurrent.futures import ThreadPoolExecutor

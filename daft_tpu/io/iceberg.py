@@ -342,10 +342,11 @@ def _ice_avro_partition_fields(schema: Schema, partition_cols: List[str]):
     amap = {"int64": "long", "int32": "int", "string": "string", "bool": "boolean",
             "float64": "double", "float32": "float", "date": "int"}
     out = []
-    for name in partition_cols:
+    for i, name in enumerate(partition_cols):
         kind = schema[name].dtype.kind
         at = amap.get(kind, "long" if schema[name].dtype.is_integer() else "string")
-        out.append({"name": name, "type": ["null", at], "default": None})
+        out.append({"name": name, "type": ["null", at], "default": None,
+                    "field-id": 1000 + i})
     return out
 
 
@@ -436,21 +437,26 @@ def write_iceberg(df, table_path: str, mode: str = "append",
 
     # ---- manifest (avro) -----------------------------------------------------------
     part_fields = _ice_avro_partition_fields(schema, parts)
+    # field-id attributes follow the Iceberg spec's manifest field IDs —
+    # external readers (pyiceberg/Spark/Trino) resolve manifest columns by
+    # field-id, not name (spec: "Manifests", table "manifest_entry fields")
     data_file_schema = {
         "type": "record", "name": "r2", "fields": [
-            {"name": "content", "type": "int"},
-            {"name": "file_path", "type": "string"},
-            {"name": "file_format", "type": "string"},
+            {"name": "content", "type": "int", "field-id": 134},
+            {"name": "file_path", "type": "string", "field-id": 100},
+            {"name": "file_format", "type": "string", "field-id": 101},
             {"name": "partition",
-             "type": {"type": "record", "name": "r102", "fields": part_fields}},
-            {"name": "record_count", "type": "long"},
-            {"name": "file_size_in_bytes", "type": "long"},
+             "type": {"type": "record", "name": "r102", "fields": part_fields},
+             "field-id": 102},
+            {"name": "record_count", "type": "long", "field-id": 103},
+            {"name": "file_size_in_bytes", "type": "long", "field-id": 104},
         ]}
     entry_schema = {
         "type": "record", "name": "manifest_entry", "fields": [
-            {"name": "status", "type": "int"},
-            {"name": "snapshot_id", "type": ["null", "long"], "default": None},
-            {"name": "data_file", "type": data_file_schema},
+            {"name": "status", "type": "int", "field-id": 0},
+            {"name": "snapshot_id", "type": ["null", "long"], "default": None,
+             "field-id": 1},
+            {"name": "data_file", "type": data_file_schema, "field-id": 2},
         ]}
     manifest_name = f"{_uuid.uuid4().hex}-m0.avro"
     manifest_path = os.path.join(meta_dir, manifest_name)
@@ -466,11 +472,11 @@ def write_iceberg(df, table_path: str, mode: str = "append",
     # ---- manifest list (avro) --------------------------------------------------------
     ml_schema = {
         "type": "record", "name": "manifest_file", "fields": [
-            {"name": "manifest_path", "type": "string"},
-            {"name": "manifest_length", "type": "long"},
-            {"name": "partition_spec_id", "type": "int"},
-            {"name": "content", "type": "int"},
-            {"name": "added_snapshot_id", "type": "long"},
+            {"name": "manifest_path", "type": "string", "field-id": 500},
+            {"name": "manifest_length", "type": "long", "field-id": 501},
+            {"name": "partition_spec_id", "type": "int", "field-id": 502},
+            {"name": "content", "type": "int", "field-id": 517},
+            {"name": "added_snapshot_id", "type": "long", "field-id": 503},
         ]}
     ml_records = [{"manifest_path": f"{table_path}/metadata/{manifest_name}",
                    "manifest_length": os.path.getsize(manifest_path),
@@ -515,6 +521,8 @@ def write_iceberg(df, table_path: str, mode: str = "append",
         "partition-specs": [{"spec-id": 0, "fields": spec_fields}],
         "default-spec-id": 0,
         "last-partition-id": 1000 + len(spec_fields),
+        "sort-orders": [{"order-id": 0, "fields": []}],
+        "default-sort-order-id": 0,
         "properties": {},
         "current-snapshot-id": snapshot_id,
         "snapshots": snapshots,

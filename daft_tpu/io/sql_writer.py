@@ -115,6 +115,13 @@ def read_sql(sql: str, connection, partition_col=None, num_partitions: int = 1):
     lo, hi = bounds["lo"][0], bounds["hi"][0]
     if lo is None:
         return daft_tpu.from_pydict(_fetch(sql))
+    if not isinstance(lo, (int, float)) or not isinstance(hi, (int, float)) \
+            or isinstance(lo, bool) or isinstance(hi, bool):
+        # non-numeric partition column (dates/strings): range arithmetic below
+        # doesn't apply — read unpartitioned rather than raising mid-plan
+        # (reference supports these via percentile-based partitioning;
+        # src/daft-connectors sql percentile path — not implemented here)
+        return daft_tpu.from_pydict(_fetch(sql))
     step = (hi - lo) / num_partitions
     parts = []
     for i in range(num_partitions):

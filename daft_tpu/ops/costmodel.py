@@ -36,12 +36,14 @@ def _env_f(name: str, default: float) -> float:
 class Calibration:
     rtt_s: float
     h2d_bytes_per_s: float
+    d2h_bytes_per_s: float        # device->host fetch bandwidth (tunnel: ~2MB/s)
     mm_plane_rows_per_s: float    # ungrouped reduce throughput (plane-rows/s)
     mm_cell_rate: float           # grouped one-hot matmul cells (rows x segments x planes)/s
     scatter_rows_per_s: float
     ext_cell_rate: float          # extreme-plane cells (rows x segments) per sec
     host_agg_rate: float          # host value-ops per sec (vectorized numpy)
     host_factorize_rate: float    # host group-key factorize rows per sec
+    host_probe_rate: float        # host hash-join probe rows per sec per dim
 
 
 _CAL: Optional[Calibration] = None
@@ -60,7 +62,8 @@ def calibrate() -> Calibration:
 
     rtt = _env_f("DAFT_TPU_COST_RTT", -1.0)
     h2d = _env_f("DAFT_TPU_COST_H2D", -1.0)
-    if rtt < 0 or h2d < 0:
+    d2h = _env_f("DAFT_TPU_COST_D2H", -1.0)
+    if rtt < 0 or h2d < 0 or d2h < 0:
         import numpy as np
 
         from ..utils import jax_setup  # noqa: F401
@@ -88,16 +91,26 @@ def calibrate() -> Calibration:
                 dt = max(time.perf_counter() - t0 - rtt, 1e-3)
                 best = max(best, buf.nbytes / dt)
             h2d = best
+        if d2h < 0:
+            ident = jax.jit(lambda a: a * 1)
+            big = jax.device_put(np.ones(256 * 1024, np.float32))  # 1 MB down
+            jax.device_get(ident(big))  # compile
+            t0 = time.perf_counter()
+            jax.device_get(ident(big))
+            dt = max(time.perf_counter() - t0 - rtt, 1e-3)
+            d2h = big.nbytes / dt
 
     _CAL = Calibration(
         rtt_s=rtt,
         h2d_bytes_per_s=h2d,
+        d2h_bytes_per_s=d2h,
         mm_plane_rows_per_s=_env_f("DAFT_TPU_COST_MM_RATE", 5e9),
         mm_cell_rate=_env_f("DAFT_TPU_COST_MM_CELL_RATE", 5e10),
         scatter_rows_per_s=_env_f("DAFT_TPU_COST_SCATTER_RATE", 1e8),
         ext_cell_rate=_env_f("DAFT_TPU_COST_EXT_RATE", 5e9),
         host_agg_rate=_env_f("DAFT_TPU_COST_HOST_AGG", 1.5e8),
         host_factorize_rate=_env_f("DAFT_TPU_COST_HOST_FACT", 8e6),
+        host_probe_rate=_env_f("DAFT_TPU_COST_HOST_PROBE", 3e7),
     )
     return _CAL
 
@@ -140,6 +153,41 @@ def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
     return (cal.rtt_s
             + nonresident_bytes / cal.h2d_bytes_per_s
             + rows * n_partials / cal.mm_plane_rows_per_s)
+
+
+def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
+                         n_gathers: int, n_mm: int, n_ext: int, n_sct: int,
+                         cap_est: int, fetch_bytes: int,
+                         factorize_rows: int, matmul_ceiling: int = 4096) -> float:
+    """One gather-join + aggregate device run: fixed round trip + amortized
+    uploads + per-dim gathers + the segment reduction (matmul cells below the
+    ceiling, sort passes above) + the finalize fetch + amortized host
+    factorize work (join indices / joined-key codes)."""
+    import math
+
+    c = (cal.rtt_s
+         + upload_bytes / cal.h2d_bytes_per_s
+         + n_gathers * rows / cal.mm_plane_rows_per_s
+         + factorize_rows / cal.host_factorize_rate
+         + fetch_bytes / cal.d2h_bytes_per_s)
+    cap_est = max(cap_est, 8)
+    if cap_est <= matmul_ceiling:
+        c += (rows * cap_est * n_mm / cal.mm_cell_rate
+              + rows * cap_est * n_ext / cal.ext_cell_rate
+              + n_sct * rows / cal.scatter_rows_per_s)
+    else:
+        logn = max(math.log2(max(rows, 2)), 1.0)
+        c += (rows * logn / cal.mm_plane_rows_per_s
+              + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
+    return c
+
+
+def host_join_agg_cost(cal: Calibration, rows: int, n_dims: int, n_aggs: int,
+                       grouped: bool, has_predicate: bool) -> float:
+    """Host execution of the same star query: probe-table passes over the fact
+    stream (one per dim) + the aggregation."""
+    return (rows * max(n_dims, 1) / cal.host_probe_rate
+            + host_agg_cost(cal, rows, n_aggs, grouped, has_predicate))
 
 
 def host_agg_cost(cal: Calibration, rows: int, n_aggs: int, grouped: bool,

@@ -207,6 +207,30 @@ class DeviceJoinAgg(PhysicalPlan):
         return f"DeviceJoinAgg({len(self.dim_plans)} dims)"
 
 
+class DeviceJoinTopN(PhysicalPlan):
+    """Star join + grouped aggregate + ORDER BY + LIMIT fused for the device
+    (ops/device_join.py DeviceJoinTopNRun): group tables stay on device; a
+    multi-key lax.sort picks the K winners and only K rows are fetched.
+    `host_plan` is the untouched translation of the same TopN subtree."""
+
+    def __init__(self, fact: PhysicalPlan, dim_plans, spec, topn, out_map,
+                 host_plan: PhysicalPlan, schema: Schema):
+        super().__init__()
+        self.fact = fact
+        self.dim_plans = dim_plans
+        self.spec = spec            # ops.device_join.JoinAggSpec
+        self.topn = topn            # ops.device_join.TopNSpec
+        self.out_map = out_map      # [(kind, index)] per output column
+        self.host_plan = host_plan
+        self.schema = schema
+
+    def children(self):
+        return [self.fact] + [p for _n, p in self.dim_plans]
+
+    def name(self) -> str:
+        return f"DeviceJoinTopN({len(self.dim_plans)} dims, k={self.topn.limit})"
+
+
 class DeviceGroupedAgg(_Unary):
     """Fused (optional filter)+grouped-agg stage eligible for the JAX device.
 
@@ -409,6 +433,25 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
                         plan.nulls_first, plan.schema)
 
     if isinstance(plan, lp.TopN):
+        from ..config import execution_config
+
+        cfg = config or execution_config()
+        if getattr(cfg, "device_mode", "off") != "off":
+            from ..ops.device_join import try_capture_join_topn
+
+            try:
+                cap3 = try_capture_join_topn(plan)
+            except Exception:
+                cap3 = None  # capture must never break planning
+            if cap3 is not None:
+                jspec, topn, out_map = cap3
+                host = PhysTopN(translate(plan.input, config), plan.sort_by,
+                                plan.descending, plan.nulls_first, plan.limit,
+                                plan.offset, plan.schema)
+                return DeviceJoinTopN(
+                    translate(jspec.fact, config),
+                    [(d.name, translate(d.base, config)) for d in jspec.dims],
+                    jspec, topn, out_map, host, plan.schema)
         return PhysTopN(translate(plan.input, config), plan.sort_by, plan.descending,
                         plan.nulls_first, plan.limit, plan.offset, plan.schema)
 

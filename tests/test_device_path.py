@@ -344,3 +344,21 @@ def test_sort_path_with_predicate_and_nulls():
                                rtol=1e-6, atol=1e-9)
     np.testing.assert_allclose(np.array(dev_out["mn"], dtype=float),
                                np.array(host["mn"], dtype=float), rtol=1e-12)
+
+
+def test_config_rejects_unknown_modes():
+    """DAFT_TPU_DEVICE=force used to silently disable the device while looking
+    like an opt-in; unknown mode strings must raise (ADVICE r4 / VERDICT r4)."""
+    import pytest
+
+    from daft_tpu.config import ExecutionConfig, execution_config_ctx
+
+    with pytest.raises(ValueError, match="device_mode"):
+        ExecutionConfig(device_mode="force")
+    with pytest.raises(ValueError, match="pipeline_mode"):
+        ExecutionConfig(pipeline_mode="auto")
+    with pytest.raises(ValueError, match="device_mode"):
+        with execution_config_ctx(device_mode="always"):
+            pass
+    # valid values construct fine
+    ExecutionConfig(device_mode="on", pipeline_mode="force")
