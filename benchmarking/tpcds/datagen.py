@@ -46,6 +46,9 @@ def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
     d0 = datetime.date(1998, 1, 1)
     n_dates = (datetime.date(2002, 12, 31) - d0).days + 1
     dates = [d0 + datetime.timedelta(days=i) for i in range(n_dates)]
+    # d_month_seq/d_week_seq count months/weeks from 1900/1970 — absolute
+    # values only matter for range filters, which the queries state in the
+    # same coordinates
     date_dim = pa.table({
         "d_date_sk": pa.array(np.arange(n_dates, dtype=np.int64) + 2_450_000),
         "d_date": pa.array(dates, pa.date32()),
@@ -54,6 +57,11 @@ def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
         "d_dom": pa.array(np.array([d.day for d in dates], np.int32)),
         "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in dates], np.int32)),
         "d_day_name": pa.array([d.strftime("%A") for d in dates]),
+        "d_month_seq": pa.array(np.array(
+            [(d.year - 1900) * 12 + d.month - 1 for d in dates], np.int32)),
+        "d_week_seq": pa.array(np.array(
+            [((d - EPOCH).days + 3) // 7 for d in dates], np.int32)),
+        "d_dow": pa.array(np.array([d.isoweekday() % 7 for d in dates], np.int32)),
     })
 
     # ---- time_dim: 86400 seconds ------------------------------------------------
@@ -141,11 +149,18 @@ def generate(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
     # ---- store -------------------------------------------------------------------
     n_store = max(int(12 * max(sf, 0.25)), 3)
     szips = rng.integers(10_000, 99_999, n_store)
+    counties = ["Williamson County", "Franklin Parish", "Walker County",
+                "Ziebach County", "Daviess County"]
     store = pa.table({
         "s_store_sk": np.arange(n_store, dtype=np.int64) + 1,
         "s_store_id": pa.array([f"AAAAAAAA{k:08d}" for k in range(1, n_store + 1)]),
         "s_store_name": pa.array([STORE_NAMES[i % len(STORE_NAMES)] for i in range(n_store)]),
         "s_state": pa.array([STATES[i] for i in rng.integers(0, len(STATES), n_store)]),
+        "s_county": pa.array([counties[i % len(counties)] for i in range(n_store)]),
+        "s_city": pa.array([["Midway", "Fairview", "Oak Grove", "Five Points",
+                             "Centerville"][i % 5] for i in range(n_store)]),
+        "s_company_name": pa.array(["Unknown"] * n_store),
+        "s_number_employees": rng.integers(200, 301, n_store).astype(np.int32),
         "s_zip": pa.array([f"{z:05d}" for z in szips]),
         "s_gmt_offset": np.full(n_store, -5.0),
     })
@@ -225,7 +240,7 @@ def cached_tables(sf: float = 0.01, seed: int = 0) -> Dict[str, pa.Table]:
     """Parquet-cached generation (same scheme as benchmarking/tpch/datagen.py)."""
     import pyarrow.parquet as pq
 
-    key = f"sf{sf}_seed{seed}"
+    key = f"sf{sf}_seed{seed}_v2"  # v2: d_month_seq/d_week_seq/d_dow + s_county/s_number_employees
     d = os.path.join(_CACHE_DIR, key)
     names = ["date_dim", "time_dim", "item", "customer_demographics",
              "household_demographics", "customer_address", "customer", "store",

@@ -623,9 +623,21 @@ def _run_device_join(node, label: str, make_run, assemble,
                 raw_stream.close()
                 return _host()
         run = make_run(stage, grouped, ctx)
-        for part in fact_stream:
-            for b in part.batches:
+        if topn:
+            batches = [b for part in fact_stream
+                       for b in part.batches if b.num_rows > 0]
+            if len(batches) > 1:
+                # the fused TopN program needs ONE fact batch; bail before any
+                # device work (and with an attributable reason)
+                _counters.reject("runtime", f"{label}: multi-batch fact")
+                raw_stream.close()
+                return _host()
+            for b in batches:
                 run.feed_batch(b)
+        else:
+            for part in fact_stream:
+                for b in part.batches:
+                    run.feed_batch(b)
         return assemble(run, stage, grouped)
     except DeviceFallback as e:
         _counters.reject("runtime", f"{label}: device fallback", str(e))
@@ -683,6 +695,12 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         if cap_est > ceiling:
             _counters.reject("cost", f"{label}: est group count over ceiling",
                              f"({card} > {ceiling})")
+            return False
+        if cap_est > MAX_MATMUL_SEGMENTS and (stage._sct_specs
+                                              or stage._use_f64):
+            _counters.reject(
+                "cost", f"{label}: high-cardinality stage needs 64-bit "
+                "scatter/f64 (no local-dense program)")
             return False
         n_mm = len(stage._mm_specs)
         n_ext = len(stage._ext_specs)

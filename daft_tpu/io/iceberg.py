@@ -141,9 +141,13 @@ def _resolve_path(table_path: str, location: str, file_path: str) -> str:
 
 
 class IcebergScanOperator(ScanOperator):
-    def __init__(self, table_path: str, snapshot_id: Optional[int] = None):
+    def __init__(self, table_path: str, snapshot_id: Optional[int] = None,
+                 meta: Optional[dict] = None):
+        """`meta` preloads the table metadata (REST catalogs hand it over the
+        wire — daft_tpu/io/iceberg_rest.py); otherwise it is resolved from
+        {table_path}/metadata via version-hint."""
         self.table_path = table_path
-        self.meta = _load_table_metadata(table_path)
+        self.meta = meta if meta is not None else _load_table_metadata(table_path)
         self._schema, self._field_names = _current_schema(self.meta)
         self._spec = _partition_spec(self.meta)
         self._snapshot = self._pick_snapshot(snapshot_id)

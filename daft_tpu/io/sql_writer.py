@@ -115,7 +115,10 @@ def read_sql(sql: str, connection, partition_col=None, num_partitions: int = 1):
     lo, hi = bounds["lo"][0], bounds["hi"][0]
     if lo is None:
         return daft_tpu.from_pydict(_fetch(sql))
-    if not isinstance(lo, (int, float)) or not isinstance(hi, (int, float)) \
+    import decimal
+
+    if not isinstance(lo, (int, float, decimal.Decimal)) \
+            or not isinstance(hi, (int, float, decimal.Decimal)) \
             or isinstance(lo, bool) or isinstance(hi, bool):
         # non-numeric partition column (dates/strings): range arithmetic below
         # doesn't apply — read unpartitioned rather than raising mid-plan

@@ -516,10 +516,13 @@ class _JoinContext:
             devf: List[Expression] = []
             hostf: List[Expression] = []
             for f in d.filters:
+                # device filter eval reads f32 planes: only dtypes whose every
+                # value is f32-exact qualify (dates < 2^24 days, small ints,
+                # bools) — int64/timestamp/float comparisons stay on host,
+                # which evaluated ALL dim filters exactly before this path
                 if dev.is_device_evaluable(f, d.base.schema) and all(
-                        d.base.schema[c].dtype.is_numeric()
-                        or d.base.schema[c].dtype.is_boolean()
-                        or d.base.schema[c].dtype.is_temporal()
+                        d.base.schema[c].dtype.kind in
+                        ("date", "bool", "int8", "int16", "uint8", "uint16")
                         for c in f.referenced_columns()):
                     devf.append(f)
                 else:
@@ -544,10 +547,9 @@ class _JoinContext:
 
         refs = expr.referenced_columns()
         deps = tuple(dim_batch.get_column(c) for c in refs)
-        anchor = deps[0] if deps else dim_batch.get_column(dim_batch.column_names()[0])
         return series_keyed(
-            anchor, ("syn", repr(expr), name), deps,
-            lambda: eval_expression(dim_batch, expr).rename(name))
+            self._filter_anchor(dim_batch, expr), ("syn", repr(expr), name),
+            deps, lambda: eval_expression(dim_batch, expr).rename(name))
 
     def host_visible(self, d: DimSpec) -> Optional[np.ndarray]:
         """Combined host-filter visibility for one dim (None = all pass);
@@ -1182,6 +1184,12 @@ class DeviceJoinGroupedRun(GroupedAggRun):
         else:
             decode = self._host_factorized_codes(batch, n, bucket)
             if decode.permuted:
+                if stage._sct_specs or stage._use_f64:
+                    # statically incompatible with the local-dense program:
+                    # bail BEFORE dispatching the packed gathers
+                    raise DeviceFallback(
+                        "local-dense path cannot serve 64-bit scatter "
+                        "extremes / f64-exact stages")
                 _pp, pdev, _l, _s = decode.fact_codes.perm_layout()
                 dcols, _ = self.ctx.provision(batch, bucket, needed, (),
                                               perm=(decode.pperm, pdev))

@@ -184,9 +184,6 @@ def test_high_cardinality_groups_fall_back(star):
 def test_tpch_device_join_sweep():
     """All 22 TPC-H queries with device_mode=on match host exactly, and the
     star-join queries actually ride the device join path."""
-    import sys
-
-    sys.path.insert(0, "/root/repo")
     from benchmarking.tpch.datagen import load_dataframes
     from benchmarking.tpch.queries import ALL_QUERIES
 
@@ -209,9 +206,6 @@ def test_tpch_q3_q10_ride_device_topn():
     (DeviceJoinTopN): group tables never leave the device, only K winner rows
     are fetched — the shape that makes orderkey-cardinality groupbys
     device-viable (VERDICT r4 next #1/#4)."""
-    import sys
-
-    sys.path.insert(0, "/root/repo")
     from benchmarking.tpch.datagen import load_dataframes
     from benchmarking.tpch.queries import ALL_QUERIES
 

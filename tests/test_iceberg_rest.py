@@ -1,0 +1,85 @@
+"""Iceberg REST catalog client against the in-process mock service
+(VERDICT r4 next #8: namespace/table listing + load + snapshot read +
+write-commit round-trip). Reference: daft/catalog/__iceberg.py."""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu.io.iceberg_rest import (IcebergRestCatalog, IcebergRestError,
+                                      make_mock_rest_server)
+
+
+@pytest.fixture()
+def rest(tmp_path):
+    server, uri = make_mock_rest_server(str(tmp_path / "wh"))
+    yield uri
+    server.shutdown()
+
+
+def _df():
+    return daft_tpu.from_pydict({
+        "id": [1, 2, 3, 4], "region": ["a", "a", "b", "b"],
+        "amount": [10.0, 20.0, 30.0, 40.0]})
+
+
+def test_namespaces_and_listing(rest):
+    cat = IcebergRestCatalog(rest, name="ice")
+    assert cat.list_namespaces() == []
+    cat.create_namespace("sales")
+    cat.create_namespace("web.logs")
+    assert cat.list_namespaces() == ["sales", "web.logs"]
+    assert cat.list_tables() == []
+    cat.create_table("sales.orders", _df().schema)
+    assert cat.list_tables() == ["sales.orders"]
+    assert cat.list_tables("web.logs") == []
+    cat.drop_table("sales.orders")
+    assert cat.list_tables() == []
+    cat.drop_namespace("web.logs")
+    assert cat.list_namespaces() == ["sales"]
+
+
+def test_write_commit_load_roundtrip(rest):
+    cat = IcebergRestCatalog(rest)
+    cat.create_namespace("sales")
+    df = _df()
+    cat.write_table("sales.orders", df)          # create + commit
+    out = cat.load_table("sales.orders").sort("id").to_pydict()
+    assert out == df.sort("id").to_pydict()
+
+    # append: second snapshot through the commit endpoint
+    cat.write_table("sales.orders", df)
+    out2 = cat.load_table("sales.orders").to_pydict()
+    assert len(out2["id"]) == 8
+    meta = cat.table_metadata("sales.orders")
+    assert len(meta["snapshots"]) >= 2
+    assert meta["refs"]["main"]["snapshot-id"] == meta["current-snapshot-id"]
+
+    # snapshot read: the FIRST snapshot still sees 4 rows
+    first = meta["snapshots"][0]["snapshot-id"]
+    old = cat.load_table("sales.orders", snapshot_id=first).to_pydict()
+    assert len(old["id"]) == 4
+
+
+def test_oauth_and_errors(rest):
+    cat = IcebergRestCatalog(rest, credential="user:pass")
+    assert cat._token == "mock-token"
+    with pytest.raises(Exception):
+        IcebergRestCatalog(rest, credential="user:WRONG")
+    cat.create_namespace("ns")
+    with pytest.raises(IcebergRestError) as ei:
+        cat.load_table("ns.missing")
+    assert ei.value.status == 404
+
+
+def test_session_attach_and_sql(rest):
+    from daft_tpu.session import Session
+
+    cat = IcebergRestCatalog(rest, name="ice")
+    cat.create_namespace("sales")
+    cat.write_table("sales.orders", _df())
+    s = Session()
+    s.attach_catalog(cat, "ice")
+    out = s.sql("SELECT region, SUM(amount) AS total FROM ice.sales.orders "
+                "GROUP BY region ORDER BY region").to_pydict()
+    assert out == {"region": ["a", "b"], "total": [30.0, 70.0]}

@@ -205,3 +205,380 @@ def test_q56(tables, pdt):
         pdt["item"].i_color.isin(["slate", "blanched", "burnished"]), 2001, 2)
     assert len(exp) > 0
     _check(ALL_QUERIES[56](tables).to_pydict(), exp)
+
+
+# ======================================================================================
+# round-5 expansion: window/rollup/report shapes (VERDICT r4 next #9)
+# ======================================================================================
+
+
+def test_q6(tables, pdt):
+    dd = pdt["date_dim"]
+    target = set(dd[(dd.d_year == 2001) & (dd.d_moy == 1)].d_month_seq)
+    months = dd[dd.d_month_seq.isin(target)].d_date_sk
+    item = pdt["item"].copy()
+    cat_avg = item.groupby("i_category")["i_current_price"].transform("mean")
+    pricey = set(item[item.i_current_price > 1.2 * cat_avg].i_item_sk)
+    m = (pdt["store_sales"][pdt["store_sales"].ss_sold_date_sk.isin(set(months))
+                            & pdt["store_sales"].ss_item_sk.isin(pricey)]
+         .merge(pdt["customer"], left_on="ss_customer_sk", right_on="c_customer_sk")
+         .merge(pdt["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk"))
+    exp = (m.groupby("ca_state", as_index=False).agg(cnt=("ca_state", "count"))
+           .rename(columns={"ca_state": "state"}))
+    exp = exp[exp.cnt >= 10].sort_values(["cnt", "state"], kind="stable").head(100)
+    assert len(exp) > 0
+    _check(ALL_QUERIES[6](tables).to_pydict(), exp)
+
+
+def _class_ratio_exp(pdt, fact, prefix, categories, lo, hi):
+    import datetime
+
+    item = pdt["item"][pdt["item"].i_category.isin(categories)]
+    dd = pdt["date_dim"]
+    dd = dd[(dd.d_date >= datetime.date(*lo)) & (dd.d_date <= datetime.date(*hi))]
+    m = (pdt[fact].merge(item, left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+         .merge(dd, left_on=f"{prefix}_sold_date_sk", right_on="d_date_sk"))
+    g = (m.groupby(["i_item_id", "i_class", "i_category", "i_current_price"],
+                   as_index=False)
+         .agg(itemrevenue=(f"{prefix}_ext_sales_price", "sum")))
+    g["revenueratio"] = g.itemrevenue * 100.0 \
+        / g.groupby("i_class")["itemrevenue"].transform("sum")
+    return (g.sort_values(["i_category", "i_class", "i_item_id", "revenueratio"],
+                          kind="stable").head(100))
+
+
+def test_q12_q20_q98(tables, pdt):
+    for qn, fact, prefix in ((12, "web_sales", "ws"), (20, "catalog_sales", "cs"),
+                             (98, "store_sales", "ss")):
+        exp = _class_ratio_exp(pdt, fact, prefix, ["Sports", "Books", "Home"],
+                               (1999, 2, 22), (1999, 3, 24))
+        assert len(exp) > 0
+        _check(ALL_QUERIES[qn](tables).to_pydict(), exp)
+
+
+def _q27_base(pdt):
+    cd = pdt["customer_demographics"]
+    cd = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+            & (cd.cd_education_status == "College")]
+    st = pdt["store"][pdt["store"].s_state.isin(
+        ["TN", "GA", "AL", "SC", "NC", "KY"])]
+    return (pdt["store_sales"]
+            .merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+            .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2002],
+                   left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+            .merge(pdt["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+
+
+def test_q27(tables, pdt):
+    import pandas as pd
+
+    base = _q27_base(pdt)
+    aggs = dict(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+    l2 = base.groupby(["i_item_id", "s_state"], as_index=False).agg(**aggs)
+    l1 = base.groupby(["i_item_id"], as_index=False).agg(**aggs)
+    l1["s_state"] = None
+    g0 = pd.DataFrame({
+        "i_item_id": [None], "s_state": [None],
+        "agg1": [base.ss_quantity.mean()], "agg2": [base.ss_list_price.mean()],
+        "agg3": [base.ss_coupon_amt.mean()], "agg4": [base.ss_sales_price.mean()]})
+    cols = ["i_item_id", "s_state", "agg1", "agg2", "agg3", "agg4"]
+    exp = (pd.concat([l2[cols], l1[cols], g0[cols]])
+           .sort_values(["i_item_id", "s_state"], kind="stable",
+                        na_position="last")
+           .head(100))
+    for c in ("i_item_id", "s_state"):  # rollup nulls: NaN -> None for _check
+        exp[c] = [None if pd.isna(v) else v for v in exp[c]]
+    assert len(exp) > 10
+    _check(ALL_QUERIES[27](tables).to_pydict(), exp)
+
+
+def test_q36(tables, pdt):
+    st = pdt["store"][pdt["store"].s_state.isin(
+        ["TN", "GA", "AL", "SC", "NC", "KY", "VA", "FL"])]
+    base = (pdt["store_sales"]
+            .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2001],
+                   left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(pdt["item"], left_on="ss_item_sk", right_on="i_item_sk")
+            .merge(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    l2 = base.groupby(["i_category", "i_class"], as_index=False).agg(
+        np=("ss_net_profit", "sum"), esp=("ss_ext_sales_price", "sum"))
+    l2["lochierarchy"] = 0
+    l1 = base.groupby(["i_category"], as_index=False).agg(
+        np=("ss_net_profit", "sum"), esp=("ss_ext_sales_price", "sum"))
+    l1["i_class"] = None
+    l1["lochierarchy"] = 1
+    g0 = pd.DataFrame({"i_category": [None], "i_class": [None],
+                       "np": [base.ss_net_profit.sum()],
+                       "esp": [base.ss_ext_sales_price.sum()],
+                       "lochierarchy": [2]})
+    cols = ["i_category", "i_class", "lochierarchy", "np", "esp"]
+    u = pd.concat([l2[cols], l1[cols], g0[cols]]).reset_index(drop=True)
+    u["gross_margin"] = u.np / u.esp
+    u["parent"] = np.where(u.lochierarchy == 0, u.i_category, None)
+    u["rank_within_parent"] = (
+        u.groupby(["lochierarchy", "parent"], dropna=False)["gross_margin"]
+        .rank(method="min", ascending=True).astype(int))
+    exp = (u[["gross_margin", "i_category", "i_class", "lochierarchy",
+              "rank_within_parent"]]
+           .sort_values(["lochierarchy", "i_category", "rank_within_parent"],
+                        ascending=[False, True, True], kind="stable",
+                        na_position="last")
+           .head(100))
+    for c in ("i_category", "i_class"):
+        exp[c] = [None if pd.isna(v) else v for v in exp[c]]
+    assert len(exp) > 5
+    _check(ALL_QUERIES[36](tables).to_pydict(), exp)
+
+
+def test_q43(tables, pdt):
+    m = (pdt["store_sales"]
+         .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2000],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["store"][pdt["store"].s_gmt_offset == -5.0],
+                left_on="ss_store_sk", right_on="s_store_sk"))
+    days = [("Sunday", "sun_sales"), ("Monday", "mon_sales"),
+            ("Tuesday", "tue_sales"), ("Wednesday", "wed_sales"),
+            ("Thursday", "thu_sales"), ("Friday", "fri_sales"),
+            ("Saturday", "sat_sales")]
+    for dname, alias in days:
+        m[alias] = np.where(m.d_day_name == dname, m.ss_sales_price, 0.0)
+    exp = (m.groupby(["s_store_name", "s_store_id"], as_index=False)
+           [[a for _d, a in days]].sum()
+           .sort_values(["s_store_name", "s_store_id"], kind="stable")
+           .head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[43](tables).to_pydict(), exp)
+
+
+def test_q48(tables, pdt):
+    m = (pdt["store_sales"]
+         .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(pdt["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(pdt["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+         .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2000],
+                left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    cd_ok = (((m.cd_marital_status == "M") & (m.cd_education_status == "4 yr Degree")
+              & m.ss_sales_price.between(100.0, 150.0))
+             | ((m.cd_marital_status == "D") & (m.cd_education_status == "2 yr Degree")
+                & m.ss_sales_price.between(50.0, 100.0))
+             | ((m.cd_marital_status == "S") & (m.cd_education_status == "College")
+                & m.ss_sales_price.between(150.0, 200.0)))
+    ca_ok = ((m.ca_country == "United States")
+             & ((m.ca_state.isin(["TN", "GA", "AL"])
+                 & m.ss_net_profit.between(0.0, 2000.0))
+                | (m.ca_state.isin(["SC", "NC", "KY"])
+                   & m.ss_net_profit.between(150.0, 3000.0))
+                | (m.ca_state.isin(["VA", "FL", "MS"])
+                   & m.ss_net_profit.between(50.0, 25000.0))))
+    total = m[cd_ok & ca_ok].ss_quantity.sum()
+    exp = pd.DataFrame({"total_quantity": [total]})
+    _check(ALL_QUERIES[48](tables).to_pydict(), exp)
+
+
+def test_q51(tables, pdt):
+    dd = pdt["date_dim"]
+    months = dd[dd.d_month_seq.between(1200, 1211)][["d_date_sk", "d_date"]]
+
+    def cume(fact, prefix):
+        m = pdt[fact].merge(months, left_on=f"{prefix}_sold_date_sk",
+                            right_on="d_date_sk")
+        g = (m.groupby([f"{prefix}_item_sk", "d_date"], as_index=False)
+             .agg(daily=(f"{prefix}_ext_sales_price", "sum"))
+             .rename(columns={f"{prefix}_item_sk": "item_sk"})
+             .sort_values(["item_sk", "d_date"], kind="stable"))
+        g["cume"] = g.groupby("item_sk")["daily"].cumsum()
+        return g[["item_sk", "d_date", "cume"]]
+
+    web, store = cume("web_sales", "ws"), cume("store_sales", "ss")
+    j = web.merge(store, on=["item_sk", "d_date"], how="outer",
+                  suffixes=("", "_ss")).sort_values(
+        ["item_sk", "d_date"], kind="stable")
+    # cummax leaves NaN at NaN positions; SQL's running max carries the last
+    # seen value through null rows — forward-fill within each item
+    j["web_cumulative"] = j.groupby("item_sk")["cume"].cummax()
+    j["web_cumulative"] = j.groupby("item_sk")["web_cumulative"].ffill()
+    j["store_cumulative"] = j.groupby("item_sk")["cume_ss"].cummax()
+    j["store_cumulative"] = j.groupby("item_sk")["store_cumulative"].ffill()
+    exp = (j[j.web_cumulative > j.store_cumulative]
+           [["item_sk", "d_date", "web_cumulative", "store_cumulative"]]
+           .sort_values(["item_sk", "d_date"], kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[51](tables).to_pydict(), exp)
+
+
+def test_q59(tables, pdt):
+    m = pdt["store_sales"].merge(pdt["date_dim"], left_on="ss_sold_date_sk",
+                                 right_on="d_date_sk")
+    days = [("Sunday", "sun"), ("Monday", "mon"), ("Tuesday", "tue"),
+            ("Wednesday", "wed"), ("Thursday", "thu"), ("Friday", "fri"),
+            ("Saturday", "sat")]
+    for dname, alias in days:
+        m[alias] = np.where(m.d_day_name == dname, m.ss_sales_price, 0.0)
+    wss = m.groupby(["d_week_seq", "ss_store_sk"], as_index=False)[
+        [a for _d, a in days]].sum()
+    dd = pdt["date_dim"]
+    w1 = set(dd[dd.d_month_seq.between(1176, 1187)].d_week_seq)
+    w2 = set(dd[dd.d_month_seq.between(1188, 1199)].d_week_seq)
+    y = (wss[wss.d_week_seq.isin(w1)]
+         .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    y2 = (wss[wss.d_week_seq.isin(w2)]
+          .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    y2 = y2.rename(columns={a: a + "2" for _d, a in days})
+    y2["d_week_seq"] = y2.d_week_seq - 52
+    j = y.merge(y2[["s_store_id", "d_week_seq"] + [a + "2" for _d, a in days]],
+                on=["s_store_id", "d_week_seq"])
+    out = pd.DataFrame({
+        "s_store_name": j.s_store_name, "s_store_id": j.s_store_id,
+        "d_week_seq": j.d_week_seq})
+    for _d, a in days:
+        out[f"r_{a}"] = j[a] / j[a + "2"]
+    exp = (out.sort_values(["s_store_name", "s_store_id", "d_week_seq"],
+                           kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[59](tables).to_pydict(), exp)
+
+
+def test_q63(tables, pdt):
+    it = pdt["item"]
+    items = it[((it.i_category.isin(["Books", "Children", "Electronics"])
+                 & it.i_class.isin(["accent", "classical", "fiction"]))
+                | (it.i_category.isin(["Women", "Music", "Men"])
+                   & it.i_class.isin(["dresses", "rock", "pants"])))]
+    m = (pdt["store_sales"]
+         .merge(items, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(pdt["date_dim"][pdt["date_dim"].d_year == 2000],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    g = (m.groupby(["i_manager_id", "d_moy"], as_index=False)
+         .agg(sum_sales=("ss_sales_price", "sum")))
+    g["avg_monthly_sales"] = g.groupby("i_manager_id")["sum_sales"].transform("mean")
+    g = g[(g.avg_monthly_sales > 0)
+          & ((g.sum_sales - g.avg_monthly_sales).abs() / g.avg_monthly_sales > 0.1)]
+    exp = (g[["i_manager_id", "sum_sales", "avg_monthly_sales"]]
+           .sort_values(["i_manager_id", "avg_monthly_sales", "sum_sales"],
+                        kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[63](tables).to_pydict(), exp)
+
+
+def test_q65(tables, pdt):
+    dd = pdt["date_dim"]
+    months = set(dd[dd.d_month_seq.between(1176, 1187)].d_date_sk)
+    ss = pdt["store_sales"][pdt["store_sales"].ss_sold_date_sk.isin(months)]
+    sales = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+             .agg(revenue=("ss_sales_price", "sum")))
+    sales["ave"] = sales.groupby("ss_store_sk")["revenue"].transform("mean")
+    low = sales[sales.revenue <= 0.1 * sales.ave]
+    exp = (low.merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk")
+           .merge(pdt["item"], left_on="ss_item_sk", right_on="i_item_sk")
+           [["s_store_name", "i_item_id", "revenue"]]
+           .sort_values(["s_store_name", "i_item_id"], kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[65](tables).to_pydict(), exp)
+
+
+def test_q73(tables, pdt):
+    hd = pdt["household_demographics"]
+    hd = hd[hd.hd_buy_potential.isin([">10000", "Unknown"])
+            & (hd.hd_vehicle_count > 0)
+            & (hd.hd_dep_count / hd.hd_vehicle_count > 1.0)]
+    dd = pdt["date_dim"]
+    dd = dd[dd.d_dom.between(1, 2) & dd.d_year.isin([1999, 2000, 2001])]
+    st = pdt["store"][pdt["store"].s_county.isin(
+        ["Williamson County", "Franklin Parish"])]
+    m = (pdt["store_sales"]
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .merge(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    g = (m.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False)
+         .agg(cnt=("ss_ticket_number", "count")))
+    g = g[g.cnt.between(1, 5)]
+    exp = (g.merge(pdt["customer"], left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+           [["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]]
+           .sort_values(["cnt", "c_last_name", "ss_ticket_number"],
+                        ascending=[False, True, True], kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[73](tables).to_pydict(), exp)
+
+
+def test_q79(tables, pdt):
+    hd = pdt["household_demographics"]
+    hd = hd[(hd.hd_dep_count == 6) | (hd.hd_vehicle_count > 2)]
+    dd = pdt["date_dim"]
+    dd = dd[(dd.d_dow == 1) & dd.d_year.isin([1999, 2000, 2001])]
+    st = pdt["store"][pdt["store"].s_number_employees.between(200, 295)]
+    m = (pdt["store_sales"]
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk"))
+    g = (m.groupby(["ss_ticket_number", "ss_customer_sk", "s_city"],
+                   as_index=False)
+         .agg(amt=("ss_coupon_amt", "sum"), profit=("ss_net_profit", "sum")))
+    exp = (g.merge(pdt["customer"], left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+           [["c_last_name", "c_first_name", "s_city", "profit",
+             "ss_ticket_number", "amt"]]
+           .sort_values(["c_last_name", "c_first_name", "s_city", "profit",
+                         "ss_ticket_number"], kind="stable").head(100))
+    assert len(exp) > 0
+    _check(ALL_QUERIES[79](tables).to_pydict(), exp)
+
+
+def test_q88(tables, pdt):
+    hd = pdt["household_demographics"]
+    hd = hd[((hd.hd_dep_count == 4) & (hd.hd_vehicle_count <= 6))
+            | ((hd.hd_dep_count == 2) & (hd.hd_vehicle_count <= 4))
+            | ((hd.hd_dep_count == 0) & (hd.hd_vehicle_count <= 2))]
+    base = (pdt["store_sales"]
+            .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+            .merge(pdt["store"][pdt["store"].s_store_name == "ese"],
+                   left_on="ss_store_sk", right_on="s_store_sk"))
+    td = pdt["time_dim"]
+
+    def slot(h, half):
+        t_ = td[(td.t_hour == h) & (td.t_minute >= 30 if half else td.t_minute < 30)]
+        return len(base.merge(t_, left_on="ss_sold_time_sk", right_on="t_time_sk"))
+
+    exp = pd.DataFrame({
+        "h8_30_to_9": [slot(8, True)], "h9_to_9_30": [slot(9, False)],
+        "h9_30_to_10": [slot(9, True)], "h10_to_10_30": [slot(10, False)],
+        "h10_30_to_11": [slot(10, True)], "h11_to_11_30": [slot(11, False)],
+        "h11_30_to_12": [slot(11, True)], "h12_to_12_30": [slot(12, False)]})
+    _check(ALL_QUERIES[88](tables).to_pydict(), exp)
+
+
+def test_q89(tables, pdt):
+    it = pdt["item"]
+    items = it[((it.i_category.isin(["Books", "Electronics", "Sports"])
+                 & it.i_class.isin(["fiction", "portable", "rock"]))
+                | (it.i_category.isin(["Men", "Jewelry", "Women"])
+                   & it.i_class.isin(["accent", "pants", "dresses"])))]
+    m = (pdt["store_sales"]
+         .merge(items, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(pdt["date_dim"][pdt["date_dim"].d_year == 1999],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pdt["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    g = (m.groupby(["i_category", "i_class", "i_brand", "s_store_name",
+                    "s_company_name", "d_moy"], as_index=False)
+         .agg(sum_sales=("ss_sales_price", "sum")))
+    g["avg_monthly_sales"] = g.groupby(
+        ["i_category", "i_brand", "s_store_name", "s_company_name"]
+    )["sum_sales"].transform("mean")
+    g = g[(g.avg_monthly_sales != 0)
+          & ((g.sum_sales - g.avg_monthly_sales).abs() / g.avg_monthly_sales > 0.1)]
+    cols = ["i_category", "i_class", "i_brand", "s_store_name",
+            "s_company_name", "d_moy", "sum_sales", "avg_monthly_sales"]
+    exp = (g[cols].sort_values(["sum_sales", "s_store_name"],
+                               kind="stable").head(100))
+    assert len(exp) > 0
+    # ties beyond (sum_sales, s_store_name) are underdetermined by the query:
+    # compare both sides under a full-column re-sort
+    got = pd.DataFrame(ALL_QUERIES[89](tables).to_pydict())
+    _check(got.sort_values(cols, kind="stable").to_dict("list"),
+           exp.sort_values(cols, kind="stable"))

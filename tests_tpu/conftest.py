@@ -1,0 +1,38 @@
+"""Real-TPU test tier (VERDICT r4 next #2).
+
+Unlike tests/ (whose conftest pins XLA:CPU so the suite is hermetic), this
+directory runs against whatever accelerator JAX finds — on the build
+environment that is the one real TPU chip behind the axon tunnel. Every test
+is marked `tpu` and SKIPS itself when the backend is CPU, so:
+
+    python -m pytest tests_tpu -m tpu -q       # on a TPU host: runs
+    python -m pytest tests_tpu -q              # CPU-only host: all skipped
+
+These tests exist because the hermetic suite validates XLA:CPU behavior only —
+MXU matmul numerics (bf16 default input precision!), Mosaic compilation limits
+and device memory behave differently on real hardware; round 4 shipped a
+quantization bug (one-hot matmul float planes at default precision) that only
+a real chip could reveal.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: requires a real TPU backend")
+
+
+@pytest.fixture(scope="session")
+def tpu_backend():
+    import jax
+
+    if jax.default_backend() in ("cpu",):
+        pytest.skip("no TPU backend (CPU platform)")
+    return jax.default_backend()
