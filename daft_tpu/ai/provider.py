@@ -42,6 +42,10 @@ def get_provider(name: str) -> Provider:
             register_provider(TransformersProvider())
         elif key == "dummy":
             register_provider(DummyProvider())
+        elif key == "jax":
+            from .jax_provider import JaxProvider
+
+            register_provider(JaxProvider())
         elif key in ("openai", "lm_studio"):
             from .openai_provider import OpenAIProvider
 

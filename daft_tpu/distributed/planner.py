@@ -223,48 +223,76 @@ def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
         return Partitioned(frags, out_keys)
 
     if isinstance(node, pp.DeviceGroupedAgg):
-        # the device belongs to the driver; shipped sub-plans aggregate on the
-        # workers' host path — rewrite to the equivalent filter + hash agg
-        inner = node.input
-        if node.predicate is not None:
-            inner = pp.PhysFilter(inner, node.predicate, inner.schema)
-        node = pp.HashAggregate(inner, node.groupby, node.aggregations, node.schema)
+        # Workers KEEP the device stage (VERDICT r4 next #5): each worker's
+        # executor decides device-vs-host at runtime from ITS config — the
+        # pool grants DAFT_TPU_DEVICE to `device_workers` workers (a device
+        # lease; the rest run the identical host fallback). The partial phase
+        # of the two-phase split stays a DeviceGroupedAgg when the split aggs
+        # still qualify for the device stage.
+        from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+        def device_frag(f, groupby, aggs, schema):
+            if try_build_grouped_agg_stage(f.schema, node.predicate,
+                                           groupby, aggs) is not None:
+                return pp.DeviceGroupedAgg(f, node.predicate, groupby, aggs,
+                                           schema)
+            inner = f
+            if node.predicate is not None:
+                inner = pp.PhysFilter(inner, node.predicate, inner.schema)
+            return pp.HashAggregate(inner, groupby, aggs, schema)
+
+        def raw_frag(f):
+            if node.predicate is not None:
+                return pp.PhysFilter(f, node.predicate, f.schema)
+            return f
+
+        return _two_phase_agg(ctx, node, device_frag, raw_frag)
 
     if isinstance(node, pp.HashAggregate):
-        from ..expressions import col as _col
-        from ..plan.agg_split import split_aggs
-
-        child = distribute(ctx, node.input)
-        keys = _key_names(node.groupby)
-        if child.partitioned_by is not None and child.partitioned_by == keys:
-            # already co-partitioned on the group keys: aggregate in place
-            frags = [pp.HashAggregate(f, node.groupby, node.aggregations, node.schema)
-                     for f in child.fragments]
-            return Partitioned(frags, keys)
-        split = split_aggs(node.aggregations)
-        if split is not None:
-            # two-phase: partial agg per fragment -> shuffle on keys -> final
-            partial_schema = _agg_schema(node.input.schema, node.groupby, split.partial)
-            partials = [
-                pp.HashAggregate(f, node.groupby, split.partial, partial_schema)
-                for f in child.fragments
-            ]
-            key_names = [e.name() for e in node.groupby]
-            key_cols = [_col(k) for k in key_names]
-            reads = _shuffle(ctx, partials, key_cols, partial_schema)
-            frags = []
-            for r in reads:
-                final = pp.HashAggregate(r, key_cols, split.final,
-                                         _agg_schema(partial_schema, key_cols, split.final))
-                frags.append(pp.Project(final, key_cols + split.projection, node.schema))
-            return Partitioned(frags, keys)
-        # unsplittable aggs (e.g. count_distinct): shuffle raw rows by key
-        reads = _shuffle(ctx, child.fragments, node.groupby, node.input.schema)
-        frags = [pp.HashAggregate(r, node.groupby, node.aggregations, node.schema)
-                 for r in reads]
-        return Partitioned(frags, keys)
+        return _two_phase_agg(
+            ctx, node,
+            lambda f, groupby, aggs, schema: pp.HashAggregate(
+                f, groupby, aggs, schema),
+            lambda f: f)
 
     raise NotImplementedError(f"distribute: unhandled node {type(node).__name__}")
+
+
+def _two_phase_agg(ctx: DistContext, node, make_leaf, raw_frag) -> Partitioned:
+    """Shared grouped-aggregation distribution (HashAggregate and
+    DeviceGroupedAgg differ only in the leaf-agg constructor):
+    co-partitioned -> aggregate in place; splittable -> partial per fragment,
+    shuffle on keys, final combine; unsplittable -> shuffle raw rows."""
+    from ..expressions import col as _col
+    from ..plan.agg_split import split_aggs
+
+    child = distribute(ctx, node.input)
+    keys = _key_names(node.groupby)
+    if child.partitioned_by is not None and child.partitioned_by == keys:
+        frags = [make_leaf(f, node.groupby, node.aggregations, node.schema)
+                 for f in child.fragments]
+        return Partitioned(frags, keys)
+    split = split_aggs(node.aggregations)
+    if split is not None:
+        partial_schema = _agg_schema(node.input.schema, node.groupby, split.partial)
+        partials = [make_leaf(f, node.groupby, split.partial, partial_schema)
+                    for f in child.fragments]
+        key_cols = [_col(e.name()) for e in node.groupby]
+        reads = _shuffle(ctx, partials, key_cols, partial_schema)
+        frags = []
+        for r in reads:
+            final = pp.HashAggregate(
+                r, key_cols, split.final,
+                _agg_schema(partial_schema, key_cols, split.final))
+            frags.append(pp.Project(final, key_cols + split.projection,
+                                    node.schema))
+        return Partitioned(frags, keys)
+    # unsplittable aggs (e.g. count_distinct): shuffle raw rows by key
+    reads = _shuffle(ctx, [raw_frag(f) for f in child.fragments],
+                     node.groupby, node.input.schema)
+    frags = [pp.HashAggregate(r, node.groupby, node.aggregations, node.schema)
+             for r in reads]
+    return Partitioned(frags, keys)
 
 
 def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,

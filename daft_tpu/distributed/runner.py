@@ -31,7 +31,8 @@ class DistributedRunner(Runner):
     def __init__(self, num_workers: int = 4, n_partitions: Optional[int] = None,
                  slots_per_worker: int = 1, shuffle_dir: Optional[str] = None,
                  shuffle_transport: str = "local",
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 device_workers: int = 0):
         """shuffle_transport: "local" (reduce tasks read the shared shuffle
         directory — single-host fast path) or "socket" (reduce tasks fetch
         partitions from the HMAC-authenticated ShuffleFetchServer, the
@@ -40,6 +41,7 @@ class DistributedRunner(Runner):
             raise ValueError(f"unknown shuffle transport {shuffle_transport!r}")
         self.num_workers = num_workers
         self.max_workers = max_workers
+        self.device_workers = device_workers
         self.n_partitions = n_partitions or num_workers
         self.slots_per_worker = slots_per_worker
         self.shuffle_transport = shuffle_transport
@@ -51,7 +53,8 @@ class DistributedRunner(Runner):
     def _ensure_pool(self) -> WorkerPool:
         if self._pool is None:
             self._pool = WorkerPool(self.num_workers, self.slots_per_worker,
-                                    max_workers=self.max_workers)
+                                    max_workers=self.max_workers,
+                                    device_workers=self.device_workers)
             if self._shuffle_dir is None:
                 self._shuffle_dir = tempfile.mkdtemp(prefix="daft_tpu_shuffle_")
             if self.shuffle_transport == "socket" and self._fetch_server is None:
@@ -67,8 +70,9 @@ class DistributedRunner(Runner):
         pool = self._ensure_pool()
         optimized = builder.optimize()
         # translate with the driver's own config: the driver-side remainder may
-        # use the device; Device* nodes inside shipped subtrees are rewritten to
-        # host equivalents by the planner (workers are host-only executors)
+        # use the device; Device* nodes inside shipped subtrees SURVIVE
+        # distribution (planner.py DeviceGroupedAgg two-phase split) — each
+        # worker's executor picks device vs host from its own leased config
         phys = translate(optimized.plan)
         endpoints = [self._fetch_server.endpoint] if self._fetch_server else None
         ctx = DistContext(pool=pool, shuffle_dir=self._shuffle_dir,

@@ -174,7 +174,9 @@ class WorkerPool:
 
     def __init__(self, num_workers: int, slots_per_worker: int = 1,
                  env: Optional[Dict[str, str]] = None,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 device_workers: int = 0,
+                 device_mode: Optional[str] = None):
         sock = os.path.join(tempfile.gettempdir(),
                             f"daft_tpu_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock")
         # HMAC-authenticated socket: only processes holding the per-pool
@@ -199,8 +201,25 @@ class WorkerPool:
         self.workers: Dict[str, WorkerProcess] = {}
         for i in range(num_workers):
             wid = f"worker-{i}"
+            wenv = dict(env)
+            if i < device_workers:
+                # device LEASE: this worker gets device capability instead of
+                # the pool default "off" — on single-chip hosts the chip
+                # belongs to at most one process, so the lease count is an
+                # explicit opt-in (reference contrast: every flotilla worker
+                # runs the full engine, daft/runners/flotilla.py:112-154).
+                # The mode is FIXED at spawn (subprocess env); requesting
+                # device workers while the driver is configured "off" means
+                # "auto" — a lease to a host-only worker would be a no-op for
+                # the process lifetime.
+                if device_mode is None:
+                    from ..config import execution_config
+
+                    device_mode = execution_config().device_mode
+                wenv["DAFT_TPU_DEVICE"] = device_mode \
+                    if device_mode != "off" else "auto"
             self.workers[wid] = WorkerProcess(wid, acceptor, sock,
-                                              slots_per_worker, env=env)
+                                              slots_per_worker, env=wenv)
 
     def scale_up(self, n: int = 1) -> List[str]:
         """Spawn up to n extra workers (bounded by max_workers over ALIVE

@@ -611,29 +611,45 @@ def _run_device_join(node, label: str, make_run, assemble,
                              f"({first.num_rows} rows)")
             raw_stream.close()
             return _host()
+        # a previously-rejected query shape skips dim materialization + the
+        # sampled-cardinality estimate entirely (repeated interactive queries
+        # must not pay the decision machinery per run)
+        dk = _decision_key(node, first.num_rows, cfg, topn)
+        if cfg.device_mode == "auto" and _DECISION_CACHE.get(dk) is False:
+            _counters.reject("cost", f"{label}: host wins (cached decision)")
+            raw_stream.close()
+            return _host()
         dim_batches = {}
         for name, plan in node.dim_plans:
             dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
         ctx = _JoinContext(node.spec, dim_batches)
         if cfg.device_mode == "auto":
             batch0 = next((b for b in first.batches if b.num_rows > 0), None)
-            if batch0 is None or not _join_device_wins(
-                    node, ctx, batch0, first.num_rows, grouped, stage,
-                    topn=topn, label=label):
+            wins = batch0 is not None and _join_device_wins(
+                node, ctx, batch0, first.num_rows, grouped, stage,
+                topn=topn, label=label)
+            _DECISION_CACHE[dk] = wins
+            if len(_DECISION_CACHE) > 512:
+                _DECISION_CACHE.pop(next(iter(_DECISION_CACHE)))
+            if not wins:
                 raw_stream.close()
                 return _host()
         run = make_run(stage, grouped, ctx)
         if topn:
-            batches = [b for part in fact_stream
-                       for b in part.batches if b.num_rows > 0]
-            if len(batches) > 1:
-                # the fused TopN program needs ONE fact batch; bail before any
-                # device work (and with an attributable reason)
-                _counters.reject("runtime", f"{label}: multi-batch fact")
-                raw_stream.close()
-                return _host()
-            for b in batches:
-                run.feed_batch(b)
+            # the fused TopN program needs ONE fact batch: bail on sighting a
+            # SECOND (before any device work, without draining the stream)
+            first_b = None
+            for part in fact_stream:
+                for b in part.batches:
+                    if b.num_rows == 0:
+                        continue
+                    if first_b is not None:
+                        _counters.reject("runtime", f"{label}: multi-batch fact")
+                        raw_stream.close()
+                        return _host()
+                    first_b = b
+            if first_b is not None:
+                run.feed_batch(first_b)
         else:
             for part in fact_stream:
                 for b in part.batches:
@@ -643,6 +659,27 @@ def _run_device_join(node, label: str, make_run, assemble,
         _counters.reject("runtime", f"{label}: device fallback", str(e))
         raw_stream.close()
         return _host()
+
+
+_DECISION_CACHE: dict = {}
+
+
+def _decision_key(node, rows: int, cfg, topn: bool) -> tuple:
+    """Structural identity of one cost decision: the captured spec's shape +
+    input size + the config knobs the decision reads."""
+    spec = node.spec
+    return (
+        topn, rows, cfg.device_mode, cfg.device_amortize_runs,
+        repr(spec.predicate),
+        tuple(repr(g) for g in spec.groupby),
+        tuple(repr(a) for a in spec.aggregations),
+        tuple((d.key_col, d.parent) for d in spec.dims),
+        # dim source identity: a rewritten/grown dim table must re-decide
+        # (ids are heuristic — the cache is advisory, both outcomes correct)
+        tuple(id(part)
+              for _n, plan in node.dim_plans
+              for part in getattr(plan, "partitions", ())),
+    )
 
 
 def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,

@@ -487,8 +487,12 @@ def _gather_col(arr, arr_valid, idx):
 
 @jax.jit
 def _gather_rows(mat, idx):
-    """One row-gather of a packed [N, P] dim matrix — the per-batch join."""
-    return mat[jnp.clip(idx, 0, mat.shape[0] - 1)]
+    """One gather of a packed [P, N] dim matrix along its MINOR axis — the
+    per-batch join. The pack is TRANSPOSED ([planes, rows], not [rows,
+    planes]) because TPU tiled layouts pad the minor dimension to 128 lanes:
+    a [64M, 5] gather output would materialize as [64M, 128] — 32GB — and
+    OOM (observed at SF10); [5, 64M] pads only the 5 to 8 sublanes."""
+    return mat[:, jnp.clip(idx, 0, mat.shape[1] - 1)]
 
 
 class _JoinContext:
@@ -920,7 +924,7 @@ class _JoinContext:
             ok_plane = ok if ok is not None else jnp.ones(cap_d, dtype=bool)
             ok_col = len(cols)
             cols.append(ok_plane.astype(jnp.float32))
-            mat = jnp.stack(cols, axis=1)
+            mat = jnp.stack(cols, axis=0)   # [P, cap_d]: minor dim stays long
             return mat, layout, code_layout, ok_col, wide
 
         return series_keyed(anchor, key, deps, build)
@@ -955,9 +959,9 @@ class _JoinContext:
             aok = didx >= 0
             if pack is not None:
                 mat, layout, code_layout, ok_col, wide = pack
-                rows = _gather_rows(mat, didx)
+                rows = _gather_rows(mat, didx)      # [P, bucket]
                 gathered[adj.name] = (rows, layout, code_layout, wide)
-                aok = aok & (rows[:, ok_col] > 0.5)
+                aok = aok & (rows[ok_col] > 0.5)
             ok_total = aok if ok_total is None else (ok_total & aok)
 
         for name in needed:
@@ -982,23 +986,23 @@ class _JoinContext:
             if name in wide:
                 w = wide[name]
                 if len(w) == 4:       # 64-bit: hi*2^48 + mid*2^24 + lo
-                    v = (rows[:, w[0]].astype(jnp.float64) * (1 << 48)
-                         + rows[:, w[1]].astype(jnp.float64) * (1 << 24)
-                         + rows[:, w[2]].astype(jnp.float64))
+                    v = (rows[w[0]].astype(jnp.float64) * (1 << 48)
+                         + rows[w[1]].astype(jnp.float64) * (1 << 24)
+                         + rows[w[2]].astype(jnp.float64))
                 else:                 # 32-bit: hi*2^24 + lo
-                    v = (rows[:, w[0]].astype(jnp.float64) * (1 << 24)
-                         + rows[:, w[1]].astype(jnp.float64))
-                dcols[name] = (v, rows[:, w[-1]] > 0.5)
+                    v = (rows[w[0]].astype(jnp.float64) * (1 << 24)
+                         + rows[w[1]].astype(jnp.float64))
+                dcols[name] = (v, rows[w[-1]] > 0.5)
             else:
                 vi, mi = layout[name]
-                dcols[name] = (rows[:, vi], rows[:, mi] > 0.5)
+                dcols[name] = (rows[vi], rows[mi] > 0.5)
 
         for name in groupby_cols:
             side = spec.col_side.get(name)
             if side is None or side == "fact":
                 continue
             rows, _l, code_layout, _w = gathered[self._root_of(side)]
-            code_out[name] = rows[:, code_layout[name]].astype(jnp.int32)
+            code_out[name] = rows[code_layout[name]].astype(jnp.int32)
 
         if ok_total is None:
             ok_total = jnp.ones(bucket, dtype=bool)

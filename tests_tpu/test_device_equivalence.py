@@ -276,3 +276,19 @@ def test_tpch_on_chip(tpch_tables, qn):
     with execution_config_ctx(device_mode="on"):
         dev = ALL_QUERIES[qn](tpch_tables).to_pydict()
     _assert_close(host, dev, rel=2e-5)
+
+
+# ---- on-device AI inference ------------------------------------------------------
+
+
+def test_jax_embedder_on_chip(tpu_backend):
+    """embed_text with zero network ON the TPU: the encoder jit runs on the
+    accelerator backend (VERDICT r4 next #7)."""
+    import numpy as np
+
+    from daft_tpu.ai.provider import get_provider
+
+    e = get_provider("jax").get_text_embedder()
+    vecs = e.embed_text(["tpu native inference", "engine owns the chip"])
+    assert len(vecs) == 2 and abs(np.linalg.norm(vecs[0]) - 1.0) < 1e-3
+    assert not np.allclose(vecs[0], vecs[1])
