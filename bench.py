@@ -65,8 +65,9 @@ def main() -> None:
     per_query = {q: float("inf") for q in QUERIES}
     q_device = {q: 0 for q in QUERIES}     # device dispatches, total across reps
     q_reject = {}                          # why a query stayed on host (first seen)
+    metric_totals = {}                     # registry snapshot summed over the last rep
     elapsed = float("inf")
-    for _ in range(REPS):
+    for rep in range(REPS):
         t0 = time.perf_counter()
         for q in QUERIES:
             counters.reset()
@@ -81,6 +82,12 @@ def main() -> None:
             if rep_batches == 0 and counters.rejections and q not in q_reject:
                 q_reject[q] = max(counters.rejections,
                                   key=counters.rejections.get)
+            if rep == REPS - 1:
+                # one full pass over the query set: per-query registry deltas
+                # (device counters + shuffle bytes) summed for attribution
+                for k, v in counters.snapshot().items():
+                    if v:
+                        metric_totals[k] = metric_totals.get(k, 0) + v
         elapsed = min(elapsed, time.perf_counter() - t0)
 
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
@@ -93,6 +100,7 @@ def main() -> None:
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
         "per_query_device": {f"q{q}": q_device[q] for q in QUERIES},
         "host_reasons": {f"q{q}": r for q, r in sorted(q_reject.items())},
+        "metrics": metric_totals,
         "sf": SF,
         "fact_rows": n_lineitem,
     }))

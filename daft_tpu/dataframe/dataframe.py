@@ -81,9 +81,15 @@ class DataFrame:
         """Execute the plan through the configured runner collecting
         per-operator runtime stats; returns the plans plus an operator table
         (rows out / batches / self time) — reference: EXPLAIN ANALYZE over
-        runtime_stats."""
+        runtime_stats. On a distributed runner the report additionally renders
+        the stage DAG rollup (per-stage task counts, min/median/max task time
+        skew, queue wait, shuffle volumes, per-worker attribution) from the
+        run's QueryTrace, plus the per-query metrics-registry deltas (device
+        batches, shuffle bytes) so engine-path attribution is in the report,
+        not only in bench.py."""
         import time
 
+        from ..observability.metrics import registry
         from ..observability.runtime_stats import (StatsCollector,
                                                    current_collector,
                                                    format_stats, set_collector)
@@ -94,17 +100,27 @@ class DataFrame:
         phys = translate(optimized.plan)
         collector = StatsCollector()
         prev = current_collector()
+        runner = get_or_create_runner()
+        reg_before = registry().snapshot()
         set_collector(collector)
         t0 = time.perf_counter()
         try:
-            for _ in get_or_create_runner().run_iter(self._builder):
+            for _ in runner.run_iter(self._builder):
                 pass
         finally:
             set_collector(prev)
         total = time.perf_counter() - t0
-        return ("== Physical Plan ==\n" + phys.display()
-                + "\n\n== Runtime Stats ==\n"
-                + format_stats(collector.finish(), total))
+        report = ("== Physical Plan ==\n" + phys.display()
+                  + "\n\n== Runtime Stats ==\n"
+                  + format_stats(collector.finish(), total))
+        trace = getattr(runner, "last_trace", None)
+        if trace is not None and trace.tasks:
+            report += "\n\n== Distributed Stages ==\n" + trace.render()
+        deltas = registry().diff(reg_before)
+        if deltas:
+            report += "\n\n== Engine Counters ==\n" + "\n".join(
+                f"{k:<32} {v:>12g}" for k, v in sorted(deltas.items()))
+        return report
 
     def _next(self, builder: LogicalPlanBuilder) -> "DataFrame":
         return DataFrame(builder)

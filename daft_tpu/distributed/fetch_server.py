@@ -51,10 +51,30 @@ class ShuffleFetchServer:
         self._listener = Listener((host, port), family="AF_INET", authkey=self.authkey)
         self._closed = False
         self._threads: List[threading.Thread] = []
+        # served-request counters (reference: flight_server metrics); mirrored
+        # into the metrics registry so EXPLAIN ANALYZE / bench can attribute
+        # transport traffic
+        self._stats_lock = threading.Lock()
+        self.requests = 0
+        self.bytes_served = 0
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="daft-shuffle-fetch")
         t.start()
         self._threads.append(t)
+
+    def _note_request(self, nbytes: int = 0) -> None:
+        from ..observability.metrics import registry
+
+        with self._stats_lock:
+            self.requests += 1
+            self.bytes_served += nbytes
+        registry().inc("shuffle_fetch_server_requests")
+        if nbytes:
+            registry().inc("shuffle_fetch_server_bytes", nbytes)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {"requests": self.requests, "bytes_served": self.bytes_served}
 
     @property
     def endpoint(self) -> Endpoint:
@@ -84,10 +104,13 @@ class ShuffleFetchServer:
                 try:
                     if msg[0] == "list":
                         _kind, sid, pidx = msg
+                        self._note_request()
                         conn.send(("files", self._list(sid, int(pidx))))
                     elif msg[0] == "fetch":
                         _kind, sid, pidx, name = msg
-                        conn.send(("file", self._read(sid, int(pidx), name)))
+                        data = self._read(sid, int(pidx), name)
+                        self._note_request(len(data))
+                        conn.send(("file", data))
                     else:
                         conn.send(("error", f"unknown request {msg[0]!r}"))
                 except Exception as e:  # noqa: BLE001 — refuse the request, keep serving
@@ -124,7 +147,12 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
                     schema: Schema) -> Iterator[MicroPartition]:
     """Stream one shuffle partition by fetching every map file from every
     endpoint (the reference's flight-client fan-in, get_flight_client +
-    do_get per partition)."""
+    do_get per partition). Fetch volume/latency is recorded into the active
+    ShuffleRecorder (shuffle.py) for per-task transport attribution."""
+    import time
+
+    from .shuffle import _note_fetch
+
     for host, port, key_hex in endpoints:
         conn = Client((host, port), family="AF_INET", authkey=bytes.fromhex(key_hex))
         try:
@@ -134,6 +162,7 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
                 raise RuntimeError(f"shuffle fetch refused: {names}")
             assert kind == "files", kind
             for name in names:
+                t0 = time.perf_counter()
                 conn.send(("fetch", shuffle_id, partition_idx, name))
                 kind, data = conn.recv()
                 if kind == "error":
@@ -142,6 +171,7 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
                 with ipc.RecordBatchFileReader(pa.BufferReader(data)) as r:
                     table = r.read_all()
                 batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
+                _note_fetch(batch.num_rows, len(data), time.perf_counter() - t0)
                 yield MicroPartition(schema, [batch])
             conn.send(("bye",))
         finally:

@@ -42,12 +42,17 @@ class DistContext:
     # fetch-server endpoints [(host, port, authkey_hex)]; when set, reduce
     # tasks read shuffle partitions over the socket tier, never the local dir
     fetch_endpoints: Optional[list] = None
+    # QueryTrace (distributed/trace.py): when set, every stage's tasks are
+    # stamped with the query's trace context and their runtime stats recorded
+    trace: Optional[object] = None
     _task_seq: itertools.count = None  # type: ignore[assignment]
+    _stage_seq: itertools.count = None  # type: ignore[assignment]
     _run_tag: str = ""
     shuffle_ids: List[str] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         self._task_seq = itertools.count()
+        self._stage_seq = itertools.count()
         # unique per context: a reused pool must never confuse this run's task
         # ids with a previous query's (stale-result isolation)
         self._run_tag = uuid.uuid4().hex[:8]
@@ -55,6 +60,9 @@ class DistContext:
 
     def task_id(self, prefix: str) -> str:
         return f"{prefix}-{self._run_tag}-{next(self._task_seq)}"
+
+    def stage_id(self, kind: str) -> str:
+        return f"{kind}:{next(self._stage_seq)}"
 
 
 @dataclass
@@ -88,9 +96,11 @@ def subtree_distributable(node: pp.PhysicalPlan) -> bool:
 
 def worth_distributing(node: pp.PhysicalPlan, min_rows: int = 0) -> bool:
     """Only ship subtrees containing an exchange-heavy op; pure scans/maps are
-    cheaper executed in-process than serialized across workers."""
+    cheaper executed in-process than serialized across workers.
+    DeviceGroupedAgg counts: it IS a grouped aggregation (the device-lowered
+    form), and omitting it silently kept every plain groupby on the driver."""
     return any(isinstance(n, (pp.HashJoin, pp.HashAggregate, pp.PhysRepartition,
-                              pp.Dedup))
+                              pp.Dedup, pp.DeviceGroupedAgg))
                for n in node.walk())
 
 
@@ -122,9 +132,11 @@ def run_distributed(ctx: DistContext, node: pp.PhysicalPlan) -> List[MicroPartit
 
     try:
         dist = distribute(ctx, node)
-        tasks = [SubPlanTask.from_plan(ctx.task_id("final"), frag)
+        stage = ctx.stage_id("final")
+        tasks = [SubPlanTask.from_plan(ctx.task_id("final"), frag,
+                                       stage_id=stage)
                  for frag in dist.fragments]
-        results = ctx.pool.run_tasks(tasks)
+        results = ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)
         parts: List[MicroPartition] = []
         for t in tasks:  # preserve fragment order
             parts.extend(results[t.task_id].partitions)
@@ -301,14 +313,16 @@ def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
     pool, return per-partition ShuffleRead fragments."""
     sid = uuid.uuid4().hex[:12]
     ctx.shuffle_ids.append(sid)
+    stage = ctx.stage_id("shuffle")
     tasks = [
         SubPlanTask.from_plan(
             ctx.task_id("shuffle"),
             pp.ShuffleWrite(frag, sid, map_id=i, num_partitions=ctx.n_partitions,
-                            by=list(by), shuffle_dir=ctx.shuffle_dir, schema=schema))
+                            by=list(by), shuffle_dir=ctx.shuffle_dir, schema=schema),
+            stage_id=stage)
         for i, frag in enumerate(fragments)
     ]
-    ctx.pool.run_tasks(tasks)
+    ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)
     return [pp.ShuffleRead(sid, p, "" if ctx.fetch_endpoints else ctx.shuffle_dir,
                            schema, ctx.fetch_endpoints)
             for p in range(ctx.n_partitions)]

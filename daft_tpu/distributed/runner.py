@@ -49,6 +49,8 @@ class DistributedRunner(Runner):
         self._owns_shuffle_dir = shuffle_dir is None
         self._pool: Optional[WorkerPool] = None
         self._fetch_server = None
+        # QueryTrace of the most recent traced run (distributed EXPLAIN ANALYZE)
+        self.last_trace = None
 
     def _ensure_pool(self) -> WorkerPool:
         if self._pool is None:
@@ -64,22 +66,105 @@ class DistributedRunner(Runner):
         return self._pool
 
     def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
+        """Execute with the full observability lifecycle: subscriber events
+        (QueryStart/Optimized/End) like the native runner, PLUS a QueryTrace
+        that collects per-task stats, per-stage shuffle counters, and worker
+        heartbeats from the pool — emitted to subscribers at query end and
+        kept on `self.last_trace` for distributed EXPLAIN ANALYZE."""
+        import time
+        import uuid
+
         from ..execution.executor import execute_plan
+        from ..observability import (QueryEnd, QueryOptimized, QueryStart,
+                                     notify, subscribers_active)
+        from ..observability.metrics import registry
+        from ..observability.runtime_stats import (StatsCollector,
+                                                   current_collector,
+                                                   set_collector)
         from ..plan.physical import translate
+        from .trace import QueryTrace
 
         pool = self._ensure_pool()
+        observed = subscribers_active()
+        prev = current_collector()
+        # trace when anyone is watching: attached subscribers OR an ambient
+        # collector (explain_analyze / DataFrame.metrics). Otherwise tasks run
+        # with collect_stats=False — the distributed zero-overhead path.
+        traced = observed or prev is not None
+        qid = uuid.uuid4().hex[:12] if traced else ""
+        t_start = time.perf_counter()
+        t_wall0 = time.time()
+        reg_before = registry().snapshot() if traced else {}
+        if observed:
+            notify("on_query_start", QueryStart(qid, builder.plan.display()))
+        t0 = time.perf_counter()
         optimized = builder.optimize()
         # translate with the driver's own config: the driver-side remainder may
         # use the device; Device* nodes inside shipped subtrees SURVIVE
         # distribution (planner.py DeviceGroupedAgg two-phase split) — each
         # worker's executor picks device vs host from its own leased config
         phys = translate(optimized.plan)
+        if observed:
+            notify("on_query_optimized", QueryOptimized(
+                qid, optimized.plan.display(), phys.display(),
+                time.perf_counter() - t0))
+        trace = QueryTrace(qid) if traced else None
+        self.last_trace = trace
         endpoints = [self._fetch_server.endpoint] if self._fetch_server else None
         ctx = DistContext(pool=pool, shuffle_dir=self._shuffle_dir,
                           n_partitions=self.n_partitions,
-                          fetch_endpoints=endpoints)
-        plan = localize(ctx, phys)
-        yield from execute_plan(plan)
+                          fetch_endpoints=endpoints, trace=trace)
+        collector = prev if prev is not None \
+            else (StatsCollector() if observed else None)
+        rows = 0
+        err = None
+        try:
+            set_collector(collector)
+            try:
+                # localize EXECUTES distributed stages eagerly (shuffle + final
+                # task waves run on the pool here, recording into the trace)
+                plan = localize(ctx, phys)
+                stream = execute_plan(plan)
+            finally:
+                set_collector(prev)
+            while True:
+                set_collector(collector)
+                try:
+                    part = next(stream)
+                except StopIteration:
+                    break
+                finally:
+                    set_collector(prev)
+                rows += part.num_rows
+                yield part
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            set_collector(prev)
+            # drain even when untraced so beats from idle periods or untraced
+            # queries never pile up and get misattributed to a later query
+            beats = pool.drain_heartbeats()
+            if trace is not None:
+                for hb in beats:
+                    # only beats from THIS query's window (workers share the
+                    # host clock; 0.5s slack covers send/receive skew)
+                    if hb.get("ts", 0.0) >= t_wall0 - 0.5:
+                        trace.add_heartbeat(hb)
+            if observed and trace is not None:
+                for ts in list(trace.tasks):
+                    notify("on_task_stats", qid, ts)
+                for sh in trace.shuffle_stats():
+                    notify("on_shuffle_stats", qid, sh)
+                for hb in list(trace.heartbeats):
+                    notify("on_worker_heartbeat", qid, hb)
+            if observed:
+                stats = collector.finish() if collector else []
+                for s in stats:
+                    notify("on_operator_stats", qid, s)
+                notify("on_query_end", QueryEnd(
+                    qid, rows, time.perf_counter() - t_start, err, stats,
+                    metrics=registry().diff(reg_before)))
 
     def shutdown(self) -> None:
         if self._fetch_server is not None:

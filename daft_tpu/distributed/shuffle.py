@@ -15,18 +15,96 @@ socket (see fetch_server) the way the reference serves them over Arrow Flight.
 from __future__ import annotations
 
 import os
-from typing import Iterator, List
+import threading
+from typing import Iterator, List, Optional
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
+from ..observability.metrics import registry
 from ..schema import Schema
 
 
 def partition_dir(base: str, shuffle_id: str, partition_idx: int) -> str:
     return os.path.join(base, shuffle_id, f"p{partition_idx}")
+
+
+class ShuffleRecorder:
+    """Accumulates one task's shuffle volume: bytes/rows/partitions written by
+    map tasks, bytes/rows/latency fetched by reduce tasks. Installed by the
+    worker loop around each task (workers execute one task at a time, but the
+    executor may drive shuffle reads from stage/pool threads — hence the lock).
+    The snapshot ships back with the TaskResult for per-stage aggregation.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.bytes_written = 0
+        self.rows_written = 0
+        self.partitions_written: set = set()
+        self.bytes_fetched = 0
+        self.rows_fetched = 0
+        self.fetch_seconds = 0.0
+        self.fetch_requests = 0
+
+    def record_write(self, shuffle_id: str, partition_idx: int,
+                     rows: int, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_written += nbytes
+            self.rows_written += rows
+            self.partitions_written.add((shuffle_id, partition_idx))
+
+    def record_fetch(self, rows: int, nbytes: int, seconds: float,
+                     requests: int = 1) -> None:
+        with self._lock:
+            self.bytes_fetched += nbytes
+            self.rows_fetched += rows
+            self.fetch_seconds += seconds
+            self.fetch_requests += requests
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "bytes_written": self.bytes_written,
+                "rows_written": self.rows_written,
+                "partitions_written": len(self.partitions_written),
+                "bytes_fetched": self.bytes_fetched,
+                "rows_fetched": self.rows_fetched,
+                "fetch_seconds": self.fetch_seconds,
+                "fetch_requests": self.fetch_requests,
+            }
+
+
+# process-global active recorder: workers run one task at a time, so a single
+# slot suffices; None (the default everywhere else) costs one attribute read
+_ACTIVE_RECORDER: Optional[ShuffleRecorder] = None
+
+
+def set_recorder(r: Optional[ShuffleRecorder]) -> None:
+    global _ACTIVE_RECORDER
+    _ACTIVE_RECORDER = r
+
+
+def current_recorder() -> Optional[ShuffleRecorder]:
+    return _ACTIVE_RECORDER
+
+
+def _note_write(shuffle_id: str, partition_idx: int, rows: int, nbytes: int) -> None:
+    registry().inc("shuffle_bytes_written", nbytes)
+    registry().inc("shuffle_rows_written", rows)
+    r = _ACTIVE_RECORDER
+    if r is not None:
+        r.record_write(shuffle_id, partition_idx, rows, nbytes)
+
+
+def _note_fetch(rows: int, nbytes: int, seconds: float) -> None:
+    registry().inc("shuffle_bytes_fetched", nbytes)
+    registry().inc("shuffle_rows_fetched", rows)
+    r = _ACTIVE_RECORDER
+    if r is not None:
+        r.record_fetch(rows, nbytes, seconds)
 
 
 class MapOutputWriter:
@@ -55,6 +133,7 @@ class MapOutputWriter:
             w = ipc.RecordBatchFileWriter(path, table.schema)
             self._writers[partition_idx] = w
         w.write_table(table)
+        _note_write(self.shuffle_id, partition_idx, batch.num_rows, table.nbytes)
 
     def close(self) -> List[int]:
         for w in self._writers.values():
@@ -76,15 +155,21 @@ def write_map_output(base: str, shuffle_id: str, map_id: int,
 def read_partition(base: str, shuffle_id: str, partition_idx: int,
                    schema: Schema) -> Iterator[MicroPartition]:
     """Stream every map's output for one shuffle partition."""
+    import time
+
     d = partition_dir(base, shuffle_id, partition_idx)
     if not os.path.isdir(d):
         return
     for name in sorted(os.listdir(d)):
         if not name.endswith(".arrow"):
             continue
-        with ipc.RecordBatchFileReader(os.path.join(d, name)) as r:
+        t0 = time.perf_counter()
+        path = os.path.join(d, name)
+        with ipc.RecordBatchFileReader(path) as r:
             table = r.read_all()
         batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
+        _note_fetch(batch.num_rows, os.path.getsize(path),
+                    time.perf_counter() - t0)
         yield MicroPartition(schema, [batch])
 
 

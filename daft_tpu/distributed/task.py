@@ -42,11 +42,34 @@ class SubPlanTask:
     # workers that already failed this task (reference: scheduler re-queues with
     # the failed worker excluded)
     excluded_workers: Tuple[str, ...] = ()
+    # pipeline stage this task belongs to (planner-assigned, e.g. "shuffle:0")
+    stage_id: str = ""
+    # query trace context stamped at dispatch (same _trace_id/_span_id scheme
+    # as observability/otlp.py) — worker-side task/operator spans join the
+    # driver query's trace through these
+    trace_id: str = ""
+    parent_span_id: str = ""
+    # run the sub-plan under a StatsCollector and ship stats back
+    collect_stats: bool = False
+    # driver time.time() when the task entered the scheduler (queue-wait base)
+    submitted_at: float = 0.0
 
     @classmethod
-    def from_plan(cls, task_id: str, plan, strategy=None, priority: int = 0) -> "SubPlanTask":
-        return cls(task_id=task_id, plan_blob=pickle.dumps(plan),
-                   strategy=strategy or Spread(), priority=priority)
+    def from_plan(cls, task_id: str, plan, strategy=None, priority: int = 0,
+                  stage_id: str = "") -> "SubPlanTask":
+        # cloudpickle serializes by VALUE anything a fresh worker process
+        # cannot import (custom DataSource tasks defined in __main__, a
+        # notebook, or a test module) — the reference ships sub-plans the same
+        # way (vendored cloudpickle). Workers unpickle with plain pickle.
+        try:
+            import cloudpickle
+
+            blob = cloudpickle.dumps(plan)
+        except ImportError:
+            blob = pickle.dumps(plan)
+        return cls(task_id=task_id, plan_blob=blob,
+                   strategy=strategy or Spread(), priority=priority,
+                   stage_id=stage_id)
 
     def plan(self):
         return pickle.loads(self.plan_blob)
@@ -61,3 +84,12 @@ class TaskResult:
     rows: int = 0
     error: Optional[str] = None
     error_tb: Optional[str] = None
+    # ---- runtime stats (populated when the task asked for collect_stats) ---------
+    bytes_out: int = 0
+    exec_seconds: float = 0.0
+    started_at: float = 0.0          # worker unix time at execution start
+    span_id: str = ""                # worker task span id within the stamped trace
+    # per-operator stats from the worker's StatsCollector (OperatorStats tuples)
+    op_stats: Tuple[Any, ...] = ()
+    # shuffle volume recorded while this task ran (ShuffleRecorder.as_dict())
+    shuffle: Optional[dict] = None

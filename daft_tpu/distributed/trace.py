@@ -1,0 +1,209 @@
+"""Driver-side distributed query trace: per-stage task stats, shuffle volume,
+worker heartbeats.
+
+Reference parity: the Flotilla scheduler's per-task stats + subscriber
+callbacks (daft/runners/flotilla.py stats path) joined to the local engine's
+runtime_stats vocabulary. The WorkerPool records every finished task here
+(timing measured where it happens: queue wait on the driver, exec wall time on
+the worker), the runner emits the accumulated records to subscribers at query
+end, and DataFrame.explain_analyze() renders the per-stage skew table.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+from typing import Dict, List, Optional
+
+from ..observability.events import ShuffleStats, TaskStats, WorkerHeartbeat
+from ..observability.metrics import registry
+from ..observability.otlp import _span_id, _trace_id
+
+
+class QueryTrace:
+    """Accumulates one distributed query's task/shuffle/heartbeat records.
+
+    Thread-safe: the pool's dispatch loop appends while the driver thread may
+    concurrently render (explain_analyze on a partially-streamed query).
+    """
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.trace_id = _trace_id(query_id) if query_id else ""
+        self.root_span_id = _span_id(query_id, "query") if query_id else ""
+        self._lock = threading.Lock()
+        self.tasks: List[TaskStats] = []
+        self.heartbeats: List[WorkerHeartbeat] = []
+        # stage_id -> accumulated shuffle dict (insertion-ordered)
+        self._shuffle: Dict[str, dict] = {}
+        self._stage_order: List[str] = []
+
+    # ---- recording (called by WorkerPool.run_tasks) ------------------------------
+    def record_task(self, task, result, dispatched_at: float) -> None:
+        """One successfully finished task: join driver-side queueing times with
+        the worker-side execution record shipped in the TaskResult."""
+        queue_wait = max(dispatched_at - task.submitted_at, 0.0) \
+            if task.submitted_at else 0.0
+        sched_lat = max(result.started_at - dispatched_at, 0.0) \
+            if result.started_at else 0.0
+        ts = TaskStats(
+            stage_id=task.stage_id or "stage",
+            task_id=task.task_id,
+            worker_id=result.worker_id,
+            queue_wait_s=queue_wait,
+            schedule_latency_s=sched_lat,
+            exec_s=result.exec_seconds,
+            rows_out=result.rows,
+            bytes_out=result.bytes_out,
+            retries=len(task.excluded_workers),
+            started_at=result.started_at,
+            trace_id=task.trace_id,
+            span_id=result.span_id,
+            parent_span_id=task.parent_span_id,
+            operator_stats=tuple(result.op_stats),
+        )
+        with self._lock:
+            self.tasks.append(ts)
+            if ts.stage_id not in self._shuffle:
+                self._shuffle[ts.stage_id] = {}
+                self._stage_order.append(ts.stage_id)
+            if result.shuffle:
+                acc = self._shuffle[ts.stage_id]
+                for k, v in result.shuffle.items():
+                    acc[k] = acc.get(k, 0) + v
+        if result.shuffle:
+            # mirror into the driver's registry so the per-query metrics diff
+            # (QueryEnd.metrics, bench snapshot) carries cluster-wide volume
+            for k in ("bytes_written", "rows_written", "bytes_fetched",
+                      "rows_fetched"):
+                v = result.shuffle.get(k, 0)
+                if v:
+                    registry().inc(f"shuffle_{k}", int(v))
+
+    def add_heartbeat(self, hb: dict) -> None:
+        rec = WorkerHeartbeat(
+            worker_id=hb.get("worker_id", "?"),
+            ts=hb.get("ts", 0.0),
+            busy_slots=hb.get("busy_slots", 0),
+            total_slots=hb.get("total_slots", 1),
+            tasks_completed=hb.get("tasks_completed", 0),
+            tasks_failed=hb.get("tasks_failed", 0),
+            rss_bytes=hb.get("rss_bytes", 0),
+            uptime_s=hb.get("uptime_s", 0.0),
+        )
+        with self._lock:
+            self.heartbeats.append(rec)
+
+    # ---- aggregation -------------------------------------------------------------
+    def shuffle_stats(self) -> List[ShuffleStats]:
+        with self._lock:
+            out = []
+            for sid in self._stage_order:
+                acc = self._shuffle[sid]
+                if not acc:
+                    continue
+                out.append(ShuffleStats(
+                    stage_id=sid,
+                    bytes_written=int(acc.get("bytes_written", 0)),
+                    rows_written=int(acc.get("rows_written", 0)),
+                    partitions_written=int(acc.get("partitions_written", 0)),
+                    bytes_fetched=int(acc.get("bytes_fetched", 0)),
+                    rows_fetched=int(acc.get("rows_fetched", 0)),
+                    fetch_seconds=float(acc.get("fetch_seconds", 0.0)),
+                    fetch_requests=int(acc.get("fetch_requests", 0)),
+                ))
+            return out
+
+    def stage_summaries(self) -> List[dict]:
+        """Per-stage rollup in execution order: task count, exec-time skew
+        (min/median/max), rows, queue wait, shuffle volume."""
+        with self._lock:
+            by_stage: Dict[str, List[TaskStats]] = {}
+            for t in self.tasks:
+                by_stage.setdefault(t.stage_id, []).append(t)
+            order = list(self._stage_order)
+            shuffle = {k: dict(v) for k, v in self._shuffle.items()}
+        out = []
+        for sid in order:
+            tasks = by_stage.get(sid, [])
+            if not tasks:
+                continue
+            times = sorted(t.exec_s for t in tasks)
+            sh = shuffle.get(sid, {})
+            out.append({
+                "stage_id": sid,
+                "tasks": len(tasks),
+                "workers": len({t.worker_id for t in tasks}),
+                "retries": sum(t.retries for t in tasks),
+                "rows_out": sum(t.rows_out for t in tasks),
+                "bytes_out": sum(t.bytes_out for t in tasks),
+                "queue_wait_s": sum(t.queue_wait_s for t in tasks),
+                "min_s": times[0],
+                "median_s": statistics.median(times),
+                "max_s": times[-1],
+                "shuffle_bytes_written": int(sh.get("bytes_written", 0)),
+                "shuffle_bytes_fetched": int(sh.get("bytes_fetched", 0)),
+            })
+        return out
+
+    def worker_summary(self) -> List[dict]:
+        with self._lock:
+            tasks = list(self.tasks)
+            hbs = list(self.heartbeats)
+        by_worker: Dict[str, dict] = {}
+        for t in tasks:
+            w = by_worker.setdefault(t.worker_id,
+                                     {"tasks": 0, "exec_s": 0.0, "rows": 0})
+            w["tasks"] += 1
+            w["exec_s"] += t.exec_s
+            w["rows"] += t.rows_out
+        for hb in hbs:
+            w = by_worker.setdefault(hb.worker_id,
+                                     {"tasks": 0, "exec_s": 0.0, "rows": 0})
+            w["rss_bytes"] = hb.rss_bytes      # latest wins (list is in order)
+            w["heartbeats"] = w.get("heartbeats", 0) + 1
+        return [{"worker_id": k, **v} for k, v in sorted(by_worker.items())]
+
+    # ---- rendering ---------------------------------------------------------------
+    def render(self) -> str:
+        """The distributed EXPLAIN ANALYZE section: stage DAG rollup with task
+        skew (min/median/max task time) and shuffle volumes, then per-worker
+        attribution."""
+        stages = self.stage_summaries()
+        if not stages:
+            return "(no distributed stages ran)"
+        lines = [f"{'stage':<22} {'tasks':>5} {'rows out':>12} "
+                 f"{'min/median/max task':>24} {'queue wait':>10} "
+                 f"{'shuffle w':>10} {'shuffle r':>10}"]
+        for s in stages:
+            skew = (f"{s['min_s']*1e3:.1f}/{s['median_s']*1e3:.1f}/"
+                    f"{s['max_s']*1e3:.1f}ms")
+            lines.append(
+                f"{s['stage_id']:<22} {s['tasks']:>5} {s['rows_out']:>12} "
+                f"{skew:>24} {s['queue_wait_s']*1e3:>8.1f}ms "
+                f"{_fmt_bytes(s['shuffle_bytes_written']):>10} "
+                f"{_fmt_bytes(s['shuffle_bytes_fetched']):>10}")
+            if s["retries"]:
+                lines.append(f"  {'':<20} ({s['retries']} task retries)")
+        workers = self.worker_summary()
+        if workers:
+            lines.append("")
+            lines.append(f"{'worker':<12} {'tasks':>5} {'busy':>10} "
+                         f"{'rows out':>12} {'rss':>10} {'heartbeats':>10}")
+            for w in workers:
+                lines.append(
+                    f"{w['worker_id']:<12} {w['tasks']:>5} "
+                    f"{w['exec_s']*1e3:>8.1f}ms {w['rows']:>12} "
+                    f"{_fmt_bytes(w.get('rss_bytes', 0)):>10} "
+                    f"{w.get('heartbeats', 0):>10}")
+        return "\n".join(lines)
+
+
+def _fmt_bytes(n: int) -> str:
+    if n <= 0:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024:
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"

@@ -20,8 +20,11 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 import traceback
 import uuid
+from collections import deque
 from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Dict, List, Optional
@@ -29,30 +32,130 @@ from typing import Dict, List, Optional
 from .task import SubPlanTask, TaskResult
 
 
-def _worker_loop(conn, worker_id: str) -> None:
-    """Receive pickled SubPlanTasks, execute, reply TaskResult."""
-    from ..execution.executor import execute_plan
+def _rss_bytes() -> int:
+    """Resident set size of this process (linux /proc; getrusage fallback)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
 
-    conn.send(("hello", worker_id))
-    while True:
-        try:
-            msg = conn.recv()
-        except (EOFError, KeyboardInterrupt):
-            return
-        if msg is None or msg[0] == "stop":
-            return
-        kind, task = msg
-        assert kind == "task"
-        try:
-            plan = task.plan()
-            parts = [p for p in execute_plan(plan)]
-            rows = sum(p.num_rows for p in parts)
-            conn.send(TaskResult(task_id=task.task_id, worker_id=worker_id,
-                                 partitions=parts, rows=rows))
-        except Exception as e:  # noqa: BLE001 — errors must cross the process boundary
-            conn.send(TaskResult(task_id=task.task_id, worker_id=worker_id,
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
+            return 0
+
+
+def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
+    """Execute one sub-plan. When the task asks for stats (driver has
+    subscribers attached or explain_analyze running) the plan runs under a
+    StatsCollector with a ShuffleRecorder installed, and the result ships the
+    per-operator stats + shuffle volume + a task span id within the stamped
+    trace context back to the driver."""
+    from ..execution.executor import execute_plan
+    from . import shuffle as shf
+
+    collector = recorder = None
+    if task.collect_stats:
+        from ..observability.otlp import _span_id
+        from ..observability.runtime_stats import StatsCollector, set_collector
+
+        collector = StatsCollector()
+        recorder = shf.ShuffleRecorder()
+        set_collector(collector)
+        shf.set_recorder(recorder)
+    started_at = time.time()
+    t0 = time.perf_counter()
+    try:
+        plan = task.plan()
+        parts = [p for p in execute_plan(plan)]
+        exec_s = time.perf_counter() - t0
+        rows = sum(p.num_rows for p in parts)
+        res = TaskResult(task_id=task.task_id, worker_id=worker_id,
+                         partitions=parts, rows=rows,
+                         exec_seconds=exec_s, started_at=started_at)
+        if collector is not None:
+            res.bytes_out = sum(p.size_bytes() for p in parts)
+            res.op_stats = tuple(collector.finish())
+            res.shuffle = recorder.as_dict()
+            res.span_id = _span_id(task.trace_id or task.task_id,
+                                   "task", task.task_id)
+        return res
+    finally:
+        if task.collect_stats:
+            from ..observability.runtime_stats import set_collector
+
+            set_collector(None)
+            shf.set_recorder(None)
+
+
+def _worker_loop(conn, worker_id: str) -> None:
+    """Receive pickled SubPlanTasks, execute, reply TaskResult. A background
+    thread interleaves ("heartbeat", {...}) reports — slot occupancy, task
+    counts, RSS — on the same connection (send-locked; the driver routes them
+    out of band in WorkerProcess.poll)."""
+    send_lock = threading.Lock()
+    stop = threading.Event()
+    state = {"busy": 0, "completed": 0, "failed": 0}
+    t_start = time.time()
+
+    def _send(msg) -> None:
+        with send_lock:
+            conn.send(msg)
+
+    try:
+        total_slots = max(int(os.environ.get("DAFT_TPU_WORKER_SLOTS", "1")), 1)
+    except ValueError:
+        total_slots = 1
+
+    def _heartbeat_loop(interval: float) -> None:
+        # first beat immediately so even sub-second queries observe >=1
+        while not stop.is_set():
+            try:
+                _send(("heartbeat", {
+                    "worker_id": worker_id, "ts": time.time(),
+                    "busy_slots": state["busy"], "total_slots": total_slots,
+                    "tasks_completed": state["completed"],
+                    "tasks_failed": state["failed"],
+                    "rss_bytes": _rss_bytes(),
+                    "uptime_s": time.time() - t_start,
+                }))
+            except (BrokenPipeError, OSError):
+                return  # driver gone; main loop will notice on recv
+            stop.wait(interval)
+
+    _send(("hello", worker_id))
+    try:
+        interval = float(os.environ.get("DAFT_TPU_HEARTBEAT_S", "2.0"))
+    except ValueError:
+        interval = 2.0
+    if interval > 0:
+        threading.Thread(target=_heartbeat_loop, args=(interval,),
+                         daemon=True, name="daft-heartbeat").start()
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, KeyboardInterrupt):
+                return
+            if msg is None or msg[0] == "stop":
+                return
+            kind, task = msg
+            assert kind == "task"
+            state["busy"] = 1
+            try:
+                res = _run_task(task, worker_id)
+                state["completed"] += 1
+                _send(res)
+            except Exception as e:  # noqa: BLE001 — errors must cross the process boundary
+                state["failed"] += 1
+                _send(TaskResult(task_id=task.task_id, worker_id=worker_id,
                                  error=f"{type(e).__name__}: {e}",
                                  error_tb=traceback.format_exc()))
+            finally:
+                state["busy"] = 0
+    finally:
+        stop.set()
 
 
 def main(argv: List[str]) -> None:
@@ -74,11 +177,16 @@ class WorkerProcess:
         self.slots = slots
         child_env = dict(os.environ)
         child_env.setdefault("DAFT_TPU_DEVICE", "off")
-        # make the engine importable in the child regardless of how the driver
-        # process was launched (script, REPL, notebook)
+        child_env["DAFT_TPU_WORKER_SLOTS"] = str(slots)
+        # make the engine AND everything the driver can import resolvable in
+        # the child (script dir, pytest-inserted test dirs): shipped sub-plans
+        # may reference classes from any module on the driver's sys.path
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         prev = child_env.get("PYTHONPATH", "")
-        child_env["PYTHONPATH"] = pkg_root + (os.pathsep + prev if prev else "")
+        paths = [pkg_root] + [p for p in sys.path if p and p != pkg_root]
+        if prev:
+            paths.append(prev)
+        child_env["PYTHONPATH"] = os.pathsep.join(paths)
         child_env.update(env or {})
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "daft_tpu.distributed._worker_entry",
@@ -123,21 +231,53 @@ class WorkerProcess:
                 self._proc.terminate()
                 raise RuntimeError(f"worker {worker_id} never connected (60s)")
         self.inflight: Dict[str, SubPlanTask] = {}
+        # out-of-band worker heartbeats received during poll (bounded window)
+        self.heartbeats: deque = deque(maxlen=256)
+        # results received while draining heartbeats; poll() serves these first
+        self._pending_results: deque = deque()
 
     def submit(self, task: SubPlanTask) -> None:
         self.inflight[task.task_id] = task
         self._conn.send(("task", task))
 
     def poll(self, timeout: float = 0.0) -> Optional[TaskResult]:
+        if self._pending_results:
+            res = self._pending_results.popleft()
+            self.inflight.pop(res.task_id, None)
+            return res
         try:
-            if self._conn.poll(timeout):
-                res: TaskResult = self._conn.recv()
+            while self._conn.poll(timeout):
+                msg = self._conn.recv()
+                if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
+                    # out-of-band heartbeat: record and keep draining (without
+                    # blocking again — the result may already be queued)
+                    self.heartbeats.append(msg[1])
+                    timeout = 0.0
+                    continue
+                res: TaskResult = msg
                 self.inflight.pop(res.task_id, None)
                 return res
         except (EOFError, BrokenPipeError, OSError):
             # dead worker: caller's alive-check re-queues its in-flight tasks
             pass
         return None
+
+    def drain_heartbeats(self) -> List[dict]:
+        """Non-destructively empty the connection: heartbeats are collected;
+        any TaskResult encountered is stashed for the next poll() (a stale
+        result from an errored stage must not be silently consumed here)."""
+        try:
+            while self._conn.poll(0.0):
+                msg = self._conn.recv()
+                if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
+                    self.heartbeats.append(msg[1])
+                else:
+                    self._pending_results.append(msg)
+        except (EOFError, BrokenPipeError, OSError):
+            pass
+        out = list(self.heartbeats)
+        self.heartbeats.clear()
+        return out
 
     @property
     def alive(self) -> bool:
@@ -244,21 +384,48 @@ class WorkerPool:
             n -= 1
         return added
 
-    def run_tasks(self, tasks: List[SubPlanTask]) -> Dict[str, TaskResult]:
+    def run_tasks(self, tasks: List[SubPlanTask], stage_id: str = "",
+                  trace=None) -> Dict[str, TaskResult]:
+        """Drive one stage of tasks to completion.
+
+        When `trace` (a distributed.trace.QueryTrace) is given, every task is
+        stamped with the query's trace context at dispatch (trace id + parent
+        span id, the otlp.py scheme) and asked to collect stats; finished
+        tasks are recorded into the trace with driver-side queue-wait/dispatch
+        timing joined to the worker-side execution record.
+        """
         from .scheduler import Scheduler
 
         sched = Scheduler({w.worker_id: w.slots
                            for w in self.workers.values() if w.alive})
+        now = time.time()
         for t in tasks:
+            if stage_id and not t.stage_id:
+                t.stage_id = stage_id
+            if trace is not None:
+                t.collect_stats = True
+                t.trace_id = trace.trace_id
+                t.parent_span_id = trace.root_span_id
+            t.submitted_at = now
             sched.submit(t)
         results: Dict[str, TaskResult] = {}
         expected = {t.task_id for t in tasks}
+        dispatched_at: Dict[str, float] = {}
+        task_by_id: Dict[str, SubPlanTask] = {t.task_id: t for t in tasks}
 
         def _requeue_elsewhere(w: WorkerProcess, task: SubPlanTask) -> None:
-            sched.submit(SubPlanTask(
+            clone = SubPlanTask(
                 task_id=task.task_id, plan_blob=task.plan_blob,
                 strategy=task.strategy, priority=task.priority,
-                excluded_workers=task.excluded_workers + (w.worker_id,)))
+                excluded_workers=task.excluded_workers + (w.worker_id,),
+                stage_id=task.stage_id, trace_id=task.trace_id,
+                parent_span_id=task.parent_span_id,
+                collect_stats=task.collect_stats,
+                # keep the FIRST submit time: a retry's queue wait includes
+                # the failed attempt's scheduling delay
+                submitted_at=task.submitted_at)
+            task_by_id[task.task_id] = clone
+            sched.submit(clone)
 
         while len(results) < len(expected):
             # elastic scale-up: when queued demand exceeds capacity by the
@@ -273,6 +440,7 @@ class WorkerPool:
                 w = self.workers[wid]
                 try:
                     w.submit(task)
+                    dispatched_at[task.task_id] = time.time()
                 except (BrokenPipeError, OSError):
                     w.inflight.pop(task.task_id, None)
                     sched.remove_worker(wid)
@@ -289,6 +457,9 @@ class WorkerPool:
                         raise RuntimeError(
                             f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}")
                     results[res.task_id] = res
+                    if trace is not None and res.task_id in task_by_id:
+                        trace.record_task(task_by_id[res.task_id], res,
+                                          dispatched_at.get(res.task_id, 0.0))
                 if not w.alive:
                     # worker died: re-queue its tasks elsewhere and DROP the
                     # entry (leaving it would leak its fd and pay a poll
@@ -310,6 +481,16 @@ class WorkerPool:
                 raise RuntimeError(
                     f"{sched.pending_count()} tasks unschedulable (no eligible workers)")
         return results
+
+    def drain_heartbeats(self) -> List[dict]:
+        """Collect heartbeats received from every live worker since the last
+        drain (the runner forwards them to subscribers / the dashboard).
+        Task results encountered while draining are preserved for poll()."""
+        out: List[dict] = []
+        for w in self.workers.values():
+            out.extend(w.drain_heartbeats())
+        out.sort(key=lambda h: h.get("ts", 0.0))
+        return out
 
     def shutdown(self) -> None:
         for w in self.workers.values():

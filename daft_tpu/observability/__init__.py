@@ -10,7 +10,11 @@ from .events import (
     QueryEnd,
     QueryOptimized,
     QueryStart,
+    ShuffleStats,
+    TaskStats,
+    WorkerHeartbeat,
 )
+from .metrics import MetricsRegistry, registry
 from .subscribers import (
     Subscriber,
     attach_subscriber,
@@ -25,6 +29,11 @@ __all__ = [
     "QueryEnd",
     "QueryOptimized",
     "QueryStart",
+    "ShuffleStats",
+    "TaskStats",
+    "WorkerHeartbeat",
+    "MetricsRegistry",
+    "registry",
     "Subscriber",
     "attach_subscriber",
     "detach_subscriber",

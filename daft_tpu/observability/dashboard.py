@@ -33,15 +33,20 @@ h2,h3{color:#eee}
 </style></head><body>
 <h2>daft_tpu — live queries</h2>
 <div class="counters" id="eng"></div>
+<div class="counters" id="wk"></div>
 <div id="t"></div><div id="detail"></div>
 <script>
 let selected = null;
 function esc(x){ return String(x ?? '').replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;'); }
 async function refresh(){
-  const [qs, eng] = await Promise.all([
-    (await fetch('/api/queries')).json(), (await fetch('/api/engine')).json()]);
+  const [qs, eng, wk] = await Promise.all([
+    (await fetch('/api/queries')).json(), (await fetch('/api/engine')).json(),
+    (await fetch('/api/workers')).json()]);
   document.getElementById('eng').innerHTML =
     Object.entries(eng).map(([k,v])=>`<span>${k}: ${v}</span>`).join('');
+  document.getElementById('wk').innerHTML =
+    Object.entries(wk).map(([k,v])=>`<span>${esc(k)}: busy ${(100*v.busy_fraction).toFixed(0)}% `+
+      `done ${v.last?v.last.tasks_completed:0} rss ${v.last?(v.last.rss_bytes/1048576).toFixed(0):0}MiB</span>`).join('');
   let h = '<table><tr><th>id</th><th>status</th><th>rows</th><th>seconds</th><th>top operators</th></tr>';
   for (const q of qs){
     const ops = (q.operators||[]).slice(0,3).map(o=>`${esc(o.name)}: ${o.rows_out}r / ${(o.seconds*1000).toFixed(1)}ms`).join('<br>');
@@ -72,12 +77,15 @@ refresh(); setInterval(refresh, 1000);
 
 
 class DashboardState(Subscriber):
-    """Bounded history of query events (newest first)."""
+    """Bounded history of query events (newest first) + a time-windowed view
+    of worker heartbeats (slot occupancy, task counts, RSS)."""
 
-    def __init__(self, max_queries: int = 100):
+    def __init__(self, max_queries: int = 100, max_heartbeats: int = 512):
         self._lock = threading.Lock()
         self._queries: deque = deque(maxlen=max_queries)
         self._by_id: dict = {}
+        self._max_heartbeats = max_heartbeats
+        self._workers: dict = {}  # worker_id -> deque of heartbeat dicts
 
     def on_query_start(self, event: QueryStart) -> None:
         rec = {"query_id": event.query_id, "started": time.time(),
@@ -101,12 +109,47 @@ class DashboardState(Subscriber):
                     "batches": stats.batches_out, "seconds": stats.seconds,
                 })
 
+    def on_task_stats(self, query_id: str, stats) -> None:
+        with self._lock:
+            rec = self._by_id.get(query_id)
+            if rec is not None:
+                rec.setdefault("tasks", []).append({
+                    "stage_id": stats.stage_id, "task_id": stats.task_id,
+                    "worker_id": stats.worker_id, "exec_s": stats.exec_s,
+                    "rows_out": stats.rows_out,
+                })
+
+    def on_shuffle_stats(self, query_id: str, stats) -> None:
+        with self._lock:
+            rec = self._by_id.get(query_id)
+            if rec is not None:
+                rec.setdefault("shuffles", []).append({
+                    "stage_id": stats.stage_id,
+                    "bytes_written": stats.bytes_written,
+                    "bytes_fetched": stats.bytes_fetched,
+                    "fetch_requests": stats.fetch_requests,
+                })
+
+    def on_worker_heartbeat(self, query_id: str, hb) -> None:
+        with self._lock:
+            dq = self._workers.get(hb.worker_id)
+            if dq is None:
+                dq = self._workers[hb.worker_id] = deque(
+                    maxlen=self._max_heartbeats)
+            dq.append({"ts": hb.ts, "busy_slots": hb.busy_slots,
+                       "total_slots": hb.total_slots,
+                       "tasks_completed": hb.tasks_completed,
+                       "tasks_failed": hb.tasks_failed,
+                       "rss_bytes": hb.rss_bytes})
+
     def on_query_end(self, event: QueryEnd) -> None:
         with self._lock:
             rec = self._by_id.get(event.query_id)
             if rec is not None:
                 rec.update(done=True, rows=event.rows, seconds=event.seconds,
                            error=event.error)
+                if event.metrics:
+                    rec["metrics"] = dict(event.metrics)
                 rec["operators"].sort(key=lambda o: -o["seconds"])
 
     def snapshot(self) -> list:
@@ -117,6 +160,26 @@ class DashboardState(Subscriber):
         with self._lock:
             rec = self._by_id.get(query_id)
             return dict(rec) if rec is not None else None
+
+    def workers(self, window_s: float = 60.0) -> dict:
+        """Per-worker utilization: last report + busy fraction over beats from
+        the last `window_s` seconds (the deque maxlen only bounds memory; the
+        utilization view is scoped by TIME, so a long-idle worker's stale
+        beats don't report as current load)."""
+        now = time.time()
+        with self._lock:
+            out = {}
+            for wid, dq in self._workers.items():
+                beats = list(dq)
+                recent = [b for b in beats if b["ts"] >= now - window_s]
+                busy = sum(1 for b in recent if b["busy_slots"] > 0)
+                out[wid] = {
+                    "last": beats[-1] if beats else None,
+                    "heartbeats": len(beats),
+                    "recent": len(recent),
+                    "busy_fraction": busy / len(recent) if recent else 0.0,
+                }
+            return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -136,13 +199,11 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path.startswith("/api/engine"):
             from ..ops import counters
 
-            body = json.dumps({
-                "device_stage_batches": counters.device_stage_batches,
-                "device_grouped_batches": counters.device_grouped_batches,
-                "device_join_batches": counters.device_join_batches,
-                "mesh_grouped_runs": counters.mesh_grouped_runs,
-                "device_stage_runs": counters.device_stage_runs,
-            }).encode()
+            # the full registry: device counters + shuffle/transport volume
+            body = json.dumps(counters.snapshot()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/workers"):
+            body = json.dumps(self.server.state.workers(), default=str).encode()
             ctype = "application/json"
         elif self.path == "/" or self.path.startswith("/index"):
             body = _HTML.encode()

@@ -15,6 +15,12 @@ import time
 
 from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 
+# Bumped whenever a record's shape changes so downstream trace pipelines can
+# branch on it. v1: implicit (no field). v2: adds schema_version to every
+# record plus the distributed task_stats/shuffle_stats/worker_heartbeat kinds
+# and query_end.metrics.
+SCHEMA_VERSION = 2
+
 
 class EventLogSubscriber(Subscriber):
     def __init__(self, path: str):
@@ -22,7 +28,8 @@ class EventLogSubscriber(Subscriber):
         self._lock = threading.Lock()
 
     def _emit(self, kind: str, payload: dict) -> None:
-        rec = {"ts": time.time(), "event": kind, **payload}
+        rec = {"ts": time.time(), "schema_version": SCHEMA_VERSION,
+               "event": kind, **payload}
         with self._lock, open(self.path, "a") as f:
             f.write(json.dumps(rec, default=str) + "\n")
 
@@ -34,6 +41,22 @@ class EventLogSubscriber(Subscriber):
 
     def on_operator_stats(self, qid, s) -> None:
         self._emit("operator_stats", {"query_id": qid, **dataclasses.asdict(s)})
+
+    def on_task_stats(self, qid, s) -> None:
+        d = dataclasses.asdict(s)
+        # operator stats are emitted as spans/records of their own scale; keep
+        # the task record flat and grep-able
+        d["operator_stats"] = [{"name": o["name"], "rows_out": o["rows_out"],
+                                "seconds": o["seconds"]}
+                               for o in d.get("operator_stats", ())]
+        self._emit("task_stats", {"query_id": qid, **d})
+
+    def on_shuffle_stats(self, qid, s) -> None:
+        self._emit("shuffle_stats", {"query_id": qid, **dataclasses.asdict(s)})
+
+    def on_worker_heartbeat(self, qid, hb) -> None:
+        self._emit("worker_heartbeat", {"query_id": qid,
+                                        **dataclasses.asdict(hb)})
 
     def on_query_end(self, e) -> None:
         d = dataclasses.asdict(e)

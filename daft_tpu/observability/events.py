@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 @dataclass(frozen=True)
@@ -33,9 +33,63 @@ class OperatorStats:
 
 
 @dataclass(frozen=True)
+class TaskStats:
+    """One distributed sub-plan task's runtime record, shipped from the worker
+    back with the result and aggregated per stage by the driver (reference:
+    Flotilla per-task stats through the subscriber path)."""
+
+    stage_id: str
+    task_id: str
+    worker_id: str
+    queue_wait_s: float        # driver: submit -> dispatch (time in the scheduler heap)
+    schedule_latency_s: float  # dispatch -> worker exec start (transport + unpickle)
+    exec_s: float              # worker-side execution wall time
+    rows_out: int
+    bytes_out: int
+    retries: int               # workers this task already failed on
+    started_at: float = 0.0    # unix time on the worker
+    trace_id: str = ""         # stamped trace context (otlp._trace_id scheme)
+    span_id: str = ""
+    parent_span_id: str = ""
+    operator_stats: Tuple[OperatorStats, ...] = ()
+
+
+@dataclass(frozen=True)
+class ShuffleStats:
+    """Per-stage shuffle/transport volume (reference: shuffle_cache +
+    flight_server counters)."""
+
+    stage_id: str
+    bytes_written: int = 0
+    rows_written: int = 0
+    partitions_written: int = 0
+    bytes_fetched: int = 0
+    rows_fetched: int = 0
+    fetch_seconds: float = 0.0
+    fetch_requests: int = 0
+
+
+@dataclass(frozen=True)
+class WorkerHeartbeat:
+    """Periodic worker self-report: slot occupancy, task counts, RSS."""
+
+    worker_id: str
+    ts: float                  # unix time on the worker
+    busy_slots: int
+    total_slots: int
+    tasks_completed: int
+    tasks_failed: int
+    rss_bytes: int
+    uptime_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class QueryEnd:
     query_id: str
     rows: int
     seconds: float
     error: Optional[str] = None
     operator_stats: List[OperatorStats] = field(default_factory=list)
+    # per-query metrics-registry counter deltas (device batches, shuffle
+    # bytes, rejections dropped, ...) — see observability/metrics.py
+    metrics: Dict[str, float] = field(default_factory=dict)

@@ -1,0 +1,100 @@
+"""Thread-safe metrics registry: named counters and gauges with snapshot/diff.
+
+Reference parity: src/common/metrics/src/ops.rs — the reference defines a
+per-operator metrics vocabulary behind one process-wide registry that
+subscribers snapshot per query. Here the registry is the single home for
+engine-path attribution counters (device batches, shuffle bytes, fetch-server
+requests); `ops/counters.py` re-exports the device names for backward
+compatibility, and runners record a per-query `diff()` into QueryEnd so
+device/shuffle attribution lands in EXPLAIN ANALYZE and the event log instead
+of only in bench.py.
+
+Zero-overhead contract: nothing in the engine's hot path reads the registry;
+writes only happen on coarse events (a device dispatch, a shuffle file, a
+fetch request), never per row.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional
+
+
+class MetricsRegistry:
+    """Named monotonically-increasing counters + last-value gauges.
+
+    All methods are safe to call from any thread (executor stage threads,
+    shuffle fetch threads, the worker heartbeat thread).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+
+    # ---- writes ------------------------------------------------------------------
+    def declare(self, *names: str) -> None:
+        """Pre-register counters at 0 so they always appear in snapshots."""
+        with self._lock:
+            for n in names:
+                self._counters.setdefault(n, 0)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # ---- reads -------------------------------------------------------------------
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time copy of every counter and gauge."""
+        with self._lock:
+            out: Dict[str, float] = dict(self._counters)
+            out.update(self._gauges)
+            return out
+
+    def diff(self, before: Dict[str, float]) -> Dict[str, float]:
+        """Counter deltas since `before` (a prior snapshot); gauges report
+        their current value. Zero deltas are dropped so per-query records
+        stay small; negative deltas (a reset() between the snapshots) clamp
+        to zero and drop rather than reporting nonsense."""
+        now = self.snapshot()
+        out: Dict[str, float] = {}
+        with self._lock:
+            gauges = set(self._gauges)
+        for k, v in now.items():
+            if k in gauges:
+                if v:
+                    out[k] = v
+                continue
+            d = v - before.get(k, 0)
+            if d > 0:
+                out[k] = d
+        return out
+
+    def reset(self, names: Optional[Iterable[str]] = None) -> None:
+        """Zero counters (all, or just `names`) and drop gauges."""
+        with self._lock:
+            if names is None:
+                for k in self._counters:
+                    self._counters[k] = 0
+                self._gauges.clear()
+            else:
+                for k in names:
+                    if k in self._counters:
+                        self._counters[k] = 0
+                    self._gauges.pop(k, None)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (one per driver / worker process)."""
+    return _REGISTRY

@@ -11,6 +11,10 @@ Span tree per query:
     daft.query  (root: query id, row count, error status)
       +- daft.optimize               (plan optimization)
       +- daft.operator:{name} x N    (per-physical-operator self time + rows)
+      +- daft.task:{stage} x M       (distributed sub-plan tasks; the worker
+      |    +- daft.operator:{name}     computed its span id from the trace
+      |                                context stamped on the SubPlanTask, so
+      |                                worker-side spans land in THIS trace)
 
 Attach with:
     from daft_tpu.observability.otlp import OTLPSubscriber
@@ -29,7 +33,8 @@ import time
 import urllib.request
 from typing import Dict, List, Optional
 
-from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+from .events import (OperatorStats, QueryEnd, QueryOptimized, QueryStart,
+                     TaskStats)
 from .subscribers import Subscriber, attach_subscriber
 
 
@@ -65,6 +70,7 @@ class OTLPSubscriber(Subscriber):
         self._starts: Dict[str, float] = {}
         self._optimize: Dict[str, QueryOptimized] = {}
         self._op_stats: Dict[str, List[OperatorStats]] = {}
+        self._task_stats: Dict[str, List[TaskStats]] = {}
         self._lock = threading.Lock()
         self.exported = 0          # test/observability hook
         self.last_error: Optional[str] = None
@@ -82,12 +88,17 @@ class OTLPSubscriber(Subscriber):
         with self._lock:
             self._op_stats.setdefault(query_id, []).append(stats)
 
+    def on_task_stats(self, query_id: str, stats: TaskStats) -> None:
+        with self._lock:
+            self._task_stats.setdefault(query_id, []).append(stats)
+
     def on_query_end(self, event: QueryEnd) -> None:
         with self._lock:
             t0 = self._starts.pop(event.query_id, time.time() - event.seconds)
             opt = self._optimize.pop(event.query_id, None)
             ops = self._op_stats.pop(event.query_id, [])
-        payload = self._encode(event, t0, opt, ops)
+            tasks = self._task_stats.pop(event.query_id, [])
+        payload = self._encode(event, t0, opt, ops, tasks)
         if self.asynchronous:
             threading.Thread(target=self._post, args=(payload,), daemon=True,
                              name="daft-otlp").start()
@@ -96,7 +107,8 @@ class OTLPSubscriber(Subscriber):
 
     # ---- OTLP JSON ----------------------------------------------------------------
     def _encode(self, end: QueryEnd, t0: float, opt: Optional[QueryOptimized],
-                ops: List[OperatorStats]) -> dict:
+                ops: List[OperatorStats],
+                tasks: Optional[List[TaskStats]] = None) -> dict:
         qid = end.query_id
         trace = _trace_id(qid)
         root = _span_id(qid, "query")
@@ -127,6 +139,42 @@ class OTLPSubscriber(Subscriber):
                                _attr("daft.batches_out", s.batches_out)],
                 "status": {"code": 1},
             })
+        # distributed sub-plan tasks: the worker computed span_id from the
+        # trace context stamped on its SubPlanTask (same _trace_id/_span_id
+        # scheme), so its task + operator spans join THIS query's waterfall
+        for ts in tasks or ():
+            t_trace = ts.trace_id or trace
+            t_span = ts.span_id or _span_id(t_trace, "task", ts.task_id)
+            t_ns0 = int(ts.started_at * 1e9) if ts.started_at else ns0
+            t_ns1 = t_ns0 + int(ts.exec_s * 1e9)
+            spans.append({
+                "traceId": t_trace, "spanId": t_span,
+                "parentSpanId": ts.parent_span_id or root,
+                "name": f"daft.task:{ts.stage_id}", "kind": 1,
+                "startTimeUnixNano": str(t_ns0), "endTimeUnixNano": str(t_ns1),
+                "attributes": [
+                    _attr("daft.task_id", ts.task_id),
+                    _attr("daft.worker_id", ts.worker_id),
+                    _attr("daft.rows_out", ts.rows_out),
+                    _attr("daft.bytes_out", ts.bytes_out),
+                    _attr("daft.queue_wait_s", ts.queue_wait_s),
+                    _attr("daft.schedule_latency_s", ts.schedule_latency_s),
+                    _attr("daft.retries", ts.retries),
+                ],
+                "status": {"code": 1},
+            })
+            for s in ts.operator_stats:
+                spans.append({
+                    "traceId": t_trace,
+                    "spanId": _span_id(t_span, "op", str(s.node_id)),
+                    "parentSpanId": t_span,
+                    "name": f"daft.operator:{s.name}", "kind": 1,
+                    "startTimeUnixNano": str(t_ns0),
+                    "endTimeUnixNano": str(t_ns0 + int(s.seconds * 1e9)),
+                    "attributes": [_attr("daft.rows_out", s.rows_out),
+                                   _attr("daft.batches_out", s.batches_out)],
+                    "status": {"code": 1},
+                })
         return {"resourceSpans": [{
             "resource": {"attributes": [_attr("service.name", self.service_name)]},
             "scopeSpans": [{"scope": {"name": "daft_tpu"}, "spans": spans}],

@@ -11,7 +11,8 @@ from __future__ import annotations
 import threading
 from typing import List
 
-from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+from .events import (OperatorStats, QueryEnd, QueryOptimized, QueryStart,
+                     ShuffleStats, TaskStats, WorkerHeartbeat)
 
 
 class Subscriber:
@@ -24,6 +25,15 @@ class Subscriber:
         pass
 
     def on_operator_stats(self, query_id: str, stats: OperatorStats) -> None:  # pragma: no cover
+        pass
+
+    def on_task_stats(self, query_id: str, stats: TaskStats) -> None:  # pragma: no cover
+        pass
+
+    def on_shuffle_stats(self, query_id: str, stats: ShuffleStats) -> None:  # pragma: no cover
+        pass
+
+    def on_worker_heartbeat(self, query_id: str, hb: WorkerHeartbeat) -> None:  # pragma: no cover
         pass
 
     def on_query_end(self, event: QueryEnd) -> None:  # pragma: no cover

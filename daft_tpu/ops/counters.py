@@ -4,6 +4,12 @@ Incremented by the device agg stages when a batch is actually processed on the
 JAX device; tests assert these to prove the engine selected the device path
 (no aspirational docstrings — see VERDICT r1 weak #1).
 
+The counters live in the process-wide MetricsRegistry
+(observability/metrics.py) so the same numbers reach EXPLAIN ANALYZE, the
+event log (QueryEnd.metrics), the dashboard, and bench.py. Module attribute
+reads (``counters.device_stage_batches``) keep working via PEP 562
+``__getattr__`` — they read the registry.
+
 `rejections` records WHY a plan/stage stayed on host (capture bailed, cost
 model chose host, runtime DeviceFallback): {reason: count}. bench.py prints it
 so a host-only number is attributable, not silent (VERDICT r4 next #1).
@@ -13,19 +19,33 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-device_stage_batches = 0     # batches through FilterAggStage (ungrouped)
-device_grouped_batches = 0   # batches through GroupedAggStage
-device_stage_runs = 0        # completed device agg node executions
-mesh_grouped_runs = 0        # grouped aggs executed via the mesh-sharded path
-device_join_batches = 0      # batches through the gather-join device stages
-device_topn_runs = 0         # join+agg+TopN fused device programs completed
+from ..observability.metrics import registry
+
+COUNTER_NAMES = (
+    "device_stage_batches",    # batches through FilterAggStage (ungrouped)
+    "device_grouped_batches",  # batches through GroupedAggStage
+    "device_stage_runs",       # completed device agg node executions
+    "mesh_grouped_runs",       # grouped aggs executed via the mesh-sharded path
+    "device_join_batches",     # batches through the gather-join device stages
+    "device_topn_runs",        # join+agg+TopN fused device programs completed
+    "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
+)
+
+registry().declare(*COUNTER_NAMES)
 
 rejections: Dict[str, int] = {}
 rejection_log: List[Tuple[str, str]] = []  # (site, reason), bounded
+_REJECTION_LOG_CAP = 256
+
+
+def __getattr__(name: str) -> int:
+    if name in COUNTER_NAMES:
+        return registry().get(name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def bump(name: str, n: int = 1) -> None:
-    globals()[name] += n
+    registry().inc(name, n)
 
 
 def reject(site: str, reason: str, detail: str = "") -> None:
@@ -33,21 +53,27 @@ def reject(site: str, reason: str, detail: str = "") -> None:
 
     `reason` must be a STATIC template — per-run numbers go in `detail`, which
     only lands in the bounded rejection_log; otherwise the rejections dict
-    would grow one key per run in a long-lived session."""
+    would grow one key per run in a long-lived session. Once the log is full,
+    dropped entries are counted in `rejection_log_dropped` so truncation is
+    visible rather than silent."""
     key = f"{site}: {reason}"
     rejections[key] = rejections.get(key, 0) + 1
-    if len(rejection_log) < 256:
+    if len(rejection_log) < _REJECTION_LOG_CAP:
         rejection_log.append((site, f"{reason} {detail}".strip()))
+    else:
+        registry().inc("rejection_log_dropped")
+
+
+def snapshot() -> Dict[str, float]:
+    """Registry snapshot (device + shuffle + transport counters)."""
+    return registry().snapshot()
 
 
 def reset() -> None:
-    global device_stage_batches, device_grouped_batches, device_stage_runs
-    global mesh_grouped_runs, device_join_batches, device_topn_runs
-    device_stage_batches = 0
-    device_grouped_batches = 0
-    device_stage_runs = 0
-    mesh_grouped_runs = 0
-    device_join_batches = 0
-    device_topn_runs = 0
+    """Zero the DEVICE counters and the rejection record (test/bench hook).
+    Scoped to COUNTER_NAMES: other subsystems' registry counters (shuffle,
+    fetch server) are not this module's to wipe — full wipes go through
+    registry().reset(); per-query attribution uses snapshot/diff instead."""
+    registry().reset(COUNTER_NAMES)
     rejections.clear()
     rejection_log.clear()

@@ -33,7 +33,13 @@ class NativeRunner(Runner):
         observed = subscribers_active()
         qid = uuid.uuid4().hex[:12] if observed else ""
         t_start = time.perf_counter()
+        reg_before = {}
         if observed:
+            from ..observability.metrics import registry
+
+            # per-query engine-path attribution (device batches, shuffle
+            # bytes): counter deltas land in QueryEnd.metrics
+            reg_before = registry().snapshot()
             notify("on_query_start", QueryStart(qid, builder.plan.display()))
         t0 = time.perf_counter()
         optimized = builder.optimize()
@@ -75,8 +81,11 @@ class NativeRunner(Runner):
         finally:
             set_collector(prev)
             if observed:
+                from ..observability.metrics import registry
+
                 stats = collector.finish() if collector else []
                 for s in stats:
                     notify("on_operator_stats", qid, s)
                 notify("on_query_end", QueryEnd(
-                    qid, rows, time.perf_counter() - t_start, err, stats))
+                    qid, rows, time.perf_counter() - t_start, err, stats,
+                    metrics=registry().diff(reg_before)))

@@ -105,6 +105,86 @@ def test_no_subscribers_no_overhead_path():
     assert current_collector() is None
 
 
+def test_overhead_guard_zero_subscribers_zero_instrumentation(monkeypatch):
+    """Tier-1 overhead guard: with no subscribers attached, a query must take
+    the zero-overhead path — no StatsCollector wrapping anywhere in the
+    executor, and the metrics registry untouched — so observability can never
+    silently tax the hot path."""
+    from daft_tpu.observability import runtime_stats
+    from daft_tpu.observability.metrics import registry
+    from daft_tpu.observability.subscribers import subscribers_active
+
+    assert not subscribers_active(), \
+        "leaked subscriber from another test would invalidate this guard"
+
+    def _forbidden_wrap(self, node, iterator):
+        raise AssertionError("StatsCollector.wrap called on the zero-overhead path")
+
+    monkeypatch.setattr(runtime_stats.StatsCollector, "wrap", _forbidden_wrap)
+    before = registry().snapshot()
+    df = daft_tpu.from_pydict({"a": list(range(1000)), "b": ["x", "y"] * 500})
+    out = (df.where(col("a") >= 500)
+           .groupby("b").agg(col("a").sum().alias("s")).to_pydict())
+    assert len(out["b"]) == 2
+    assert registry().diff(before) == {}, "registry touched with no observers"
+
+
+def test_stats_collector_nested_self_time():
+    """Self-time attribution with nested operators: the parent's attributed
+    time excludes its child's production time (runtime_stats contract)."""
+    import time as _time
+
+    from daft_tpu.observability.runtime_stats import StatsCollector
+
+    class FakeNode:
+        def __init__(self, name):
+            self._name = name
+
+        def name(self):
+            return self._name
+
+    class Part:
+        num_rows = 1
+
+    child_node, parent_node = FakeNode("child"), FakeNode("parent")
+    c = StatsCollector()
+
+    def child_gen():
+        for _ in range(3):
+            _time.sleep(0.02)  # child production time
+            yield Part()
+
+    child_stream = c.wrap(child_node, child_gen())
+
+    def parent_gen():
+        for part in child_stream:
+            _time.sleep(0.005)  # parent's own work per batch
+            yield part
+
+    parent_stream = c.wrap(parent_node, parent_gen())
+    assert sum(p.num_rows for p in parent_stream) == 3
+    stats = {s.name: s for s in c.finish()}
+    assert stats["child"].rows_out == 3 and stats["parent"].rows_out == 3
+    # child self time ~3*20ms; parent self time ~3*5ms and must NOT include
+    # the child's 60ms of production time
+    assert stats["child"].seconds >= 0.05
+    assert stats["parent"].seconds < stats["child"].seconds
+    assert stats["parent"].seconds < 0.045
+
+
+def test_otlp_trace_id_stable_and_derived_from_query_id():
+    """The OTLP trace id is a pure function of the query id (hash scheme
+    shared with the distributed task stamping), so repeated encodes of the
+    same query land in the same trace."""
+    from daft_tpu.observability.otlp import _span_id, _trace_id
+
+    assert _trace_id("abc") == _trace_id("abc")
+    assert _trace_id("abc") != _trace_id("abd")
+    assert len(_trace_id("abc")) == 32
+    assert _span_id("abc", "task", "t0") == _span_id("abc", "task", "t0")
+    assert len(_span_id("abc", "task", "t0")) == 16
+
+
 def test_explain_analyze_reports_operators():
     rng = np.random.default_rng(0)
     df = daft_tpu.from_pydict({
