@@ -90,6 +90,17 @@ def main() -> None:
                         metric_totals[k] = metric_totals.get(k, 0) + v
         elapsed = min(elapsed, time.perf_counter() - t0)
 
+    # HBM residency gauges (resident bytes, high-water, entry count) come
+    # from the manager's own state — process-lifetime values, replacing the
+    # meaningless per-query gauge sums. The hbm_* COUNTERS are left alone:
+    # counters.reset() zeroes them per query, so the summed snapshot loop
+    # above already accumulated true per-query deltas for them.
+    from daft_tpu.device.residency import manager as _residency
+
+    _res = _residency().stats()
+    for k in ("hbm_bytes_resident", "hbm_bytes_high_water", "hbm_entries"):
+        metric_totals[k] = _res[k]
+
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
         "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",

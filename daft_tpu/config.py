@@ -51,6 +51,16 @@ class ExecutionConfig:
     device_amortize_runs: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_DEVICE_AMORTIZE", 64)
     )
+    # HBM residency budget (daft_tpu/device/residency.py): total device bytes
+    # the engine may keep cached across queries (resident column planes, join
+    # index planes, packed dim matrices). Positive = bytes; 0 (default) = auto
+    # (3/4 of jax.Device.memory_stats()['bytes_limit'] when the backend
+    # reports it, else unbounded); negative = unbounded. Over budget, the
+    # manager evicts least-recently-used unpinned entries; buffers pinned by
+    # an executing query are never evicted mid-run.
+    hbm_budget_bytes: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_HBM_BUDGET", 0)
+    )
     # morsel sizing (reference default_morsel_size, common/daft-config/src/lib.rs:131)
     morsel_size_rows: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_MORSEL_SIZE", 128 * 1024)

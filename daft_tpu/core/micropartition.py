@@ -67,12 +67,25 @@ class TableStatistics:
 
 
 class MicroPartition:
-    __slots__ = ("_schema", "_batches", "_stats")
+    # _rtoken: lazily-assigned monotonic identity token (device/residency.py
+    # identity_token) — unlike id(), never reused after GC, so advisory caches
+    # (the executor's cost-decision cache) can key on partition identity safely
+    __slots__ = ("_schema", "_batches", "_stats", "_rtoken", "__weakref__")
 
     def __init__(self, schema: Schema, batches: List[RecordBatch], stats: Optional[TableStatistics] = None):
         self._schema = schema
         self._batches = [b for b in batches if b.num_rows > 0] or []
         self._stats = stats
+
+    def __getstate__(self):
+        """Pickle for cross-process shipping (distributed tasks): identity
+        tokens are PROCESS-local — shipping one would collide with the
+        receiver's independently-counted tokens and alias two distinct
+        partitions in advisory caches."""
+        return (self._schema, self._batches, self._stats)
+
+    def __setstate__(self, state):
+        self._schema, self._batches, self._stats = state
 
     # ---- constructors -------------------------------------------------------------
     @classmethod

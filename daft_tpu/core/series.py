@@ -28,7 +28,14 @@ def _combine(arr) -> pa.Array:
 
 
 class Series:
-    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "_device_cache", "_dict_codes")
+    # _device_cache holds small HOST-side memo values only (dictionary-reject
+    # markers, distinct-count estimates); device-resident buffers live in the
+    # process-wide HBM residency manager (daft_tpu/device/residency.py), keyed
+    # by _rtoken — a monotonic identity token that, unlike id(), is never
+    # reused after GC. __weakref__ lets the manager drop entries when the
+    # Series dies.
+    __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs", "_device_cache",
+                 "_dict_codes", "_rtoken", "__weakref__")
 
     def __init__(self, name: str, dtype: DataType, arrow: Optional[pa.Array], pyobjs: Optional[list] = None):
         self._name = name
@@ -220,23 +227,25 @@ class Series:
             pad_shape = (pad,) + values.shape[1:]
             values = np.concatenate([values, np.zeros(pad_shape, dtype=values.dtype)])
             validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
+        from ..observability.metrics import registry
+
+        # h2d attribution: a fully-resident repeat query shows a zero delta
+        registry().inc("hbm_h2d_bytes", int(values.nbytes) + int(validity.nbytes))
         return jnp.asarray(values), jnp.asarray(validity)
 
     def to_device_cached(self, pad_to: Optional[int] = None, f32: bool = False):
-        """to_device with a device-residency cache on this Series.
+        """to_device through the process-wide HBM residency manager.
 
         Collected tables queried repeatedly keep their columns resident in HBM
         (GPU-database-style column cache), so only the first query pays the
-        host->device transfer. Series is immutable, so the cache never stales.
+        host->device transfer. Series is immutable, so the cached plane never
+        stales; the manager evicts it LRU under the DAFT_TPU_HBM_BUDGET.
         """
-        cache = getattr(self, "_device_cache", None)
-        if cache is None:
-            cache = {}
-            object.__setattr__(self, "_device_cache", cache)
-        key = (pad_to, f32)
-        if key not in cache:
-            cache[key] = self.to_device(pad_to, f32=f32)
-        return cache[key]
+        from ..device.residency import manager
+
+        return manager().get_or_build(
+            self, ("col", pad_to, bool(f32)), (),
+            lambda: self.to_device(pad_to, f32=f32))
 
     def __getstate__(self):
         """Pickle for cross-process shipping (distributed tasks/UDF workers):
@@ -251,9 +260,11 @@ class Series:
         object.__setattr__(self, "_pyobjs", pyobjs)
 
     def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False) -> bool:
-        """True if this column is already in HBM for the given layout (cost-model hook)."""
-        cache = getattr(self, "_device_cache", None)
-        return bool(cache) and (pad_to, f32) in cache
+        """True if this column is already in HBM for the given layout (cost-model
+        hook — resident inputs are costed with zero transfer bytes)."""
+        from ..device.residency import manager
+
+        return manager().is_resident(self, ("col", pad_to, bool(f32)))
 
     def dict_codes(self):
         """Dictionary-encode this column: (codes int32 ndarray, values list, K).

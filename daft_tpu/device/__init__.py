@@ -1,0 +1,13 @@
+"""Device-memory subsystem: the process-wide HBM residency manager.
+
+``residency.manager()`` is the single owner of every device-resident buffer
+the engine caches across queries (resident column planes, join index planes,
+packed dim matrices, visibility planes, dictionary-code planes). See
+residency.py for the design.
+"""
+
+from .residency import (ResidencyManager, expr_structure, exprs_structure,
+                        identity_token, manager)
+
+__all__ = ["ResidencyManager", "manager", "identity_token",
+           "expr_structure", "exprs_structure"]

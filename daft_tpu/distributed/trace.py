@@ -90,6 +90,7 @@ class QueryTrace:
             tasks_failed=hb.get("tasks_failed", 0),
             rss_bytes=hb.get("rss_bytes", 0),
             uptime_s=hb.get("uptime_s", 0.0),
+            hbm_bytes=hb.get("hbm_bytes_resident", 0),
         )
         with self._lock:
             self.heartbeats.append(rec)

@@ -46,6 +46,17 @@ def _rss_bytes() -> int:
             return 0
 
 
+def _hbm_bytes() -> int:
+    """Device bytes held by this worker's HBM residency manager (0 when the
+    worker never touched a device)."""
+    try:
+        from ..device.residency import manager
+
+        return manager().bytes_resident()
+    except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
+        return 0
+
+
 def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
     """Execute one sub-plan. When the task asks for stats (driver has
     subscribers attached or explain_analyze running) the plan runs under a
@@ -118,6 +129,7 @@ def _worker_loop(conn, worker_id: str) -> None:
                     "tasks_completed": state["completed"],
                     "tasks_failed": state["failed"],
                     "rss_bytes": _rss_bytes(),
+                    "hbm_bytes_resident": _hbm_bytes(),
                     "uptime_s": time.time() - t_start,
                 }))
             except (BrokenPipeError, OSError):

@@ -23,6 +23,7 @@ import numpy as np
 from ..core import relational as rel
 from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
+from ..device.residency import identity_token
 from ..expressions import ColumnRef, Expression
 from ..expressions.eval import eval_expression, eval_projection
 from ..plan import physical as pp
@@ -455,6 +456,7 @@ def _exec_device_agg(node) -> MicroPartition:
         return _host_agg(stream)
 
     from ..core.series import Series
+    from ..device.residency import manager as _residency
 
     in_schema = node.input.schema
     if grouped and cfg.mesh_devices >= 2:
@@ -471,16 +473,19 @@ def _exec_device_agg(node) -> MicroPartition:
         run = stage.start_run()
         buffered: List[MicroPartition] = []
         try:
-            for part in stream:
-                buffered.append(part)
-                for b in part.batches:
-                    run.feed_batch(b)
+            # pin the query's resident planes so a tight HBM budget cannot
+            # evict buffers this run still reads; released at scope exit
+            with _residency().pin_scope():
+                for part in stream:
+                    buffered.append(part)
+                    for b in part.batches:
+                        run.feed_batch(b)
+                key_rows, results = run.finalize()
         except DeviceFallback:
             # runtime shape outside the device kernel envelope (e.g. group count
             # beyond the matmul segment ceiling, raised before any dispatch for
             # the offending batch): rerun the whole stage on host
             return _host_agg(itertools.chain(buffered, stream))
-        key_rows, results = run.finalize()
         return _grouped_output(node.schema, node.groupby, node.aggregations,
                                key_rows, results)
 
@@ -489,10 +494,11 @@ def _exec_device_agg(node) -> MicroPartition:
     stage = try_build_filter_agg_stage(in_schema, node.predicate, node.aggregations)
     assert stage is not None, "planner emitted DeviceFilterAgg for a non-qualifying plan"
     run = stage.start_run()
-    for part in stream:
-        for b in part.batches:
-            run.feed_batch(b)
-    final = run.finalize()
+    with _residency().pin_scope():
+        for part in stream:
+            for b in part.batches:
+                run.feed_batch(b)
+        final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
         f = node.schema[name]
@@ -635,26 +641,32 @@ def _run_device_join(node, label: str, make_run, assemble,
                 raw_stream.close()
                 return _host()
         run = make_run(stage, grouped, ctx)
-        if topn:
-            # the fused TopN program needs ONE fact batch: bail on sighting a
-            # SECOND (before any device work, without draining the stream)
-            first_b = None
-            for part in fact_stream:
-                for b in part.batches:
-                    if b.num_rows == 0:
-                        continue
-                    if first_b is not None:
-                        _counters.reject("runtime", f"{label}: multi-batch fact")
-                        raw_stream.close()
-                        return _host()
-                    first_b = b
-            if first_b is not None:
-                run.feed_batch(first_b)
-        else:
-            for part in fact_stream:
-                for b in part.batches:
-                    run.feed_batch(b)
-        return assemble(run, stage, grouped)
+        from ..device.residency import manager as _residency
+
+        # pin-scope the feed + finalize: entries this query touches (packed
+        # planes, index planes, resident columns) cannot be evicted mid-run
+        # by a tight HBM budget; the budget re-enforces at scope exit
+        with _residency().pin_scope():
+            if topn:
+                # the fused TopN program needs ONE fact batch: bail on sighting a
+                # SECOND (before any device work, without draining the stream)
+                first_b = None
+                for part in fact_stream:
+                    for b in part.batches:
+                        if b.num_rows == 0:
+                            continue
+                        if first_b is not None:
+                            _counters.reject("runtime", f"{label}: multi-batch fact")
+                            raw_stream.close()
+                            return _host()
+                        first_b = b
+                if first_b is not None:
+                    run.feed_batch(first_b)
+            else:
+                for part in fact_stream:
+                    for b in part.batches:
+                        run.feed_batch(b)
+            return assemble(run, stage, grouped)
     except DeviceFallback as e:
         _counters.reject("runtime", f"{label}: device fallback", str(e))
         raw_stream.close()
@@ -674,9 +686,10 @@ def _decision_key(node, rows: int, cfg, topn: bool) -> tuple:
         tuple(repr(g) for g in spec.groupby),
         tuple(repr(a) for a in spec.aggregations),
         tuple((d.key_col, d.parent) for d in spec.dims),
-        # dim source identity: a rewritten/grown dim table must re-decide
-        # (ids are heuristic — the cache is advisory, both outcomes correct)
-        tuple(id(part)
+        # dim source identity via monotonic tokens (device/residency.py): a
+        # rewritten/grown dim table must re-decide. Raw id() here could pin a
+        # stale routing decision when CPython reuses a freed object's id
+        tuple(identity_token(part)
               for _n, plan in node.dim_plans
               for part in getattr(plan, "partitions", ())),
     )
@@ -717,7 +730,9 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
                 if spec.col_side.get(c) not in ("fact", None)]
     nonres = sum(batch.num_rows * 5 for c in fact_cols
                  if not batch.get_column(c).is_device_resident(bucket, f32=True))
-    nonres += len(spec.dims) * bucket * 4      # padded per-dim index planes
+    # padded per-dim index planes: residency-aware — a repeat query whose
+    # index planes are already in HBM is costed with zero transfer for them
+    nonres += ctx.nonresident_index_bytes(batch, bucket)
     n_gathers = len(dim_cols) + len(spec.dims)  # value planes + visibility
 
     if grouped:

@@ -140,7 +140,8 @@ class DashboardState(Subscriber):
                        "total_slots": hb.total_slots,
                        "tasks_completed": hb.tasks_completed,
                        "tasks_failed": hb.tasks_failed,
-                       "rss_bytes": hb.rss_bytes})
+                       "rss_bytes": hb.rss_bytes,
+                       "hbm_bytes": getattr(hb, "hbm_bytes", 0)})
 
     def on_query_end(self, event: QueryEnd) -> None:
         with self._lock:
@@ -178,6 +179,9 @@ class DashboardState(Subscriber):
                     "heartbeats": len(beats),
                     "recent": len(recent),
                     "busy_fraction": busy / len(recent) if recent else 0.0,
+                    # HBM residency gauge from the latest beat (device-buffer
+                    # bytes this worker holds across queries)
+                    "hbm_bytes": beats[-1].get("hbm_bytes", 0) if beats else 0,
                 }
             return out
 

@@ -71,7 +71,7 @@ class ShuffleStats:
 
 @dataclass(frozen=True)
 class WorkerHeartbeat:
-    """Periodic worker self-report: slot occupancy, task counts, RSS."""
+    """Periodic worker self-report: slot occupancy, task counts, RSS, HBM."""
 
     worker_id: str
     ts: float                  # unix time on the worker
@@ -81,6 +81,9 @@ class WorkerHeartbeat:
     tasks_failed: int
     rss_bytes: int
     uptime_s: float = 0.0
+    # device bytes this worker's HBM residency manager holds (0 = no device
+    # buffers cached) — see daft_tpu/device/residency.py
+    hbm_bytes: int = 0
 
 
 @dataclass(frozen=True)

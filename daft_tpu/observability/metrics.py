@@ -61,16 +61,20 @@ class MetricsRegistry:
 
     def diff(self, before: Dict[str, float]) -> Dict[str, float]:
         """Counter deltas since `before` (a prior snapshot); gauges report
-        their current value. Zero deltas are dropped so per-query records
-        stay small; negative deltas (a reset() between the snapshots) clamp
-        to zero and drop rather than reporting nonsense."""
+        their current value, but only when it CHANGED since `before` — a
+        standing gauge (e.g. hbm_bytes_resident left by an earlier query)
+        must not show up in the per-query record of a query that never
+        touched it (the zero-overhead guard depends on this). Zero counter
+        deltas are dropped so per-query records stay small; negative deltas
+        (a reset() between the snapshots) clamp to zero and drop rather than
+        reporting nonsense."""
         now = self.snapshot()
         out: Dict[str, float] = {}
         with self._lock:
             gauges = set(self._gauges)
         for k, v in now.items():
             if k in gauges:
-                if v:
+                if v != before.get(k, 0):
                     out[k] = v
                 continue
             d = v - before.get(k, 0)

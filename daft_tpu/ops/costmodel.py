@@ -8,7 +8,11 @@ environment-specific terms are measured live on the actual device link:
   price every device-side query pays exactly once (stages defer all fetches to
   finalize — ops/stage.py, ops/grouped_stage.py).
 - ``h2d_bytes_per_s`` — host->device bandwidth, paid only for columns not yet
-  resident in HBM (Series.to_device_cached keeps collected tables resident).
+  resident in HBM. Residency is tracked by the process-wide manager
+  (daft_tpu/device/residency.py): the executor probes it per input column and
+  per join index plane before costing a device plan, so repeat queries whose
+  planes survived eviction are priced with ZERO transfer bytes and first
+  touches amortize over ExecutionConfig.device_amortize_runs.
 
 Compute-rate terms are constants measured on v5e (overridable via env):
 matmul segment-reduction streams ~5e9 plane-rows/s, scatter segment ops

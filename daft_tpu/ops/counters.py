@@ -29,6 +29,13 @@ COUNTER_NAMES = (
     "device_join_batches",     # batches through the gather-join device stages
     "device_topn_runs",        # join+agg+TopN fused device programs completed
     "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
+    # HBM residency manager (daft_tpu/device/residency.py)
+    "hbm_cache_hits",          # residency lookups served from HBM
+    "hbm_cache_misses",        # residency lookups that built/uploaded
+    "hbm_evictions",           # entries evicted under the HBM budget
+    "hbm_eviction_bytes",      # device bytes released by evictions
+    "hbm_pins",                # entries pinned by an executing query
+    "hbm_h2d_bytes",           # host->device column upload bytes (Series.to_device)
 )
 
 registry().declare(*COUNTER_NAMES)

@@ -44,13 +44,14 @@ import jax.numpy as jnp
 
 from ..core.kernels.encoding import _common_key_dtype, canonical_key_values
 from ..datatype import DataType, Field
+from ..device.residency import expr_structure, exprs_structure
 from ..expressions.expressions import (AggExpr, Alias, BinaryOp, ColumnRef,
                                        Expression, IsIn, Literal)
 from ..schema import Schema
 from . import counters
 from . import device_eval as dev
 from .grouped_stage import (DeviceFallback, GroupedAggRun, GroupedAggStage,
-                            MAX_MATMUL_SEGMENTS, MAX_SORT_SEGMENTS, _Decode,
+                            MAX_MATMUL_SEGMENTS, _Decode,
                             _pad_groups, cached_dict_code_plane,
                             try_build_grouped_agg_stage)
 from .stage import FilterAggRun, FilterAggStage, device_row_mask, pad_bucket
@@ -404,10 +405,11 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
 # ======================================================================================
 
 
-def series_keyed(anchor, key: tuple, deps: tuple, build):
-    """Cache ``build()`` on `anchor` Series' ``_device_cache`` under `key`,
-    valid while every object in `deps` is IDENTICAL (strong refs held in the
-    entry, so a freed object can never alias a new one via id() reuse).
+def series_keyed(anchor, key: tuple, deps: tuple, build, literals=None):
+    """Cache ``build()`` in the process-wide HBM residency manager, anchored
+    on `anchor` Series' identity under `key`, valid while every object in
+    `deps` is IDENTICAL (strong refs held in the entry, so a freed object can
+    never alias a new one via id() reuse) and `literals` compare EQUAL.
 
     This is the identity spine of the join runtime: per-rep plan objects (and
     the RecordBatches a pruning Project re-creates) are transient, but the
@@ -416,18 +418,16 @@ def series_keyed(anchor, key: tuple, deps: tuple, build):
     columns key on Series identity and survive across queries/reps. Without
     it every rep re-uploads fact-bucket-sized arrays (~11MB/s over a tunneled
     device link — measured 3-9s/query of pure re-upload in round 4).
+
+    `literals` carries the per-query predicate literal values for slots whose
+    `key` is the filter STRUCTURE: varying-literal queries then reuse ONE slot
+    per query shape (rebuilt in place on a literal change) instead of growing
+    HBM by one entry per distinct literal. The manager accounts every entry's
+    device bytes and evicts LRU under DAFT_TPU_HBM_BUDGET.
     """
-    cache = getattr(anchor, "_device_cache", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(anchor, "_device_cache", cache)
-    hit = cache.get(key)
-    if hit is not None and len(hit[0]) == len(deps) \
-            and all(a is b for a, b in zip(hit[0], deps)):
-        return hit[1]
-    val = build()
-    cache[key] = (tuple(deps), val)
-    return val
+    from ..device.residency import manager
+
+    return manager().get_or_build(anchor, key, deps, build, literals=literals)
 
 
 def unique_key_index(dim_key_series, probe_vals: np.ndarray,
@@ -546,14 +546,18 @@ class _JoinContext:
 
     def _cached_syn(self, dim_batch, name: str, expr: Expression):
         """Synthetic dim column, evaluated once per (expr, referenced-series)
-        and reused across queries/reps — so its device upload is cached too."""
+        and reused across queries/reps — so its device upload is cached too.
+        Keyed on the expression STRUCTURE; literal values live in the entry,
+        so varying-literal predicates reuse one slot."""
         from ..expressions.eval import eval_expression
 
         refs = expr.referenced_columns()
         deps = tuple(dim_batch.get_column(c) for c in refs)
+        skel, lits = expr_structure(expr)
         return series_keyed(
-            self._filter_anchor(dim_batch, expr), ("syn", repr(expr), name),
-            deps, lambda: eval_expression(dim_batch, expr).rename(name))
+            self._filter_anchor(dim_batch, expr), ("syn", skel, name),
+            deps, lambda: eval_expression(dim_batch, expr).rename(name),
+            literals=lits)
 
     def host_visible(self, d: DimSpec) -> Optional[np.ndarray]:
         """Combined host-filter visibility for one dim (None = all pass);
@@ -574,8 +578,9 @@ class _JoinContext:
                 vis &= np.asarray(m.to_numpy(), dtype=bool) & m.validity_numpy()
             return vis
 
-        return series_keyed(anchor, ("hostvis",) + tuple(repr(f) for f in hostf),
-                            deps, build)
+        skels, lits = exprs_structure(hostf)
+        return series_keyed(anchor, ("hostvis",) + skels, deps, build,
+                            literals=lits)
 
     def vis_plane(self, d: DimSpec, cap_d: int):
         """bool[cap_d] device plane: dim row passes all its filters. Device-
@@ -587,7 +592,8 @@ class _JoinContext:
         ref_cols = sorted({c for f in devf + hostf for c in f.referenced_columns()})
         deps = tuple(b.get_column(c) for c in ref_cols)
         anchor = deps[0] if deps else b.get_column(b.column_names()[0])
-        key = ("visplane", cap_d) + tuple(repr(f) for f in devf + hostf)
+        skels, lits = exprs_structure(devf + hostf)
+        key = ("visplane", cap_d) + skels
 
         def build():
             vis = None
@@ -613,13 +619,16 @@ class _JoinContext:
                 vis = vis & (jnp.arange(cap_d) < b.num_rows)
             return vis
 
-        return series_keyed(anchor, key, deps, build)
+        return series_keyed(anchor, key, deps, build, literals=lits)
 
     def _fact_membership_plane(self, batch, bucket: int, syn: str) -> dev.DCol:
         """bool plane for a fact string membership predicate: resident dict
         codes compared against the (tiny) per-query match-code set. Null rows
         are invalid (SQL three-valued comparisons), matching host eval.
-        Cached on the fact column Series per (match values, bucket)."""
+        One slot per (fact column, syn, bucket) — syn keeps two membership
+        predicates over the SAME column in one query from thrashing a shared
+        slot; the per-query match values are the slot's literals, so varying
+        predicates rebuild in place."""
         colname, values = self.spec.fact_synthetic[syn]
         s = batch.get_column(colname)
 
@@ -635,7 +644,8 @@ class _JoinContext:
                 else jnp.ones(bucket, dtype=bool)
             return plane, valid
 
-        return series_keyed(s, ("fmem", values, bucket), (), build)
+        return series_keyed(s, ("fmem", syn, bucket), (), build,
+                            literals=values)
 
     def _permuted_membership(self, batch, bucket: int, syn: str, perm) -> dev.DCol:
         colname, values = self.spec.fact_synthetic[syn]
@@ -646,7 +656,8 @@ class _JoinContext:
             plane, valid = self._fact_membership_plane(batch, bucket, syn)
             return (plane.astype(jnp.float32)[pdev] > 0.5), valid[pdev]
 
-        return series_keyed(s, ("fmemp", values, bucket), (pperm_np,), build)
+        return series_keyed(s, ("fmemp", syn, bucket), (pperm_np,), build,
+                            literals=values)
 
     # ---- per fact batch -----------------------------------------------------------
     def _probe_anchor(self, batch, d: DimSpec):
@@ -749,6 +760,22 @@ class _JoinContext:
 
         return series_keyed(anchor, ("didxp", d.key_col, d.parent, bucket),
                             (idx_np, pperm_np), build_p)
+
+    def nonresident_index_bytes(self, batch, bucket: int) -> int:
+        """h2d bytes the cost model should charge for dim index planes not
+        already resident in HBM (advisory: mirrors dev_idx's cache keys —
+        both the plain and the perm-folded local-dense variants — so a
+        repeat query is costed with zero index-plane transfer)."""
+        from ..device.residency import manager
+
+        total = 0
+        for d in self.dims:
+            anchor = self._probe_anchor(batch, d)
+            if not any(manager().is_resident(
+                    anchor, (fam, d.key_col, d.parent, bucket))
+                    for fam in ("didx", "didxp")):
+                total += bucket * 4
+        return total
 
     # ---- packed per-adjacent-dim planes ------------------------------------------
     #
@@ -882,10 +909,13 @@ class _JoinContext:
                       for d in sub_dims)
         deps += tuple(self.batches[d.parent[0]].get_column(d.parent[1])
                       for d in sub_dims if d.parent[0] != "fact")
+        # filters enter the key by STRUCTURE; their literals live in the slot,
+        # so varying-literal reps rebuild one pack instead of accumulating
+        fskels, flits = exprs_structure(
+            [f for n in sub
+             for f in self._dev_filters[n] + self._host_filters[n]])
         key = ("pack", tuple(my_vals), tuple(my_codes),
-               tuple((d.key_col,) + d.parent for d in sub_dims),
-               tuple(repr(f) for n in sub
-                     for f in self._dev_filters[n] + self._host_filters[n]))
+               tuple((d.key_col,) + d.parent for d in sub_dims), fskels)
 
         def build():
             planes, code_planes, ok = self._build_space(adj, vals, codes)
@@ -927,7 +957,7 @@ class _JoinContext:
             mat = jnp.stack(cols, axis=0)   # [P, cap_d]: minor dim stays long
             return mat, layout, code_layout, ok_col, wide
 
-        return series_keyed(anchor, key, deps, build)
+        return series_keyed(anchor, key, deps, build, literals=flits)
 
     def _permuted_fact_plane(self, series, bucket: int, perm) -> dev.DCol:
         """Resident fact plane reordered by the group-sorted permutation —
@@ -992,7 +1022,14 @@ class _JoinContext:
                 else:                 # 32-bit: hi*2^24 + lo
                     v = (rows[w[0]].astype(jnp.float64) * (1 << 24)
                          + rows[w[1]].astype(jnp.float64))
-                dcols[name] = (v, rows[w[-1]] > 0.5)
+                # hand the plane back as int64 (exact: digits recombine below
+                # 2^53), NOT f64 — the stage compiler's f32 fcast would
+                # quantize an f64 plane past 2^24, silently corrupting
+                # SUM/MIN/MAX over wide int dim columns (ADVICE r5 high);
+                # int planes pass fcast untouched and the isum/i64-scatter
+                # agg paths receive exact values
+                dcols[name] = (jnp.round(v).astype(jnp.int64),
+                               rows[w[-1]] > 0.5)
             else:
                 vi, mi = layout[name]
                 dcols[name] = (rows[vi], rows[mi] > 0.5)
@@ -1040,6 +1077,18 @@ class _FactorizedCodes:
         self._perm_dev = None
         self._full_rows = None
         self._rank_planes: Dict[int, object] = {}
+
+    def device_nbytes(self) -> int:
+        """Residency-manager accounting hook: device planes here materialize
+        LAZILY after the entry is stored, so the manager re-measures on every
+        cache hit via this hook."""
+        from ..device.residency import device_nbytes
+
+        lazy = [self._dcodes, self._perm_dev,
+                list(self._rank_planes.values())]
+        if self._perm is not None:
+            lazy.extend(self._perm[1:])  # local codes + seg_lo device arrays
+        return device_nbytes(lazy)
 
     @property
     def dcodes(self):

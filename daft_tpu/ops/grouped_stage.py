@@ -148,19 +148,18 @@ def _isum_digit(v, kind: str):
 
 
 def cached_dict_code_plane(src, codes: np.ndarray, rows: int, cap: int):
-    """Device plane of dictionary codes padded to `cap`, cached on the Series
-    (THE one implementation — grouped stages and the join stage share it, so
-    the padding-rows-are-code-0 invariant lives in one place)."""
-    cache = getattr(src, "_device_cache", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(src, "_device_cache", cache)
-    ck = ("dictcodes", cap)
-    if ck not in cache:
+    """Device plane of dictionary codes padded to `cap`, registered in the
+    HBM residency manager anchored on the Series (THE one implementation —
+    grouped stages and the join stage share it, so the
+    padding-rows-are-code-0 invariant lives in one place)."""
+    from ..device.residency import manager
+
+    def build():
         padded = np.zeros(cap, dtype=np.int32)
         padded[:rows] = codes
-        cache[ck] = jnp.asarray(padded)
-    return cache[ck]
+        return jnp.asarray(padded)
+
+    return manager().get_or_build(src, ("dictcodes", cap), (), build)
 
 
 def resolve_key_series(batch, groupby, n: int):

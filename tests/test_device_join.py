@@ -221,6 +221,41 @@ def test_tpch_q3_q10_ride_device_topn():
         _assert_close(host, dev)
 
 
+def test_wide_int_dim_planes_exact_past_2_24(star):
+    """Dim-side int64/int32 columns ride the packed f32 gather as digit
+    planes and recombine exactly — and must STAY exact through the stage
+    compiler (ADVICE r5 high: the f64 recombine was downcast to f32 by fcast,
+    quantizing values past 2^24). SUM/MIN/MAX over wide int dim columns must
+    match the host bit-for-bit."""
+    fact, _, _ = star
+    wide = daft_tpu.from_pydict({
+        "w_k": list(range(500)),
+        # int64 values far past 2^24 (and sums past 2^32)
+        "w_big": [300_266_000_000 + i * 7_919 for i in range(500)],
+        # int32 values past 2^24 (f32 quantizes these)
+        "w_mid": np.asarray([16_777_216 + i * 3 for i in range(500)],
+                            dtype=np.int32),
+        "w_grp": [f"g{i % 5}" for i in range(500)],
+    }).collect()
+
+    def q():
+        return (fact.join(wide, left_on="f_k1", right_on="w_k")
+                .groupby("w_grp")
+                .agg(col("w_big").sum().alias("s64"),
+                     col("w_big").min().alias("mn64"),
+                     col("w_big").max().alias("mx64"),
+                     col("w_mid").sum().alias("s32"),
+                     col("w_mid").min().alias("mn32"),
+                     col("w_mid").max().alias("mx32"))
+                .sort("w_grp"))
+
+    host, dev, jb = _both(q)
+    assert jb > 0, "device join path never ran"
+    # bit-for-bat integer equality — no float tolerance
+    for c in host:
+        assert host[c] == dev[c], (c, host[c], dev[c])
+
+
 def test_auto_mode_cpu_backend_stays_on_host(star):
     """auto mode on a CPU backend must run the host plan AND record why
     (rejection log, VERDICT r4 next #1) — device joins only engage on a real
