@@ -101,6 +101,16 @@ def main() -> None:
     for k in ("hbm_bytes_resident", "hbm_bytes_high_water", "hbm_entries"):
         metric_totals[k] = _res[k]
 
+    # Distributed placement attribution: the sched_* counters accumulated in
+    # the snapshot loop above already carry sched_bytes_avoided etc.; derive
+    # the affinity hit RATE so a device capture shows locality wins alongside
+    # the HBM gauges without post-processing.
+    hits = metric_totals.get("sched_affinity_hits", 0)
+    misses = metric_totals.get("sched_affinity_misses", 0)
+    if hits or misses:
+        metric_totals["sched_affinity_hit_rate"] = round(
+            hits / (hits + misses), 4)
+
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
         "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",

@@ -266,6 +266,57 @@ class Series:
 
         return manager().is_resident(self, ("col", pad_to, bool(f32)))
 
+    def content_fingerprint(self) -> Optional[int]:
+        """64-bit CONTENT hash of this column (dtype + length + values +
+        validity; the name is excluded — device planes depend only on data).
+
+        Unlike ``_rtoken`` (process-local identity), the fingerprint is a pure
+        function of the data: the driver and a worker that unpickled a copy
+        compute the SAME value independently, so residency slot keys derived
+        from it are stable across processes and across re-unpickled sub-plans
+        (distributed cache-affinity scheduling + worker-side slot rebinding,
+        device/residency.py). Cached in ``_device_cache`` (dropped on pickle,
+        recomputed on demand). None = no stable identity (python-object
+        columns, hash failure) — callers degrade to identity-only caching."""
+        cache = getattr(self, "_device_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_device_cache", cache)
+        fp = cache.get("__content_fp__")
+        if fp is not None:
+            return fp
+        if self._pyobjs is not None or self._arrow is None:
+            return None
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+        h.update(repr(self._dtype).encode())
+        h.update(len(self).to_bytes(8, "little"))
+        try:
+            vals = self.to_numpy()
+            if vals.dtype == object:
+                raise TypeError("no dense repr")
+            # to_numpy fills nulls deterministically (0/NaN) — hashing the
+            # dense values + validity mask is content-exact
+            h.update(np.ascontiguousarray(vals).tobytes())
+            h.update(self.validity_numpy().tobytes())
+        except Exception:
+            try:
+                # strings/nested: hash the Arrow IPC serialization. Distinct
+                # logical values can never collide; equal arrays in unusual
+                # physical layouts may hash differently, which only costs a
+                # missed cache rebind, never correctness
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_stream(
+                        sink, pa.schema([pa.field("c", self._arrow.type)])) as w:
+                    w.write_batch(pa.record_batch([self._arrow], names=["c"]))
+                h.update(sink.getvalue())
+            except Exception:
+                return None
+        fp = int.from_bytes(h.digest(), "little")
+        cache["__content_fp__"] = fp
+        return fp
+
     def dict_codes(self):
         """Dictionary-encode this column: (codes int32 ndarray, values list, K).
 

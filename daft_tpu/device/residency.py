@@ -32,9 +32,20 @@ Design:
 - Budget: ``DAFT_TPU_HBM_BUDGET`` / ExecutionConfig.hbm_budget_bytes.
   Positive = bytes; 0 (default) = auto, a fraction of
   ``jax.Device.memory_stats()['bytes_limit']`` when the backend reports it,
-  else unbounded; negative = unbounded. Over budget, entries are evicted in
-  LRU order — eviction drops the registry reference; XLA frees the HBM when
-  the last reference dies.
+  else unbounded; negative = unbounded. Over budget, entries are evicted
+  (recency-bucketed LRU, cheapest-to-rebuild first): the EVICT_BUCKET
+  least-recently-used unpinned entries are weighed by estimated rebuild cost
+  (upload bytes / bandwidth + host factorize time, ops/costmodel.py
+  rebuild_cost_estimate) so re-uploadable column planes shed before join
+  index planes of similar age. Eviction drops the registry reference; XLA
+  frees the HBM when the last reference dies.
+
+- Stable keys: deps-free slots carry a content-derived 64-bit key
+  (stable_slot_key) identical across processes. They power (a) worker-side
+  slot REBINDING — a repeat distributed sub-plan's freshly-unpickled columns
+  hit the planes the previous task uploaded — and (b) the heartbeat digest()
+  that the distributed scheduler intersects with sub-plan fingerprints for
+  cache-affinity placement (distributed/affinity.py).
 
 - Pinning: ``pin_scope()`` brackets one query execution. Entries touched
   inside the scope are pinned until scope exit and never evicted mid-query,
@@ -122,6 +133,36 @@ def exprs_structure(exprs: Iterable) -> Tuple[tuple, tuple]:
     return tuple(skels), tuple(lits)
 
 
+# ---- stable slot keys --------------------------------------------------------------
+
+
+def stable_slot_key(anchor, key: tuple) -> Optional[int]:
+    """64-bit cross-process identity of one residency slot: a hash of the
+    anchor's CONTENT fingerprint (Series.content_fingerprint) and the
+    structural slot key. The same data under the same slot shape produces the
+    same value in the driver and in every worker, so these keys are the
+    vocabulary of the distributed cache-affinity protocol: workers publish
+    digests of them in heartbeats, the planner fingerprints sub-plans with
+    them, and the scheduler intersects the two. None = the anchor has no
+    stable content identity (python-object column) — the slot stays
+    identity-keyed only."""
+    fp_fn = getattr(anchor, "content_fingerprint", None)
+    if fp_fn is None:
+        return None
+    try:
+        fp = fp_fn()
+    except Exception:
+        return None
+    if fp is None:
+        return None
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    h.update(fp.to_bytes(8, "little"))
+    h.update(repr(key).encode())
+    return int.from_bytes(h.digest(), "little")
+
+
 # ---- byte accounting ---------------------------------------------------------------
 
 
@@ -162,15 +203,19 @@ def device_nbytes(value) -> int:
 
 
 class _Entry:
-    __slots__ = ("deps", "literals", "value", "nbytes", "pins", "anchor_ref")
+    __slots__ = ("deps", "literals", "value", "nbytes", "pins", "anchor_ref",
+                 "stable", "cost")
 
-    def __init__(self, deps: tuple, literals, value, nbytes: int):
+    def __init__(self, deps: tuple, literals, value, nbytes: int,
+                 stable: Optional[int] = None, cost: float = 0.0):
         self.deps = deps
         self.literals = literals
         self.value = value
         self.nbytes = nbytes
         self.pins = 0
         self.anchor_ref = None  # keeps the death-callback weakref alive
+        self.stable = stable    # cross-process slot key (None = identity-only)
+        self.cost = cost        # estimated rebuild seconds (eviction ordering)
 
 
 class ResidencyManager:
@@ -184,17 +229,42 @@ class ResidencyManager:
         self._auto_budget: Optional[int] = None
         self._dead: list = []          # full keys whose anchor was collected
         self._tl = threading.local()   # active pin scopes (per thread)
+        # stable slot key -> full key, for deps-free entries only: the
+        # cross-process rebind index (distributed repeat sub-plans) and the
+        # source of heartbeat digests
+        self._stable: dict = {}
+        # stable entries whose anchor died but were RETAINED (insertion-
+        # ordered for FIFO capping): content-addressed planes a repeat
+        # sub-plan can still rebind. Capped by DAFT_TPU_HBM_ORPHANS — 0
+        # (default) keeps the strict die-with-your-anchor policy; the worker
+        # pool opts its children in so planes survive between tasks.
+        self._orphans: "OrderedDict[tuple, None]" = OrderedDict()
+        self._orphan_cap: Optional[int] = None
 
     # ---- lookup / build ------------------------------------------------------------
     def get_or_build(self, anchor, key: tuple, deps: tuple,
-                     build: Callable[[], Any], literals=None):
+                     build: Callable[[], Any], literals=None,
+                     rebuild_rows: int = 0):
         """Return the cached value for (anchor, key), building it when absent.
 
         Hit requires every object in `deps` IDENTICAL to the stored tuple and
         `literals` EQUAL to the stored ones; a mismatch rebuilds in place —
-        the slot is reused, never duplicated."""
+        the slot is reused, never duplicated.
+
+        Deps-free slots (column planes, dictionary-code planes — values that
+        are pure functions of the anchor's content) additionally carry a
+        STABLE content-derived key: when the identity probe misses but an
+        entry with the same stable key and equal literals exists, the slot is
+        REBOUND to the new anchor instead of rebuilt — this is what lets a
+        worker serve a repeat sub-plan's freshly-unpickled (new identity, same
+        content) columns from HBM with zero re-upload.
+
+        `rebuild_rows` is the host-side row count the build re-factorizes
+        (dictionary codes, join indices); with the entry's device bytes it
+        prices the rebuild for cost-weighted eviction."""
         full_key = (identity_token(anchor), key)
         deps = tuple(deps)
+        stable = stable_slot_key(anchor, key) if not deps else None
         with self._lock:
             self._sweep_dead()
             e = self._entries.get(full_key)
@@ -211,18 +281,39 @@ class ResidencyManager:
                 self._pin(full_key, e)
                 registry().inc("hbm_cache_hits")
                 return e.value
+            if stable is not None:
+                e = self._stable_rebind(stable, full_key, anchor, literals)
+                if e is not None:
+                    registry().inc("hbm_cache_hits")
+                    registry().inc("hbm_stable_rehits")
+                    return e.value
         registry().inc("hbm_cache_misses")
         value = build()  # outside the lock: builds may re-enter the manager
         nb = device_nbytes(value)
+        from ..ops.costmodel import rebuild_cost_estimate
+
+        cost = rebuild_cost_estimate(nb, rebuild_rows)
         with self._lock:
             old = self._entries.pop(full_key, None)
-            e = _Entry(deps, literals, value, nb)
+            e = _Entry(deps, literals, value, nb, stable=stable, cost=cost)
             if old is not None:
                 self._bytes -= old.nbytes
+                if old.stable is not None:
+                    self._stable.pop(old.stable, None)
                 # rebuild-in-place: active pin scopes hold this slot by KEY —
                 # the replacement inherits the pin count so it cannot be
                 # evicted mid-query and scope exits balance exactly
                 e.pins = old.pins
+            if stable is not None:
+                # a stale same-content slot under another identity (e.g. a
+                # literal change arriving via a re-unpickled anchor) would
+                # duplicate device bytes — drop it unless a query holds it
+                prev_full = self._stable.get(stable)
+                if prev_full is not None and prev_full != full_key:
+                    prev = self._entries.get(prev_full)
+                    if prev is not None and prev.pins == 0:
+                        self._drop_entry(prev_full, prev)
+                self._stable[stable] = full_key
             self._entries[full_key] = e
             self._bytes += nb
             self._watch_anchor(anchor, full_key, e)
@@ -230,6 +321,31 @@ class ResidencyManager:
             self._note_bytes()
             self._evict_over_budget()
         return value
+
+    def _stable_rebind(self, stable: int, full_key: tuple, anchor,
+                       literals) -> Optional[_Entry]:
+        """Move a deps-free entry with matching content identity to a new
+        anchor (called under the lock). Returns the entry on success."""
+        prev_full = self._stable.get(stable)
+        if prev_full is None or prev_full == full_key:
+            return None
+        e = self._entries.get(prev_full)
+        # rebind only unpinned deps-free slots with equal literals: a pinned
+        # slot is held by key in an active pin scope and must not be re-keyed
+        if e is None or e.deps or e.pins != 0 or e.literals != literals:
+            return None
+        del self._entries[prev_full]
+        self._orphans.pop(prev_full, None)  # re-anchored: no longer orphaned
+        self._entries[full_key] = e
+        self._stable[stable] = full_key
+        nb = device_nbytes(e.value)
+        if nb != e.nbytes:
+            self._bytes += nb - e.nbytes
+            e.nbytes = nb
+            self._note_bytes()
+        self._watch_anchor(anchor, full_key, e)
+        self._pin(full_key, e)
+        return e
 
     def is_resident(self, anchor, key: tuple) -> bool:
         """Advisory residency probe for the cost model (no deps/literal check,
@@ -299,23 +415,51 @@ class ResidencyManager:
         except Exception:
             return 0
 
+    # entries per recency bucket: eviction considers the least-recently-used
+    # unpinned entries together (the OLDEST HALF of the registry, capped at
+    # EVICT_BUCKET) and drops the cheapest-to-rebuild first, so a cold budget
+    # squeeze sheds re-uploadable column planes before join index / dictionary
+    # planes of similar age (strict LRU would drop whichever went longest
+    # untouched, regardless of replacement price). Bounding the bucket to the
+    # oldest HALF keeps recency meaningful: with two entries the pick is pure
+    # LRU, so a hot cheap plane is never sacrificed to protect a cold
+    # expensive one — that inversion would re-upload the hot plane every
+    # query while the squatter never leaves.
+    EVICT_BUCKET = 8
+
     def _evict_over_budget(self) -> None:
         budget = self.budget_bytes()
         if budget <= 0:
             return
         while self._bytes > budget:
-            victim_key = None
-            for k, e in self._entries.items():  # front = least recently used
-                if e.pins == 0:
-                    victim_key = k
-                    break
-            if victim_key is None:
+            # front = least recently used; only UNPINNED entries count toward
+            # the half, or pinned entries would pad the window into the
+            # recency-hot tail and re-admit the inversion
+            unpinned = [(k, e) for k, e in self._entries.items() if e.pins == 0]
+            if not unpinned:
                 return  # everything pinned: overshoot until the scope ends
-            e = self._entries.pop(victim_key)
-            self._bytes -= e.nbytes
+            limit = min(self.EVICT_BUCKET, max(1, (len(unpinned) + 1) // 2))
+            bucket = unpinned[:limit]  # oldest recency bucket
+            victim_key, e = min(bucket, key=lambda kv: kv[1].cost)
+            lru_cost = bucket[0][1].cost
+            if e.cost < lru_cost:
+                # rebuild seconds the pure-LRU victim would have cost, saved
+                # by taking the cheaper entry instead (µs, monotone counter)
+                registry().inc("hbm_evict_cost_saved",
+                               int((lru_cost - e.cost) * 1e6))
+            self._drop_entry(victim_key, e)
             registry().inc("hbm_evictions")
             registry().inc("hbm_eviction_bytes", e.nbytes)
         self._note_bytes()
+
+    def _drop_entry(self, full_key: tuple, e: _Entry) -> None:
+        """Remove one entry + its stable-index row; bytes accounting only
+        (callers own counters/gauge refresh). Lock held by caller."""
+        self._entries.pop(full_key, None)
+        self._orphans.pop(full_key, None)
+        self._bytes -= e.nbytes
+        if e.stable is not None and self._stable.get(e.stable) == full_key:
+            del self._stable[e.stable]
 
     def _note_bytes(self) -> None:
         if self._bytes > self._high_water:
@@ -339,16 +483,65 @@ class ResidencyManager:
 
     def _sweep_dead(self) -> None:
         swept = False
+        cap = self._orphan_budget()
         while self._dead:
             k = self._dead.pop()
-            e = self._entries.pop(k, None)
+            e = self._entries.get(k)
+            if e is None:
+                continue
+            if cap > 0 and e.stable is not None and e.pins == 0:
+                # content-addressed plane: the anchor is gone but identical
+                # data (a repeat sub-plan's fresh unpickle) can still rebind
+                # it — retain as an orphan, FIFO-capped below
+                self._orphans[k] = None
+                continue
+            self._drop_entry(k, e)
+            swept = True
+        while len(self._orphans) > cap:
+            k = next(iter(self._orphans))
+            e = self._entries.get(k)
             if e is not None:
-                self._bytes -= e.nbytes
-                swept = True
+                self._drop_entry(k, e)
+            else:
+                self._orphans.pop(k, None)
+            swept = True
         if swept:
             registry().set_gauge("hbm_bytes_resident", float(self._bytes))
 
+    def _orphan_budget(self) -> int:
+        """Max stable entries retained past their anchor's death
+        (DAFT_TPU_HBM_ORPHANS, read once). 0 = strict anchor-coupled
+        lifetime — the driver default, so dropping a host table still frees
+        its device planes; WorkerPool sets a positive cap in worker
+        environments so planes outlive the transient per-task plan objects."""
+        if self._orphan_cap is None:
+            import os
+
+            try:
+                self._orphan_cap = max(
+                    int(os.environ.get("DAFT_TPU_HBM_ORPHANS", "0")), 0)
+            except ValueError:
+                self._orphan_cap = 0
+        return self._orphan_cap
+
     # ---- introspection -------------------------------------------------------------
+    def digest(self, cap: int = 64) -> list:
+        """Compact residency digest for heartbeats: up to `cap`
+        (stable_slot_key, device_bytes) pairs, most-recently-used first.
+        Only deps-free slots appear — they are the ones a repeat sub-plan can
+        actually rebind to, so advertising anything else would overstate the
+        transfer bytes a scheduler placement avoids."""
+        out = []
+        with self._lock:
+            self._sweep_dead()
+            for k in reversed(self._entries):
+                e = self._entries[k]
+                if e.stable is not None:
+                    out.append((e.stable, e.nbytes))
+                    if len(out) >= cap:
+                        break
+        return out
+
     def bytes_resident(self) -> int:
         with self._lock:
             self._sweep_dead()
@@ -373,6 +566,8 @@ class ResidencyManager:
                 "hbm_evictions": reg.get("hbm_evictions"),
                 "hbm_eviction_bytes": reg.get("hbm_eviction_bytes"),
                 "hbm_pins": reg.get("hbm_pins"),
+                "hbm_stable_rehits": reg.get("hbm_stable_rehits"),
+                "hbm_evict_cost_saved": reg.get("hbm_evict_cost_saved"),
             }
 
     def clear(self) -> None:
@@ -380,10 +575,13 @@ class ResidencyManager:
         — ops/counters.reset() owns those."""
         with self._lock:
             self._entries.clear()
+            self._stable.clear()
+            self._orphans.clear()
             self._dead.clear()
             self._bytes = 0
             self._high_water = 0
             self._auto_budget = None
+            self._orphan_cap = None
             registry().set_gauge("hbm_bytes_resident", 0.0)
             registry().set_gauge("hbm_bytes_high_water", 0.0)
 

@@ -1,0 +1,113 @@
+"""Sub-plan residency fingerprints for cache-affinity scheduling.
+
+The driver side of the distributed residency protocol (the worker side is
+``ResidencyManager.digest()`` published in heartbeats): a fingerprint is the
+set of stable slot keys a sub-plan's DEVICE path would probe, with estimated
+device bytes per slot. Because stable keys are content-derived
+(device/residency.py stable_slot_key — same column data + same slot shape →
+same 64-bit key in any process), a key computed here from the plan the driver
+is ABOUT to ship equals the key a worker registered when it executed the same
+sub-plan before. ``Scheduler._pick_worker`` intersects the two and steers
+repeat sub-plans to the worker already holding their planes (soft affinity in
+the Delay-Scheduling tradition — never blocking on a saturated worker).
+
+Mirrored slot shapes (must track the executors' registration sites):
+
+- ``("col", bucket, f32)`` — Series.to_device_cached column planes fed by
+  GroupedAggRun.feed_batch / FilterAggRun.feed_batch (f32 = not stage._use_f64,
+  bucket = pad_bucket(batch rows)).
+- ``("dictcodes", bucket)`` — grouped_stage.cached_dict_code_plane group-key
+  dictionary planes (dict-keyed stages only).
+
+Join-stage slots (index planes, packed dim matrices) are identity-dependent
+(non-empty deps) and never rebind across processes, so they are deliberately
+absent from both digests and fingerprints.
+
+Everything here is advisory: any failure degrades to an empty fingerprint and
+the scheduler's plain spread policy. A host-only plan (no Device* nodes) exits
+before touching any device module — the zero-overhead contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..plan import physical as pp
+
+# fingerprint length cap: a sub-plan probing more slots than this is scored on
+# its hottest ones only (heartbeat digests are capped too)
+MAX_FINGERPRINT_SLOTS = 128
+
+
+def plan_fingerprint(plan) -> Tuple[Tuple[int, int], ...]:
+    """(stable_slot_key, estimated_bytes) pairs for one sub-plan, or () when
+    nothing in it can be device-resident (host-only plan, streaming leaves,
+    python-object columns)."""
+    try:
+        device_nodes = [
+            n for n in plan.walk()
+            if isinstance(n, (pp.DeviceGroupedAgg, pp.DeviceFilterAgg))
+        ]
+        if not device_nodes:
+            return ()
+        slots: Dict[int, int] = {}
+        for node in device_nodes:
+            _node_slots(node, slots)
+            if len(slots) >= MAX_FINGERPRINT_SLOTS:
+                break
+        items = list(slots.items())[:MAX_FINGERPRINT_SLOTS]
+        return tuple(items)
+    except Exception:  # noqa: BLE001 — advisory: never fail task creation
+        return ()
+
+
+def _node_slots(node, slots: Dict[int, int]) -> None:
+    from ..device.residency import stable_slot_key
+    from ..expressions.expressions import Alias, ColumnRef
+    from ..ops.stage import pad_bucket
+
+    if isinstance(node, pp.DeviceGroupedAgg):
+        from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+        stage = try_build_grouped_agg_stage(
+            node.input.schema, node.predicate, node.groupby, node.aggregations)
+    else:
+        from ..ops.stage import try_build_filter_agg_stage
+
+        stage = try_build_filter_agg_stage(
+            node.input.schema, node.predicate, node.aggregations)
+    if stage is None:
+        return
+    f32 = not stage._use_f64
+    key_cols: List[str] = []
+    if isinstance(node, pp.DeviceGroupedAgg) and getattr(stage, "dict_keys", False):
+        for g in node.groupby:
+            ref = g.child if isinstance(g, Alias) else g
+            if isinstance(ref, ColumnRef):
+                key_cols.append(ref.name())
+
+    for scan in (n for n in node.walk() if isinstance(n, pp.InMemoryScan)):
+        for part in scan.partitions:
+            for b in part.batches:
+                if b.num_rows == 0:
+                    continue
+                bucket = pad_bucket(b.num_rows)
+                for cname in stage._input_cols:
+                    _add_slot(slots, b, cname, ("col", bucket, f32),
+                              bucket * 5, stable_slot_key)
+                for cname in key_cols:
+                    _add_slot(slots, b, cname, ("dictcodes", bucket),
+                              bucket * 4, stable_slot_key)
+                if len(slots) >= MAX_FINGERPRINT_SLOTS:
+                    return
+
+
+def _add_slot(slots: Dict[int, int], batch, cname: str, key: tuple,
+              est_bytes: int, stable_slot_key) -> None:
+    try:
+        s = batch.get_column(cname)
+    except Exception:  # noqa: BLE001 — column introduced above the scan
+        return
+    sk = stable_slot_key(s, key)
+    if sk is not None:
+        slots[sk] = est_bytes

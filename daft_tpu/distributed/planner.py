@@ -104,6 +104,24 @@ def worth_distributing(node: pp.PhysicalPlan, min_rows: int = 0) -> bool:
                for n in node.walk())
 
 
+def _fingerprint(ctx: DistContext, frag: pp.PhysicalPlan):
+    """Residency fingerprint for one fragment — but ONLY once some worker
+    actually holds planes (a non-empty heartbeat digest). On a cold pool every
+    digest is empty and affinity can never hit, so skipping the fingerprint
+    skips its content-hash pass over the fragment's input columns on the
+    dispatch path; from the second query on, the hashes are computed (and
+    memoized per Series) exactly when they can pay off."""
+    from .affinity import plan_fingerprint
+
+    try:
+        workers = getattr(ctx.pool, "workers", {}).values()
+        if not any(getattr(w, "last_digest", None) for w in workers):
+            return ()
+    except Exception:  # noqa: BLE001 — advisory
+        return ()
+    return plan_fingerprint(frag)
+
+
 def localize(ctx: DistContext, node: pp.PhysicalPlan) -> pp.PhysicalPlan:
     """Replace maximal distributable subtrees with their distributed results."""
     if subtree_distributable(node) and worth_distributing(node):
@@ -134,7 +152,8 @@ def run_distributed(ctx: DistContext, node: pp.PhysicalPlan) -> List[MicroPartit
         dist = distribute(ctx, node)
         stage = ctx.stage_id("final")
         tasks = [SubPlanTask.from_plan(ctx.task_id("final"), frag,
-                                       stage_id=stage)
+                                       stage_id=stage,
+                                       rfingerprint=_fingerprint(ctx, frag))
                  for frag in dist.fragments]
         results = ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)
         parts: List[MicroPartition] = []
@@ -319,7 +338,11 @@ def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
             ctx.task_id("shuffle"),
             pp.ShuffleWrite(frag, sid, map_id=i, num_partitions=ctx.n_partitions,
                             by=list(by), shuffle_dir=ctx.shuffle_dir, schema=schema),
-            stage_id=stage)
+            stage_id=stage,
+            # residency fingerprint of the map fragment (the device planes its
+            # partial-agg stage would probe): repeat shuffles of a resident
+            # table stick to the workers already holding those planes
+            rfingerprint=_fingerprint(ctx, frag))
         for i, frag in enumerate(fragments)
     ]
     ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)

@@ -6,15 +6,26 @@ capacity and assigns: Spread -> worker with most available slots (default.rs:48)
 WorkerAffinity soft -> preferred worker if it has a slot else spread, hard ->
 only that worker. Pure logic, no IO — hermetically unit-tested with mock
 workers exactly like the reference (scheduling/scheduler/mod.rs:257-298).
+
+Cache-affinity extension (Delay Scheduling / Sparrow lineage): each
+WorkerSnapshot carries the worker's RESIDENCY DIGEST — the stable slot keys of
+device planes its HBM holds, published in heartbeats
+(device/residency.py digest()). A task whose ``rfingerprint``
+(distributed/affinity.py) intersects a free worker's digest is steered there,
+scored by estimated transfer-bytes-avoided minus a load penalty, so repeat
+sub-plans stick to the worker that already paid their uploads. The policy is
+SOFT: nothing resident, a saturated preferred worker, or a losing score all
+degrade to the plain spread pick — no task ever waits for locality.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
 
+from ..observability.metrics import registry
 from .task import Spread, SubPlanTask, WorkerAffinity
 
 
@@ -23,6 +34,8 @@ class WorkerSnapshot:
     worker_id: str
     total_slots: int
     active_tasks: int = 0
+    # latest heartbeat residency digest: stable slot key -> device bytes held
+    resident: Dict[int, int] = field(default_factory=dict)
 
     @property
     def available_slots(self) -> int:
@@ -34,7 +47,8 @@ class Scheduler:
 
     Usage: submit() tasks, then schedule() to drain as many as capacity allows
     (schedule() itself marks assigned slots busy); call task_finished() as
-    results arrive to free slots.
+    results arrive to free slots. update_residency() feeds worker heartbeat
+    digests in between passes.
     """
 
     def __init__(self, workers: Dict[str, int]):
@@ -50,6 +64,19 @@ class Scheduler:
                 os.environ.get("DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25))
         except ValueError:
             self._autoscaling_threshold = 1.25
+        # load penalty per active task when scoring affinity candidates: an
+        # affinity pick must beat spread by more than this many bytes per unit
+        # of load, or locality is not worth queueing behind a busy worker
+        try:
+            self._affinity_penalty_bytes = int(
+                os.environ.get("DAFT_TPU_AFFINITY_PENALTY_BYTES",
+                               8 * 1024 * 1024))
+        except ValueError:
+            self._affinity_penalty_bytes = 8 * 1024 * 1024
+        # per-scheduler placement totals (the pool snapshots these into the
+        # query trace; the same increments go to the process registry)
+        self._stats = {"affinity_hits": 0, "affinity_misses": 0,
+                       "bytes_avoided": 0, "affinity_skips": 0}
 
     # ---- worker lifecycle ----------------------------------------------------
     def add_worker(self, worker_id: str, slots: int) -> None:
@@ -63,8 +90,21 @@ class Scheduler:
         if w is not None and w.active_tasks > 0:
             w.active_tasks -= 1
 
+    def update_residency(self, worker_id: str, digest) -> None:
+        """Install a worker's latest heartbeat residency digest (iterable of
+        (stable_slot_key, bytes) pairs, or a dict)."""
+        w = self._workers.get(worker_id)
+        if w is None:
+            return
+        w.resident = dict(digest) if digest else {}
+
     def snapshots(self) -> List[WorkerSnapshot]:
         return list(self._workers.values())
+
+    def placement_stats(self) -> Dict[str, int]:
+        """Affinity placement totals since construction (one Scheduler serves
+        one stage, so these are per-stage numbers for the query trace)."""
+        return dict(self._stats)
 
     # ---- scheduling ----------------------------------------------------------
     def submit(self, task: SubPlanTask) -> None:
@@ -96,14 +136,34 @@ class Scheduler:
         """Assign as many pending tasks as current capacity allows.
 
         Tasks whose strategy cannot be satisfied right now (hard affinity to a
-        busy/absent worker, every eligible worker full) stay pending.
+        busy/absent worker, every eligible worker full) stay pending. A
+        hard-affinity task that finds its preferred worker full marks that
+        worker in a per-pass skip set: later heap entries bound to the same
+        worker are re-queued without an eligibility scan instead of spinning
+        the heap head-of-line (counted in sched_affinity_skips).
         """
         assigned: List[Tuple[SubPlanTask, str]] = []
         skipped: List[Tuple[int, int, SubPlanTask]] = []
+        blocked_prefs: Set[str] = set()
         while self._heap:
             prio, seq, task = heapq.heappop(self._heap)
+            strategy = task.strategy
+            if (isinstance(strategy, WorkerAffinity) and strategy.hard
+                    and strategy.worker_id in blocked_prefs):
+                self._stats["affinity_skips"] += 1
+                registry().inc("sched_affinity_skips")
+                skipped.append((prio, seq, task))
+                continue
             wid = self._pick_worker(task)
             if wid is None:
+                if isinstance(strategy, WorkerAffinity) and strategy.hard:
+                    # only a genuinely FULL preferred worker poisons the skip
+                    # set: a task whose pref is merely excluded (requeue) or
+                    # absent must not starve siblings the worker could serve
+                    pref = self._workers.get(strategy.worker_id)
+                    if (pref is not None and pref.available_slots == 0
+                            and strategy.worker_id not in task.excluded_workers):
+                        blocked_prefs.add(strategy.worker_id)
                 skipped.append((prio, seq, task))
                 continue
             self._workers[wid].active_tasks += 1
@@ -127,5 +187,47 @@ class Scheduler:
         free = [w for w in eligible if w.available_slots > 0]
         if not free:
             return None
+        wid = self._pick_resident(task, free, eligible)
+        if wid is not None:
+            return wid
         # Spread: most available slots; stable tiebreak by id for determinism
         return max(free, key=lambda w: (w.available_slots, w.worker_id)).worker_id
+
+    def _pick_resident(self, task: SubPlanTask, free: List[WorkerSnapshot],
+                       eligible: List[WorkerSnapshot]) -> Optional[str]:
+        """Cache-affinity pick: the free worker with the best
+        (bytes-avoided − load·penalty) score, when positive. Returns None to
+        fall through to spread (also recording a miss when the task's planes
+        sit only on workers with no free slot — locality lost to saturation)."""
+        fp = task.rfingerprint
+        if not fp:
+            return None
+        best: Optional[WorkerSnapshot] = None
+        best_score = 0
+        best_avoided = 0
+        for w in free:
+            avoided = self._overlap_bytes(w, fp)
+            if avoided <= 0:
+                continue
+            score = avoided - self._affinity_penalty_bytes * w.active_tasks
+            if best is None or (score, w.available_slots, w.worker_id) > \
+                    (best_score, best.available_slots, best.worker_id):
+                best, best_score, best_avoided = w, score, avoided
+        if best is not None and best_score > 0:
+            self._stats["affinity_hits"] += 1
+            self._stats["bytes_avoided"] += best_avoided
+            registry().inc("sched_affinity_hits")
+            registry().inc("sched_bytes_avoided", best_avoided)
+            return best.worker_id
+        if any(w.available_slots == 0 and self._overlap_bytes(w, fp) > 0
+               for w in eligible):
+            self._stats["affinity_misses"] += 1
+            registry().inc("sched_affinity_misses")
+        return None
+
+    @staticmethod
+    def _overlap_bytes(w: WorkerSnapshot, fp) -> int:
+        if not w.resident:
+            return 0
+        # bytes the worker actually holds for the slots this task would probe
+        return sum(w.resident.get(k, 0) for k, _est in fp)

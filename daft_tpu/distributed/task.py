@@ -53,10 +53,15 @@ class SubPlanTask:
     collect_stats: bool = False
     # driver time.time() when the task entered the scheduler (queue-wait base)
     submitted_at: float = 0.0
+    # residency fingerprint: (stable_slot_key, est_bytes) pairs for the device
+    # planes this sub-plan would probe (distributed/affinity.py). The scheduler
+    # intersects it with worker heartbeat digests for cache-affinity placement;
+    # () = no device-cacheable inputs (plain spread scheduling).
+    rfingerprint: Tuple[Tuple[int, int], ...] = ()
 
     @classmethod
     def from_plan(cls, task_id: str, plan, strategy=None, priority: int = 0,
-                  stage_id: str = "") -> "SubPlanTask":
+                  stage_id: str = "", rfingerprint: Tuple = ()) -> "SubPlanTask":
         # cloudpickle serializes by VALUE anything a fresh worker process
         # cannot import (custom DataSource tasks defined in __main__, a
         # notebook, or a test module) — the reference ships sub-plans the same
@@ -69,7 +74,7 @@ class SubPlanTask:
             blob = pickle.dumps(plan)
         return cls(task_id=task_id, plan_blob=blob,
                    strategy=strategy or Spread(), priority=priority,
-                   stage_id=stage_id)
+                   stage_id=stage_id, rfingerprint=tuple(rfingerprint))
 
     def plan(self):
         return pickle.loads(self.plan_blob)

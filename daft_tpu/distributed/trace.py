@@ -37,6 +37,9 @@ class QueryTrace:
         # stage_id -> accumulated shuffle dict (insertion-ordered)
         self._shuffle: Dict[str, dict] = {}
         self._stage_order: List[str] = []
+        # stage_id -> scheduler placement totals (affinity hits/misses,
+        # bytes avoided, head-of-line skips) — see Scheduler.placement_stats
+        self._placement: Dict[str, Dict[str, int]] = {}
 
     # ---- recording (called by WorkerPool.run_tasks) ------------------------------
     def record_task(self, task, result, dispatched_at: float) -> None:
@@ -91,9 +94,17 @@ class QueryTrace:
             rss_bytes=hb.get("rss_bytes", 0),
             uptime_s=hb.get("uptime_s", 0.0),
             hbm_bytes=hb.get("hbm_bytes_resident", 0),
+            hbm_h2d_bytes=hb.get("hbm_h2d_bytes", 0),
+            hbm_digest_entries=len(hb.get("hbm_digest") or ()),
         )
         with self._lock:
             self.heartbeats.append(rec)
+
+    def note_placement(self, stage_id: str, stats: Dict[str, int]) -> None:
+        """Record one stage's scheduler placement totals (called by the pool
+        when the stage drains)."""
+        with self._lock:
+            self._placement[stage_id] = dict(stats)
 
     # ---- aggregation -------------------------------------------------------------
     def shuffle_stats(self) -> List[ShuffleStats]:
@@ -124,6 +135,7 @@ class QueryTrace:
                 by_stage.setdefault(t.stage_id, []).append(t)
             order = list(self._stage_order)
             shuffle = {k: dict(v) for k, v in self._shuffle.items()}
+            placement = {k: dict(v) for k, v in self._placement.items()}
         out = []
         for sid in order:
             tasks = by_stage.get(sid, [])
@@ -131,7 +143,11 @@ class QueryTrace:
                 continue
             times = sorted(t.exec_s for t in tasks)
             sh = shuffle.get(sid, {})
+            pl = placement.get(sid, {})
             out.append({
+                "affinity_hits": int(pl.get("affinity_hits", 0)),
+                "affinity_misses": int(pl.get("affinity_misses", 0)),
+                "sched_bytes_avoided": int(pl.get("bytes_avoided", 0)),
                 "stage_id": sid,
                 "tasks": len(tasks),
                 "workers": len({t.worker_id for t in tasks}),
@@ -186,6 +202,11 @@ class QueryTrace:
                 f"{_fmt_bytes(s['shuffle_bytes_fetched']):>10}")
             if s["retries"]:
                 lines.append(f"  {'':<20} ({s['retries']} task retries)")
+            if s["affinity_hits"] or s["affinity_misses"]:
+                lines.append(
+                    f"  {'':<20} (cache affinity: {s['affinity_hits']} hits, "
+                    f"{s['affinity_misses']} misses, "
+                    f"{_fmt_bytes(s['sched_bytes_avoided'])} transfer avoided)")
         workers = self.worker_summary()
         if workers:
             lines.append("")

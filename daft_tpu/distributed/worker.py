@@ -57,6 +57,30 @@ def _hbm_bytes() -> int:
         return 0
 
 
+def _hbm_digest() -> list:
+    """Compact residency digest: (stable_slot_key, bytes) pairs for the device
+    planes this worker holds (capped). The driver drains these into scheduler
+    WorkerSnapshots for cache-affinity placement."""
+    try:
+        from ..device.residency import manager
+
+        return manager().digest()
+    except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
+        return []
+
+
+def _hbm_h2d_bytes() -> int:
+    """Cumulative host->device upload bytes in this worker (hbm_h2d_bytes
+    counter) — a repeat sub-plan served from resident planes shows a zero
+    delta, which the affinity tests assert end to end."""
+    try:
+        from ..observability.metrics import registry
+
+        return registry().get("hbm_h2d_bytes")
+    except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
+        return 0
+
+
 def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
     """Execute one sub-plan. When the task asks for stats (driver has
     subscribers attached or explain_analyze running) the plan runs under a
@@ -130,6 +154,8 @@ def _worker_loop(conn, worker_id: str) -> None:
                     "tasks_failed": state["failed"],
                     "rss_bytes": _rss_bytes(),
                     "hbm_bytes_resident": _hbm_bytes(),
+                    "hbm_digest": _hbm_digest(),
+                    "hbm_h2d_bytes": _hbm_h2d_bytes(),
                     "uptime_s": time.time() - t_start,
                 }))
             except (BrokenPipeError, OSError):
@@ -190,6 +216,11 @@ class WorkerProcess:
         child_env = dict(os.environ)
         child_env.setdefault("DAFT_TPU_DEVICE", "off")
         child_env["DAFT_TPU_WORKER_SLOTS"] = str(slots)
+        # workers retain content-addressed device planes past their transient
+        # per-task anchors (device/residency.py orphan policy): a repeat
+        # sub-plan rebinds them instead of re-uploading. The HBM budget still
+        # bounds total bytes; this caps the orphaned ENTRY count.
+        child_env.setdefault("DAFT_TPU_HBM_ORPHANS", "256")
         # make the engine AND everything the driver can import resolvable in
         # the child (script dir, pytest-inserted test dirs): shipped sub-plans
         # may reference classes from any module on the driver's sys.path
@@ -247,10 +278,23 @@ class WorkerProcess:
         self.heartbeats: deque = deque(maxlen=256)
         # results received while draining heartbeats; poll() serves these first
         self._pending_results: deque = deque()
+        # latest residency digest from a heartbeat: stable slot key -> bytes
+        # (scheduler cache-affinity input; survives heartbeat window drains).
+        # digest_seq bumps on every refresh so the dispatch loop pushes the
+        # digest to the scheduler only when it actually changed
+        self.last_digest: Dict[int, int] = {}
+        self.digest_seq = 0
 
     def submit(self, task: SubPlanTask) -> None:
         self.inflight[task.task_id] = task
         self._conn.send(("task", task))
+
+    def _note_heartbeat(self, hb: dict) -> None:
+        self.heartbeats.append(hb)
+        digest = hb.get("hbm_digest")
+        if digest is not None:
+            self.last_digest = dict(digest)
+            self.digest_seq += 1
 
     def poll(self, timeout: float = 0.0) -> Optional[TaskResult]:
         if self._pending_results:
@@ -263,7 +307,7 @@ class WorkerProcess:
                 if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
                     # out-of-band heartbeat: record and keep draining (without
                     # blocking again — the result may already be queued)
-                    self.heartbeats.append(msg[1])
+                    self._note_heartbeat(msg[1])
                     timeout = 0.0
                     continue
                 res: TaskResult = msg
@@ -274,19 +318,26 @@ class WorkerProcess:
             pass
         return None
 
-    def drain_heartbeats(self) -> List[dict]:
-        """Non-destructively empty the connection: heartbeats are collected;
-        any TaskResult encountered is stashed for the next poll() (a stale
-        result from an errored stage must not be silently consumed here)."""
+    def pump(self) -> None:
+        """Drain whatever the connection holds without consuming anything:
+        heartbeats land in the window (and refresh last_digest), results are
+        stashed for the next poll(). Lets the pool refresh residency digests
+        before scheduling a stage."""
         try:
             while self._conn.poll(0.0):
                 msg = self._conn.recv()
                 if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
-                    self.heartbeats.append(msg[1])
+                    self._note_heartbeat(msg[1])
                 else:
                     self._pending_results.append(msg)
         except (EOFError, BrokenPipeError, OSError):
             pass
+
+    def drain_heartbeats(self) -> List[dict]:
+        """Non-destructively empty the connection: heartbeats are collected;
+        any TaskResult encountered is stashed for the next poll() (a stale
+        result from an errored stage must not be silently consumed here)."""
+        self.pump()
         out = list(self.heartbeats)
         self.heartbeats.clear()
         return out
@@ -410,6 +461,14 @@ class WorkerPool:
 
         sched = Scheduler({w.worker_id: w.slots
                            for w in self.workers.values() if w.alive})
+        # seed residency digests from the latest heartbeats so the FIRST
+        # scheduling pass of this stage is already cache-affinity aware
+        digest_seen: Dict[str, int] = {}
+        for w in self.workers.values():
+            if w.alive:
+                w.pump()
+                sched.update_residency(w.worker_id, w.last_digest)
+                digest_seen[w.worker_id] = w.digest_seq
         now = time.time()
         for t in tasks:
             if stage_id and not t.stage_id:
@@ -435,7 +494,8 @@ class WorkerPool:
                 collect_stats=task.collect_stats,
                 # keep the FIRST submit time: a retry's queue wait includes
                 # the failed attempt's scheduling delay
-                submitted_at=task.submitted_at)
+                submitted_at=task.submitted_at,
+                rfingerprint=task.rfingerprint)
             task_by_id[task.task_id] = clone
             sched.submit(clone)
 
@@ -460,6 +520,13 @@ class WorkerPool:
             progressed = bool(assignments)
             for w in list(self.workers.values()):
                 res = w.poll(timeout=0.005)
+                # heartbeats may have arrived during the poll: refresh this
+                # worker's residency digest for the next scheduling pass —
+                # but only when it actually changed (seq check), not a dict
+                # copy per worker per 5ms dispatch iteration
+                if digest_seen.get(w.worker_id) != w.digest_seq:
+                    sched.update_residency(w.worker_id, w.last_digest)
+                    digest_seen[w.worker_id] = w.digest_seq
                 if res is not None:
                     progressed = True
                     sched.task_finished(res.worker_id)
@@ -492,6 +559,8 @@ class WorkerPool:
                 # nothing running, nothing newly assignable -> unschedulable
                 raise RuntimeError(
                     f"{sched.pending_count()} tasks unschedulable (no eligible workers)")
+        if trace is not None:
+            trace.note_placement(stage_id or "stage", sched.placement_stats())
         return results
 
     def drain_heartbeats(self) -> List[dict]:

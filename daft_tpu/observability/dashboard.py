@@ -141,7 +141,9 @@ class DashboardState(Subscriber):
                        "tasks_completed": hb.tasks_completed,
                        "tasks_failed": hb.tasks_failed,
                        "rss_bytes": hb.rss_bytes,
-                       "hbm_bytes": getattr(hb, "hbm_bytes", 0)})
+                       "hbm_bytes": getattr(hb, "hbm_bytes", 0),
+                       "hbm_h2d_bytes": getattr(hb, "hbm_h2d_bytes", 0),
+                       "hbm_digest_entries": getattr(hb, "hbm_digest_entries", 0)})
 
     def on_query_end(self, event: QueryEnd) -> None:
         with self._lock:
@@ -179,9 +181,15 @@ class DashboardState(Subscriber):
                     "heartbeats": len(beats),
                     "recent": len(recent),
                     "busy_fraction": busy / len(recent) if recent else 0.0,
-                    # HBM residency gauge from the latest beat (device-buffer
-                    # bytes this worker holds across queries)
+                    # HBM residency gauges from the latest beat: device-buffer
+                    # bytes held across queries, cumulative h2d upload bytes
+                    # (flat across repeats = served from residency), and the
+                    # size of the digest the scheduler uses for cache affinity
                     "hbm_bytes": beats[-1].get("hbm_bytes", 0) if beats else 0,
+                    "hbm_h2d_bytes":
+                        beats[-1].get("hbm_h2d_bytes", 0) if beats else 0,
+                    "hbm_digest_entries":
+                        beats[-1].get("hbm_digest_entries", 0) if beats else 0,
                 }
             return out
 

@@ -18,8 +18,9 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # Bumped whenever a record's shape changes so downstream trace pipelines can
 # branch on it. v1: implicit (no field). v2: adds schema_version to every
 # record plus the distributed task_stats/shuffle_stats/worker_heartbeat kinds
-# and query_end.metrics.
-SCHEMA_VERSION = 2
+# and query_end.metrics. v3: worker_heartbeat gains hbm_h2d_bytes +
+# hbm_digest_entries (cache-affinity scheduling observability).
+SCHEMA_VERSION = 3
 
 
 class EventLogSubscriber(Subscriber):

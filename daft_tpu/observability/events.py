@@ -84,6 +84,13 @@ class WorkerHeartbeat:
     # device bytes this worker's HBM residency manager holds (0 = no device
     # buffers cached) — see daft_tpu/device/residency.py
     hbm_bytes: int = 0
+    # cumulative host->device upload bytes on this worker (hbm_h2d_bytes):
+    # flat across a repeat query = its planes were served from residency
+    hbm_h2d_bytes: int = 0
+    # entries in the worker's residency digest (the stable-slot-key list the
+    # scheduler intersects with sub-plan fingerprints); the digest itself
+    # stays out of the event record — it is scheduler input, not telemetry
+    hbm_digest_entries: int = 0
 
 
 @dataclass(frozen=True)

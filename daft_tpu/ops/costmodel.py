@@ -124,6 +124,34 @@ def reset_calibration() -> None:
     _CAL = None
 
 
+# Default link rates for ADVISORY estimates that must never trigger a live
+# device probe (HBM eviction ordering runs inside the residency manager's
+# lock, possibly in a process that never calibrated). Overridable via the same
+# env knobs calibrate() honors; a completed calibration takes precedence.
+_STATIC_H2D_BPS = 1e9
+_STATIC_FACTORIZE_RPS = 8e6
+
+
+def rebuild_cost_estimate(nbytes: int, factorize_rows: int = 0) -> float:
+    """Estimated seconds to rebuild one evicted HBM residency entry: the
+    re-upload of its device bytes plus any host factorize work its build
+    re-runs (dictionary codes, join indices). This orders cost-weighted
+    eviction (device/residency.py): a plain column plane is cheap (pure
+    re-upload) while an index/dictionary plane of the same size carries the
+    host pass that produced it, so it evicts last."""
+    cal = _CAL
+    if cal is not None:
+        h2d, fact = cal.h2d_bytes_per_s, cal.host_factorize_rate
+    else:
+        h2d = _env_f("DAFT_TPU_COST_H2D", -1.0)
+        if h2d <= 0:
+            h2d = _STATIC_H2D_BPS
+        fact = _env_f("DAFT_TPU_COST_HOST_FACT", _STATIC_FACTORIZE_RPS)
+        if fact <= 0:
+            fact = _STATIC_FACTORIZE_RPS
+    return nbytes / h2d + factorize_rows / fact
+
+
 def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                         n_mm: int, n_ext: int, n_sct: int, cap: int,
                         factorize_rows: int) -> float:

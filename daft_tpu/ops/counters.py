@@ -36,6 +36,13 @@ COUNTER_NAMES = (
     "hbm_eviction_bytes",      # device bytes released by evictions
     "hbm_pins",                # entries pinned by an executing query
     "hbm_h2d_bytes",           # host->device column upload bytes (Series.to_device)
+    "hbm_stable_rehits",       # slots rebound by content identity (repeat sub-plans)
+    "hbm_evict_cost_saved",    # µs of rebuild cost avoided vs pure-LRU eviction
+    # distributed cache-affinity scheduling (distributed/scheduler.py)
+    "sched_affinity_hits",     # tasks placed on a worker holding their planes
+    "sched_affinity_misses",   # fingerprinted tasks spread while planes sat on a full worker
+    "sched_bytes_avoided",     # est. h2d bytes saved by affinity placements
+    "sched_affinity_skips",    # hard-affinity heap skips (head-of-line guard)
 )
 
 registry().declare(*COUNTER_NAMES)

@@ -405,7 +405,8 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
 # ======================================================================================
 
 
-def series_keyed(anchor, key: tuple, deps: tuple, build, literals=None):
+def series_keyed(anchor, key: tuple, deps: tuple, build, literals=None,
+                 rebuild_rows: int = 0):
     """Cache ``build()`` in the process-wide HBM residency manager, anchored
     on `anchor` Series' identity under `key`, valid while every object in
     `deps` is IDENTICAL (strong refs held in the entry, so a freed object can
@@ -427,7 +428,8 @@ def series_keyed(anchor, key: tuple, deps: tuple, build, literals=None):
     """
     from ..device.residency import manager
 
-    return manager().get_or_build(anchor, key, deps, build, literals=literals)
+    return manager().get_or_build(anchor, key, deps, build, literals=literals,
+                                  rebuild_rows=rebuild_rows)
 
 
 def unique_key_index(dim_key_series, probe_vals: np.ndarray,
@@ -693,7 +695,8 @@ class _JoinContext:
                 return idx
 
             out[d.name] = series_keyed(
-                anchor, ("uki", d.key_col, d.parent, repr(kdt), n), deps, build)
+                anchor, ("uki", d.key_col, d.parent, repr(kdt), n), deps, build,
+                rebuild_rows=n)
         return out
 
     def _probe_dtype(self, batch, d: DimSpec):
@@ -749,7 +752,7 @@ class _JoinContext:
                 return jnp.asarray(padded)
 
             return series_keyed(anchor, ("didx", d.key_col, d.parent, bucket),
-                                (idx_np,), build)
+                                (idx_np,), build, rebuild_rows=n)
 
         pperm_np, _pdev = perm
 
@@ -759,7 +762,7 @@ class _JoinContext:
             return jnp.asarray(padded)
 
         return series_keyed(anchor, ("didxp", d.key_col, d.parent, bucket),
-                            (idx_np, pperm_np), build_p)
+                            (idx_np, pperm_np), build_p, rebuild_rows=n)
 
     def nonresident_index_bytes(self, batch, bucket: int) -> int:
         """h2d bytes the cost model should charge for dim index planes not

@@ -159,7 +159,10 @@ def cached_dict_code_plane(src, codes: np.ndarray, rows: int, cap: int):
         padded[:rows] = codes
         return jnp.asarray(padded)
 
-    return manager().get_or_build(src, ("dictcodes", cap), (), build)
+    # rebuild_rows: losing this plane re-runs the host dictionary factorize
+    # over the source rows — weigh that in cost-ordered eviction
+    return manager().get_or_build(src, ("dictcodes", cap), (), build,
+                                  rebuild_rows=rows)
 
 
 def resolve_key_series(batch, groupby, n: int):

@@ -95,6 +95,101 @@ def test_excluded_workers_skipped():
 
 
 # ---------------------------------------------------------------------------
+# Cache-affinity placement (hermetic: fingerprints vs heartbeat digests)
+# ---------------------------------------------------------------------------
+
+def _fp_task(tid, fp, excluded=()):
+    return SubPlanTask(task_id=tid, plan_blob=b"", strategy=Spread(),
+                       rfingerprint=tuple(fp), excluded_workers=tuple(excluded))
+
+
+def test_soft_affinity_wins_when_slots_free():
+    """A task whose fingerprint intersects a worker's residency digest lands
+    there, even though spread would pick the emptier worker."""
+    s = Scheduler({"w0": 4, "w1": 2})
+    s.update_residency("w1", [(101, 1 << 20)])
+    s.submit(_fp_task("t0", [(101, 1 << 20), (999, 64)]))
+    [(_, wid)] = s.schedule()
+    assert wid == "w1"
+    stats = s.placement_stats()
+    assert stats["affinity_hits"] == 1
+    assert stats["bytes_avoided"] == 1 << 20
+
+
+def test_affinity_falls_back_to_spread_when_preferred_full():
+    """Saturated resident worker: the task spreads instead of waiting (soft
+    policy — no head-of-line blocking), recorded as an affinity miss."""
+    s2 = Scheduler({"w0": 2, "w1": 1})
+    s2.update_residency("w1", [(7, 1 << 20)])
+    s2._workers["w1"].active_tasks = 1  # saturated resident worker
+    s2.submit(_fp_task("t0", [(7, 1 << 20)]))
+    [(_, wid)] = s2.schedule()
+    assert wid == "w0"
+    stats = s2.placement_stats()
+    assert stats["affinity_hits"] == 0 and stats["affinity_misses"] == 1
+
+
+def test_affinity_load_penalty_prefers_idle_when_overlap_small():
+    """A tiny resident overlap does not justify queueing behind a loaded
+    worker: score = bytes − penalty·load must be positive to win."""
+    s = Scheduler({"w0": 4, "w1": 4})
+    s.update_residency("w1", [(5, 1024)])  # 1KiB resident, far below penalty
+    s._workers["w1"].active_tasks = 2      # loaded but not full
+    s.submit(_fp_task("t0", [(5, 1024)]))
+    [(_, wid)] = s.schedule()
+    assert wid == "w0"  # spread wins: locality value below the load penalty
+
+
+def test_affinity_respects_excluded_workers():
+    """A requeued task never returns to the failed worker, resident planes or
+    not."""
+    s = Scheduler({"w0": 1, "w1": 1})
+    s.update_residency("w0", [(42, 1 << 20)])
+    s.submit(_fp_task("t0", [(42, 1 << 20)], excluded=["w0"]))
+    [(_, wid)] = s.schedule()
+    assert wid == "w1"
+    assert s.placement_stats()["affinity_hits"] == 0
+
+
+def test_hard_affinity_blocks_despite_resident_elsewhere():
+    """Hard affinity still pins to its worker: residency elsewhere is
+    irrelevant."""
+    s = Scheduler({"w0": 1, "w1": 1})
+    s._workers["w0"].active_tasks = 1
+    s.update_residency("w1", [(9, 1 << 20)])
+    t = SubPlanTask(task_id="t0", plan_blob=b"",
+                    strategy=WorkerAffinity("w0", hard=True),
+                    rfingerprint=((9, 1 << 20),))
+    s.submit(t)
+    assert s.schedule() == []  # waits for w0; never steals w1
+    s.task_finished("w0")
+    [(_, wid)] = s.schedule()
+    assert wid == "w0"
+
+
+def test_hard_affinity_skip_set_avoids_head_of_line_spin():
+    """Once one hard-affinity task finds its preferred worker full, later
+    heap entries bound to the same worker are requeued without an eligibility
+    scan (counted), and all run once the worker frees up."""
+    s = Scheduler({"w0": 1, "w1": 1})
+    s._workers["w0"].active_tasks = 1
+    for i in range(4):
+        s.submit(_task(f"h{i}", strategy=WorkerAffinity("w0", hard=True)))
+    assert s.schedule() == []
+    # first task discovered the full worker; the other three skipped via the set
+    assert s.placement_stats()["affinity_skips"] == 3
+    assert s.pending_count() == 4
+    s.task_finished("w0")
+    done = []
+    while s.pending_count():
+        for t, wid in s.schedule():
+            assert wid == "w0"
+            done.append(t.task_id)
+            s.task_finished("w0")
+    assert sorted(done) == ["h0", "h1", "h2", "h3"]
+
+
+# ---------------------------------------------------------------------------
 # End-to-end on a real worker pool
 # ---------------------------------------------------------------------------
 
@@ -404,6 +499,129 @@ def test_device_nodes_survive_distribution():
                 assert abs(g[1] - o[1]) < 1e-9 and g[2] == o[2]
         finally:
             r.shutdown()
+
+
+def test_hard_affinity_excluded_pref_does_not_poison_skip_set():
+    """A hard-affinity task whose preferred worker is merely EXCLUDED (after a
+    requeue) must not block siblings whose affinity to that worker is
+    satisfiable — only a genuinely full worker enters the skip set."""
+    s = Scheduler({"w0": 1, "w1": 1})
+    # t_excluded pops first (lower seq) and cannot run on w0; t_ok can
+    t_excl = SubPlanTask(task_id="t_excl", plan_blob=b"",
+                         strategy=WorkerAffinity("w0", hard=True),
+                         excluded_workers=("w0",))
+    t_ok = SubPlanTask(task_id="t_ok", plan_blob=b"",
+                       strategy=WorkerAffinity("w0", hard=True))
+    s.submit(t_excl)
+    s.submit(t_ok)
+    assigned = {t.task_id: wid for t, wid in s.schedule()}
+    assert assigned == {"t_ok": "w0"}  # w0 had a slot; t_ok was not starved
+    assert s.placement_stats()["affinity_skips"] == 0
+
+
+def test_repeat_query_cache_affinity_two_workers(monkeypatch):
+    """The acceptance loop for residency-aware scheduling: across two device
+    workers, the second run of an identical query places each sub-plan on the
+    worker already holding its planes (sched_affinity_hits > 0) and those
+    workers re-upload NOTHING (per-worker hbm_h2d_bytes flat), while results
+    stay bit-identical."""
+    import time
+
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.distributed.runner import DistributedRunner
+    from daft_tpu.observability.metrics import registry
+
+    monkeypatch.setenv("DAFT_TPU_HEARTBEAT_S", "0.2")  # fast digest delivery
+    rng = np.random.default_rng(11)
+    n = 20_000
+    data = daft_tpu.from_pydict({
+        "k": rng.integers(0, 8, n).tolist(),
+        "v": rng.uniform(0, 1, n).tolist(),
+    }).collect()
+
+    def q():
+        return (data.groupby("k")
+                .agg(col("v").sum().alias("s"), col("v").count().alias("c"))
+                .sort("k"))
+
+    def worker_h2d(pool, want, after_ts):
+        """Per-worker cumulative upload bytes from beats emitted AFTER
+        `after_ts` (the query's completion): a beat sent once the driver has
+        all results necessarily postdates every upload the worker's tasks
+        made, so stale mid-query beats can neither fake nor mask a
+        re-upload."""
+        out = {}
+        deadline = time.time() + 15
+        while time.time() < deadline and set(out) != set(want):
+            for hb in pool.drain_heartbeats():
+                if hb.get("ts", 0.0) > after_ts:
+                    out[hb["worker_id"]] = hb.get("hbm_h2d_bytes", 0)
+            time.sleep(0.05)
+        assert set(out) == set(want), f"missing fresh heartbeats: {out}"
+        return out
+
+    with execution_config_ctx(device_mode="on"):
+        r = DistributedRunner(num_workers=2, n_partitions=2, device_workers=2)
+        try:
+            import daft_tpu.runners as runners
+
+            runners.set_runner(r)
+            first = q().to_pydict()
+            t_first_done = time.time()
+            pool = r._pool
+            h2d_after_first = worker_h2d(pool, pool.workers, t_first_done)
+            assert any(v > 0 for v in h2d_after_first.values()), \
+                "first run never uploaded — device path did not execute"
+            before = registry().snapshot()
+            second = q().to_pydict()
+            t_second_done = time.time()
+            diff = registry().diff(before)
+            h2d_after_second = worker_h2d(pool, pool.workers, t_second_done)
+        finally:
+            runners.set_runner(None)
+            r.shutdown()
+    assert first == second
+    assert diff.get("sched_affinity_hits", 0) > 0, diff
+    assert diff.get("sched_bytes_avoided", 0) > 0, diff
+    assert h2d_after_second == h2d_after_first, \
+        "repeat query re-uploaded planes that were resident on its workers"
+
+
+def test_affinity_saturated_worker_no_deadlock():
+    """More fingerprinted tasks than the resident worker has slots: the
+    overflow spreads to the other worker and the stage completes (soft
+    affinity never deadlocks on a saturated preferred worker)."""
+    from daft_tpu.core.micropartition import MicroPartition
+    from daft_tpu.core.recordbatch import RecordBatch
+    from daft_tpu.core.series import Series
+    from daft_tpu.datatype import DataType
+    from daft_tpu.distributed.worker import WorkerPool
+    from daft_tpu.plan import physical as pp
+    from daft_tpu.schema import Schema
+
+    s = Series.from_pylist(list(range(64)), "a", DataType.int64())
+    schema = Schema([s.field()])
+    part = MicroPartition(schema, [RecordBatch(schema, [s], 64)])
+    plan = pp.InMemoryScan([part], schema)
+    pool = WorkerPool(2, slots_per_worker=1)
+    try:
+        # every task claims the same (synthetic) resident slot on worker-0;
+        # pin the digest against overwrites from the workers' real (empty)
+        # heartbeat digests — these host-only workers hold no device planes
+        w0 = pool.workers["worker-0"]
+        w0.last_digest = {12345: 1 << 20}
+        w0._note_heartbeat = lambda hb, _w=w0: _w.heartbeats.append(hb)
+        tasks = [SubPlanTask.from_plan(f"t{i}", plan) for i in range(6)]
+        for t in tasks:
+            t.rfingerprint = ((12345, 1 << 20),)
+        results = pool.run_tasks(tasks)
+        assert len(results) == 6
+        assert all(r.rows == 64 for r in results.values())
+        # both workers participated: the saturated preferred worker did not
+        # serialize the whole stage
+        assert len({r.worker_id for r in results.values()}) == 2
+    finally:
+        pool.shutdown()
 
 
 def test_device_worker_lease_env():

@@ -197,6 +197,197 @@ def test_identity_token_not_pickled():
     assert getattr(pickle.loads(pickle.dumps(s)), "_rtoken", None) is None
 
 
+def test_cost_weighted_eviction_cheapest_first():
+    """Under budget pressure, the cheaper-to-rebuild entry in the oldest
+    recency bucket evicts first: a plain (re-uploadable) plane goes before an
+    equally-recent expensive one (join index / dictionary planes carry host
+    factorize work via rebuild_rows), and the saved rebuild cost is counted."""
+    import jax.numpy as jnp
+
+    m = manager()
+    m.clear()
+    saved_before = registry().get("hbm_evict_cost_saved")
+
+    class Anchor:  # plain object: identity-keyed, no stable content
+        pass
+
+    dear, cheap, extra = Anchor(), Anchor(), Anchor()
+
+    def one_kb():
+        # explicit f32: entry size must not depend on whether x64 mode was
+        # enabled by earlier tests (jax_setup import order)
+        return jnp.ones(256, dtype=jnp.float32)
+
+    with execution_config_ctx(hbm_budget_bytes=2 * 1024 + 512):
+        # insert the EXPENSIVE entry first: it is the LRU-oldest, so pure
+        # recency eviction would take it — cost weighting must not
+        m.get_or_build(dear, ("d",), (), one_kb, rebuild_rows=50_000_000)
+        m.get_or_build(cheap, ("c",), (), one_kb)
+        m.get_or_build(extra, ("x",), (), one_kb)
+        assert m.is_resident(dear, ("d",)), \
+            "expensive-to-rebuild plane was evicted despite a cheap candidate"
+        assert not m.is_resident(cheap, ("c",))
+        assert m.bytes_resident() <= 2 * 1024 + 512
+    assert registry().get("hbm_evict_cost_saved") > saved_before
+    m.clear()
+
+
+def test_eviction_keeps_recency_with_few_entries():
+    """Cost weighting must not invert recency wholesale: with only a cold
+    expensive entry and a hot cheap one, the eviction bucket is the oldest
+    HALF (= the cold entry alone), so the squatter leaves and the hot plane
+    stays — not the thrash of re-uploading the hot plane every query."""
+    import jax.numpy as jnp
+
+    m = manager()
+    m.clear()
+
+    class Anchor:
+        pass
+
+    cold_dear, hot_cheap = Anchor(), Anchor()
+
+    def one_kb():
+        return jnp.ones(256, dtype=jnp.float32)
+
+    with execution_config_ctx(hbm_budget_bytes=1024 + 512):
+        m.get_or_build(cold_dear, ("d",), (), one_kb, rebuild_rows=50_000_000)
+        m.get_or_build(hot_cheap, ("c",), (), one_kb)  # over budget now
+        assert m.is_resident(hot_cheap, ("c",))
+        assert not m.is_resident(cold_dear, ("d",)), \
+            "rebuild cost protected a cold squatter over the hot plane"
+    m.clear()
+
+
+def test_eviction_bucket_ignores_pinned_padding():
+    """Pinned entries must not widen the recency window: with one pinned
+    entry plus a cold expensive and a hot cheap plane, the oldest-half bucket
+    spans the UNPINNED entries only (= the cold one), so the hot plane
+    survives."""
+    import jax.numpy as jnp
+
+    m = manager()
+    m.clear()
+
+    class Anchor:
+        pass
+
+    pinned, cold_dear, hot_cheap = Anchor(), Anchor(), Anchor()
+
+    def one_kb():
+        return jnp.ones(256, dtype=jnp.float32)
+
+    with execution_config_ctx(hbm_budget_bytes=2 * 1024 + 512):
+        # both registered (and released) under budget first
+        with m.pin_scope():
+            m.get_or_build(pinned, ("pin",), (), one_kb)
+            m.get_or_build(cold_dear, ("d",), (), one_kb,
+                           rebuild_rows=50_000_000)
+        with m.pin_scope():
+            # re-pin one entry (moves to MRU), then push over budget: LRU
+            # order is [cold_dear, pinned, hot_cheap] with only cold_dear and
+            # hot_cheap unpinned — the half-window must span those two, not
+            # all three, so the single candidate is cold_dear
+            m.get_or_build(pinned, ("pin",), (), one_kb)
+            m.get_or_build(hot_cheap, ("c",), (), one_kb)
+            assert m.is_resident(hot_cheap, ("c",)), \
+                "pinned padding widened the bucket onto the hot plane"
+            assert not m.is_resident(cold_dear, ("d",))
+    m.clear()
+
+
+def test_cost_weighted_eviction_never_touches_pins():
+    """Pinned entries stay resident whatever their rebuild cost: a pinned
+    cheap plane survives while unpinned entries (even expensive ones) evict."""
+    import jax.numpy as jnp
+
+    m = manager()
+    m.clear()
+
+    class Anchor:
+        pass
+
+    pinned_cheap, dear = Anchor(), Anchor()
+
+    def one_kb():
+        return jnp.ones(256, dtype=jnp.float32)
+
+    with execution_config_ctx(hbm_budget_bytes=1024 + 512):
+        # expensive entry registered OUTSIDE any pin scope: evictable
+        m.get_or_build(dear, ("d",), (), one_kb, rebuild_rows=10_000_000)
+        with m.pin_scope():
+            # pushes over budget; the only unpinned candidate is `dear`,
+            # whose high rebuild cost must not protect it from a pin
+            m.get_or_build(pinned_cheap, ("p",), (), one_kb)
+            assert m.is_resident(pinned_cheap, ("p",))
+            assert not m.is_resident(dear, ("d",)), \
+                "unpinned entry should have evicted, not the pinned one"
+    m.clear()
+
+
+def test_stable_rebind_serves_unpickled_copy_without_reupload():
+    """A content-identical Series (e.g. a worker's freshly-unpickled repeat
+    sub-plan input) rebinds the existing slot: one entry, no new h2d bytes,
+    and the digest advertises the slot under the same stable key both
+    times."""
+    import pickle
+
+    from daft_tpu.core.series import Series
+    from daft_tpu.device.residency import stable_slot_key
+
+    m = manager()
+    m.clear()
+    s = Series.from_pylist(list(range(4096)), "c")
+    s.to_device_cached(4096, f32=True)
+    h2d = registry().get("hbm_h2d_bytes")
+    rehits = registry().get("hbm_stable_rehits")
+    digest1 = dict(m.digest())
+    assert stable_slot_key(s, ("col", 4096, True)) in digest1
+
+    s2 = pickle.loads(pickle.dumps(s))
+    assert s2 is not s and getattr(s2, "_rtoken", None) is None
+    s2.to_device_cached(4096, f32=True)
+    assert registry().get("hbm_h2d_bytes") == h2d, "rebind re-uploaded"
+    assert registry().get("hbm_stable_rehits") == rehits + 1
+    assert m.entry_count() == 1
+    assert dict(m.digest()) == digest1
+    m.clear()
+
+
+def test_orphan_retention_is_opt_in(monkeypatch):
+    """Driver default (DAFT_TPU_HBM_ORPHANS unset): entries still die with
+    their anchor. With a positive cap (the worker-pool environment), a stable
+    entry survives its anchor and a content-equal anchor rebinds it."""
+    import gc
+    import pickle
+
+    from daft_tpu.core.series import Series
+
+    m = manager()
+    m.clear()
+    blob = pickle.dumps(Series.from_pylist(list(range(512)), "c"))
+
+    s = pickle.loads(blob)
+    s.to_device_cached(512, f32=True)
+    del s
+    gc.collect()
+    assert m.entry_count() == 0  # strict anchor-coupled lifetime by default
+
+    monkeypatch.setenv("DAFT_TPU_HBM_ORPHANS", "8")
+    m.clear()  # re-reads the cap
+    s = pickle.loads(blob)
+    s.to_device_cached(512, f32=True)
+    h2d = registry().get("hbm_h2d_bytes")
+    del s
+    gc.collect()
+    assert m.entry_count() == 1  # orphaned but retained (content-addressed)
+    s2 = pickle.loads(blob)
+    s2.to_device_cached(512, f32=True)  # rebinds the orphan
+    assert registry().get("hbm_h2d_bytes") == h2d
+    assert m.entry_count() == 1
+    m.clear()
+
+
 def test_rebuild_in_place_keeps_pin():
     """A dep/literal mismatch inside a pin scope rebuilds the slot in place;
     the replacement must inherit the pin so a tight budget cannot evict a
