@@ -111,6 +111,20 @@ def main() -> None:
         metric_totals["sched_affinity_hit_rate"] = round(
             hits / (hits + misses), 4)
 
+    # Dispatch-coalescing attribution: whether the RTT amortization actually
+    # paid on this capture. bucket_fill_ratio = real rows / padded bucket rows
+    # across coalesced dispatches (padding efficiency); dispatch_rtts_saved =
+    # morsels consumed minus dispatches issued (each saved dispatch is one
+    # avoided ~90ms round trip on a tunneled link).
+    cap_rows = metric_totals.get("bucket_capacity_rows", 0)
+    if cap_rows:
+        metric_totals["bucket_fill_ratio"] = round(
+            metric_totals.get("bucket_fill_rows", 0) / cap_rows, 4)
+    morsels_in = metric_totals.get("coalesce_morsels_in", 0)
+    if morsels_in:
+        metric_totals["dispatch_rtts_saved"] = int(
+            morsels_in - metric_totals.get("dispatch_coalesced", 0))
+
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
         "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",

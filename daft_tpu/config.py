@@ -27,6 +27,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 @dataclass(frozen=True)
 class ExecutionConfig:
     # device (TPU) stage selection
@@ -64,6 +71,36 @@ class ExecutionConfig:
     # morsel sizing (reference default_morsel_size, common/daft-config/src/lib.rs:131)
     morsel_size_rows: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_MORSEL_SIZE", 128 * 1024)
+    )
+    # Morsel-size selection policy (reference: dynamic_batching/mod.rs
+    # BatchingStrategy — static / dynamic / latency-constrained):
+    #   - "static" (default): fixed morsel_size_rows, the zero-overhead path
+    #   - "dynamic": per-operator throughput feedback grows/shrinks the morsel
+    #     size toward the knee of measured rows/sec (execution/batching.py)
+    #   - "latency": cap morsel size so one morsel's processing time stays
+    #     under batch_latency_ms (interactive/streaming consumers)
+    batching_mode: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_BATCHING", "static")
+    )
+    # Device dispatch coalescing (ops/stage.py DispatchCoalescer): incoming
+    # morsels destined for one device stage accumulate into a super-batch and
+    # flush once pending rows reach batch_fill_target of the power-of-two
+    # bucket at morsel_size_rows — one compiled dispatch then covers N morsels
+    # and the ~90ms dispatch RTT amortizes N-fold. 0 disables coalescing
+    # (every morsel dispatches individually, the pre-coalescing behavior).
+    batch_fill_target: float = field(
+        default_factory=lambda: _env_float("DAFT_TPU_BATCH_FILL", 0.5)
+    )
+    # Latency bound, milliseconds, checked at each morsel ARRIVAL (the
+    # coalescer is pull-driven — no timer thread): a morsel arriving after
+    # the oldest pending one has waited this long flushes the partial
+    # super-batch instead of accumulating further, so a steadily-flowing
+    # stream dispatches at a bounded cadence (upload of super-batch k+1
+    # overlapping device compute of batch k) rather than one giant batch at
+    # stream end. A stalled upstream flushes on the next arrival or at
+    # stream end. Also the per-morsel target for batching_mode="latency".
+    batch_latency_ms: float = field(
+        default_factory=lambda: _env_float("DAFT_TPU_BATCH_LATENCY_MS", 50.0)
     )
     # Broadcast-join threshold (reference: 10MiB). Gates DISTRIBUTED broadcast
     # joins (distributed/planner.py); local planning builds on the smaller
@@ -110,6 +147,18 @@ class ExecutionConfig:
             raise ValueError(
                 f"pipeline_mode must be one of 'on'/'off'/'force', got "
                 f"{self.pipeline_mode!r} (check DAFT_TPU_PIPELINE)")
+        if self.batching_mode not in ("static", "dynamic", "latency"):
+            raise ValueError(
+                f"batching_mode must be one of 'static'/'dynamic'/'latency', "
+                f"got {self.batching_mode!r} (check DAFT_TPU_BATCHING)")
+        if not 0.0 <= self.batch_fill_target <= 1.0:
+            raise ValueError(
+                f"batch_fill_target must be in [0, 1] (0 disables coalescing), "
+                f"got {self.batch_fill_target!r} (check DAFT_TPU_BATCH_FILL)")
+        if self.batch_latency_ms <= 0:
+            raise ValueError(
+                f"batch_latency_ms must be positive, got "
+                f"{self.batch_latency_ms!r} (check DAFT_TPU_BATCH_LATENCY_MS)")
 
 
 _default: Optional[ExecutionConfig] = None

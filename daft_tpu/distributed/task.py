@@ -98,3 +98,11 @@ class TaskResult:
     op_stats: Tuple[Any, ...] = ()
     # shuffle volume recorded while this task ran (ShuffleRecorder.as_dict())
     shuffle: Optional[dict] = None
+    # worker metrics-registry counter deltas over this task's execution
+    # (device_stage_batches, dispatch_coalesced, hbm_* ...): the driver's
+    # per-operator stats alone cannot show WHICH engine path a worker took —
+    # a device-leased worker's dispatches land here. The trace mirrors the
+    # device/coalescing subset (trace._MIRRORED_ENGINE_COUNTERS) into the
+    # driver registry for EXPLAIN ANALYZE / QueryEnd.metrics; hbm_* stays
+    # per-process (worker HBM telemetry flows via heartbeats instead).
+    engine_counters: Optional[dict] = None

@@ -20,6 +20,17 @@ from ..observability.metrics import registry
 from ..observability.otlp import _span_id, _trace_id
 
 
+# Worker engine counters mirrored into the driver registry per finished task
+# (device-path + batching attribution; shuffle volume arrives via
+# result.shuffle, hbm gauges stay per-process).
+_MIRRORED_ENGINE_COUNTERS = (
+    "device_stage_batches", "device_grouped_batches", "device_stage_runs",
+    "device_join_batches", "device_topn_runs", "mesh_grouped_runs",
+    "dispatch_coalesced", "coalesce_morsels_in", "bucket_fill_rows",
+    "bucket_capacity_rows", "morsel_resize",
+)
+
+
 class QueryTrace:
     """Accumulates one distributed query's task/shuffle/heartbeat records.
 
@@ -64,6 +75,7 @@ class QueryTrace:
             span_id=result.span_id,
             parent_span_id=task.parent_span_id,
             operator_stats=tuple(result.op_stats),
+            engine_counters=tuple(sorted((result.engine_counters or {}).items())),
         )
         with self._lock:
             self.tasks.append(ts)
@@ -82,6 +94,17 @@ class QueryTrace:
                 v = result.shuffle.get(k, 0)
                 if v:
                     registry().inc(f"shuffle_{k}", int(v))
+        if result.engine_counters:
+            # device-path attribution crosses the process boundary the same
+            # way: a device-leased worker's dispatches/coalescing land in the
+            # driver's per-query diff (distributed EXPLAIN ANALYZE engine
+            # counters, QueryEnd.metrics, bench snapshot). Curated list —
+            # shuffle counters are mirrored above from result.shuffle, and
+            # gauges don't sum across processes.
+            for k in _MIRRORED_ENGINE_COUNTERS:
+                v = result.engine_counters.get(k, 0)
+                if v:
+                    registry().inc(k, int(v))
 
     def add_heartbeat(self, hb: dict) -> None:
         rec = WorkerHeartbeat(

@@ -91,12 +91,15 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
     from . import shuffle as shf
 
     collector = recorder = None
+    reg_before = None
     if task.collect_stats:
+        from ..observability.metrics import registry
         from ..observability.otlp import _span_id
         from ..observability.runtime_stats import StatsCollector, set_collector
 
         collector = StatsCollector()
         recorder = shf.ShuffleRecorder()
+        reg_before = registry().snapshot()
         set_collector(collector)
         shf.set_recorder(recorder)
     started_at = time.time()
@@ -115,6 +118,12 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
             res.shuffle = recorder.as_dict()
             res.span_id = _span_id(task.trace_id or task.task_id,
                                    "task", task.task_id)
+            from ..observability.metrics import registry
+
+            # which engine paths THIS task took in THIS process (device
+            # dispatches, coalescing, HBM traffic) — per-operator stats can't
+            # carry that; see TaskResult.engine_counters
+            res.engine_counters = registry().diff(reg_before)
         return res
     finally:
         if task.collect_stats:
@@ -388,6 +397,22 @@ class WorkerPool:
         self._listener = Listener(sock, family="AF_UNIX", authkey=authkey)
         env = dict(env or {})
         env["DAFT_TPU_WORKER_AUTHKEY"] = authkey.hex()
+        # Batching/coalescing config plumbing: workers read ExecutionConfig
+        # from THEIR environment, so a driver-side set_execution_config(...)
+        # (not expressed as env vars) would silently not reach sub-plans.
+        # Mirror the driver's effective knobs into the children; an explicit
+        # `env=` entry passed by the caller still wins (setdefault). Like the
+        # device lease below, the knobs are FIXED at pool construction
+        # (subprocess env): a config change after the pool exists applies to
+        # driver-side planning/costing but not to already-spawned workers —
+        # recreate the runner/pool to re-lease the new knobs.
+        from ..config import execution_config
+
+        cfg = execution_config()
+        env.setdefault("DAFT_TPU_BATCHING", cfg.batching_mode)
+        env.setdefault("DAFT_TPU_BATCH_FILL", str(cfg.batch_fill_target))
+        env.setdefault("DAFT_TPU_BATCH_LATENCY_MS", str(cfg.batch_latency_ms))
+        env.setdefault("DAFT_TPU_MORSEL_SIZE", str(cfg.morsel_size_rows))
         from ..utils.sockets import DeadlineAcceptor
 
         acceptor = DeadlineAcceptor(self._listener)

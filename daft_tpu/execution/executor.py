@@ -71,14 +71,27 @@ def _map_op(stream: Iterator[MicroPartition], fn) -> Iterator[MicroPartition]:
     oversized partitions into zero-copy slices and fan out across the compute
     pool, yielding in order (reference: intermediate_op.rs:45-59 — every
     intermediate op runs N concurrent workers over morsels). Off mode: plain
-    sequential map."""
+    sequential map.
+
+    Morsel sizing consults the configured BatchingStrategy
+    (execution/batching.py): "static" keeps the fixed cfg.morsel_size_rows on
+    the exact pre-strategy code path (no strategy allocation — the tier-1
+    zero-overhead guarantee); "dynamic"/"latency" give this operator its own
+    feedback-driven strategy, fed per-morsel timings by pmap_stream."""
     from ..config import execution_config
 
     if _pipeline_on():
         from .pipeline import morsel_stream, pmap_stream
 
         cfg = execution_config()
-        yield from pmap_stream(morsel_stream(stream, cfg.morsel_size_rows), fn)
+        if cfg.batching_mode == "static":
+            yield from pmap_stream(morsel_stream(stream, cfg.morsel_size_rows), fn)
+        else:
+            from .batching import adaptive_morsel_stream, make_strategy
+
+            strat = make_strategy(cfg)
+            yield from pmap_stream(adaptive_morsel_stream(stream, strat), fn,
+                                   strategy=strat)
     else:
         for i, part in enumerate(stream):
             yield fn(part, i)
@@ -404,7 +417,15 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
     raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")
 
 
-_MORSEL_ROWS = 256 * 1024
+def _agg_morsel_rows() -> int:
+    """Morsel size for the partial-agg splitter in _two_phase_agg — the
+    config's morsel_size_rows (the batching strategies also initialize from
+    it). Was a hardcoded 256Ki that silently drifted from the 128Ki config
+    default and ignored DAFT_TPU_MORSEL_SIZE."""
+    from ..config import execution_config
+
+    return max(execution_config().morsel_size_rows, 1)
+
 
 # Operators that run as their own concurrent stage in pipeline mode. Excluded:
 # InMemoryScan (yields references), PhysConcat (pass-through), PhysLimit/TopN/
@@ -438,12 +459,22 @@ def _exec_device_agg(node) -> MicroPartition:
     if cfg.device_mode == "auto":
         first = next(stream, None)
         if first is not None:
-            stream = itertools.chain([first], stream)
+            second = None
             if first.num_rows >= cfg.device_min_rows:
                 import jax
 
                 if jax.default_backend() not in ("cpu",):
-                    use_device = _device_wins(node, first, grouped)
+                    from .batching import coalesce_target_rows
+
+                    if coalesce_target_rows(cfg) > 0:
+                        # peek one partition further: observed second-
+                        # partition morsels widen the coalesce horizon in
+                        # the cost decision (skipped when coalescing is off)
+                        second = next(stream, None)
+                    use_device = _device_wins(node, first, grouped,
+                                              second=second)
+            stream = itertools.chain(
+                [first] if second is None else [first, second], stream)
 
     def _host_agg(s):
         if node.predicate is not None:
@@ -471,6 +502,8 @@ def _exec_device_agg(node) -> MicroPartition:
             in_schema, node.predicate, node.groupby, node.aggregations)
         assert stage is not None, "planner emitted DeviceGroupedAgg for a non-qualifying plan"
         run = stage.start_run()
+        coal = _make_coalescer(run.feed_batch, cfg)
+        feed = coal.add if coal is not None else run.feed_batch
         buffered: List[MicroPartition] = []
         try:
             # pin the query's resident planes so a tight HBM budget cannot
@@ -479,7 +512,9 @@ def _exec_device_agg(node) -> MicroPartition:
                 for part in stream:
                     buffered.append(part)
                     for b in part.batches:
-                        run.feed_batch(b)
+                        feed(b)
+                if coal is not None:
+                    coal.close()
                 key_rows, results = run.finalize()
         except DeviceFallback:
             # runtime shape outside the device kernel envelope (e.g. group count
@@ -494,10 +529,14 @@ def _exec_device_agg(node) -> MicroPartition:
     stage = try_build_filter_agg_stage(in_schema, node.predicate, node.aggregations)
     assert stage is not None, "planner emitted DeviceFilterAgg for a non-qualifying plan"
     run = stage.start_run()
+    coal = _make_coalescer(run.feed_batch, cfg)
+    feed = coal.add if coal is not None else run.feed_batch
     with _residency().pin_scope():
         for part in stream:
             for b in part.batches:
-                run.feed_batch(b)
+                feed(b)
+        if coal is not None:
+            coal.close()
         final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
@@ -505,6 +544,23 @@ def _exec_device_agg(node) -> MicroPartition:
         cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
     out = RecordBatch(node.schema, cols, 1)
     return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+def _make_coalescer(feed, cfg):
+    """DispatchCoalescer for one device stage run (ops/stage.py), or None when
+    coalescing is disabled (batch_fill_target == 0) — morsels then dispatch
+    one-to-one, the pre-coalescing behavior. The flush threshold
+    (batching.coalesce_target_rows) makes one compiled dispatch cover N small
+    morsels with its bucket at least batch_fill_target full."""
+    from .batching import coalesce_target_rows
+
+    target = coalesce_target_rows(cfg)
+    if target <= 0:
+        return None
+    from ..ops.stage import DispatchCoalescer
+
+    return DispatchCoalescer(feed, target_rows=target,
+                             latency_s=cfg.batch_latency_ms / 1e3)
 
 
 def _exec_device_join_agg(node) -> MicroPartition:
@@ -611,7 +667,6 @@ def _run_device_join(node, label: str, make_run, assemble,
         if first is None:
             raw_stream.close()
             return _host()
-        fact_stream = itertools.chain([first], raw_stream)
         if cfg.device_mode == "auto" and first.num_rows < cfg.device_min_rows:
             _counters.reject("cost", f"{label}: below device_min_rows",
                              f"({first.num_rows} rows)")
@@ -619,12 +674,33 @@ def _run_device_join(node, label: str, make_run, assemble,
             return _host()
         # a previously-rejected query shape skips dim materialization + the
         # sampled-cardinality estimate entirely (repeated interactive queries
-        # must not pay the decision machinery per run)
-        dk = _decision_key(node, first.num_rows, cfg, topn)
+        # must not pay the decision machinery per run). The coalesce horizon
+        # is data-dependent, so the fact's FIRST-partition batch layout is
+        # part of the cached verdict's identity — the same shape arriving as
+        # one big batch vs eight small ones is a DIFFERENT costed decision.
+        # The layout signature is computable without the second-partition
+        # peek below, so cached-reject repeats pay for NO extra partition.
+        dk = _decision_key(node, first.num_rows, cfg, topn,
+                           _batch_layout(first))
         if cfg.device_mode == "auto" and _DECISION_CACHE.get(dk) is False:
             _counters.reject("cost", f"{label}: host wins (cached decision)")
             raw_stream.close()
             return _host()
+        second = None
+        if cfg.device_mode == "auto" and not topn:
+            from .batching import coalesce_target_rows
+
+            if coalesce_target_rows(cfg) > 0:
+                # peek one partition further (cached REJECTS returned above
+                # without paying this; cached accepts consume the stream on
+                # the device path anyway): observed second-partition morsels
+                # widen the coalesce horizon. Skipped entirely when
+                # coalescing is disabled — the horizon is 1.0 regardless.
+                second = next(raw_stream, None)
+        fact_stream = itertools.chain(
+            [first] if second is None else [first, second], raw_stream)
+        coal = 1.0 if topn else _coalesce_horizon(
+            [first] if second is None else [first, second])
         dim_batches = {}
         for name, plan in node.dim_plans:
             dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
@@ -633,7 +709,7 @@ def _run_device_join(node, label: str, make_run, assemble,
             batch0 = next((b for b in first.batches if b.num_rows > 0), None)
             wins = batch0 is not None and _join_device_wins(
                 node, ctx, batch0, first.num_rows, grouped, stage,
-                topn=topn, label=label)
+                topn=topn, label=label, coalesce=coal)
             _DECISION_CACHE[dk] = wins
             if len(_DECISION_CACHE) > 512:
                 _DECISION_CACHE.pop(next(iter(_DECISION_CACHE)))
@@ -663,9 +739,17 @@ def _run_device_join(node, label: str, make_run, assemble,
                 if first_b is not None:
                     run.feed_batch(first_b)
             else:
+                # coalesce fact morsels like the agg paths: one gather-join
+                # dispatch per super-batch. Single-batch facts (the resident-
+                # table repeat-query case) pass through identity-preserving,
+                # so series_keyed caches on the stored batch still hit.
+                coalescer = _make_coalescer(run.feed_batch, cfg)
+                feed = coalescer.add if coalescer is not None else run.feed_batch
                 for part in fact_stream:
                     for b in part.batches:
-                        run.feed_batch(b)
+                        feed(b)
+                if coalescer is not None:
+                    coalescer.close()
             return assemble(run, stage, grouped)
     except DeviceFallback as e:
         _counters.reject("runtime", f"{label}: device fallback", str(e))
@@ -676,12 +760,38 @@ def _run_device_join(node, label: str, make_run, assemble,
 _DECISION_CACHE: dict = {}
 
 
-def _decision_key(node, rows: int, cfg, topn: bool) -> tuple:
+def _batch_layout(part: MicroPartition) -> tuple:
+    """Batch-granularity signature of one partition: (nonempty batch count,
+    mean batch rows padded to its bucket). The coalesce horizon derives from
+    this, so it identifies a cached cost verdict without needing the
+    second-partition peek."""
+    from ..ops.stage import pad_bucket
+
+    sizes = [b.num_rows for b in part.batches if b.num_rows > 0]
+    if not sizes:
+        return (0, 0)
+    return (len(sizes), pad_bucket(int(sum(sizes) / len(sizes))))
+
+
+def _decision_key(node, rows: int, cfg, topn: bool, layout: tuple) -> tuple:
     """Structural identity of one cost decision: the captured spec's shape +
-    input size + the config knobs the decision reads."""
+    input size + the config knobs the decision reads + the data-dependent
+    fact batch layout the coalesce horizon derives from.
+
+    The cache is a repeat-query heuristic, not an exact memo: inputs that
+    would require paying the decision machinery per run are deliberately NOT
+    keyed — the second-partition peek (whether the stream continues past the
+    first partition) and the live HBM residency picture both shift the
+    costs, and a repeat whose tail or residency differs reuses the prior
+    verdict. Both paths stay correct; only placement can be stale, and a
+    config change to any keyed knob re-decides."""
     spec = node.spec
     return (
         topn, rows, cfg.device_mode, cfg.device_amortize_runs,
+        # the coalescing horizon feeds the costed decision: a config change to
+        # the coalescer knobs OR a different fact batch layout must re-decide,
+        # not hit a stale cached verdict
+        cfg.batch_fill_target, cfg.morsel_size_rows, layout,
         repr(spec.predicate),
         tuple(repr(g) for g in spec.groupby),
         tuple(repr(a) for a in spec.aggregations),
@@ -696,7 +806,8 @@ def _decision_key(node, rows: int, cfg, topn: bool) -> tuple:
 
 
 def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
-                      topn: bool = False, label: str = "join agg") -> bool:
+                      topn: bool = False, label: str = "join agg",
+                      coalesce: float = 1.0) -> bool:
     """Cost-model decision for a DeviceJoinAgg node (see ops/costmodel.py).
 
     One-time investments (fact column uploads, index planes, joined-key
@@ -712,6 +823,11 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
     spec = node.spec
     cal = costmodel.calibrate()
     bucket = pad_bucket(batch.num_rows)
+    # coalesce horizon computed by the caller (from the fact's batch layout;
+    # 1.0 for TopN — its one-batch fact can never coalesce, so pricing an
+    # amortized RTT would flip marginal host-wins shapes to a device run
+    # that pays the full round trip, and cache the wrong verdict)
+    coal = max(coalesce, 1.0)
     amort = max(execution_config().device_amortize_runs, 1) \
         if _resident_source_rec(node.fact) else 1
 
@@ -765,7 +881,7 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         nonres += bucket * 4                   # codes plane (host-factorize case)
         dev_cost = costmodel.device_join_agg_cost(
             cal, rows, nonres // amort, n_gathers, n_mm, n_ext, n_sct,
-            cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS)
+            cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal)
         if topn:
             # device multi-key sort over the cap-length planes
             nkeys = len(node.topn.keys) + 2
@@ -783,7 +899,7 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         fetch = 256 * max(len(stage.aggs), 1)
         dev_cost = costmodel.device_join_agg_cost(
             cal, rows, nonres // amort, n_gathers, max(len(stage.aggs), 1),
-            0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS)
+            0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal)
         host_cost = costmodel.host_join_agg_cost(
             cal, host_rows, len(spec.dims), len(stage.aggs), False, False)
         if spec.predicate is not None:
@@ -895,7 +1011,8 @@ def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
                            ordered_keys, out_cols)
 
 
-def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
+def _device_wins(node, first: MicroPartition, grouped: bool,
+                 second: Optional[MicroPartition] = None) -> bool:
     """Cost-model decision for one device-agg stage based on the first morsel.
 
     One-time cacheable costs (column upload, key-dictionary builds) amortize
@@ -911,6 +1028,7 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
         return False
     rows = first.num_rows
     cal = costmodel.calibrate()
+    coal = _coalesce_horizon([first] if second is None else [first, second])
 
     def _resident_source(n) -> bool:
         while n is not None:
@@ -956,12 +1074,12 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
                         + len(stage._sct_specs))
             dev_cost = costmodel.device_grouped_sort_cost(
                 cal, rows, nonres // amort, n_planes=n_planes,
-                factorize_rows=factorize_cost_rows)
+                factorize_rows=factorize_cost_rows, coalesce=coal)
         else:
             dev_cost = costmodel.device_grouped_cost(
                 cal, rows, nonres // amort, n_mm=len(stage._mm_specs),
                 n_ext=len(stage._ext_specs), n_sct=len(stage._sct_specs),
-                cap=cap_est, factorize_rows=factorize_cost_rows)
+                cap=cap_est, factorize_rows=factorize_cost_rows, coalesce=coal)
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=True,
             has_predicate=node.predicate is not None)
@@ -978,11 +1096,53 @@ def _device_wins(node, first: MicroPartition, grouped: bool) -> bool:
         for c in stage._input_cols
         if not batch.get_column(c).is_device_resident(bucket, f32=True))
     dev_cost = costmodel.device_ungrouped_cost(
-        cal, rows, nonres // amort, n_partials=max(len(stage.aggs), 1))
+        cal, rows, nonres // amort, n_partials=max(len(stage.aggs), 1),
+        coalesce=coal)
     host_cost = costmodel.host_agg_cost(
         cal, rows, len(node.aggregations), grouped=False,
         has_predicate=node.predicate is not None)
     return dev_cost < host_cost
+
+
+def _coalesce_horizon(parts) -> float:
+    """Expected dispatch-coalescing factor from the OBSERVED leading
+    partitions' batch granularity (`parts`: the first partition, plus a
+    peeked second when the caller got one). The coalescer merges
+    RecordBatches, so the morsel size that matters is the mean nonempty
+    BATCH size, not the partition row count — a 128Ki-row partition of
+    8Ki-row batches genuinely coalesces 8:1 even though the partition
+    itself clears every gate.
+
+    Capped by the TOTAL batch count actually observed: the cost model must
+    never price an RTT amortization the coalescer cannot deliver, so a lone
+    single-batch partition earns no optimism however small, and a confirmed
+    two-partition stream earns at most 2x until more morsels are seen
+    (conservative for long streams — the decision only needs to be right
+    within ~2x, and under-promising keeps marginal shapes on the safe host
+    side). The horizon also assumes morsels arrive within batch_latency_ms
+    of each other; a trickling stream flushes on the deadline and realizes
+    less amortization than priced — inter-arrival times are unknowable
+    before execution, so that optimism is accepted and bounded by the
+    observed-morsel cap. Note the repeat-query direction is conservative
+    too: planes a
+    prior COALESCED run left resident anchor on the concatenated super-batch
+    (reached via content-addressed rebind at upload time), which the
+    per-batch residency probes here cannot see, so repeat uploads price at
+    full h2d even when the rebind makes them free. 1.0 when coalescing is
+    disabled."""
+    from ..config import execution_config
+    from ..ops.costmodel import expected_coalesce_factor
+    from .batching import coalesce_target_rows
+
+    cfg = execution_config()
+    target = coalesce_target_rows(cfg)
+    if target <= 0:
+        return 1.0
+    sizes = [b.num_rows for p in parts for b in p.batches if b.num_rows > 0]
+    if len(sizes) <= 1:
+        return 1.0
+    mean_rows = int(sum(sizes) / len(sizes))
+    return min(expected_coalesce_factor(mean_rows, target), float(len(sizes)))
 
 
 
@@ -1032,15 +1192,16 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
                 else rel.grouped_agg(big, groupby, aggs)
         # small total input or unsplittable aggs: one-phase in memory
         total_rows = sum(b.num_rows for b in batches)
-        if split is None or total_rows <= _MORSEL_ROWS:
+        morsel_rows = _agg_morsel_rows()
+        if split is None or total_rows <= morsel_rows:
             big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
             return rel.ungrouped_agg(big, aggs) if ungrouped \
                 else rel.grouped_agg(big, groupby, aggs)
         # re-chunk into morsels so partials parallelize even for one big batch
         if len(batches) == 1:
             b = batches[0]
-            batches = [b.slice(s, s + _MORSEL_ROWS)
-                       for s in range(0, b.num_rows, _MORSEL_ROWS)]
+            batches = [b.slice(s, s + morsel_rows)
+                       for s in range(0, b.num_rows, morsel_rows)]
         if ungrouped:
             partials = pool_map(lambda b: rel.ungrouped_agg(b, split.partial), batches)
             final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)

@@ -125,17 +125,33 @@ def spawn_stage(gen: Iterator, maxsize: int = 4) -> Iterator:
     return consume()
 
 
-def pmap_stream(stream: Iterator, fn: Callable, window: int = 0) -> Iterator:
+def pmap_stream(stream: Iterator, fn: Callable, window: int = 0,
+                strategy=None) -> Iterator:
     """Ordered parallel map over a stream: keep up to `window` fn(item, index)
     calls in flight on the shared compute pool, yielding results in input
     order. While the window is full this thread blocks on the OLDEST future,
     so upstream production, pool workers, and downstream consumption overlap.
+
+    `strategy` (an execution.batching.BatchingStrategy): each morsel's rows
+    and processing wall time are fed back via strategy.record() from the pool
+    worker that ran it, closing the adaptive-batching feedback loop. None
+    (static mode) adds nothing to the per-morsel path.
     """
     from ..utils.pool import compute_pool
 
     pool = compute_pool()
     if window <= 0:
         window = pool._max_workers
+    if strategy is not None:
+        import time
+
+        inner = fn
+
+        def fn(item, i):  # noqa: F811 — timed wrapper around the caller's fn
+            t0 = time.perf_counter()
+            out = inner(item, i)
+            strategy.record(item.num_rows, time.perf_counter() - t0)
+            return out
     futs: deque = deque()
     try:
         for i, item in enumerate(stream):

@@ -19,8 +19,10 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # branch on it. v1: implicit (no field). v2: adds schema_version to every
 # record plus the distributed task_stats/shuffle_stats/worker_heartbeat kinds
 # and query_end.metrics. v3: worker_heartbeat gains hbm_h2d_bytes +
-# hbm_digest_entries (cache-affinity scheduling observability).
-SCHEMA_VERSION = 3
+# hbm_digest_entries (cache-affinity scheduling observability). v4:
+# task_stats gains engine_counters (per-task worker registry deltas — device
+# dispatches, coalescing, HBM traffic).
+SCHEMA_VERSION = 4
 
 
 class EventLogSubscriber(Subscriber):

@@ -52,6 +52,9 @@ class TaskStats:
     span_id: str = ""
     parent_span_id: str = ""
     operator_stats: Tuple[OperatorStats, ...] = ()
+    # worker metrics-registry counter deltas over the task (device dispatches,
+    # coalescing, HBM traffic) — proves WHICH engine path the worker took
+    engine_counters: Tuple[Tuple[str, float], ...] = ()
 
 
 @dataclass(frozen=True)

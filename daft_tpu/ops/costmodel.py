@@ -14,6 +14,13 @@ environment-specific terms are measured live on the actual device link:
   planes survived eviction are priced with ZERO transfer bytes and first
   touches amortize over ExecutionConfig.device_amortize_runs.
 
+The fixed per-dispatch ``rtt_s`` is additionally divided by the expected
+COALESCE horizon (``expected_coalesce_factor``): the executor's
+DispatchCoalescer (ops/stage.py) concatenates incoming morsels into
+bucket-filling super-batches, so one compiled dispatch covers N morsels and
+its round trip amortizes N-fold — query shapes that were marginal rejections
+(a full RTT per half-empty morsel) flip to the device honestly.
+
 Compute-rate terms are constants measured on v5e (overridable via env):
 matmul segment-reduction streams ~5e9 plane-rows/s, scatter segment ops
 ~1e8 rows/s (TPU scatter serializes — why the grouped stage avoids it), host
@@ -152,11 +159,31 @@ def rebuild_cost_estimate(nbytes: int, factorize_rows: int = 0) -> float:
     return nbytes / h2d + factorize_rows / fact
 
 
+_COALESCE_CAP = 64.0
+
+
+def expected_coalesce_factor(first_rows: int, target_rows: int) -> float:
+    """How many incoming morsels one coalesced device dispatch is expected to
+    cover, from the first morsel's size and the coalescer's flush threshold
+    (batch_fill_target × the power-of-two bucket at the configured morsel
+    size — see executor._make_coalescer / stage.DispatchCoalescer).
+
+    The device cost functions divide their fixed per-dispatch price by this
+    horizon: a stream of small morsels that each lose to the host on a full
+    RTT can honestly win once one dispatch covers N of them. Bucket-filling
+    morsels (first_rows >= target) coalesce 1:1 — no optimism for inputs the
+    coalescer cannot help. Capped like device_amortize_runs so a degenerate
+    first morsel cannot promise an unbounded horizon."""
+    if target_rows <= 0 or first_rows <= 0:
+        return 1.0
+    return float(min(max(target_rows / first_rows, 1.0), _COALESCE_CAP))
+
+
 def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                         n_mm: int, n_ext: int, n_sct: int, cap: int,
-                        factorize_rows: int) -> float:
+                        factorize_rows: int, coalesce: float = 1.0) -> float:
     cap = max(cap, 8)
-    return (cal.rtt_s
+    return (cal.rtt_s / max(coalesce, 1.0)
             + nonresident_bytes / cal.h2d_bytes_per_s
             # one-hot matmul work scales with rows x segments x planes
             + rows * cap * n_mm / cal.mm_cell_rate
@@ -166,14 +193,15 @@ def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
 
 
 def device_grouped_sort_cost(cal: Calibration, rows: int, nonresident_bytes: int,
-                             n_planes: int, factorize_rows: int) -> float:
+                             n_planes: int, factorize_rows: int,
+                             coalesce: float = 1.0) -> float:
     """High-cardinality path (grouped_stage._build_sorted): argsort + one
     segmented scan per plane — O(n log n) sort plus O(n) per plane, no
     one-hot cells."""
     import math
 
     logn = max(math.log2(max(rows, 2)), 1.0)
-    return (cal.rtt_s
+    return (cal.rtt_s / max(coalesce, 1.0)
             + nonresident_bytes / cal.h2d_bytes_per_s
             + rows * logn / cal.mm_plane_rows_per_s      # bitonic sort passes
             + rows * max(n_planes, 1) / cal.mm_plane_rows_per_s
@@ -181,8 +209,8 @@ def device_grouped_sort_cost(cal: Calibration, rows: int, nonresident_bytes: int
 
 
 def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
-                          n_partials: int) -> float:
-    return (cal.rtt_s
+                          n_partials: int, coalesce: float = 1.0) -> float:
+    return (cal.rtt_s / max(coalesce, 1.0)
             + nonresident_bytes / cal.h2d_bytes_per_s
             + rows * n_partials / cal.mm_plane_rows_per_s)
 
@@ -190,14 +218,16 @@ def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
 def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
                          n_gathers: int, n_mm: int, n_ext: int, n_sct: int,
                          cap_est: int, fetch_bytes: int,
-                         factorize_rows: int, matmul_ceiling: int = 4096) -> float:
-    """One gather-join + aggregate device run: fixed round trip + amortized
-    uploads + per-dim gathers + the segment reduction (matmul cells below the
-    ceiling, sort passes above) + the finalize fetch + amortized host
-    factorize work (join indices / joined-key codes)."""
+                         factorize_rows: int, matmul_ceiling: int = 4096,
+                         coalesce: float = 1.0) -> float:
+    """One gather-join + aggregate device run: fixed round trip (amortized
+    over the expected coalesce horizon) + amortized uploads + per-dim gathers
+    + the segment reduction (matmul cells below the ceiling, sort passes
+    above) + the finalize fetch + amortized host factorize work (join
+    indices / joined-key codes)."""
     import math
 
-    c = (cal.rtt_s
+    c = (cal.rtt_s / max(coalesce, 1.0)
          + upload_bytes / cal.h2d_bytes_per_s
          + n_gathers * rows / cal.mm_plane_rows_per_s
          + factorize_rows / cal.host_factorize_rate

@@ -29,6 +29,13 @@ COUNTER_NAMES = (
     "device_join_batches",     # batches through the gather-join device stages
     "device_topn_runs",        # join+agg+TopN fused device programs completed
     "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
+    # adaptive batching + device dispatch coalescing (execution/batching.py,
+    # ops/stage.py DispatchCoalescer)
+    "dispatch_coalesced",      # super-batch dispatches issued by the coalescer
+    "coalesce_morsels_in",     # morsels the coalescer consumed (÷ dispatch_coalesced = amortization)
+    "bucket_fill_rows",        # real rows covered by coalesced dispatches
+    "bucket_capacity_rows",    # padded bucket rows of those dispatches (fill ratio denominator)
+    "morsel_resize",           # adaptive batching morsel-size changes
     # HBM residency manager (daft_tpu/device/residency.py)
     "hbm_cache_hits",          # residency lookups served from HBM
     "hbm_cache_misses",        # residency lookups that built/uploaded
@@ -87,7 +94,9 @@ def reset() -> None:
     """Zero the DEVICE counters and the rejection record (test/bench hook).
     Scoped to COUNTER_NAMES: other subsystems' registry counters (shuffle,
     fetch server) are not this module's to wipe — full wipes go through
-    registry().reset(); per-query attribution uses snapshot/diff instead."""
-    registry().reset(COUNTER_NAMES)
+    registry().reset(); per-query attribution uses snapshot/diff instead.
+    The bucket_fill_ratio GAUGE (derived from the coalescing counters) is
+    dropped along with them so a reset can't leave a stale ratio behind."""
+    registry().reset(COUNTER_NAMES + ("bucket_fill_ratio",))
     rejections.clear()
     rejection_log.clear()

@@ -69,7 +69,7 @@ def test_distributed_event_log_has_tasks_shuffles_heartbeats(dist_runner, tmp_pa
         disable_event_log(sub)
 
     events = [json.loads(l) for l in open(p)]
-    assert all(e["schema_version"] == 3 for e in events)
+    assert all(e["schema_version"] == 4 for e in events)
     by_kind = {}
     for e in events:
         by_kind.setdefault(e["event"], []).append(e)
@@ -87,6 +87,8 @@ def test_distributed_event_log_has_tasks_shuffles_heartbeats(dist_runner, tmp_pa
     assert sum(t["rows_out"] for t in tasks) >= 50
     # worker-side operator stats rode along
     assert any(t["operator_stats"] for t in tasks)
+    # v4: per-task worker engine-counter deltas ship in the record
+    assert all("engine_counters" in t for t in tasks)
 
     # per-stage shuffle byte counters
     shuffles = by_kind["shuffle_stats"]
