@@ -14,6 +14,11 @@ Environment knobs:
     BENCH_REPS=5          timed repetitions (best-of; tunnel jitter guard)
     BENCH_SUITE=tpcds     run the TPC-DS store-sales suite instead of TPC-H
                           (benchmarking/tpcds; default queries 3,7,19,42,52,55,96)
+    BENCH_SHUFFLE=1       run the 2-worker shuffle microbench instead: a
+                          socket-transport distributed groupby whose JSON
+                          carries the wire/logical byte counters and the
+                          derived compression/overlap ratios
+    BENCH_SHUFFLE_ROWS=N  microbench fact rows (default 200_000)
 
 The run reports which engine paths actually executed: device_batches counts
 real XLA dispatches of the TPU agg/join stages (ops/counters.py), so a number
@@ -40,7 +45,77 @@ QUERIES = [int(x) for x in os.environ.get(
 REPS = int(os.environ.get("BENCH_REPS", 5))
 
 
+def _derive_shuffle_ratios(metric_totals: dict) -> None:
+    """Attach the derived shuffle transport ratios wherever the raw counters
+    landed, so a capture round can attribute wire savings without
+    post-processing: compression = wire/logical bytes written (< 1 means the
+    codec paid), overlap = overlapped transfer seconds / cumulative fetch
+    seconds (> 0 means the pipelined fan-in actually overlapped transfers)."""
+    wire = metric_totals.get("shuffle_wire_bytes", 0)
+    logical = metric_totals.get("shuffle_logical_bytes", 0)
+    if logical:
+        metric_totals["shuffle_compression_ratio"] = round(wire / logical, 4)
+    cum = metric_totals.get("shuffle_fetch_seconds", 0.0)
+    overlap = metric_totals.get("shuffle_overlap_seconds", 0.0)
+    if cum:
+        metric_totals["shuffle_overlap_ratio"] = round(overlap / cum, 4)
+
+
+def shuffle_microbench() -> None:
+    """2-worker socket-transport shuffle microbench (BENCH_SHUFFLE=1): a
+    distributed groupby that crosses the pipelined compressed shuffle, traced
+    so worker-side transport counters are re-homed into the driver registry.
+    Prints the same one-JSON-line contract as the main bench."""
+    import daft_tpu
+    from daft_tpu.distributed.runner import DistributedRunner
+    from daft_tpu import col
+    from daft_tpu.observability.metrics import registry
+    from daft_tpu.observability.runtime_stats import (StatsCollector,
+                                                      set_collector)
+
+    n = int(os.environ.get("BENCH_SHUFFLE_ROWS", 200_000))
+    df = daft_tpu.from_pydict({
+        "k": [i % 997 for i in range(n)],
+        "v": [float(i % 8191) for i in range(n)],
+        "w": [i % 31 for i in range(n)],
+    })
+    q = df.groupby("k").agg(col("v").sum().alias("s"),
+                            col("w").max().alias("mw"))
+    runner = DistributedRunner(num_workers=2, n_partitions=4,
+                               shuffle_transport="socket")
+    try:
+        before = registry().snapshot()
+        collector = StatsCollector()  # forces traced tasks -> shuffle counters
+        elapsed = float("inf")
+        for _ in range(REPS):
+            set_collector(collector)
+            try:
+                t0 = time.perf_counter()
+                rows = sum(p.num_rows for p in runner.run(q._builder))
+                elapsed = min(elapsed, time.perf_counter() - t0)
+            finally:
+                set_collector(None)
+        metric_totals = {k: v for k, v in registry().diff(before).items()
+                         if k.startswith("shuffle_")}
+        _derive_shuffle_ratios(metric_totals)
+        print(json.dumps({
+            "metric": "shuffle_microbench_rows_per_sec",
+            "value": round(n / elapsed, 1),
+            "unit": "rows/sec",
+            "vs_baseline": round((n / elapsed) / BASELINE_ROWS_PER_SEC, 4),
+            "group_rows": rows,
+            "fact_rows": n,
+            "reps": REPS,
+            "metrics": metric_totals,
+        }))
+    finally:
+        runner.shutdown()
+
+
 def main() -> None:
+    if os.environ.get("BENCH_SHUFFLE"):
+        shuffle_microbench()
+        return
     if SUITE == "tpcds":
         from benchmarking.tpcds.datagen import load_dataframes
         from benchmarking.tpcds.queries import ALL_QUERIES
@@ -124,6 +199,11 @@ def main() -> None:
     if morsels_in:
         metric_totals["dispatch_rtts_saved"] = int(
             morsels_in - metric_totals.get("dispatch_coalesced", 0))
+
+    # Shuffle transport attribution: compression + overlap ratios derived
+    # from the wire/logical byte and cumulative/overlap second counters
+    # (only present when the capture crossed a distributed shuffle).
+    _derive_shuffle_ratios(metric_totals)
 
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({

@@ -102,6 +102,33 @@ class ExecutionConfig:
     batch_latency_ms: float = field(
         default_factory=lambda: _env_float("DAFT_TPU_BATCH_LATENCY_MS", 50.0)
     )
+    # Shuffle transport (distributed/shuffle.py + fetch_server.py) ------------
+    # Arrow IPC body compression for shuffle map files: "lz4" (default — fast
+    # codec, typically 1.5-3x on analytic columns), "zstd" (denser, slower),
+    # or "none" (raw buffers, the pre-compression wire format). Readers
+    # auto-detect from the IPC message headers, so mixed-codec shuffle dirs
+    # decode fine; the knob only governs what NEW map files are written with.
+    shuffle_compression: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_SHUFFLE_COMPRESSION", "lz4")
+    )
+    # Reduce-side fan-in: how many fetch connections one `fetch_partition`
+    # drives concurrently (thread-per-connection, endpoints round-robined
+    # across them). 1 with shuffle_prefetch_batches=0 is the serial
+    # compatibility path: one endpoint at a time, one request at a time, no
+    # queue and no threads (bit-identical to the pre-pipelining transport).
+    shuffle_fetch_parallelism: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM", 4)
+    )
+    # Bounded prefetch queue between the fetch threads and the reduce
+    # iterator: decoded shuffle batches buffered ahead of reduce compute.
+    # Network transfer overlaps reduce work up to this depth, and the queue
+    # (not the map-file size) bounds reduce-side fetch memory. 0 TOGETHER
+    # with shuffle_fetch_parallelism=1 selects the fully-inline serial path
+    # (no threads, no queue); with parallelism > 1 the threaded fan-in still
+    # runs, degraded to a depth-1 handoff queue.
+    shuffle_prefetch_batches: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_SHUFFLE_PREFETCH", 8)
+    )
     # Broadcast-join threshold (reference: 10MiB). Gates DISTRIBUTED broadcast
     # joins (distributed/planner.py); local planning builds on the smaller
     # side unconditionally (plan/physical.py inner-join swap) and does not
@@ -159,6 +186,20 @@ class ExecutionConfig:
             raise ValueError(
                 f"batch_latency_ms must be positive, got "
                 f"{self.batch_latency_ms!r} (check DAFT_TPU_BATCH_LATENCY_MS)")
+        if self.shuffle_compression not in ("none", "lz4", "zstd"):
+            raise ValueError(
+                f"shuffle_compression must be one of 'none'/'lz4'/'zstd', got "
+                f"{self.shuffle_compression!r} (check DAFT_TPU_SHUFFLE_COMPRESSION)")
+        if self.shuffle_fetch_parallelism < 1:
+            raise ValueError(
+                f"shuffle_fetch_parallelism must be >= 1, got "
+                f"{self.shuffle_fetch_parallelism!r} "
+                f"(check DAFT_TPU_SHUFFLE_FETCH_PARALLELISM)")
+        if self.shuffle_prefetch_batches < 0:
+            raise ValueError(
+                f"shuffle_prefetch_batches must be >= 0 (0 disables prefetch), "
+                f"got {self.shuffle_prefetch_batches!r} "
+                f"(check DAFT_TPU_SHUFFLE_PREFETCH)")
 
 
 _default: Optional[ExecutionConfig] = None

@@ -4,7 +4,8 @@ Reference parity: src/daft-shuffles/src/server/flight_server.rs:72 (Arrow
 Flight `do_get` streams one shuffle partition's files) + client/fetch.rs
 fan-in. Here the transport is a multiprocessing.connection TCP listener —
 the same HMAC challenge/response machinery the worker tier already uses —
-serving the Arrow-IPC files written by MapOutputWriter (shuffle.py).
+serving the compressed Arrow-IPC stream files written by MapOutputWriter
+(shuffle.py).
 
 Topology: every host that runs map tasks starts one ShuffleFetchServer over
 its local shuffle directory; reduce tasks fetch each partition from EVERY
@@ -12,32 +13,70 @@ endpoint and merge (map outputs for one partition are spread across hosts).
 On a single host there is one endpoint, but the fan-in path is identical.
 
 Protocol (pickle frames over the authenticated connection):
-    -> ("list",  shuffle_id, partition_idx)          <- ("files", [name, ...])
-    -> ("fetch", shuffle_id, partition_idx, name)    <- ("file", bytes)
+    -> ("list",   shuffle_id, partition_idx)         <- ("files", [name, ...])
+    -> ("fetch",  shuffle_id, partition_idx, name)   <- ("file", bytes)
+    -> ("fetchs", shuffle_id, partition_idx, name)   <- ("part", bytes)* ("end", total)
     -> ("bye",)                                       closes the connection
+
+"fetch" ships a whole file in one frame (the serial compatibility path);
+"fetchs" streams it in bounded chunks so the client decodes the first IPC
+batch before the last byte arrives. Requests on one connection are served
+in order, so a client may PIPELINE: send the request for file k+1 while
+still draining file k's chunks — the reply frames never interleave.
+
+The reduce-side fan-in (`fetch_partition`) runs one fetch thread per
+endpoint (capped by ExecutionConfig.shuffle_fetch_parallelism), pipelines
+requests within each connection, and lands decoded batches in a bounded
+queue (shuffle_prefetch_batches) that the reduce iterator drains — network
+transfer overlaps reduce compute with real backpressure. With
+shuffle_fetch_parallelism=1 and shuffle_prefetch_batches=0 the transport
+degrades to the original serial loop: no threads, no queue, one request in
+flight.
 """
 
 from __future__ import annotations
 
+import io
 import os
+import queue as _queue
 import re
 import secrets
 import threading
+import time
+from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Iterator, List, Optional, Tuple
 
-import pyarrow as pa
-import pyarrow.ipc as ipc
-
 from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
+from ..observability.metrics import registry
 from ..schema import Schema
-from .shuffle import partition_dir
+from .shuffle import (_note_fetch, _note_fetch_wall, iter_ipc_batches,
+                      partition_dir)
 
 _SAFE_ID = re.compile(r"^[A-Za-z0-9_\-]+$")
 _SAFE_FILE = re.compile(r"^m\d+\.arrow$")
 
+# chunk size for the streamed "fetchs" reply — big enough to amortize the
+# pickle-frame overhead, small enough that a batch decodes mid-file
+_STREAM_CHUNK = 512 * 1024
+
 Endpoint = Tuple[str, int, str]  # (host, port, authkey_hex)
+
+
+class _FetchAborted(Exception):
+    """Internal: the consumer closed the fetch generator (stop event set);
+    producer threads unwind promptly instead of blocking in recv() forever
+    against a stalled peer — no leaked threads or connection fds."""
+
+
+def _recv_interruptible(conn, stop):
+    """conn.recv() that polls in short slices so a set stop event aborts the
+    wait (a blocking recv would never observe it)."""
+    while not conn.poll(0.1):
+        if stop.is_set():
+            raise _FetchAborted()
+    return conn.recv()
 
 
 class ShuffleFetchServer:
@@ -63,8 +102,6 @@ class ShuffleFetchServer:
         self._threads.append(t)
 
     def _note_request(self, nbytes: int = 0) -> None:
-        from ..observability.metrics import registry
-
         with self._stats_lock:
             self.requests += 1
             self.bytes_served += nbytes
@@ -82,12 +119,20 @@ class ShuffleFetchServer:
         return (host, port, self.authkey.hex())
 
     def _accept_loop(self) -> None:
+        # a rejected handshake (bad auth, reset mid-challenge) is per-client
+        # and cheap to retry; a PERSISTENT accept error (fd exhaustion,
+        # half-closed listener) must not spin the thread hot — back off
+        # exponentially, resetting once an accept succeeds again
+        backoff = 0.005
         while not self._closed:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError, Exception):  # noqa: BLE001 — closed or bad auth
+                backoff = 0.005
+            except (OSError, EOFError, AuthenticationError):
                 if self._closed:
                     return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.25)
                 continue
             threading.Thread(target=self._serve, args=(conn,), daemon=True,
                              name="daft-shuffle-conn").start()
@@ -111,10 +156,21 @@ class ShuffleFetchServer:
                         data = self._read(sid, int(pidx), name)
                         self._note_request(len(data))
                         conn.send(("file", data))
+                    elif msg[0] == "fetchs":
+                        _kind, sid, pidx, name = msg
+                        total = 0
+                        for chunk in self._read_chunks(sid, int(pidx), name):
+                            total += len(chunk)
+                            conn.send(("part", chunk))
+                        conn.send(("end", total))
+                        self._note_request(total)
                     else:
                         conn.send(("error", f"unknown request {msg[0]!r}"))
                 except Exception as e:  # noqa: BLE001 — refuse the request, keep serving
-                    conn.send(("error", f"{type(e).__name__}: {e}"))
+                    try:
+                        conn.send(("error", f"{type(e).__name__}: {e}"))
+                    except (BrokenPipeError, OSError):
+                        return  # client hung up mid-reply (abandoned fetch)
         finally:
             conn.close()
 
@@ -129,11 +185,23 @@ class ShuffleFetchServer:
             return []
         return sorted(n for n in os.listdir(d) if _SAFE_FILE.match(n))
 
-    def _read(self, shuffle_id: str, partition_idx: int, name: str) -> bytes:
+    def _path(self, shuffle_id: str, partition_idx: int, name: str) -> str:
         if not _SAFE_FILE.match(name):
             raise ValueError(f"bad shuffle file name {name!r}")
-        with open(os.path.join(self._pdir(shuffle_id, partition_idx), name), "rb") as f:
+        return os.path.join(self._pdir(shuffle_id, partition_idx), name)
+
+    def _read(self, shuffle_id: str, partition_idx: int, name: str) -> bytes:
+        with open(self._path(shuffle_id, partition_idx, name), "rb") as f:
             return f.read()
+
+    def _read_chunks(self, shuffle_id: str, partition_idx: int,
+                     name: str) -> Iterator[bytes]:
+        with open(self._path(shuffle_id, partition_idx, name), "rb") as f:
+            while True:
+                chunk = f.read(_STREAM_CHUNK)
+                if not chunk:
+                    return
+                yield chunk
 
     def close(self) -> None:
         self._closed = True
@@ -143,16 +211,92 @@ class ShuffleFetchServer:
             pass
 
 
+class _FrameStream(io.RawIOBase):
+    """Readable over one "fetchs" reply: ("part", bytes)* then ("end", total).
+
+    Pulls frames from the connection on demand — the IPC stream reader layered
+    on top decodes batch k while the server is still sending batch k+1's
+    bytes. `drain()` consumes any unread tail so the connection is positioned
+    at the next reply (the pipelined request's frames must never leak into
+    this file's reader or vice versa)."""
+
+    def __init__(self, conn, stop=None):
+        self._conn = conn
+        self._stop = stop
+        self._buf = b""
+        self._eof = False
+        self.total = 0     # wire bytes, valid once the "end" frame was seen
+        self.received = 0  # wire bytes seen so far (partial-fetch accounting)
+
+    def readable(self) -> bool:
+        return True
+
+    def _pump(self) -> None:
+        msg = _recv_interruptible(self._conn, self._stop) \
+            if self._stop is not None else self._conn.recv()
+        kind = msg[0]
+        if kind == "part":
+            self._buf += msg[1]
+            self.received += len(msg[1])
+        elif kind == "end":
+            self._eof = True
+            self.total = int(msg[1])
+        elif kind == "error":
+            raise RuntimeError(f"shuffle fetch refused: {msg[1]}")
+        else:
+            raise RuntimeError(f"unexpected shuffle frame {kind!r}")
+
+    def readinto(self, b) -> int:
+        while not self._buf:
+            if self._eof:
+                return 0
+            self._pump()
+        n = min(len(b), len(self._buf))
+        b[:n] = self._buf[:n]
+        self._buf = self._buf[n:]
+        return n
+
+    def drain(self) -> None:
+        while not self._eof:
+            self._buf = b""
+            self._pump()
+        self._buf = b""
+
+
 def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,
-                    schema: Schema) -> Iterator[MicroPartition]:
+                    schema: Schema, parallelism: Optional[int] = None,
+                    prefetch: Optional[int] = None) -> Iterator[MicroPartition]:
     """Stream one shuffle partition by fetching every map file from every
     endpoint (the reference's flight-client fan-in, get_flight_client +
     do_get per partition). Fetch volume/latency is recorded into the active
-    ShuffleRecorder (shuffle.py) for per-task transport attribution."""
-    import time
+    ShuffleRecorder (shuffle.py) for per-task transport attribution.
 
-    from .shuffle import _note_fetch
+    `parallelism`/`prefetch` default from ExecutionConfig
+    (shuffle_fetch_parallelism / shuffle_prefetch_batches). parallelism<=1
+    with prefetch==0 selects the serial compatibility path — one endpoint at
+    a time, one whole-file request in flight, no threads, no queue."""
+    if not endpoints:
+        return
+    if parallelism is None or prefetch is None:
+        from ..config import execution_config
 
+        cfg = execution_config()
+        if parallelism is None:
+            parallelism = cfg.shuffle_fetch_parallelism
+        if prefetch is None:
+            prefetch = cfg.shuffle_prefetch_batches
+    if parallelism <= 1 and prefetch == 0:
+        yield from _fetch_serial(endpoints, shuffle_id, partition_idx, schema)
+    else:
+        yield from _fetch_pipelined(endpoints, shuffle_id, partition_idx,
+                                    schema, parallelism, prefetch)
+
+
+def _fetch_serial(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,
+                  schema: Schema) -> Iterator[MicroPartition]:
+    """The original serial transport: every file from every endpoint, one
+    request at a time over one connection. Batches still decode one IPC
+    message at a time (bounded memory), but nothing overlaps."""
     for host, port, key_hex in endpoints:
         conn = Client((host, port), family="AF_INET", authkey=bytes.fromhex(key_hex))
         try:
@@ -168,11 +312,180 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
                 if kind == "error":
                     raise RuntimeError(f"shuffle fetch refused: {data}")
                 assert kind == "file", kind
-                with ipc.RecordBatchFileReader(pa.BufferReader(data)) as r:
-                    table = r.read_all()
-                batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
-                _note_fetch(batch.num_rows, len(data), time.perf_counter() - t0)
-                yield MicroPartition(schema, [batch])
+                # yield each batch as it decodes (peak memory: the wire bytes
+                # plus ONE decoded batch); segmented timing keeps the
+                # consumer's processing between yields out of fetch_seconds.
+                # The finally records even when the consumer closes the
+                # generator mid-file — the wire bytes WERE transferred
+                rows = 0
+                spent = 0.0
+                t_seg = t0
+                try:
+                    for rb in iter_ipc_batches(io.BytesIO(data)):
+                        batch = RecordBatch.from_arrow(rb).cast_to_schema(schema)
+                        rows += batch.num_rows
+                        spent += time.perf_counter() - t_seg
+                        yield MicroPartition(schema, [batch])
+                        t_seg = time.perf_counter()
+                    spent += time.perf_counter() - t_seg
+                finally:
+                    _note_fetch(rows, len(data), spent)
             conn.send(("bye",))
         finally:
             conn.close()
+
+
+def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
+                     partition_idx: int, schema: Schema, parallelism: int,
+                     prefetch: int) -> Iterator[MicroPartition]:
+    """Parallel multi-peer fetch with bounded prefetch.
+
+    One thread per endpoint (endpoints round-robined when there are more than
+    `parallelism`), each pipelining chunk-streamed "fetchs" requests on its
+    connection (the request for file k+1 is sent before file k finishes
+    decoding). Decoded batches land in a bounded queue the caller drains —
+    the queue depth, not the map-file size, bounds reduce-side memory, and a
+    slow consumer backpressures the network naturally.
+
+    Overlap accounting: each request's in-flight time runs from its send to
+    its last decoded byte, NET of time this connection spent blocked on the
+    full prefetch queue (consumer backpressure is reduce compute, not
+    transfer, and must not masquerade as fetch time); summed over requests
+    this over-counts the union transfer window by the seconds two requests
+    were in flight together — `shuffle_overlap_seconds`."""
+    n_threads = min(max(parallelism, 1), len(endpoints))
+    groups = [endpoints[i::n_threads] for i in range(n_threads)]
+    q: _queue.Queue = _queue.Queue(maxsize=max(prefetch, 1))
+    stop = threading.Event()
+    agg_lock = threading.Lock()
+    agg = {"cum": 0.0, "first_send": None, "last_end": None, "hw": 0}
+
+    def _put(item) -> bool:
+        # never block forever: a consumer that stopped draining (closed
+        # generator) sets `stop`, and the producer gives up instead of
+        # leaking a thread wedged in put()
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _note_send(t: float) -> None:
+        with agg_lock:
+            if agg["first_send"] is None or t < agg["first_send"]:
+                agg["first_send"] = t
+
+    def _note_done(in_flight: float, t_end: float) -> None:
+        with agg_lock:
+            agg["cum"] += in_flight
+            if agg["last_end"] is None or t_end > agg["last_end"]:
+                agg["last_end"] = t_end
+
+    def _fetch_endpoint(ep: Endpoint) -> None:
+        host, port, key_hex = ep
+        conn = Client((host, port), family="AF_INET",
+                      authkey=bytes.fromhex(key_hex))
+        try:
+            conn.send(("list", shuffle_id, partition_idx))
+            kind, names = _recv_interruptible(conn, stop)
+            if kind == "error":
+                raise RuntimeError(f"shuffle fetch refused: {names}")
+            assert kind == "files", kind
+            if not names:
+                conn.send(("bye",))
+                return
+            send_at: dict = {}
+            sent_blocked: dict = {}
+            # cumulative seconds THIS connection spent blocked on the full
+            # prefetch queue — consumer backpressure, subtracted from every
+            # request clock spanning it so reduce compute never masquerades
+            # as fetch/overlap time
+            tally = {"blocked": 0.0}
+
+            def _send_req(i: int) -> None:
+                send_at[i] = time.perf_counter()
+                sent_blocked[i] = tally["blocked"]
+                _note_send(send_at[i])
+                conn.send(("fetchs", shuffle_id, partition_idx, names[i]))
+
+            _send_req(0)
+            for i in range(len(names)):
+                if i + 1 < len(names):
+                    # pipeline: file k+1's request rides behind file k's
+                    # reply frames; the server serves in order
+                    _send_req(i + 1)
+                frames = _FrameStream(conn, stop)
+                rows = 0
+                for rb in iter_ipc_batches(io.BufferedReader(frames)):
+                    batch = RecordBatch.from_arrow(rb).cast_to_schema(schema)
+                    rows += batch.num_rows
+                    t_put = time.perf_counter()
+                    if not _put(("batch", MicroPartition(schema, [batch]))):
+                        # consumer gone mid-file: account the transfer that
+                        # DID happen (received wire bytes, decoded rows)
+                        # before unwinding
+                        _note_fetch(rows, frames.received, max(
+                            (time.perf_counter() - send_at[i])
+                            - (tally["blocked"] - sent_blocked[i]), 0.0))
+                        return
+                    tally["blocked"] += time.perf_counter() - t_put
+                    sz = q.qsize()
+                    with agg_lock:
+                        if sz > agg["hw"]:
+                            agg["hw"] = sz
+                frames.drain()  # position the connection at the next reply
+                t_end = time.perf_counter()
+                in_flight = max(
+                    (t_end - send_at[i])
+                    - (tally["blocked"] - sent_blocked[i]), 0.0)
+                _note_done(in_flight, t_end)
+                _note_fetch(rows, frames.total, in_flight)
+            conn.send(("bye",))
+        finally:
+            conn.close()
+
+    def _run(eps: List[Endpoint]) -> None:
+        try:
+            for ep in eps:
+                if stop.is_set():
+                    return
+                _fetch_endpoint(ep)
+            _put(("done", None))
+        except _FetchAborted:
+            return  # consumer closed the generator; nothing to report
+        except Exception as e:  # noqa: BLE001 — crossed to the consumer, re-raised there
+            _put(("err", e))
+
+    threads = [threading.Thread(target=_run, args=(g,), daemon=True,
+                                name="daft-shuffle-fetch-client")
+               for g in groups]
+    for t in threads:
+        t.start()
+    try:
+        done = 0
+        while done < len(threads):
+            kind, payload = q.get()
+            if kind == "done":
+                done += 1
+            elif kind == "err":
+                raise RuntimeError(f"shuffle fetch failed: {payload}") from payload
+            else:
+                yield payload
+    finally:
+        stop.set()
+        while True:  # unblock producers wedged in put()
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                break
+        for t in threads:
+            t.join(timeout=5)
+        with agg_lock:
+            cum, hw = agg["cum"], agg["hw"]
+            window = (agg["last_end"] - agg["first_send"]) \
+                if agg["first_send"] is not None and agg["last_end"] is not None \
+                else 0.0
+        _note_fetch_wall(window, n_threads, max(cum - window, 0.0))
+        registry().set_gauge_max("shuffle_fetch_inflight", hw)

@@ -1,8 +1,8 @@
 """Disk-backed Arrow-IPC shuffle cache.
 
 Reference parity: src/daft-shuffles/src/shuffle_cache.rs:39 (InProgressShuffleCache
-partitions each MicroPartition and writes Arrow IPC files per partition to local
-disk) + server/flight_server.rs (partition fetch). Layout:
+partitions each MicroPartition and writes compressed Arrow IPC files per
+partition to local disk) + server/flight_server.rs (partition fetch). Layout:
 
     {base}/{shuffle_id}/p{partition}/m{map_id}.arrow
 
@@ -10,21 +10,36 @@ Each map task appends one file per partition it produced rows for; a reduce
 task for partition p streams every m*.arrow under p{p}/. On one host the
 "fetch" is a file read; the multi-host path serves the same files over a
 socket (see fetch_server) the way the reference serves them over Arrow Flight.
+
+Wire format: Arrow IPC *stream* files with per-message body compression
+(ExecutionConfig.shuffle_compression: none|lz4|zstd, default lz4 — the
+reference's flight payloads are compressed the same way). Readers auto-detect
+both the codec (from the IPC message headers) and the container (stream vs
+legacy file format, from the ARROW1 magic), and decode batch-by-batch so
+reduce-side memory is bounded by a few batches, never a whole map file.
+
+Two byte measures flow through the counters so the compression ratio is
+attributable end to end: `shuffle_logical_bytes` (uncompressed Arrow buffer
+bytes of what was written) and `shuffle_wire_bytes` (the bytes that actually
+hit disk/the socket).
 """
 
 from __future__ import annotations
 
+import io
 import os
 import threading
+import time
 from typing import Iterator, List, Optional
 
-import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
 from ..observability.metrics import registry
 from ..schema import Schema
+
+_ARROW_FILE_MAGIC = b"ARROW1"
 
 
 def partition_dir(base: str, shuffle_id: str, partition_idx: int) -> str:
@@ -37,17 +52,28 @@ class ShuffleRecorder:
     worker loop around each task (workers execute one task at a time, but the
     executor may drive shuffle reads from stage/pool threads — hence the lock).
     The snapshot ships back with the TaskResult for per-stage aggregation.
+
+    Fetch timing is recorded on two axes because fetches overlap (pipelined
+    requests, multi-peer fan-in): `fetch_seconds` is the CUMULATIVE in-flight
+    time summed over requests (it over-counts wall time by design once
+    requests run concurrently), `fetch_wall_seconds` is the union transfer
+    window. Their difference is the transfer overlap the pipelined transport
+    bought.
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.bytes_written = 0
+        self.bytes_written = 0          # logical (uncompressed Arrow) bytes
+        self.wire_bytes_written = 0     # bytes that hit disk/the socket
         self.rows_written = 0
         self.partitions_written: set = set()
-        self.bytes_fetched = 0
+        self.bytes_fetched = 0          # wire bytes received
         self.rows_fetched = 0
-        self.fetch_seconds = 0.0
+        self.fetch_seconds = 0.0        # cumulative per-request in-flight time
+        self.fetch_wall_seconds = 0.0   # union transfer window
+        self.overlap_seconds = 0.0      # cumulative - window, per fetch call
         self.fetch_requests = 0
+        self.fetch_fanin = 0            # max concurrent fetch connections
 
     def record_write(self, shuffle_id: str, partition_idx: int,
                      rows: int, nbytes: int) -> None:
@@ -55,6 +81,10 @@ class ShuffleRecorder:
             self.bytes_written += nbytes
             self.rows_written += rows
             self.partitions_written.add((shuffle_id, partition_idx))
+
+    def record_write_wire(self, nbytes: int) -> None:
+        with self._lock:
+            self.wire_bytes_written += nbytes
 
     def record_fetch(self, rows: int, nbytes: int, seconds: float,
                      requests: int = 1) -> None:
@@ -64,16 +94,27 @@ class ShuffleRecorder:
             self.fetch_seconds += seconds
             self.fetch_requests += requests
 
+    def record_fetch_wall(self, wall_seconds: float, fanin: int,
+                          overlap_seconds: float) -> None:
+        with self._lock:
+            self.fetch_wall_seconds += wall_seconds
+            self.overlap_seconds += overlap_seconds
+            self.fetch_fanin = max(self.fetch_fanin, fanin)
+
     def as_dict(self) -> dict:
         with self._lock:
             return {
                 "bytes_written": self.bytes_written,
+                "wire_bytes_written": self.wire_bytes_written,
                 "rows_written": self.rows_written,
                 "partitions_written": len(self.partitions_written),
                 "bytes_fetched": self.bytes_fetched,
                 "rows_fetched": self.rows_fetched,
                 "fetch_seconds": self.fetch_seconds,
+                "fetch_wall_seconds": self.fetch_wall_seconds,
+                "overlap_seconds": self.overlap_seconds,
                 "fetch_requests": self.fetch_requests,
+                "fetch_fanin": self.fetch_fanin,
             }
 
 
@@ -93,32 +134,110 @@ def current_recorder() -> Optional[ShuffleRecorder]:
 
 def _note_write(shuffle_id: str, partition_idx: int, rows: int, nbytes: int) -> None:
     registry().inc("shuffle_bytes_written", nbytes)
+    registry().inc("shuffle_logical_bytes", nbytes)
     registry().inc("shuffle_rows_written", rows)
     r = _ACTIVE_RECORDER
     if r is not None:
         r.record_write(shuffle_id, partition_idx, rows, nbytes)
 
 
+def _note_write_wire(nbytes: int) -> None:
+    registry().inc("shuffle_wire_bytes", nbytes)
+    r = _ACTIVE_RECORDER
+    if r is not None:
+        r.record_write_wire(nbytes)
+
+
 def _note_fetch(rows: int, nbytes: int, seconds: float) -> None:
     registry().inc("shuffle_bytes_fetched", nbytes)
     registry().inc("shuffle_rows_fetched", rows)
+    registry().inc("shuffle_fetch_seconds", seconds)
     r = _ACTIVE_RECORDER
     if r is not None:
         r.record_fetch(rows, nbytes, seconds)
+
+
+def _note_fetch_wall(wall_seconds: float, fanin: int,
+                     overlap_seconds: float) -> None:
+    registry().inc("shuffle_fetch_wall_seconds", wall_seconds)
+    if overlap_seconds > 0:
+        registry().inc("shuffle_overlap_seconds", overlap_seconds)
+    r = _ACTIVE_RECORDER
+    if r is not None:
+        r.record_fetch_wall(wall_seconds, fanin, overlap_seconds)
+
+
+class _ChainReader(io.RawIOBase):
+    """Readable that serves a peeked prefix before delegating to the source
+    (iter_ipc_batches sniffs the container magic without requiring seek)."""
+
+    def __init__(self, head: bytes, rest):
+        self._head = head
+        self._rest = rest
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        if self._head:
+            n = min(len(b), len(self._head))
+            b[:n] = self._head[:n]
+            self._head = self._head[n:]
+            return n
+        data = self._rest.read(len(b))
+        if not data:
+            return 0
+        b[: len(data)] = data
+        return len(data)
+
+
+def iter_ipc_batches(source) -> Iterator:
+    """Yield pyarrow RecordBatches from a readable binary file-like object,
+    one at a time (never read_all — reduce-side memory stays bounded by a
+    batch, and the first batch is decodable before the last byte arrives).
+
+    Auto-detects the container: Arrow IPC *stream* format (what
+    MapOutputWriter emits) is decoded incrementally; the legacy *file* format
+    (pre-compression shuffle dirs, or external tooling) is materialized and
+    read batch-by-batch. Per-message compression (lz4/zstd) is handled by the
+    IPC reader transparently — the codec travels in the message headers.
+    """
+    head = source.read(len(_ARROW_FILE_MAGIC))
+    if head.startswith(_ARROW_FILE_MAGIC):
+        # legacy file format needs random access (footer at the end)
+        data = head + source.read()
+        with ipc.RecordBatchFileReader(io.BytesIO(data)) as r:
+            for i in range(r.num_record_batches):
+                yield r.get_batch(i)
+        return
+    with ipc.open_stream(io.BufferedReader(_ChainReader(head, source))) as r:
+        for batch in r:
+            yield batch
 
 
 class MapOutputWriter:
     """Streaming writer for one map task: per-partition IPC files opened lazily,
     appended batch-by-batch as the input streams through (the map task never
     materializes its whole output — matching the reference's incremental
-    InProgressShuffleCache, shuffle_cache.rs:39)."""
+    InProgressShuffleCache, shuffle_cache.rs:39). Files are IPC *stream*
+    format with body compression from ExecutionConfig.shuffle_compression
+    unless overridden per-writer."""
 
-    def __init__(self, base: str, shuffle_id: str, map_id: int, num_partitions: int):
+    def __init__(self, base: str, shuffle_id: str, map_id: int,
+                 num_partitions: int, compression: Optional[str] = None):
+        if compression is None:
+            from ..config import execution_config
+
+            compression = execution_config().shuffle_compression
         self.base = base
         self.shuffle_id = shuffle_id
         self.map_id = map_id
+        self.compression = compression
         self.rows = [0] * num_partitions
+        self._opts = ipc.IpcWriteOptions(
+            compression=None if compression == "none" else compression)
         self._writers: dict = {}
+        self._paths: dict = {}
 
     def append(self, partition_idx: int, batch: RecordBatch) -> None:
         if batch.num_rows == 0:
@@ -130,22 +249,33 @@ class MapOutputWriter:
             d = partition_dir(self.base, self.shuffle_id, partition_idx)
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, f"m{self.map_id}.arrow")
-            w = ipc.RecordBatchFileWriter(path, table.schema)
+            w = ipc.new_stream(path, table.schema, options=self._opts)
             self._writers[partition_idx] = w
+            self._paths[partition_idx] = path
         w.write_table(table)
         _note_write(self.shuffle_id, partition_idx, batch.num_rows, table.nbytes)
 
     def close(self) -> List[int]:
-        for w in self._writers.values():
+        wire = 0
+        for p, w in self._writers.items():
             w.close()
+            try:
+                wire += os.path.getsize(self._paths[p])
+            except OSError:
+                pass
         self._writers.clear()
+        self._paths.clear()
+        if wire:
+            _note_write_wire(wire)
         return self.rows
 
 
 def write_map_output(base: str, shuffle_id: str, map_id: int,
-                     partitioned: List[List[RecordBatch]]) -> List[int]:
+                     partitioned: List[List[RecordBatch]],
+                     compression: Optional[str] = None) -> List[int]:
     """Persist one map task's per-partition batches; returns rows per partition."""
-    out = MapOutputWriter(base, shuffle_id, map_id, len(partitioned))
+    out = MapOutputWriter(base, shuffle_id, map_id, len(partitioned),
+                          compression=compression)
     for p, batches in enumerate(partitioned):
         for b in batches:
             out.append(p, b)
@@ -154,23 +284,38 @@ def write_map_output(base: str, shuffle_id: str, map_id: int,
 
 def read_partition(base: str, shuffle_id: str, partition_idx: int,
                    schema: Schema) -> Iterator[MicroPartition]:
-    """Stream every map's output for one shuffle partition."""
-    import time
-
+    """Stream every map's output for one shuffle partition, one IPC batch at a
+    time (peak memory is a batch, not a map file). Fetch time excludes the
+    consumer's processing between yields (segmented timing)."""
     d = partition_dir(base, shuffle_id, partition_idx)
     if not os.path.isdir(d):
         return
     for name in sorted(os.listdir(d)):
         if not name.endswith(".arrow"):
             continue
-        t0 = time.perf_counter()
         path = os.path.join(d, name)
-        with ipc.RecordBatchFileReader(path) as r:
-            table = r.read_all()
-        batch = RecordBatch.from_arrow(table).cast_to_schema(schema)
-        _note_fetch(batch.num_rows, os.path.getsize(path),
-                    time.perf_counter() - t0)
-        yield MicroPartition(schema, [batch])
+        rows = 0
+        spent = 0.0
+        nbytes = 0
+        with open(path, "rb") as f:
+            t0 = time.perf_counter()
+            try:
+                for rb in iter_ipc_batches(f):
+                    batch = RecordBatch.from_arrow(rb).cast_to_schema(schema)
+                    rows += batch.num_rows
+                    spent += time.perf_counter() - t0
+                    yield MicroPartition(schema, [batch])
+                    t0 = time.perf_counter()
+                spent += time.perf_counter() - t0
+                nbytes = os.path.getsize(path)
+            except BaseException:
+                # consumer closed the generator (or decode failed) mid-file:
+                # account what was actually read off disk so far
+                nbytes = f.tell()
+                raise
+            finally:
+                if rows or nbytes:
+                    _note_fetch(rows, nbytes, spent)
 
 
 def cleanup(base: str, shuffle_id: str) -> None:

@@ -85,7 +85,12 @@ class QueryTrace:
             if result.shuffle:
                 acc = self._shuffle[ts.stage_id]
                 for k, v in result.shuffle.items():
-                    acc[k] = acc.get(k, 0) + v
+                    if k == "fetch_fanin":
+                        # a max across tasks, not a volume — summing would
+                        # report nonsense parallelism
+                        acc[k] = max(acc.get(k, 0), v)
+                    else:
+                        acc[k] = acc.get(k, 0) + v
         if result.shuffle:
             # mirror into the driver's registry so the per-query metrics diff
             # (QueryEnd.metrics, bench snapshot) carries cluster-wide volume
@@ -94,6 +99,20 @@ class QueryTrace:
                 v = result.shuffle.get(k, 0)
                 if v:
                     registry().inc(f"shuffle_{k}", int(v))
+            # wire/logical + overlap attribution (workers count these in
+            # THEIR registries; re-home them so the driver-side per-query
+            # diff can assert compression ratio and transfer overlap)
+            for src, dst in (("bytes_written", "shuffle_logical_bytes"),
+                             ("wire_bytes_written", "shuffle_wire_bytes")):
+                v = result.shuffle.get(src, 0)
+                if v:
+                    registry().inc(dst, int(v))
+            for src, dst in (("fetch_seconds", "shuffle_fetch_seconds"),
+                             ("fetch_wall_seconds", "shuffle_fetch_wall_seconds"),
+                             ("overlap_seconds", "shuffle_overlap_seconds")):
+                v = result.shuffle.get(src, 0.0)
+                if v:
+                    registry().inc(dst, float(v))
         if result.engine_counters:
             # device-path attribution crosses the process boundary the same
             # way: a device-leased worker's dispatches/coalescing land in the
@@ -146,6 +165,10 @@ class QueryTrace:
                     rows_fetched=int(acc.get("rows_fetched", 0)),
                     fetch_seconds=float(acc.get("fetch_seconds", 0.0)),
                     fetch_requests=int(acc.get("fetch_requests", 0)),
+                    wire_bytes_written=int(acc.get("wire_bytes_written", 0)),
+                    fetch_wall_seconds=float(acc.get("fetch_wall_seconds", 0.0)),
+                    overlap_seconds=float(acc.get("overlap_seconds", 0.0)),
+                    fetch_fanin=int(acc.get("fetch_fanin", 0)),
                 ))
             return out
 
@@ -183,6 +206,11 @@ class QueryTrace:
                 "max_s": times[-1],
                 "shuffle_bytes_written": int(sh.get("bytes_written", 0)),
                 "shuffle_bytes_fetched": int(sh.get("bytes_fetched", 0)),
+                "shuffle_wire_bytes": int(sh.get("wire_bytes_written", 0)),
+                "shuffle_fetch_cum_s": float(sh.get("fetch_seconds", 0.0)),
+                "shuffle_fetch_wall_s": float(sh.get("fetch_wall_seconds", 0.0)),
+                "shuffle_overlap_s": float(sh.get("overlap_seconds", 0.0)),
+                "shuffle_fetch_fanin": int(sh.get("fetch_fanin", 0)),
             })
         return out
 
@@ -225,6 +253,26 @@ class QueryTrace:
                 f"{_fmt_bytes(s['shuffle_bytes_fetched']):>10}")
             if s["retries"]:
                 lines.append(f"  {'':<20} ({s['retries']} task retries)")
+            if s["shuffle_wire_bytes"] and s["shuffle_bytes_written"]:
+                # per-stage compression ratio: wire bytes on disk/socket vs
+                # logical Arrow buffer bytes
+                ratio = s["shuffle_wire_bytes"] / s["shuffle_bytes_written"]
+                lines.append(
+                    f"  {'':<20} (compression: "
+                    f"{_fmt_bytes(s['shuffle_wire_bytes'])} wire / "
+                    f"{_fmt_bytes(s['shuffle_bytes_written'])} logical = "
+                    f"{ratio:.2f}x)")
+            if s["shuffle_fetch_cum_s"]:
+                # fetch_seconds is CUMULATIVE in-flight time (over-counts the
+                # wall-clock transfer window once requests overlap); the wall
+                # window and the overlap bought by the pipelined fan-in are
+                # labeled separately
+                lines.append(
+                    f"  {'':<20} (fetch: "
+                    f"{s['shuffle_fetch_cum_s']*1e3:.1f}ms cumulative / "
+                    f"{s['shuffle_fetch_wall_s']*1e3:.1f}ms wall, "
+                    f"overlap {s['shuffle_overlap_s']*1e3:.1f}ms, "
+                    f"fan-in {s['shuffle_fetch_fanin']})")
             if s["affinity_hits"] or s["affinity_misses"]:
                 lines.append(
                     f"  {'':<20} (cache affinity: {s['affinity_hits']} hits, "

@@ -413,6 +413,15 @@ class WorkerPool:
         env.setdefault("DAFT_TPU_BATCH_FILL", str(cfg.batch_fill_target))
         env.setdefault("DAFT_TPU_BATCH_LATENCY_MS", str(cfg.batch_latency_ms))
         env.setdefault("DAFT_TPU_MORSEL_SIZE", str(cfg.morsel_size_rows))
+        # shuffle transport knobs: map tasks write (compression) and reduce
+        # tasks fetch (fan-in parallelism, prefetch depth) in WORKER
+        # processes, so the driver's effective knobs must reach them the same
+        # way the batching knobs do
+        env.setdefault("DAFT_TPU_SHUFFLE_COMPRESSION", cfg.shuffle_compression)
+        env.setdefault("DAFT_TPU_SHUFFLE_FETCH_PARALLELISM",
+                       str(cfg.shuffle_fetch_parallelism))
+        env.setdefault("DAFT_TPU_SHUFFLE_PREFETCH",
+                       str(cfg.shuffle_prefetch_batches))
         from ..utils.sockets import DeadlineAcceptor
 
         acceptor = DeadlineAcceptor(self._listener)

@@ -128,6 +128,9 @@ class DashboardState(Subscriber):
                     "bytes_written": stats.bytes_written,
                     "bytes_fetched": stats.bytes_fetched,
                     "fetch_requests": stats.fetch_requests,
+                    "wire_bytes_written": getattr(stats, "wire_bytes_written", 0),
+                    "overlap_seconds": getattr(stats, "overlap_seconds", 0.0),
+                    "fetch_fanin": getattr(stats, "fetch_fanin", 0),
                 })
 
     def on_worker_heartbeat(self, query_id: str, hb) -> None:

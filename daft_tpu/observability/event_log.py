@@ -22,7 +22,9 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # hbm_digest_entries (cache-affinity scheduling observability). v4:
 # task_stats gains engine_counters (per-task worker registry deltas — device
 # dispatches, coalescing, HBM traffic).
-SCHEMA_VERSION = 4
+# v5: shuffle_stats gains wire_bytes_written / fetch_wall_seconds /
+# overlap_seconds / fetch_fanin (pipelined compressed shuffle transport).
+SCHEMA_VERSION = 5
 
 
 class EventLogSubscriber(Subscriber):

@@ -63,13 +63,21 @@ class ShuffleStats:
     flight_server counters)."""
 
     stage_id: str
-    bytes_written: int = 0
+    bytes_written: int = 0        # logical (uncompressed Arrow) bytes
     rows_written: int = 0
     partitions_written: int = 0
-    bytes_fetched: int = 0
+    bytes_fetched: int = 0        # wire bytes received
     rows_fetched: int = 0
-    fetch_seconds: float = 0.0
+    fetch_seconds: float = 0.0    # CUMULATIVE per-request in-flight time
     fetch_requests: int = 0
+    # pipelined-transport additions: bytes that actually hit disk/the wire
+    # (compression ratio = wire/logical), the union fetch transfer window
+    # (fetch_seconds over-counts it by the overlapped seconds once requests
+    # run concurrently), the overlap itself, and the max fetch fan-in
+    wire_bytes_written: int = 0
+    fetch_wall_seconds: float = 0.0
+    overlap_seconds: float = 0.0
+    fetch_fanin: int = 0
 
 
 @dataclass(frozen=True)

@@ -47,6 +47,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def set_gauge_max(self, name: str, value: float) -> None:
+        """High-water gauge: keep the largest value ever reported (e.g.
+        shuffle_fetch_inflight — the deepest the prefetch queue got)."""
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
     # ---- reads -------------------------------------------------------------------
     def get(self, name: str) -> int:
         with self._lock:

@@ -69,7 +69,7 @@ def test_distributed_event_log_has_tasks_shuffles_heartbeats(dist_runner, tmp_pa
         disable_event_log(sub)
 
     events = [json.loads(l) for l in open(p)]
-    assert all(e["schema_version"] == 4 for e in events)
+    assert all(e["schema_version"] == 5 for e in events)
     by_kind = {}
     for e in events:
         by_kind.setdefault(e["event"], []).append(e)
@@ -96,6 +96,11 @@ def test_distributed_event_log_has_tasks_shuffles_heartbeats(dist_runner, tmp_pa
                for s in shuffles)
     assert any(s["bytes_fetched"] > 0 and s["fetch_requests"] > 0
                for s in shuffles)
+    # v5: wire/logical + overlap attribution travels in the record
+    assert all("wire_bytes_written" in s and "fetch_wall_seconds" in s
+               and "overlap_seconds" in s and "fetch_fanin" in s
+               for s in shuffles)
+    assert any(s["wire_bytes_written"] > 0 for s in shuffles)
 
     # >= 1 worker heartbeat with utilization fields
     hbs = by_kind["worker_heartbeat"]
