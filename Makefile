@@ -1,0 +1,26 @@
+# Developer/CI entry points. The perf gate compares a fresh bench capture
+# against the newest committed BENCH_r*.json and fails loudly on >5% per-query
+# regressions (bench.py --compare).
+
+PY ?= python
+LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
+NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
+
+.PHONY: test bench bench-gate bench-compare
+
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+bench:
+	$(PY) bench.py
+
+# CI perf gate: run the bench, diff against the latest committed capture.
+bench-gate:
+	@test -n "$(LATEST_BENCH)" || (echo "no BENCH_r*.json capture to gate against" && exit 2)
+	$(PY) bench.py > $(NEW_BENCH)
+	$(PY) bench.py --compare $(LATEST_BENCH) $(NEW_BENCH)
+
+# Ad-hoc: make bench-compare OLD=BENCH_r04.json NEW=BENCH_r05.json
+bench-compare:
+	$(PY) bench.py --compare $(OLD) $(NEW)

@@ -19,6 +19,14 @@ Environment knobs:
                           carries the wire/logical byte counters and the
                           derived compression/overlap ratios
     BENCH_SHUFFLE_ROWS=N  microbench fact rows (default 200_000)
+    BENCH_PROFILE=1       after timing, save a per-query Chrome-trace timeline
+                          (explain_analyze(profile=...)) — open in Perfetto
+    BENCH_PROFILE_DIR=d   where the trace JSONs land (default ".")
+
+Compare mode (the perf regression gate — see Makefile `bench-gate`):
+    python bench.py --compare OLD.json NEW.json
+prints the per-query speedup table and exits non-zero when NEW regresses
+any query (or the headline rows/sec) by more than 5%.
 
 The run reports which engine paths actually executed: device_batches counts
 real XLA dispatches of the TPU agg/join stages (ops/counters.py), so a number
@@ -112,6 +120,72 @@ def shuffle_microbench() -> None:
         runner.shutdown()
 
 
+REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
+
+
+def _load_capture(path: str) -> dict:
+    """A bench JSON — either the raw one-line output of this script or a
+    driver capture record wrapping it under "parsed" (the committed
+    BENCH_r*.json shape)."""
+    with open(path) as f:
+        data = json.load(f)
+    if "per_query_ms" not in data and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    return data
+
+
+def compare(old_path: str, new_path: str) -> int:
+    """Per-query speedup table OLD -> NEW; returns the number of regressions
+    (queries or the headline metric slower by more than the tolerance)."""
+    old = _load_capture(old_path)
+    new = _load_capture(new_path)
+    old_q = old.get("per_query_ms", {})
+    new_q = new.get("per_query_ms", {})
+    regressions = []
+    # a query that vanished from NEW is lost coverage, not a pass: a
+    # regression hiding in a dropped query must fail the gate loudly
+    for q in sorted(set(old_q) - set(new_q)):
+        print(f"{q:<8} missing from NEW capture  <-- REGRESSION")
+        regressions.append(q)
+    print(f"{'query':<8} {'old ms':>10} {'new ms':>10} {'speedup':>8}")
+    for q in sorted(set(old_q) & set(new_q),
+                    key=lambda s: int(s[1:]) if s[1:].isdigit() else 0):
+        o, n = old_q[q], new_q[q]
+        speedup = o / n if n else float("inf")
+        flag = ""
+        if n > o * (1 + REGRESSION_TOLERANCE):
+            flag = "  <-- REGRESSION"
+            regressions.append(q)
+        print(f"{q:<8} {o:>10.1f} {n:>10.1f} {speedup:>7.2f}x{flag}")
+    ov, nv = old.get("value", 0), new.get("value", 0)
+    if ov and nv:
+        flag = ""
+        if nv < ov * (1 - REGRESSION_TOLERANCE):
+            flag = "  <-- REGRESSION"
+            regressions.append("rows_per_sec")
+        print(f"{'TOTAL':<8} {'':>10} {'':>10} {nv / ov:>7.2f}x{flag}  "
+              f"({old.get('metric', '?')}: {ov:g} -> {nv:g} rows/sec)")
+    if regressions:
+        print(f"FAIL: {len(regressions)} regression(s) > "
+              f"{REGRESSION_TOLERANCE:.0%}: {', '.join(regressions)}")
+    else:
+        print(f"OK: no regressions > {REGRESSION_TOLERANCE:.0%} "
+              f"across {len(set(old_q) & set(new_q))} queries")
+    return len(regressions)
+
+
+def _save_profiles(tables, ALL_QUERIES) -> None:
+    """BENCH_PROFILE=1: one Chrome-trace timeline per query via
+    explain_analyze(profile=...) — an extra instrumented run AFTER the timed
+    reps, so profiling overhead never contaminates the headline number."""
+    out_dir = os.environ.get("BENCH_PROFILE_DIR", ".")
+    os.makedirs(out_dir, exist_ok=True)
+    for q in QUERIES:
+        path = os.path.join(out_dir, f"bench_trace_{SUITE}_sf{SF:g}_q{q}.json")
+        ALL_QUERIES[q](tables).explain_analyze(profile=path)
+        print(f"profile: {path}", file=sys.stderr)
+
+
 def main() -> None:
     if os.environ.get("BENCH_SHUFFLE"):
         shuffle_microbench()
@@ -134,7 +208,10 @@ def main() -> None:
     for q in QUERIES:
         ALL_QUERIES[q](tables).to_pydict()
 
+    from daft_tpu.execution import memory as _mem
+
     counters.reset()
+    _mem.reset_counters()
     # best-of-N timed repetitions: the tunneled device's d2h round trip
     # occasionally spikes 5-10x, which is link jitter, not engine throughput
     per_query = {q: float("inf") for q in QUERIES}
@@ -146,6 +223,10 @@ def main() -> None:
         t0 = time.perf_counter()
         for q in QUERIES:
             counters.reset()
+            # spill counters live in the registry but outside COUNTER_NAMES:
+            # reset per query too, or the summed snapshot loop below would
+            # multiply the process-cumulative value once per query
+            _mem.reset_counters()
             tq = time.perf_counter()
             ALL_QUERIES[q](tables).to_pydict()
             per_query[q] = min(per_query[q], time.perf_counter() - tq)
@@ -205,6 +286,9 @@ def main() -> None:
     # (only present when the capture crossed a distributed shuffle).
     _derive_shuffle_ratios(metric_totals)
 
+    if os.environ.get("BENCH_PROFILE"):
+        _save_profiles(tables, ALL_QUERIES)
+
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
     print(json.dumps({
         "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",
@@ -222,4 +306,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--compare":
+        if len(sys.argv) != 4:
+            print("usage: python bench.py --compare OLD.json NEW.json",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(1 if compare(sys.argv[2], sys.argv[3]) else 0)
     main()

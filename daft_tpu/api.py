@@ -27,7 +27,30 @@ __all__ = [
     "from_pydict", "from_pylist", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "from_glob_path", "sql", "sql_expr",
     "cls", "method", "udf", "Func",
+    "launch_dashboard", "enable_event_log",
 ]
+
+
+# ---- observability conveniences ------------------------------------------------------
+
+
+def launch_dashboard(host: str = "127.0.0.1", port: int = 0):
+    """Start the embedded dashboard (query history UI, /api/* JSON, a
+    Prometheus /metrics exposition, and per-query Chrome-trace downloads at
+    /api/query/<id>/trace); returns the Dashboard (``.url``, ``.shutdown()``).
+    Reference: daft.subscribers.dashboard.launch."""
+    from .observability.dashboard import launch
+
+    return launch(host, port)
+
+
+def enable_event_log(path: str):
+    """Append one JSON line per query lifecycle event to `path` (see
+    observability/event_log.py, schema_version documented there); returns the
+    subscriber for observability.event_log.disable_event_log."""
+    from .observability.event_log import enable_event_log as _enable
+
+    return _enable(path)
 
 
 def element() -> Expression:

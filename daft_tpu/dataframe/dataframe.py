@@ -77,22 +77,31 @@ class DataFrame:
             s += "\n\n== Physical Plan ==\n" + translate(opt.plan).display()
         return s
 
-    def explain_analyze(self) -> str:
+    def explain_analyze(self, profile: Optional[str] = None) -> str:
         """Execute the plan through the configured runner collecting
         per-operator runtime stats; returns the plans plus an operator table
-        (rows out / batches / self time) — reference: EXPLAIN ANALYZE over
-        runtime_stats. On a distributed runner the report additionally renders
-        the stage DAG rollup (per-stage task counts, min/median/max task time
-        skew, queue wait, shuffle volumes, per-worker attribution) from the
-        run's QueryTrace, plus the per-query metrics-registry deltas (device
-        batches, shuffle bytes) so engine-path attribution is in the report,
-        not only in bench.py."""
+        (rows out / batches / self time split into compute / starve /
+        blocked) — reference: EXPLAIN ANALYZE over runtime_stats. On a
+        distributed runner the report additionally renders the stage DAG
+        rollup (per-stage task counts, min/median/max task time skew, queue
+        wait, shuffle volumes, straggler flags, per-worker attribution) from
+        the run's QueryTrace, plus the per-query metrics-registry deltas
+        (device batches, shuffle bytes) so engine-path attribution is in the
+        report, not only in bench.py.
+
+        `profile="trace.json"` additionally writes the query's timeline as
+        Chrome trace-event JSON (QueryTrace.to_chrome_trace) — open it in
+        Perfetto (ui.perfetto.dev) or chrome://tracing. Works on both the
+        native runner (driver lanes only) and the distributed runner (plus
+        per-worker task lanes and device/io spans)."""
+        import json
         import time
 
         from ..observability.metrics import registry
-        from ..observability.runtime_stats import (StatsCollector,
+        from ..observability.runtime_stats import (SpanRecorder, StatsCollector,
                                                    current_collector,
-                                                   format_stats, set_collector)
+                                                   current_spans, format_stats,
+                                                   set_collector, set_spans)
         from ..plan.physical import translate
         from ..runners import get_or_create_runner
 
@@ -103,16 +112,26 @@ class DataFrame:
         runner = get_or_create_runner()
         reg_before = registry().snapshot()
         set_collector(collector)
+        span_rec = prev_spans = None
+        if profile:
+            # capture real wall-clock device/io spans for the timeline
+            span_rec = SpanRecorder()
+            prev_spans = current_spans()
+            set_spans(span_rec)
+        t_wall0 = time.time()
         t0 = time.perf_counter()
         try:
             for _ in runner.run_iter(self._builder):
                 pass
         finally:
             set_collector(prev)
+            if profile:
+                set_spans(prev_spans)
         total = time.perf_counter() - t0
+        stats = collector.finish()
         report = ("== Physical Plan ==\n" + phys.display()
                   + "\n\n== Runtime Stats ==\n"
-                  + format_stats(collector.finish(), total))
+                  + format_stats(stats, total))
         trace = getattr(runner, "last_trace", None)
         if trace is not None and trace.tasks:
             report += "\n\n== Distributed Stages ==\n" + trace.render()
@@ -120,6 +139,18 @@ class DataFrame:
         if deltas:
             report += "\n\n== Engine Counters ==\n" + "\n".join(
                 f"{k:<32} {v:>12g}" for k, v in sorted(deltas.items()))
+        if profile:
+            if trace is None:
+                # native runner: synthesize an empty trace for driver lanes
+                from ..distributed.trace import QueryTrace
+
+                trace = QueryTrace("")
+                trace.started_wall = t_wall0
+            data = trace.to_chrome_trace(driver_ops=stats,
+                                         driver_spans=span_rec.drain(),
+                                         total_seconds=total)
+            with open(profile, "w") as f:
+                json.dump(data, f)
         return report
 
     def _next(self, builder: LogicalPlanBuilder) -> "DataFrame":

@@ -286,10 +286,18 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
         if prefetch is None:
             prefetch = cfg.shuffle_prefetch_batches
     if parallelism <= 1 and prefetch == 0:
-        yield from _fetch_serial(endpoints, shuffle_id, partition_idx, schema)
+        inner = _fetch_serial(endpoints, shuffle_id, partition_idx, schema)
     else:
-        yield from _fetch_pipelined(endpoints, shuffle_id, partition_idx,
-                                    schema, parallelism, prefetch)
+        inner = _fetch_pipelined(endpoints, shuffle_id, partition_idx,
+                                 schema, parallelism, prefetch)
+    # timeline profiling: one "shuffle.fetch" slice per partition fan-in,
+    # covering the whole consumption window (transfer overlapped with the
+    # consumer's reduce work — the wall window, same axis as fetch_wall)
+    from ..observability.runtime_stats import span_iter
+
+    yield from span_iter("shuffle.fetch", "io", inner,
+                         shuffle_id=shuffle_id, partition=partition_idx,
+                         endpoints=len(endpoints))
 
 
 def _fetch_serial(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,

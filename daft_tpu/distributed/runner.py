@@ -85,6 +85,11 @@ class DistributedRunner(Runner):
         from .trace import QueryTrace
 
         pool = self._ensure_pool()
+        # discard beats buffered in the worker pipes since the LAST drain
+        # (the idle gap between queries): the end-of-query window filter
+        # below judges by driver receive time, and these would all be
+        # stamped inside THIS query's window at the first poll
+        pool.drain_heartbeats()
         observed = subscribers_active()
         prev = current_collector()
         # trace when anyone is watching: attached subscribers OR an ambient
@@ -109,6 +114,10 @@ class DistributedRunner(Runner):
                 qid, optimized.plan.display(), phys.display(),
                 time.perf_counter() - t0))
         trace = QueryTrace(qid) if traced else None
+        if trace is not None:
+            # trace epoch = query start (pre-optimize), so the timeline's
+            # t=0 is where the user's wall clock started, not post-planning
+            trace.started_wall = t_wall0
         self.last_trace = trace
         endpoints = [self._fetch_server.endpoint] if self._fetch_server else None
         ctx = DistContext(pool=pool, shuffle_dir=self._shuffle_dir,
@@ -147,9 +156,13 @@ class DistributedRunner(Runner):
             beats = pool.drain_heartbeats()
             if trace is not None:
                 for hb in beats:
-                    # only beats from THIS query's window (workers share the
-                    # host clock; 0.5s slack covers send/receive skew)
-                    if hb.get("ts", 0.0) >= t_wall0 - 0.5:
+                    # only beats from THIS query's window, judged by the
+                    # DRIVER-side receive stamp (0.5s slack): the worker's
+                    # send clock may be skewed — that skew is exactly what
+                    # clock_offsets() estimates from these beats, so a
+                    # worker-clock filter would drop the skewed beats it
+                    # needs (send-ts fallback for beats predating the stamp)
+                    if hb.get("recv_ts", hb.get("ts", 0.0)) >= t_wall0 - 0.5:
                         trace.add_heartbeat(hb)
             if observed and trace is not None:
                 for ts in list(trace.tasks):
@@ -158,6 +171,9 @@ class DistributedRunner(Runner):
                     notify("on_shuffle_stats", qid, sh)
                 for hb in list(trace.heartbeats):
                     notify("on_worker_heartbeat", qid, hb)
+                # the assembled QueryTrace itself (timeline profiler source):
+                # the dashboard serves its Chrome trace as a download
+                notify("on_query_trace", qid, trace)
             if observed:
                 stats = collector.finish() if collector else []
                 for s in stats:

@@ -290,6 +290,15 @@ def read_partition(base: str, shuffle_id: str, partition_idx: int,
     d = partition_dir(base, shuffle_id, partition_idx)
     if not os.path.isdir(d):
         return
+    # timeline profiling: one "shuffle.read" slice per partition (local
+    # shared-dir transport), covering the whole consumption window
+    from ..observability.runtime_stats import span_iter
+
+    yield from span_iter("shuffle.read", "io", _read_partition_inner(d, schema),
+                         shuffle_id=shuffle_id, partition=partition_idx)
+
+
+def _read_partition_inner(d: str, schema: Schema) -> Iterator[MicroPartition]:
     for name in sorted(os.listdir(d)):
         if not name.endswith(".arrow"):
             continue

@@ -106,3 +106,9 @@ class TaskResult:
     # driver registry for EXPLAIN ANALYZE / QueryEnd.metrics; hbm_* stays
     # per-process (worker HBM telemetry flows via heartbeats instead).
     engine_counters: Optional[dict] = None
+    # timeline profiler spans recorded while this task ran (SpanRecorder
+    # dicts: device dispatch / h2d / d2h / coalescer flushes / shuffle
+    # fetches, worker-clock unix timestamps). QueryTrace aligns them to the
+    # driver clock via heartbeat-estimated offsets for the Chrome trace
+    # export; bounded by the recorder cap, empty when nothing coarse ran.
+    spans: Tuple[dict, ...] = ()

@@ -13,11 +13,21 @@ from __future__ import annotations
 
 import statistics
 import threading
+import time
 from typing import Dict, List, Optional
 
+from ..config import _env_float
 from ..observability.events import ShuffleStats, TaskStats, WorkerHeartbeat
 from ..observability.metrics import registry
 from ..observability.otlp import _span_id, _trace_id
+
+# straggler detection threshold: a task is flagged when its exec time exceeds
+# k x its stage's median (the detection half of speculative re-execution)
+_DEFAULT_STRAGGLER_K = 2.0
+
+
+def straggler_threshold() -> float:
+    return _env_float("DAFT_TPU_STRAGGLER_K", _DEFAULT_STRAGGLER_K)
 
 
 # Worker engine counters mirrored into the driver registry per finished task
@@ -42,9 +52,13 @@ class QueryTrace:
         self.query_id = query_id
         self.trace_id = _trace_id(query_id) if query_id else ""
         self.root_span_id = _span_id(query_id, "query") if query_id else ""
+        self.started_wall = time.time()   # trace epoch for the timeline export
         self._lock = threading.Lock()
         self.tasks: List[TaskStats] = []
         self.heartbeats: List[WorkerHeartbeat] = []
+        # task_id -> worker-clock timeline spans shipped in the TaskResult
+        # (kept off TaskStats so event-log task records stay flat/grep-able)
+        self.task_spans: Dict[str, List[dict]] = {}
         # stage_id -> accumulated shuffle dict (insertion-ordered)
         self._shuffle: Dict[str, dict] = {}
         self._stage_order: List[str] = []
@@ -79,6 +93,8 @@ class QueryTrace:
         )
         with self._lock:
             self.tasks.append(ts)
+            if result.spans:
+                self.task_spans[ts.task_id] = list(result.spans)
             if ts.stage_id not in self._shuffle:
                 self._shuffle[ts.stage_id] = {}
                 self._stage_order.append(ts.stage_id)
@@ -138,9 +154,30 @@ class QueryTrace:
             hbm_bytes=hb.get("hbm_bytes_resident", 0),
             hbm_h2d_bytes=hb.get("hbm_h2d_bytes", 0),
             hbm_digest_entries=len(hb.get("hbm_digest") or ()),
+            recv_ts=hb.get("recv_ts", 0.0),
         )
         with self._lock:
             self.heartbeats.append(rec)
+
+    def clock_offsets(self) -> Dict[str, float]:
+        """Per-worker clock offset estimate (driver = worker + offset).
+
+        Cristian-style one-way bound from heartbeat round trips: every beat
+        gives recv_ts(driver) - ts(worker) = true offset + transit; the MIN
+        over a query's beats is the tightest bound (transit >= 0). On
+        same-host workers (shared clock) this converges to the send/recv
+        latency, typically sub-millisecond. Workers without beats map to 0.
+        """
+        with self._lock:
+            hbs = list(self.heartbeats)
+        out: Dict[str, float] = {}
+        for hb in hbs:
+            if hb.ts <= 0 or hb.recv_ts <= 0:
+                continue
+            d = hb.recv_ts - hb.ts
+            if hb.worker_id not in out or d < out[hb.worker_id]:
+                out[hb.worker_id] = d
+        return out
 
     def note_placement(self, stage_id: str, stats: Dict[str, int]) -> None:
         """Record one stage's scheduler placement totals (called by the pool
@@ -232,6 +269,181 @@ class QueryTrace:
             w["heartbeats"] = w.get("heartbeats", 0) + 1
         return [{"worker_id": k, **v} for k, v in sorted(by_worker.items())]
 
+    def straggler_report(self, threshold: Optional[float] = None) -> List[dict]:
+        """Tasks whose exec time exceeded `threshold` x their stage median —
+        the detection half of speculative re-execution (the scheduler can act
+        on exactly this list). Stages need >= 2 tasks for a meaningful
+        median; threshold defaults from DAFT_TPU_STRAGGLER_K (2.0)."""
+        k = threshold if threshold is not None else straggler_threshold()
+        with self._lock:
+            by_stage: Dict[str, List[TaskStats]] = {}
+            for t in self.tasks:
+                by_stage.setdefault(t.stage_id, []).append(t)
+        out = []
+        for sid, tasks in by_stage.items():
+            if len(tasks) < 2:
+                continue
+            med = statistics.median(t.exec_s for t in tasks)
+            if med <= 1e-9:
+                continue
+            for t in tasks:
+                if t.exec_s > k * med:
+                    out.append({
+                        "stage_id": sid, "task_id": t.task_id,
+                        "worker_id": t.worker_id, "exec_s": t.exec_s,
+                        "median_s": med, "ratio": t.exec_s / med,
+                    })
+        out.sort(key=lambda r: -r["ratio"])
+        return out
+
+    # ---- timeline export ---------------------------------------------------------
+    def to_chrome_trace(self, driver_ops=None, driver_spans=None,
+                        total_seconds: Optional[float] = None) -> dict:
+        """The query as Chrome trace-event JSON (open in Perfetto / chrome://
+        tracing): driver lane (query + stage windows + operator slices) and
+        one process per worker with a task lane, an operator lane, and a
+        device/io lane of REAL wall-clock spans (dispatch, h2d/d2h, coalescer
+        flushes, shuffle fetches). Worker timestamps are re-aligned onto the
+        driver clock via heartbeat-estimated offsets (clock_offsets).
+
+        Operator slices have no per-batch timestamps by design (recording
+        them would tax the hot path), so each lane lays its operators out
+        SEQUENTIALLY from the lane's start — slice WIDTH is the attributed
+        self time, position within the lane is schematic. Stall slices
+        (starve/blocked) ride a separate lane the same way. Device/io spans
+        are true wall-clock intervals.
+        """
+        epoch = self.started_wall
+        offsets = self.clock_offsets()
+        events: List[dict] = []
+        # trace-event pids/tids are integers; names arrive via "M" metadata.
+        # driver = pid 0; workers 1..N. Lane (tid) layout per process:
+        # 0 query/tasks, 1 stages (driver only), 2 operators, 3 stalls,
+        # 4 device/io
+        T_MAIN, T_STAGES, T_OPS, T_STALLS, T_IO = 0, 1, 2, 3, 4
+
+        def ev(name, cat, pid, tid, ts_s, dur_s, args=None):
+            e = {"name": name, "cat": cat, "ph": "X", "pid": pid, "tid": tid,
+                 "ts": round(ts_s * 1e6, 1),
+                 "dur": round(max(dur_s, 0.0) * 1e6, 1)}
+            if args:
+                e["args"] = args
+            events.append(e)
+
+        def meta(pid, kind, label, tid=None):
+            e = {"name": kind, "ph": "M", "pid": pid, "args": {"name": label}}
+            if tid is not None:
+                e["tid"] = tid
+            events.append(e)
+
+        def name_lanes(pid, main_label):
+            meta(pid, "thread_name", main_label, T_MAIN)
+            meta(pid, "thread_name", "operators", T_OPS)
+            meta(pid, "thread_name", "stalls", T_STALLS)
+            meta(pid, "thread_name", "device/io", T_IO)
+
+        def op_lanes(ops, pid, start_s):
+            """Sequential operator + stall lanes for one process/task."""
+            cursor = start_s
+            for s in ops:
+                # slice width = compute when the stall split is populated
+                # (stall lanes draw starve/blocked separately — a fully-
+                # starved operator must not double-draw its wait); whole
+                # self time only for split-less legacy records
+                split = (s.compute_seconds + s.starve_seconds
+                         + s.blocked_seconds)
+                width = s.compute_seconds if split > 0 else s.seconds
+                ev(s.name, "operator", pid, T_OPS, cursor, width,
+                   {"node_id": s.node_id, "rows_out": s.rows_out,
+                    "batches_out": s.batches_out,
+                    "compute_s": round(s.compute_seconds, 6),
+                    "starve_s": round(s.starve_seconds, 6),
+                    "blocked_s": round(s.blocked_seconds, 6)})
+                cursor += width
+            cursor = start_s
+            for s in ops:
+                if s.starve_seconds > 0:
+                    ev(f"starve:{s.name}", "stall", pid, T_STALLS, cursor,
+                       s.starve_seconds)
+                    cursor += s.starve_seconds
+                if s.blocked_seconds > 0:
+                    ev(f"blocked:{s.name}", "stall", pid, T_STALLS, cursor,
+                       s.blocked_seconds)
+                    cursor += s.blocked_seconds
+
+        def raw_spans(spans, pid, offset):
+            for sp in spans:
+                ev(sp["name"], sp.get("cat", "span"), pid, T_IO,
+                   sp["ts"] + offset - epoch, sp["dur"], sp.get("args"))
+
+        with self._lock:
+            tasks = list(self.tasks)
+            task_spans = {k: list(v) for k, v in self.task_spans.items()}
+
+        worker_pid = {wid: i + 1 for i, wid in
+                      enumerate(sorted({t.worker_id for t in tasks}))}
+
+        meta(0, "process_name", "driver")
+        name_lanes(0, "query")
+        meta(0, "thread_name", "stages", T_STAGES)
+        end = epoch + (total_seconds or 0.0)
+        for t in tasks:
+            off = offsets.get(t.worker_id, 0.0)
+            if t.started_at:
+                end = max(end, t.started_at + off + t.exec_s)
+        ev(f"query:{self.query_id or 'local'}", "query", 0, T_MAIN,
+           0.0, end - epoch, {"query_id": self.query_id})
+
+        # stage windows on the driver lane: [first task start, last task end]
+        by_stage: Dict[str, List[TaskStats]] = {}
+        for t in tasks:
+            by_stage.setdefault(t.stage_id, []).append(t)
+        for sid, sts in by_stage.items():
+            timed = [t for t in sts if t.started_at]
+            if not timed:
+                continue
+            s0 = min(t.started_at + offsets.get(t.worker_id, 0.0)
+                     for t in timed)
+            s1 = max(t.started_at + offsets.get(t.worker_id, 0.0) + t.exec_s
+                     for t in timed)
+            ev(f"stage:{sid}", "stage", 0, T_STAGES, s0 - epoch, s1 - s0,
+               {"tasks": len(sts)})
+
+        if driver_ops:
+            op_lanes(driver_ops, 0, 0.0)
+        if driver_spans:
+            raw_spans(driver_spans, 0, 0.0)
+
+        stragglers = {r["task_id"] for r in self.straggler_report()}
+        for wid, pid in worker_pid.items():
+            meta(pid, "process_name", f"worker {wid}")
+            name_lanes(pid, "tasks")
+        for t in tasks:
+            pid = worker_pid[t.worker_id]
+            off = offsets.get(t.worker_id, 0.0)
+            t0 = (t.started_at + off - epoch) if t.started_at else 0.0
+            ev(f"task:{t.task_id}", "task", pid, T_MAIN, t0, t.exec_s,
+               {"stage_id": t.stage_id, "worker_id": t.worker_id,
+                "rows_out": t.rows_out, "retries": t.retries,
+                "queue_wait_s": round(t.queue_wait_s, 6),
+                "straggler": t.task_id in stragglers})
+            if t.operator_stats:
+                op_lanes(t.operator_stats, pid, t0)
+            if t.task_id in task_spans:
+                raw_spans(task_spans[t.task_id], pid, off)
+
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "query_id": self.query_id,
+                "trace_id": self.trace_id,
+                "trace_epoch_unix_s": epoch,
+                "clock_offsets_s": offsets,
+                "workers": {w: p for w, p in worker_pid.items()},
+            },
+        }
+
     # ---- rendering ---------------------------------------------------------------
     def render(self) -> str:
         """The distributed EXPLAIN ANALYZE section: stage DAG rollup with task
@@ -278,6 +490,17 @@ class QueryTrace:
                     f"  {'':<20} (cache affinity: {s['affinity_hits']} hits, "
                     f"{s['affinity_misses']} misses, "
                     f"{_fmt_bytes(s['sched_bytes_avoided'])} transfer avoided)")
+        stragglers = self.straggler_report()
+        if stragglers:
+            k = straggler_threshold()
+            lines.append("")
+            lines.append(f"stragglers (> {k:g}x stage median task time — "
+                         "speculative re-execution candidates):")
+            for r in stragglers:
+                lines.append(
+                    f"  {r['stage_id']}/{r['task_id']} on {r['worker_id']}: "
+                    f"{r['exec_s']*1e3:.1f}ms vs median "
+                    f"{r['median_s']*1e3:.1f}ms ({r['ratio']:.1f}x)")
         workers = self.worker_summary()
         if workers:
             lines.append("")

@@ -90,17 +90,20 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
     from ..execution.executor import execute_plan
     from . import shuffle as shf
 
-    collector = recorder = None
+    collector = recorder = span_rec = None
     reg_before = None
     if task.collect_stats:
         from ..observability.metrics import registry
         from ..observability.otlp import _span_id
-        from ..observability.runtime_stats import StatsCollector, set_collector
+        from ..observability.runtime_stats import (SpanRecorder, StatsCollector,
+                                                   set_collector, set_spans)
 
         collector = StatsCollector()
         recorder = shf.ShuffleRecorder()
+        span_rec = SpanRecorder()
         reg_before = registry().snapshot()
         set_collector(collector)
+        set_spans(span_rec)
         shf.set_recorder(recorder)
     started_at = time.time()
     t0 = time.perf_counter()
@@ -116,6 +119,9 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
             res.bytes_out = sum(p.size_bytes() for p in parts)
             res.op_stats = tuple(collector.finish())
             res.shuffle = recorder.as_dict()
+            # timeline spans (device dispatch/h2d/d2h, shuffle fetch) in
+            # worker-clock unix time; the driver's QueryTrace re-aligns them
+            res.spans = tuple(span_rec.drain())
             res.span_id = _span_id(task.trace_id or task.task_id,
                                    "task", task.task_id)
             from ..observability.metrics import registry
@@ -127,9 +133,10 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
         return res
     finally:
         if task.collect_stats:
-            from ..observability.runtime_stats import set_collector
+            from ..observability.runtime_stats import set_collector, set_spans
 
             set_collector(None)
+            set_spans(None)
             shf.set_recorder(None)
 
 
@@ -299,6 +306,11 @@ class WorkerProcess:
         self._conn.send(("task", task))
 
     def _note_heartbeat(self, hb: dict) -> None:
+        # driver-side receive stamp: recv_ts - ts (worker send clock) over a
+        # query's beats lower-bounds to the worker->driver clock offset used
+        # to align worker span timestamps in the Chrome trace export
+        hb = dict(hb)
+        hb["recv_ts"] = time.time()
         self.heartbeats.append(hb)
         digest = hb.get("hbm_digest")
         if digest is not None:

@@ -51,7 +51,9 @@ def _exec(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
     if isinstance(node, _STAGE_NODES) and _pipeline_on():
         from .pipeline import spawn_stage
 
-        gen = spawn_stage(gen)
+        # node identity rides along so the stage channel can attribute
+        # put-side backpressure to this operator (no-op without a collector)
+        gen = spawn_stage(gen, node=node)
     return gen
 
 

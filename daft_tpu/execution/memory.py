@@ -8,8 +8,12 @@ DAFT_TPU_MEMORY_LIMIT); when over budget they switch to their spilling
 strategy (Grace partitioning / sorted-run generation) instead of OOMing.
 
 Spill files are Arrow IPC on local disk, written incrementally and read back
-streaming; `spills` counts every spilled batch so tests can assert the
-out-of-core path actually engaged.
+streaming; the `spill_batches` / `spill_bytes` counters live in the
+process-wide MetricsRegistry (observability/metrics.py) so spill activity
+reaches QueryEnd.metrics, EXPLAIN ANALYZE's engine counters, the dashboard's
+/metrics exposition, and the bench JSON. The historical module attributes
+(``memory.spills`` / ``memory.spill_bytes``) keep working as a PEP 562 view
+over the registry, the same pattern as ops/counters.py.
 """
 
 from __future__ import annotations
@@ -23,22 +27,32 @@ import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from ..core.recordbatch import RecordBatch
+from ..observability.metrics import registry
 from ..schema import Schema
 
-spills = 0          # batches written to spill files (test/observability hook)
-spill_bytes = 0
+SPILL_COUNTER_NAMES = (
+    "spill_batches",   # batches written to spill files
+    "spill_bytes",     # logical bytes of those batches
+)
+
+registry().declare(*SPILL_COUNTER_NAMES)
+
+_ATTR_TO_COUNTER = {"spills": "spill_batches", "spill_bytes": "spill_bytes"}
+
+
+def __getattr__(name: str) -> int:
+    if name in _ATTR_TO_COUNTER:
+        return registry().get(_ATTR_TO_COUNTER[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def _bump(n_batches: int, n_bytes: int) -> None:
-    global spills, spill_bytes
-    spills += n_batches
-    spill_bytes += n_bytes
+    registry().inc("spill_batches", n_batches)
+    registry().inc("spill_bytes", n_bytes)
 
 
 def reset_counters() -> None:
-    global spills, spill_bytes
-    spills = 0
-    spill_bytes = 0
+    registry().reset(SPILL_COUNTER_NAMES)
 
 
 class MemoryBudget:

@@ -25,8 +25,9 @@ primitives:
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from queue import Full, Queue
+from queue import Empty, Full, Queue
 from typing import Callable, Iterator, List, Optional
 
 from ..core.micropartition import MicroPartition
@@ -42,15 +43,38 @@ _SENTINEL = object()
 
 class Channel:
     """Bounded single-producer/single-consumer channel with error and
-    cancellation propagation."""
+    cancellation propagation.
 
-    def __init__(self, maxsize: int = 4):
+    Stall attribution (`profile` = (StatsCollector, producer_node_id), set by
+    spawn_stage only while a collector is active): time the producer spends
+    blocked in put() on a FULL queue is downstream backpressure charged to
+    the producer node; time a consumer spends blocked in get() on an EMPTY
+    queue is upstream starvation charged to whatever node is active on the
+    consumer thread. The unprofiled path is byte-for-byte the original —
+    uncontended put/get never read a clock."""
+
+    def __init__(self, maxsize: int = 4, profile=None):
         self._q: Queue = Queue(maxsize)
         self._cancel = threading.Event()
         self._err: Optional[BaseException] = None
+        self._profile = profile
 
     # ---- producer side -----------------------------------------------------------
     def put(self, item) -> None:
+        if self._profile is not None and not self._cancel.is_set():
+            try:
+                self._q.put_nowait(item)
+                return
+            except Full:
+                pass
+            t0 = time.perf_counter()
+            self._put_blocking(item)
+            collector, nid = self._profile
+            collector.note_blocked(nid, time.perf_counter() - t0)
+            return
+        self._put_blocking(item)
+
+    def _put_blocking(self, item) -> None:
         while True:
             if self._cancel.is_set():
                 raise StageCancelled()
@@ -75,7 +99,17 @@ class Channel:
     def __iter__(self) -> Iterator:
         try:
             while True:
-                item = self._q.get()
+                if self._profile is None:
+                    item = self._q.get()
+                else:
+                    try:
+                        item = self._q.get_nowait()
+                    except Empty:
+                        t0 = time.perf_counter()
+                        item = self._q.get()
+                        # starvation lands on the CONSUMER's active node (the
+                        # operator whose next() this wait happened inside)
+                        self._profile[0].note_starve(time.perf_counter() - t0)
                 if item is _SENTINEL:
                     if self._err is not None:
                         raise self._err
@@ -87,10 +121,15 @@ class Channel:
             self._cancel.set()
 
 
-def spawn_stage(gen: Iterator, maxsize: int = 4) -> Iterator:
+def spawn_stage(gen: Iterator, maxsize: int = 4, node=None) -> Iterator:
     """Run `gen` on a dedicated stage thread; return a bounded-channel iterator
     over its output. The stage thread inherits the ambient stats collector
     (threading.local in observability.runtime_stats).
+
+    `node` (the physical node whose generator this is) enables stall
+    attribution on the channel while a collector is active: put-side
+    backpressure is charged to this node, get-side starvation to the
+    consumer. With no collector the channel runs unprofiled.
 
     The thread starts on the FIRST pull, not at call time: a plan that is
     built but never iterated (caller bails before next()) must not leak
@@ -98,8 +137,10 @@ def spawn_stage(gen: Iterator, maxsize: int = 4) -> Iterator:
     consumer iterator, which would otherwise never run."""
     from ..observability.runtime_stats import current_collector, set_collector
 
-    ch = Channel(maxsize)
     collector = current_collector()
+    profile = (collector, collector.node_id(node)) \
+        if collector is not None and node is not None else None
+    ch = Channel(maxsize, profile=profile)
 
     def run():
         set_collector(collector)
@@ -136,21 +177,31 @@ def pmap_stream(stream: Iterator, fn: Callable, window: int = 0,
     and processing wall time are fed back via strategy.record() from the pool
     worker that ran it, closing the adaptive-batching feedback loop. None
     (static mode) adds nothing to the per-morsel path.
+
+    While a SpanRecorder is installed (timeline profiling) every morsel's
+    pool execution is additionally recorded as a "pipeline.morsel" span —
+    the recorder is captured here because pool workers are foreign threads.
     """
+    from ..observability.runtime_stats import current_spans
     from ..utils.pool import compute_pool
 
     pool = compute_pool()
     if window <= 0:
         window = pool._max_workers
-    if strategy is not None:
-        import time
-
+    spans = current_spans()
+    if strategy is not None or spans is not None:
         inner = fn
 
         def fn(item, i):  # noqa: F811 — timed wrapper around the caller's fn
             t0 = time.perf_counter()
+            w0 = time.time()
             out = inner(item, i)
-            strategy.record(item.num_rows, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            if strategy is not None:
+                strategy.record(item.num_rows, dt)
+            if spans is not None:
+                spans.record("pipeline.morsel", "compute", w0, w0 + dt,
+                             {"rows": item.num_rows})
             return out
     futs: deque = deque()
     try:

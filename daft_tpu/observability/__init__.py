@@ -14,7 +14,7 @@ from .events import (
     TaskStats,
     WorkerHeartbeat,
 )
-from .metrics import MetricsRegistry, registry
+from .metrics import Histogram, MetricsRegistry, prometheus_text, registry
 from .subscribers import (
     Subscriber,
     attach_subscriber,
@@ -22,7 +22,8 @@ from .subscribers import (
     notify,
     subscribers_active,
 )
-from .runtime_stats import StatsCollector, current_collector
+from .runtime_stats import (SpanRecorder, StatsCollector, current_collector,
+                            current_spans, profile_span, set_spans)
 
 __all__ = [
     "OperatorStats",
@@ -32,15 +33,21 @@ __all__ = [
     "ShuffleStats",
     "TaskStats",
     "WorkerHeartbeat",
+    "Histogram",
     "MetricsRegistry",
+    "prometheus_text",
     "registry",
     "Subscriber",
     "attach_subscriber",
     "detach_subscriber",
     "notify",
     "subscribers_active",
+    "SpanRecorder",
     "StatsCollector",
     "current_collector",
+    "current_spans",
+    "profile_span",
+    "set_spans",
 ]
 
 # OTLP trace export opt-in via environment (DAFT_TPU_OTLP_ENDPOINT)

@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .events import OperatorStats, QueryEnd, QueryOptimized, QueryStart
+from .metrics import Histogram, prometheus_text
 from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 
 _HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
@@ -80,12 +81,22 @@ class DashboardState(Subscriber):
     """Bounded history of query events (newest first) + a time-windowed view
     of worker heartbeats (slot occupancy, task counts, RSS)."""
 
-    def __init__(self, max_queries: int = 100, max_heartbeats: int = 512):
+    def __init__(self, max_queries: int = 100, max_heartbeats: int = 512,
+                 max_traces: int = 32):
         self._lock = threading.Lock()
         self._queries: deque = deque(maxlen=max_queries)
         self._by_id: dict = {}
         self._max_heartbeats = max_heartbeats
         self._workers: dict = {}  # worker_id -> deque of heartbeat dicts
+        # query_id -> QueryTrace (bounded separately from the query records:
+        # traces hold per-task spans and are served as downloads, not JSON'd
+        # into /api/queries)
+        self._traces: dict = {}
+        self._trace_order: deque = deque()
+        self._max_traces = max_traces
+        # per-query wall-clock latency, fixed Prometheus buckets -> p50/p99
+        # derivable by any scraper (and locally via .quantile)
+        self.query_latency = Histogram()
 
     def on_query_start(self, event: QueryStart) -> None:
         rec = {"query_id": event.query_id, "started": time.time(),
@@ -148,7 +159,20 @@ class DashboardState(Subscriber):
                        "hbm_h2d_bytes": getattr(hb, "hbm_h2d_bytes", 0),
                        "hbm_digest_entries": getattr(hb, "hbm_digest_entries", 0)})
 
+    def on_query_trace(self, query_id: str, trace) -> None:
+        with self._lock:
+            if query_id not in self._traces:
+                self._trace_order.append(query_id)
+                while len(self._trace_order) > self._max_traces:
+                    self._traces.pop(self._trace_order.popleft(), None)
+            self._traces[query_id] = trace
+
+    def trace(self, query_id: str):
+        with self._lock:
+            return self._traces.get(query_id)
+
     def on_query_end(self, event: QueryEnd) -> None:
+        self.query_latency.observe(event.seconds)
         with self._lock:
             rec = self._by_id.get(event.query_id)
             if rec is not None:
@@ -201,9 +225,43 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    def _metrics_text(self) -> str:
+        """Prometheus exposition: full registry + live HBM residency gauges
+        (read straight off the manager, so hbm_bytes_resident is present and
+        current even in a process that never updated the registry gauge) +
+        the per-query latency histogram."""
+        from ..ops import counters  # noqa: F401 — declares the device
+        # counter vocabulary at 0 (scrape surface must be import-order
+        # independent; same forcing import /api/engine does)
+        extra = {}
+        try:
+            from ..device.residency import manager
+
+            st = manager().stats()
+            extra["hbm_bytes_resident"] = st.get("hbm_bytes_resident", 0)
+            extra["hbm_bytes_high_water"] = st.get("hbm_bytes_high_water", 0)
+            extra["hbm_entries"] = st.get("hbm_entries", 0)
+        except Exception:  # noqa: BLE001 — a scrape must never 500 on a device-less host
+            extra["hbm_bytes_resident"] = 0
+        return prometheus_text(
+            extra_gauges=extra,
+            histograms={"query_latency_seconds": self.server.state.query_latency})
+
     def do_GET(self):
         if self.path.startswith("/api/queries"):
             body = json.dumps(self.server.state.snapshot(), default=str).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/query/") and self.path.endswith("/trace"):
+            # Chrome trace-event JSON download for one query's timeline
+            # (open in Perfetto / chrome://tracing)
+            qid = self.path.split("/")[-2]
+            trace = self.server.state.trace(qid)
+            if trace is None:
+                body = json.dumps({"error_404": True}).encode()
+            else:
+                rec = self.server.state.query(qid) or {}
+                body = json.dumps(trace.to_chrome_trace(
+                    total_seconds=rec.get("seconds"))).encode()
             ctype = "application/json"
         elif self.path.startswith("/api/query/"):
             qid = self.path.rsplit("/", 1)[1]
@@ -211,6 +269,9 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(rec if rec is not None else {"error_404": True},
                               default=str).encode()
             ctype = "application/json"
+        elif self.path == "/metrics" or self.path.startswith("/metrics?"):
+            body = self._metrics_text().encode()
+            ctype = "text/plain; version=0.0.4"
         elif self.path.startswith("/api/engine"):
             from ..ops import counters
 

@@ -24,7 +24,12 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # dispatches, coalescing, HBM traffic).
 # v5: shuffle_stats gains wire_bytes_written / fetch_wall_seconds /
 # overlap_seconds / fetch_fanin (pipelined compressed shuffle transport).
-SCHEMA_VERSION = 5
+# v6: operator_stats records (standalone and nested in task_stats) gain the
+# stall-attribution split compute_seconds / starve_seconds / blocked_seconds
+# (seconds == their sum); worker_heartbeat gains recv_ts (driver receive
+# stamp backing the Chrome-trace clock-offset estimate); spill counters
+# (spill_batches/spill_bytes) now appear in query_end.metrics.
+SCHEMA_VERSION = 6
 
 
 class EventLogSubscriber(Subscriber):
@@ -52,7 +57,10 @@ class EventLogSubscriber(Subscriber):
         # operator stats are emitted as spans/records of their own scale; keep
         # the task record flat and grep-able
         d["operator_stats"] = [{"name": o["name"], "rows_out": o["rows_out"],
-                                "seconds": o["seconds"]}
+                                "seconds": o["seconds"],
+                                "compute_seconds": o.get("compute_seconds", 0.0),
+                                "starve_seconds": o.get("starve_seconds", 0.0),
+                                "blocked_seconds": o.get("blocked_seconds", 0.0)}
                                for o in d.get("operator_stats", ())]
         self._emit("task_stats", {"query_id": qid, **d})
 

@@ -22,7 +22,14 @@ class QueryOptimized:
 
 @dataclass(frozen=True)
 class OperatorStats:
-    """Per-physical-operator runtime metrics for one query execution."""
+    """Per-physical-operator runtime metrics for one query execution.
+
+    `node_id` is a stable per-query sequential id (wrap order), NOT id() —
+    see StatsCollector.node_id. `seconds` (attributed self time) always
+    equals compute + starve + blocked, so the stall split reconciles with
+    the headline column: compute is the operator's own body, starve is time
+    blocked pulling from an empty upstream stage channel, blocked is time
+    its producer thread spent pushing into a full downstream channel."""
 
     node_id: int
     name: str
@@ -30,6 +37,9 @@ class OperatorStats:
     batches_out: int
     seconds: float        # wall time attributed to this operator (self time)
     detail: str = ""
+    compute_seconds: float = 0.0
+    starve_seconds: float = 0.0
+    blocked_seconds: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -102,6 +112,12 @@ class WorkerHeartbeat:
     # scheduler intersects with sub-plan fingerprints); the digest itself
     # stays out of the event record — it is scheduler input, not telemetry
     hbm_digest_entries: int = 0
+    # driver time.time() when the beat arrived (0 until the driver stamps
+    # it). ts is the WORKER's clock at send; recv_ts - ts, minimized over a
+    # query's beats, estimates the worker->driver clock offset (one-way
+    # Cristian bound) used to align worker span timestamps in the Chrome
+    # trace export (QueryTrace.clock_offsets)
+    recv_ts: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -66,6 +66,12 @@ class MetricsRegistry:
             out.update(self._gauges)
             return out
 
+    def export(self) -> "tuple[Dict[str, int], Dict[str, float]]":
+        """(counters, gauges) as separate copies — the Prometheus exposition
+        needs the TYPE distinction that snapshot() flattens away."""
+        with self._lock:
+            return dict(self._counters), dict(self._gauges)
+
     def diff(self, before: Dict[str, float]) -> Dict[str, float]:
         """Counter deltas since `before` (a prior snapshot); gauges report
         their current value, but only when it CHANGED since `before` — a
@@ -105,7 +111,113 @@ class MetricsRegistry:
 
 _REGISTRY = MetricsRegistry()
 
+# Counters owned by lazily-imported subsystems, pre-declared here so the
+# Prometheus exposition is import-order independent: a scraper must see the
+# series at 0 from the first scrape of a fresh process, not only after the
+# owning module happens to load (execution/memory.py declares these too —
+# declare() is a setdefault — and documents their semantics).
+_REGISTRY.declare("spill_batches", "spill_bytes")
+
 
 def registry() -> MetricsRegistry:
     """The process-wide registry (one per driver / worker process)."""
     return _REGISTRY
+
+
+# ---- Prometheus text exposition ------------------------------------------------------
+
+_NAME_SANITIZE = None  # compiled lazily; /metrics is a cold path
+
+
+def _prom_name(name: str) -> str:
+    global _NAME_SANITIZE
+    if _NAME_SANITIZE is None:
+        import re
+
+        _NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+    return _NAME_SANITIZE.sub("_", name)
+
+
+def prometheus_text(prefix: str = "daft_tpu_",
+                    extra_gauges: Optional[Dict[str, float]] = None,
+                    histograms: Optional[Dict[str, "Histogram"]] = None) -> str:
+    """The whole registry in Prometheus text exposition format (version
+    0.0.4): every counter as `<prefix><name>` TYPE counter, every gauge TYPE
+    gauge, plus caller-supplied live gauges (e.g. hbm_bytes_resident read
+    straight off the residency manager) and fixed-bucket histograms. Served
+    by the dashboard's /metrics endpoint; scrapeable by any standard infra."""
+    counters, gauges = _REGISTRY.export()
+    if extra_gauges:
+        for k, v in extra_gauges.items():
+            counters.pop(k, None)
+            gauges[k] = v
+    lines = []
+    for name in sorted(counters):
+        m = prefix + _prom_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {counters[name]}")
+    for name in sorted(gauges):
+        m = prefix + _prom_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {gauges[name]}")
+    for name in sorted(histograms or ()):
+        lines.extend(histograms[name].prometheus_lines(prefix + _prom_name(name)))
+    return "\n".join(lines) + "\n"
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics: bucket counts
+    are cumulative, le labels are upper bounds). Fixed buckets make p50/p99
+    derivable by any scraper via histogram_quantile; the default bucket set
+    spans interactive sub-second queries through multi-minute batch scans."""
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+    def __init__(self, buckets: Optional[Iterable[float]] = None):
+        self.buckets = tuple(sorted(buckets)) if buckets else self.DEFAULT_BUCKETS
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (the upper bound of the bucket
+        the q-th observation falls in) — what a scraper's
+        histogram_quantile() would report, computable locally."""
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return 0.0
+            rank = q * total
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                if cum >= rank:
+                    return b
+            return float("inf")
+
+    def prometheus_lines(self, metric: str) -> list:
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_count = self._sum, self._count
+        lines = [f"# TYPE {metric} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, counts[:-1]):
+            cum += c
+            lines.append(f'{metric}_bucket{{le="{b}"}} {cum}')
+        cum += counts[-1]
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{metric}_sum {total_sum}")
+        lines.append(f"{metric}_count {total_count}")
+        return lines

@@ -136,7 +136,10 @@ class OTLPSubscriber(Subscriber):
                 "startTimeUnixNano": str(ns0),
                 "endTimeUnixNano": str(ns0 + int(s.seconds * 1e9)),
                 "attributes": [_attr("daft.rows_out", s.rows_out),
-                               _attr("daft.batches_out", s.batches_out)],
+                               _attr("daft.batches_out", s.batches_out),
+                               _attr("daft.compute_s", s.compute_seconds),
+                               _attr("daft.starve_s", s.starve_seconds),
+                               _attr("daft.blocked_s", s.blocked_seconds)],
                 "status": {"code": 1},
             })
         # distributed sub-plan tasks: the worker computed span_id from the
@@ -172,7 +175,10 @@ class OTLPSubscriber(Subscriber):
                     "startTimeUnixNano": str(t_ns0),
                     "endTimeUnixNano": str(t_ns0 + int(s.seconds * 1e9)),
                     "attributes": [_attr("daft.rows_out", s.rows_out),
-                                   _attr("daft.batches_out", s.batches_out)],
+                                   _attr("daft.batches_out", s.batches_out),
+                                   _attr("daft.compute_s", s.compute_seconds),
+                                   _attr("daft.starve_s", s.starve_seconds),
+                                   _attr("daft.blocked_s", s.blocked_seconds)],
                     "status": {"code": 1},
                 })
         return {"resourceSpans": [{

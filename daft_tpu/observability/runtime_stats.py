@@ -1,17 +1,40 @@
-"""Per-operator runtime statistics (reference:
-daft-local-execution/src/runtime_stats — rows/CPU per pipeline node feeding
-progress bars, subscribers, and EXPLAIN ANALYZE).
+"""Per-operator runtime statistics + the query timeline profiler's span sink
+(reference: daft-local-execution/src/runtime_stats — rows/CPU per pipeline
+node feeding progress bars, subscribers, and EXPLAIN ANALYZE).
 
 The executor asks current_collector() per query; when a collector is active
 (subscribers attached or explain_analyze running) every physical node's
 output iterator is wrapped to count rows/batches and attribute self-time.
 When inactive the executor takes its zero-overhead path.
+
+Wall-clock attribution (the profiler tentpole): an operator's attributed
+self time splits three ways —
+
+- compute: time its own body spent producing (total next() time minus nested
+  same-thread children minus channel starvation),
+- starve: time blocked pulling from an UPSTREAM stage channel that had
+  nothing ready (pipeline.Channel get-side, attributed to the consumer node
+  active on that thread),
+- blocked: time the operator's stage thread spent blocked pushing into a
+  FULL downstream channel (pipeline.Channel put-side backpressure, attributed
+  to the channel's producer node).
+
+seconds == compute + starve + blocked by construction, so EXPLAIN ANALYZE's
+stall columns always reconcile with the self-time column.
+
+SpanRecorder is the timeline profiler's sink: coarse wall-clock spans
+(device dispatch, H2D/D2H transfer, coalescer flushes, shuffle fetches)
+recorded by the engine only while a recorder is installed — the no-recorder
+path is a single attribute read, preserving the zero-overhead guarantee.
+One process-wide slot (like distributed.shuffle's ShuffleRecorder): workers
+run one task at a time and the driver profiles one query at a time.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from .events import OperatorStats
@@ -21,8 +44,28 @@ _local = threading.local()
 
 class StatsCollector:
     def __init__(self) -> None:
-        # node_id -> [name, rows, batches, total_seconds, child_seconds]
+        # nid -> [name, rows, batches, total_seconds, child_seconds,
+        #         starve_seconds, blocked_seconds]
         self._nodes: Dict[int, list] = {}
+        # stable per-query sequential node ids: keyed off id(node) for O(1)
+        # lookup, but every wrapped node is ANCHORED (strong ref) for the
+        # collector's lifetime so CPython can never reuse a freed node's id
+        # mid-query and silently merge two operators' stats (the id()-reuse
+        # bug class fixed for _decision_key in the residency manager)
+        self._ids: Dict[int, int] = {}
+        self._anchors: List[object] = []
+        self._seq = 0
+
+    def node_id(self, node) -> int:
+        """Stable sequential id for `node` within this collector (1-based in
+        wrap order — deterministic across identical runs, unlike id())."""
+        nid = self._ids.get(id(node))
+        if nid is None:
+            self._seq += 1
+            nid = self._seq
+            self._ids[id(node)] = nid
+            self._anchors.append(node)
+        return nid
 
     def wrap(self, node, iterator):
         """Wrap one operator's output iterator with row/time accounting.
@@ -30,8 +73,9 @@ class StatsCollector:
         Attributed time is SELF time: total time blocked in this operator's
         next() minus time its direct children spent producing for it.
         """
-        nid = id(node)
-        entry = self._nodes.setdefault(nid, [node.name(), 0, 0, 0.0, 0.0])
+        nid = self.node_id(node)
+        entry = self._nodes.setdefault(
+            nid, [node.name(), 0, 0, 0.0, 0.0, 0.0, 0.0])
 
         def gen():
             while True:
@@ -59,12 +103,35 @@ class StatsCollector:
 
         return gen()
 
+    # ---- stall attribution (called by pipeline.Channel) --------------------------
+    def note_starve(self, seconds: float) -> None:
+        """Upstream starvation: the calling thread's active node spent
+        `seconds` blocked on an empty stage channel. The wait happened inside
+        that node's next() window, so it is carved OUT of compute at finish()."""
+        nid = getattr(_local, "active", None)
+        if nid is not None:
+            entry = self._nodes.get(nid)
+            if entry is not None:
+                entry[5] += seconds
+
+    def note_blocked(self, nid: int, seconds: float) -> None:
+        """Downstream backpressure: node `nid`'s stage thread spent `seconds`
+        blocked pushing into a full channel. Happens OUTSIDE the node's
+        next() window (the producer loop), so finish() adds it on top."""
+        entry = self._nodes.get(nid)
+        if entry is not None:
+            entry[6] += seconds
+
     def finish(self) -> List[OperatorStats]:
         out = []
-        for nid, (name, rows, batches, total, child) in self._nodes.items():
+        for nid, (name, rows, batches, total, child, starve,
+                  blocked) in self._nodes.items():
+            compute = max(total - child - starve, 0.0)
             out.append(OperatorStats(
                 node_id=nid, name=name, rows_out=rows, batches_out=batches,
-                seconds=max(total - child, 0.0)))
+                seconds=compute + starve + blocked,
+                compute_seconds=compute, starve_seconds=starve,
+                blocked_seconds=blocked))
         return out
 
 
@@ -76,10 +143,103 @@ def set_collector(c: Optional[StatsCollector]) -> None:
     _local.collector = c
 
 
+# ---- timeline spans ------------------------------------------------------------------
+
+
+class SpanRecorder:
+    """Thread-safe wall-clock span sink for the query timeline profiler.
+
+    Spans are plain dicts (picklable — workers ship them back in TaskResult):
+    {"name", "cat", "ts": unix seconds, "dur": seconds, "args": {...}}.
+    Bounded: past `cap` spans the recorder counts drops instead of growing —
+    a pathological query must never OOM the profiler.
+    """
+
+    def __init__(self, cap: int = 8192):
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+        self.cap = cap
+        self.dropped = 0
+
+    def record(self, name: str, cat: str, t0: float, t1: float,
+               args: Optional[dict] = None) -> None:
+        span = {"name": name, "cat": cat, "ts": t0, "dur": max(t1 - t0, 0.0)}
+        if args:
+            span["args"] = args
+        with self._lock:
+            if len(self._spans) >= self.cap:
+                self.dropped += 1
+                return
+            self._spans.append(span)
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            spans, self._spans = self._spans, []
+            return spans
+
+
+# process-global active span recorder (None = profiling off everywhere; the
+# engine's instrumentation sites pay one module-attribute read)
+_ACTIVE_SPANS: Optional[SpanRecorder] = None
+
+
+def current_spans() -> Optional[SpanRecorder]:
+    return _ACTIVE_SPANS
+
+
+def set_spans(rec: Optional[SpanRecorder]) -> None:
+    global _ACTIVE_SPANS
+    _ACTIVE_SPANS = rec
+
+
+@contextmanager
+def profile_span(name: str, cat: str, **args):
+    """Record the enclosed block as a timeline span when a SpanRecorder is
+    active; a no-op (no clock read, no record) otherwise. Used at COARSE
+    sites only (a device dispatch, a coalescer flush, a shuffle fetch),
+    never per row."""
+    rec = _ACTIVE_SPANS
+    if rec is None:
+        yield
+        return
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        rec.record(name, cat, t0, time.time(), args or None)
+
+
+def span_iter(name: str, cat: str, inner, **args):
+    """Stream `inner` through as-is; while a SpanRecorder is active, record
+    ONE span covering the whole consumption window (first pull to exhaustion
+    or consumer close), with rows/batches accumulated into the span args on
+    top of the caller's. The no-recorder path delegates without timing —
+    the streaming counterpart of profile_span, shared by the shuffle
+    read/fetch sites."""
+    rec = _ACTIVE_SPANS
+    if rec is None:
+        yield from inner
+        return
+    t0 = time.time()
+    rows = batches = 0
+    try:
+        for part in inner:
+            rows += part.num_rows
+            batches += 1
+            yield part
+    finally:
+        rec.record(name, cat, t0, time.time(),
+                   {**args, "rows": rows, "batches": batches})
+
+
 def format_stats(stats: List[OperatorStats], total_seconds: float) -> str:
-    lines = [f"{'operator':<24} {'rows out':>12} {'batches':>8} {'self time':>10}"]
+    lines = [f"{'operator':<24} {'rows out':>12} {'batches':>8} "
+             f"{'self time':>10} {'compute':>10} {'starve':>10} {'blocked':>10}"]
     for s in sorted(stats, key=lambda s: -s.seconds):
-        lines.append(f"{s.name:<24} {s.rows_out:>12} {s.batches_out:>8} "
-                     f"{s.seconds * 1000:>8.1f}ms")
+        lines.append(
+            f"{s.name:<24} {s.rows_out:>12} {s.batches_out:>8} "
+            f"{s.seconds * 1000:>8.1f}ms {s.compute_seconds * 1000:>8.1f}ms "
+            f"{s.starve_seconds * 1000:>8.1f}ms "
+            f"{s.blocked_seconds * 1000:>8.1f}ms")
     lines.append(f"{'TOTAL':<24} {'':>12} {'':>8} {total_seconds * 1000:>8.1f}ms")
     return "\n".join(lines)

@@ -36,6 +36,12 @@ class Subscriber:
     def on_worker_heartbeat(self, query_id: str, hb: WorkerHeartbeat) -> None:  # pragma: no cover
         pass
 
+    def on_query_trace(self, query_id: str, trace) -> None:  # pragma: no cover
+        """The distributed run's assembled QueryTrace (distributed/trace.py)
+        at query end — the timeline profiler's source object. Subscribers
+        that persist it should render via trace.to_chrome_trace()."""
+        pass
+
     def on_query_end(self, event: QueryEnd) -> None:  # pragma: no cover
         pass
 

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from ..core.kernels.encoding import _common_key_dtype, canonical_key_values
 from ..datatype import DataType, Field
 from ..device.residency import expr_structure, exprs_structure
+from ..observability.runtime_stats import profile_span
 from ..expressions.expressions import (AggExpr, Alias, BinaryOp, ColumnRef,
                                        Expression, IsIn, Literal)
 from ..schema import Schema
@@ -1229,34 +1230,37 @@ class DeviceJoinGroupedRun(GroupedAggRun):
             gb_cols.append(node._name)
 
         total = None if self.force_host_codes else self._dict_product(batch, gb_cols)
-        if total is not None and 0 < total <= min(self.max_segments,
-                                                  MAX_MATMUL_SEGMENTS):
-            dcols, code_planes = self.ctx.provision(batch, bucket, needed, gb_cols)
-            decode = self._dict_combined_codes(batch, n, bucket, gb_cols,
-                                               code_planes)
-            prog = stage._jit_for(decode.cap)
-            out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
-                       jnp.asarray(float(self._row_offset)))
-        else:
-            decode = self._host_factorized_codes(batch, n, bucket)
-            if decode.permuted:
-                if stage._sct_specs or stage._use_f64:
-                    # statically incompatible with the local-dense program:
-                    # bail BEFORE dispatching the packed gathers
-                    raise DeviceFallback(
-                        "local-dense path cannot serve 64-bit scatter "
-                        "extremes / f64-exact stages")
-                _pp, pdev, _l, _s = decode.fact_codes.perm_layout()
-                dcols, _ = self.ctx.provision(batch, bucket, needed, (),
-                                              perm=(decode.pperm, pdev))
-                prog = stage._jit_local(decode.cap)
-                out = prog(dcols, decode.local_codes, decode.seg_lo,
-                           device_row_mask(n, bucket))
-            else:
-                dcols, _ = self.ctx.provision(batch, bucket, needed, ())
+        with profile_span("device.dispatch", "device", op="join_agg",
+                          rows=n, bucket=bucket):
+            if total is not None and 0 < total <= min(self.max_segments,
+                                                      MAX_MATMUL_SEGMENTS):
+                dcols, code_planes = self.ctx.provision(batch, bucket, needed,
+                                                        gb_cols)
+                decode = self._dict_combined_codes(batch, n, bucket, gb_cols,
+                                                   code_planes)
                 prog = stage._jit_for(decode.cap)
                 out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
                            jnp.asarray(float(self._row_offset)))
+            else:
+                decode = self._host_factorized_codes(batch, n, bucket)
+                if decode.permuted:
+                    if stage._sct_specs or stage._use_f64:
+                        # statically incompatible with the local-dense program:
+                        # bail BEFORE dispatching the packed gathers
+                        raise DeviceFallback(
+                            "local-dense path cannot serve 64-bit scatter "
+                            "extremes / f64-exact stages")
+                    _pp, pdev, _l, _s = decode.fact_codes.perm_layout()
+                    dcols, _ = self.ctx.provision(batch, bucket, needed, (),
+                                                  perm=(decode.pperm, pdev))
+                    prog = stage._jit_local(decode.cap)
+                    out = prog(dcols, decode.local_codes, decode.seg_lo,
+                               device_row_mask(n, bucket))
+                else:
+                    dcols, _ = self.ctx.provision(batch, bucket, needed, ())
+                    prog = stage._jit_for(decode.cap)
+                    out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
+                               jnp.asarray(float(self._row_offset)))
         decode.row_offset = float(self._row_offset)
         self._row_offset += n
         self._pending.append((out, decode))
@@ -1507,7 +1511,8 @@ class DeviceJoinTopNRun(DeviceJoinGroupedRun):
                  tuple(e[top] for e in out["ext"]),
                  tuple(s[top] for s in out["sct"]),
                  present[top])
-        gids, mm_rows, ext_rows, sct_rows, present_rows = jax.device_get(fetch)
+        with profile_span("device.d2h", "device", op="join_topn", rows=int(k_eff)):
+            gids, mm_rows, ext_rows, sct_rows, present_rows = jax.device_get(fetch)
         counters.bump("device_stage_runs")
         counters.bump("device_topn_runs")
 
@@ -1598,8 +1603,9 @@ class DeviceJoinUngroupedRun(FilterAggRun):
         if n == 0:
             return
         bucket = pad_bucket(n)
-        dcols = self.ctx.device_cols(batch, bucket,
-                                     list(self.stage._input_cols) + ["__join_ok__"])
+        with profile_span("device.h2d", "device", rows=n, bucket=bucket):
+            dcols = self.ctx.device_cols(
+                batch, bucket, list(self.stage._input_cols) + ["__join_ok__"])
         self._run(dcols, n, bucket)
         counters.bump("device_join_batches")
 

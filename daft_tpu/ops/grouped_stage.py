@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..expressions.expressions import AggExpr, Alias, ColumnRef, Expression
+from ..observability.runtime_stats import profile_span
 from ..schema import Schema
 from . import counters
 from . import device_eval as dev
@@ -691,11 +692,14 @@ class GroupedAggRun:
         bucket = pad_bucket(n)
         decode = self._codes_for(batch, n, bucket)
         prog = stage._jit_for(decode.cap)
-        dcols = {name: batch.get_column(name).to_device_cached(
-                     bucket, f32=not stage._use_f64)
-                 for name in stage._input_cols}
-        out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
-                   jnp.asarray(float(self._row_offset)))
+        with profile_span("device.h2d", "device", rows=n, bucket=bucket):
+            dcols = {name: batch.get_column(name).to_device_cached(
+                         bucket, f32=not stage._use_f64)
+                     for name in stage._input_cols}
+        with profile_span("device.dispatch", "device", op="grouped_agg",
+                          rows=n, bucket=bucket, groups_cap=decode.cap):
+            out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
+                       jnp.asarray(float(self._row_offset)))
         self._row_offset += n
         self._pending.append((out, decode))
         counters.bump("device_grouped_batches")
@@ -775,7 +779,9 @@ class GroupedAggRun:
             counters.bump("device_stage_runs")
             return [], [(np.empty(0), np.empty(0, dtype=bool)) for _ in stage.aggs]
 
-        fetched = jax.device_get([out for out, _ in pending])  # single round trip
+        with profile_span("device.d2h", "device", op="grouped_agg",
+                          batches=len(pending)):
+            fetched = jax.device_get([out for out, _ in pending])  # one round trip
         counters.bump("device_stage_runs")
 
         # host merge across batches: key tuple -> slot, vectorized per table

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..expressions.expressions import AggExpr, Alias, Expression
+from ..observability.runtime_stats import profile_span
 from ..schema import Schema
 from . import counters
 from . import device_eval as dev
@@ -178,22 +179,25 @@ class FilterAggRun:
         self._device_partials: List[Dict] = []
 
     def _run(self, dcols: Dict[str, dev.DCol], n: int, bucket: int) -> None:
-        res = self.stage._jit_for(bucket)(dcols, device_row_mask(n, bucket))
+        with profile_span("device.dispatch", "device", op="filter_agg",
+                          rows=n, bucket=bucket):
+            res = self.stage._jit_for(bucket)(dcols, device_row_mask(n, bucket))
         counters.bump("device_stage_batches")
         self._device_partials.append(res)  # stays on device; fetched at finalize
 
     def feed(self, columns: Dict[str, Tuple[np.ndarray, np.ndarray]], n: int) -> None:
         bucket = pad_bucket(n)
-        dcols = {}
-        for name in self.stage._input_cols:
-            vals, valid = columns[name]
-            if vals.dtype == np.float64 and not self.stage._use_f64:
-                vals = vals.astype(np.float32)
-            if len(vals) < bucket:
-                pad = bucket - len(vals)
-                vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
-                valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
-            dcols[name] = (jnp.asarray(vals), jnp.asarray(valid))
+        with profile_span("device.h2d", "device", rows=n, bucket=bucket):
+            dcols = {}
+            for name in self.stage._input_cols:
+                vals, valid = columns[name]
+                if vals.dtype == np.float64 and not self.stage._use_f64:
+                    vals = vals.astype(np.float32)
+                if len(vals) < bucket:
+                    pad = bucket - len(vals)
+                    vals = np.concatenate([vals, np.zeros(pad, dtype=vals.dtype)])
+                    valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
+                dcols[name] = (jnp.asarray(vals), jnp.asarray(valid))
         self._run(dcols, n, bucket)
 
     def feed_batch(self, batch) -> None:
@@ -201,15 +205,18 @@ class FilterAggRun:
         n = batch.num_rows
         bucket = pad_bucket(n)
         f32 = not self.stage._use_f64
-        dcols = {name: batch.get_column(name).to_device_cached(bucket, f32=f32)
-                 for name in self.stage._input_cols}
+        with profile_span("device.h2d", "device", rows=n, bucket=bucket):
+            dcols = {name: batch.get_column(name).to_device_cached(bucket, f32=f32)
+                     for name in self.stage._input_cols}
         self._run(dcols, n, bucket)
 
     def finalize(self) -> Dict[str, Optional[float]]:
-        fetched = [
-            {k: (v[0].item(), bool(v[1])) for k, v in res.items()}
-            for res in jax.device_get(self._device_partials)  # single round trip
-        ]
+        with profile_span("device.d2h", "device", op="filter_agg",
+                          batches=len(self._device_partials)):
+            fetched = [
+                {k: (v[0].item(), bool(v[1])) for k, v in res.items()}
+                for res in jax.device_get(self._device_partials)  # one round trip
+            ]
         out = {}
         for name, agg in self.stage.aggs:
             if not fetched:
@@ -288,7 +295,8 @@ class DispatchCoalescer:
     def flush(self) -> None:
         if not self._pending:
             return
-        if len(self._pending) == 1:
+        morsels_in = len(self._pending)
+        if morsels_in == 1:
             batch = self._pending[0]  # identity-preserving: device caches hit
         else:
             from ..core.recordbatch import RecordBatch
@@ -297,7 +305,11 @@ class DispatchCoalescer:
         self._pending = []
         self._rows = 0
         self._oldest = None
-        self._feed(batch)
+        with profile_span("device.coalesce_flush", "device",
+                          morsels_in=morsels_in, rows=batch.num_rows,
+                          fill_ratio=round(
+                              batch.num_rows / pad_bucket(batch.num_rows), 4)):
+            self._feed(batch)
         counters.bump("dispatch_coalesced")
         counters.bump("bucket_fill_rows", batch.num_rows)
         counters.bump("bucket_capacity_rows", pad_bucket(batch.num_rows))

@@ -108,19 +108,43 @@ def test_no_subscribers_no_overhead_path():
 def test_overhead_guard_zero_subscribers_zero_instrumentation(monkeypatch):
     """Tier-1 overhead guard: with no subscribers attached, a query must take
     the zero-overhead path — no StatsCollector wrapping anywhere in the
-    executor, and the metrics registry untouched — so observability can never
-    silently tax the hot path."""
+    executor, no timeline span recording, no stall-clock reads on the
+    pipeline channels, and the metrics registry untouched — so observability
+    can never silently tax the hot path."""
+    from daft_tpu.execution import pipeline
     from daft_tpu.observability import runtime_stats
     from daft_tpu.observability.metrics import registry
     from daft_tpu.observability.subscribers import subscribers_active
 
     assert not subscribers_active(), \
         "leaked subscriber from another test would invalidate this guard"
+    assert runtime_stats.current_spans() is None, \
+        "leaked span recorder from another test would invalidate this guard"
 
     def _forbidden_wrap(self, node, iterator):
         raise AssertionError("StatsCollector.wrap called on the zero-overhead path")
 
+    def _forbidden_span(self, *a, **k):
+        raise AssertionError("SpanRecorder.record called on the zero-overhead path")
+
+    def _forbidden_stall(self, *a, **k):
+        raise AssertionError("stall attribution ran on the zero-overhead path")
+
     monkeypatch.setattr(runtime_stats.StatsCollector, "wrap", _forbidden_wrap)
+    monkeypatch.setattr(runtime_stats.SpanRecorder, "record", _forbidden_span)
+    monkeypatch.setattr(runtime_stats.StatsCollector, "note_starve",
+                        _forbidden_stall)
+    monkeypatch.setattr(runtime_stats.StatsCollector, "note_blocked",
+                        _forbidden_stall)
+
+    # every stage channel must be UNPROFILED with no collector active
+    orig_channel_init = pipeline.Channel.__init__
+
+    def _checked_init(self, maxsize=4, profile=None):
+        assert profile is None, "profiled Channel on the zero-overhead path"
+        orig_channel_init(self, maxsize, profile)
+
+    monkeypatch.setattr(pipeline.Channel, "__init__", _checked_init)
     before = registry().snapshot()
     df = daft_tpu.from_pydict({"a": list(range(1000)), "b": ["x", "y"] * 500})
     out = (df.where(col("a") >= 500)

@@ -1,0 +1,521 @@
+"""Query timeline profiler: stall-attributed operator time, Chrome-trace
+export with per-worker task lanes and device spans, the Prometheus /metrics
+surface, straggler detection, spill-counter registry plumbing, and the bench
+perf-regression gate (ISSUE 6)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import daft_tpu
+import daft_tpu.runners as runners
+from daft_tpu import col
+from daft_tpu.config import execution_config_ctx
+from daft_tpu.observability.events import OperatorStats, TaskStats
+from daft_tpu.observability.runtime_stats import (SpanRecorder, StatsCollector,
+                                                 current_spans, profile_span,
+                                                 set_collector, set_spans)
+
+
+class _FakeNode:
+    def __init__(self, name):
+        self._name = name
+
+    def name(self):
+        return self._name
+
+
+class _Part:
+    num_rows = 1
+
+
+# ---------------------------------------------------------------------------
+# Stall attribution: starve / blocked split through the pipeline channels
+# ---------------------------------------------------------------------------
+
+def test_channel_starve_attributed_to_consumer():
+    """A slow producer starves its consumer: the wait shows up as the
+    consumer's starve_seconds, and compute+starve+blocked == seconds."""
+    from daft_tpu.execution.pipeline import spawn_stage
+
+    c = StatsCollector()
+    producer, consumer = _FakeNode("producer"), _FakeNode("consumer")
+
+    def produce():
+        for _ in range(3):
+            time.sleep(0.03)
+            yield _Part()
+
+    set_collector(c)
+    try:
+        upstream = spawn_stage(c.wrap(producer, produce()), node=producer)
+
+        def consume():
+            for part in upstream:
+                yield part
+
+        n = sum(p.num_rows for p in c.wrap(consumer, consume()))
+    finally:
+        set_collector(None)
+    assert n == 3
+    stats = {s.name: s for s in c.finish()}
+    cons = stats["consumer"]
+    assert cons.starve_seconds > 0.05, cons
+    assert cons.compute_seconds < cons.starve_seconds
+    for s in stats.values():
+        assert s.seconds == pytest.approx(
+            s.compute_seconds + s.starve_seconds + s.blocked_seconds)
+
+
+def test_channel_blocked_attributed_to_producer():
+    """A slow consumer backpressures the producer through the bounded
+    channel: the producer's blocked_seconds captures the put-side waits."""
+    from daft_tpu.execution.pipeline import spawn_stage
+
+    c = StatsCollector()
+    producer = _FakeNode("producer")
+
+    def produce():
+        for _ in range(8):
+            yield _Part()
+
+    set_collector(c)
+    try:
+        upstream = spawn_stage(c.wrap(producer, produce()), maxsize=1,
+                               node=producer)
+        n = 0
+        for part in upstream:
+            time.sleep(0.02)  # slow consumer -> full channel upstream
+            n += part.num_rows
+    finally:
+        set_collector(None)
+    assert n == 8
+    prod = {s.name: s for s in c.finish()}["producer"]
+    assert prod.blocked_seconds > 0.03, prod
+    assert prod.seconds == pytest.approx(
+        prod.compute_seconds + prod.starve_seconds + prod.blocked_seconds)
+
+
+def test_stable_node_ids_survive_id_reuse():
+    """Sequential node ids: two distinct nodes never share stats even if
+    CPython hands the second the first's recycled id() (the collector anchors
+    every wrapped node, making reuse impossible while it is alive)."""
+    c = StatsCollector()
+    ids = set()
+    for i in range(50):
+        # no reference kept by the caller — without anchoring, id() reuse
+        # across iterations would be near-certain here
+        nid = c.node_id(_FakeNode(f"n{i}"))
+        assert nid not in ids
+        ids.add(nid)
+    assert ids == set(range(1, 51))
+
+
+def test_explain_analyze_shows_stall_columns():
+    rng = np.random.default_rng(0)
+    df = daft_tpu.from_pydict({
+        "k": rng.choice(["a", "b", "c"], 20_000).tolist(),
+        "v": rng.uniform(0, 1, 20_000).tolist(),
+    })
+    report = (df.where(col("v") > 0.25)
+              .groupby("k").agg(col("v").sum().alias("s"))
+              .explain_analyze())
+    assert "compute" in report and "starve" in report and "blocked" in report
+    assert "== Runtime Stats ==" in report
+
+
+# ---------------------------------------------------------------------------
+# SpanRecorder + device spans
+# ---------------------------------------------------------------------------
+
+def test_span_recorder_profile_span_and_cap():
+    rec = SpanRecorder(cap=2)
+    set_spans(rec)
+    try:
+        with profile_span("a", "device", rows=5):
+            pass
+        with profile_span("b", "io"):
+            pass
+        with profile_span("c", "io"):  # over cap -> dropped, not grown
+            pass
+    finally:
+        set_spans(None)
+    assert current_spans() is None
+    spans = rec.drain()
+    assert [s["name"] for s in spans] == ["a", "b"]
+    assert spans[0]["args"] == {"rows": 5}
+    assert rec.dropped == 1
+    # no recorder active: profile_span must not record anywhere
+    with profile_span("ghost", "device"):
+        pass
+    assert rec.drain() == []
+
+
+def test_device_stage_records_dispatch_spans():
+    """DAFT_TPU_DEVICE=on (JAX CPU backend): the device agg path emits
+    h2d/dispatch/d2h spans while a recorder is installed."""
+    rng = np.random.default_rng(1)
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, 8, 30_000).tolist(),
+        "v": rng.uniform(0, 100, 30_000).tolist(),
+    })
+    rec = SpanRecorder()
+    set_spans(rec)
+    try:
+        with execution_config_ctx(device_mode="on"):
+            out = df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+    finally:
+        set_spans(None)
+    assert len(out["k"]) == 8
+    names = {s["name"] for s in rec.drain()}
+    assert "device.dispatch" in names, names
+    assert "device.d2h" in names, names
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export
+# ---------------------------------------------------------------------------
+
+def _mk_task(stage, task_id, worker, started, exec_s, ops=(), **kw):
+    return TaskStats(stage_id=stage, task_id=task_id, worker_id=worker,
+                     queue_wait_s=0.0, schedule_latency_s=0.0, exec_s=exec_s,
+                     rows_out=10, bytes_out=100, retries=0,
+                     started_at=started, operator_stats=tuple(ops), **kw)
+
+
+def test_chrome_trace_synthetic_lanes_and_offsets():
+    from daft_tpu.distributed.trace import QueryTrace
+
+    tr = QueryTrace("qtest")
+    t0 = tr.started_wall
+    op = OperatorStats(node_id=1, name="PhysAgg", rows_out=10, batches_out=1,
+                      seconds=0.3, compute_seconds=0.1, starve_seconds=0.15,
+                      blocked_seconds=0.05)
+    tr.tasks.append(_mk_task("s0", "t0", "worker-0", t0 + 0.1, 0.5, [op]))
+    tr.tasks.append(_mk_task("s0", "t1", "worker-1", t0 + 0.1, 0.4))
+    tr.task_spans["t0"] = [{"name": "device.dispatch", "cat": "device",
+                            "ts": t0 + 0.2, "dur": 0.05,
+                            "args": {"rows": 10}}]
+    # heartbeats: worker-1's clock runs 2s behind the driver
+    tr.add_heartbeat({"worker_id": "worker-1", "ts": t0 - 2.0,
+                      "recv_ts": t0 + 0.001})
+    tr.add_heartbeat({"worker_id": "worker-1", "ts": t0 - 1.5,
+                      "recv_ts": t0 + 0.6})
+    offs = tr.clock_offsets()
+    assert offs["worker-1"] == pytest.approx(2.001, abs=1e-6)
+
+    data = tr.to_chrome_trace(total_seconds=1.0)
+    evs = data["traceEvents"]
+    assert all(isinstance(e["pid"], int) or e["ph"] == "M" for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and isinstance(e["ts"], float) for e in xs)
+    # two worker processes with task slices
+    task_pids = {e["pid"] for e in xs if e["cat"] == "task"}
+    assert len(task_pids) == 2
+    # the device span landed on worker-0's device/io lane at a real offset
+    disp = [e for e in xs if e["name"] == "device.dispatch"]
+    assert len(disp) == 1 and disp[0]["ts"] == pytest.approx(0.2e6, abs=1e3)
+    # operator + stall slices
+    assert any(e["cat"] == "operator" and e["name"] == "PhysAgg" for e in xs)
+    assert any(e["name"] == "starve:PhysAgg" for e in xs)
+    # stage + query slices on the driver (pid 0)
+    assert any(e["cat"] == "stage" and e["pid"] == 0 for e in xs)
+    assert any(e["cat"] == "query" and e["pid"] == 0 for e in xs)
+    assert data["metadata"]["clock_offsets_s"]["worker-1"] > 1.9
+    json.dumps(data)  # wholly serializable
+
+
+def test_straggler_report_thresholds(monkeypatch):
+    from daft_tpu.distributed.trace import QueryTrace
+
+    tr = QueryTrace("qs")
+    tr._stage_order.append("s0")   # normally set by record_task
+    tr._shuffle["s0"] = {}
+    for i in range(4):
+        tr.tasks.append(_mk_task("s0", f"t{i}", "w0", 0.0, 0.1))
+    tr.tasks.append(_mk_task("s0", "slow", "w1", 0.0, 1.0))
+    flagged = tr.straggler_report(threshold=2.0)
+    assert [r["task_id"] for r in flagged] == ["slow"]
+    assert flagged[0]["ratio"] == pytest.approx(10.0)
+    assert tr.straggler_report(threshold=20.0) == []
+    # env knob steers the default
+    monkeypatch.setenv("DAFT_TPU_STRAGGLER_K", "20")
+    assert tr.straggler_report() == []
+    monkeypatch.setenv("DAFT_TPU_STRAGGLER_K", "2")
+    rep = tr.straggler_report()
+    assert len(rep) == 1
+    # and the EXPLAIN ANALYZE render names it
+    assert "stragglers" in tr.render() and "slow" in tr.render()
+
+
+def test_distributed_groupby_join_chrome_trace_e2e(tmp_path):
+    """Acceptance: a 2-worker distributed groupby-join query with device
+    leases produces a Chrome trace with task lanes from both workers and at
+    least one device-dispatch slice, via explain_analyze(profile=...)."""
+    from daft_tpu.distributed.runner import DistributedRunner
+
+    rng = np.random.default_rng(7)
+    n = 40_000
+    fact = daft_tpu.from_pydict({
+        "k": rng.integers(0, 40, n).tolist(),
+        "v": rng.uniform(0, 100, n).tolist(),
+    })
+    dim = daft_tpu.from_pydict({
+        "k": list(range(40)),
+        "grp": [i % 5 for i in range(40)],
+    })
+    q = (fact.join(dim, on="k")
+         .groupby("grp").agg(col("v").sum().alias("s"))
+         .sort("grp"))
+
+    path = str(tmp_path / "trace.json")
+    native = runners.NativeRunner()
+    with execution_config_ctx(device_mode="on"):
+        r = DistributedRunner(num_workers=2, n_partitions=2, device_workers=2)
+        try:
+            runners.set_runner(r)
+            report = q.explain_analyze(profile=path)
+        finally:
+            runners.set_runner(native)
+            r.shutdown()
+    assert "== Distributed Stages ==" in report
+    with open(path) as f:
+        data = json.load(f)
+    evs = data["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # task lanes from >= 2 workers
+    workers = {e["args"]["worker_id"] for e in xs if e["cat"] == "task"}
+    assert len(workers) >= 2, workers
+    # >= 1 device-dispatch slice shipped back from a device-leased worker
+    assert any(e["name"] == "device.dispatch" for e in xs), \
+        sorted({e["name"] for e in xs})
+    # per-operator stall split rides along and reconciles
+    ops = [e for e in xs if e["cat"] == "operator"]
+    assert ops
+    for e in ops:
+        a = e["args"]
+        assert a["compute_s"] >= 0 and a["starve_s"] >= 0 and a["blocked_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Dashboard HTTP surface: /metrics + trace download + JSON endpoints
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.headers.get("Content-Type", ""), r.read()
+
+
+def _parse_prometheus(text):
+    """{"name": value} for plain samples; histogram samples keep labels."""
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, typ = line.split()
+            types[name] = typ
+            continue
+        assert not line.startswith("#"), line
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out, types
+
+
+def test_metrics_endpoint_prometheus_format():
+    from daft_tpu.observability.dashboard import launch
+
+    dash = launch()
+    try:
+        daft_tpu.from_pydict({"a": list(range(100))}).where(
+            col("a") > 10).to_pydict()
+        ctype, body = _get(dash.url + "/metrics")
+        assert ctype.startswith("text/plain")
+        samples, types = _parse_prometheus(body.decode())
+        # acceptance: hbm_bytes_resident served as a gauge
+        assert "daft_tpu_hbm_bytes_resident" in samples
+        assert types["daft_tpu_hbm_bytes_resident"] == "gauge"
+        # engine counters exported with counter TYPE
+        assert types.get("daft_tpu_device_stage_batches") == "counter"
+        # spill counters reach the scrape surface (registry-backed)
+        assert "daft_tpu_spill_batches" in samples
+        # query-latency histogram: count >= 1, cumulative buckets monotone,
+        # +Inf bucket == count
+        assert types["daft_tpu_query_latency_seconds"] == "histogram"
+        assert samples["daft_tpu_query_latency_seconds_count"] >= 1
+        buckets = [(k, v) for k, v in samples.items()
+                   if k.startswith("daft_tpu_query_latency_seconds_bucket")]
+        assert buckets
+        vals = [v for _, v in buckets]
+        assert vals == sorted(vals)
+        assert vals[-1] == samples["daft_tpu_query_latency_seconds_count"]
+    finally:
+        dash.shutdown()
+
+
+def test_histogram_quantiles():
+    from daft_tpu.observability.metrics import Histogram
+
+    h = Histogram()
+    for _ in range(90):
+        h.observe(0.02)
+    for _ in range(10):
+        h.observe(4.0)
+    assert h.quantile(0.5) == 0.025   # bucket upper bound containing p50
+    assert h.quantile(0.99) == 5.0
+    lines = h.prometheus_lines("m")
+    assert lines[0] == "# TYPE m histogram"
+    assert 'm_bucket{le="+Inf"} 100' in lines
+    assert "m_count 100" in lines
+
+
+def test_dashboard_trace_download_and_endpoints():
+    """Distributed query through an attached dashboard: every JSON endpoint
+    answers with the right shape and /api/query/<id>/trace serves the
+    Chrome-trace download."""
+    from daft_tpu.distributed.runner import DistributedRunner
+    from daft_tpu.observability.dashboard import launch
+
+    rng = np.random.default_rng(3)
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, 20, 10_000).tolist(),
+        "v": rng.uniform(0, 1, 10_000).tolist(),
+    })
+    dash = launch()
+    native = runners.NativeRunner()
+    r = DistributedRunner(num_workers=2, n_partitions=2)
+    try:
+        runners.set_runner(r)
+        out = df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+        assert len(out["k"]) == 20
+        _, body = _get(dash.url + "/api/queries")
+        queries = json.loads(body)
+        assert queries and queries[0]["done"]
+        qid = queries[0]["query_id"]
+        _, body = _get(dash.url + f"/api/query/{qid}")
+        assert json.loads(body)["query_id"] == qid
+        _, body = _get(dash.url + f"/api/query/{qid}/trace")
+        trace = json.loads(body)
+        assert trace["traceEvents"], trace.get("error_404")
+        assert any(e.get("cat") == "task" for e in trace["traceEvents"])
+        _, body = _get(dash.url + "/api/query/nope/trace")
+        assert json.loads(body)["error_404"] is True
+        _, body = _get(dash.url + "/api/engine")
+        assert "device_stage_batches" in json.loads(body)
+        _, body = _get(dash.url + "/api/workers")
+        workers = json.loads(body)
+        assert isinstance(workers, dict)
+        for w in workers.values():
+            assert "busy_fraction" in w and "hbm_bytes" in w
+    finally:
+        runners.set_runner(native)
+        r.shutdown()
+        dash.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Spill counters in the registry (satellite)
+# ---------------------------------------------------------------------------
+
+def test_spill_counters_flow_through_registry():
+    from daft_tpu.execution import memory as mem
+    from daft_tpu.observability.metrics import registry
+
+    rng = np.random.default_rng(5)
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, 500, 50_000).tolist(),
+        "v": rng.uniform(0, 1, 50_000).tolist(),
+    })
+    mem.reset_counters()
+    before = registry().snapshot()
+    with execution_config_ctx(memory_limit_bytes=64 * 1024, device_mode="off"):
+        df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+    diff = registry().diff(before)
+    assert diff.get("spill_batches", 0) > 0, diff
+    assert diff.get("spill_bytes", 0) > 0, diff
+    # the historical module attributes are a live view over the registry
+    assert mem.spills == registry().get("spill_batches")
+    assert mem.spill_bytes == registry().get("spill_bytes")
+    mem.reset_counters()
+    assert mem.spills == 0 and mem.spill_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# Event log schema v6 round trip (satellite)
+# ---------------------------------------------------------------------------
+
+def test_event_log_v6_round_trip(tmp_path):
+    from daft_tpu.observability.event_log import (SCHEMA_VERSION,
+                                                  disable_event_log,
+                                                  enable_event_log)
+
+    assert SCHEMA_VERSION == 6
+    p = str(tmp_path / "ev.jsonl")
+    sub = enable_event_log(p)
+    try:
+        daft_tpu.from_pydict({"a": list(range(100))}).where(
+            col("a") > 4).to_pydict()
+    finally:
+        disable_event_log(sub)
+    events = [json.loads(l) for l in open(p)]
+    assert events and all(e["schema_version"] == 6 for e in events)
+    ops = [e for e in events if e["event"] == "operator_stats"]
+    assert ops
+    for o in ops:
+        for f in ("compute_seconds", "starve_seconds", "blocked_seconds"):
+            assert f in o, o
+        assert o["seconds"] == pytest.approx(
+            o["compute_seconds"] + o["starve_seconds"] + o["blocked_seconds"])
+
+
+# ---------------------------------------------------------------------------
+# bench.py --compare perf gate (satellite)
+# ---------------------------------------------------------------------------
+
+def _bench_mod():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    return bench
+
+
+def test_bench_compare_flags_regressions(tmp_path, capsys):
+    bench = _bench_mod()
+    old = {"metric": "tpch_sf1", "value": 1000.0,
+           "per_query_ms": {"q1": 100.0, "q3": 200.0, "q6": 50.0}}
+    new_ok = {"metric": "tpch_sf1", "value": 1040.0,
+              "per_query_ms": {"q1": 95.0, "q3": 198.0, "q6": 49.0}}
+    new_bad = {"metric": "tpch_sf1", "value": 900.0,
+               "per_query_ms": {"q1": 100.0, "q3": 260.0, "q6": 50.0}}
+    po, pok, pbad = (tmp_path / n for n in ("old.json", "ok.json", "bad.json"))
+    po.write_text(json.dumps(old))
+    pok.write_text(json.dumps(new_ok))
+    pbad.write_text(json.dumps(new_bad))
+
+    assert bench.compare(str(po), str(pok)) == 0
+    out = capsys.readouterr().out
+    assert "OK: no regressions" in out
+
+    n = bench.compare(str(po), str(pbad))
+    out = capsys.readouterr().out
+    assert n == 2  # q3 (+30%) and the headline rows/sec (-10%)
+    assert "REGRESSION" in out and "q3" in out
+    # within-tolerance jitter never trips the gate
+    new_jitter = {"metric": "tpch_sf1", "value": 980.0,
+                  "per_query_ms": {"q1": 103.0, "q3": 204.0, "q6": 51.0}}
+    pj = tmp_path / "jitter.json"
+    pj.write_text(json.dumps(new_jitter))
+    assert bench.compare(str(po), str(pj)) == 0
+    # a query missing from NEW is lost coverage -> counted as a regression
+    new_dropped = {"metric": "tpch_sf1", "value": 1000.0,
+                   "per_query_ms": {"q1": 100.0, "q6": 50.0}}
+    pd = tmp_path / "dropped.json"
+    pd.write_text(json.dumps(new_dropped))
+    assert bench.compare(str(po), str(pd)) == 1
+    assert "missing from NEW" in capsys.readouterr().out
