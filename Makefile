@@ -6,11 +6,25 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test bench bench-gate bench-compare
+.PHONY: test test-mesh bench bench-mesh bench-gate bench-compare
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# In-mesh SPMD suite under 8 forced host devices (the MULTICHIP harness
+# environment): bit-exact mesh vs single-chip vs host parity, sharded
+# residency, cost-tier flips.
+test-mesh:
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytest tests/test_mesh_stage.py tests/test_distributed.py \
+		-q -p no:cacheprovider
+
+# CPU-CI mesh capture: a TPC-H-shaped groupby sharded across 8 simulated
+# devices, bit-identical across mesh/single-chip/host (bench.py mesh_microbench).
+bench-mesh:
+	env BENCH_MESH=1 JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PY) bench.py
 
 bench:
 	$(PY) bench.py

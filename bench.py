@@ -46,11 +46,32 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SF = float(os.environ.get("BENCH_SF", 1.0))
 BASELINE_ROWS_PER_SEC = 50e6
+
+# BENCH_MESH=1 on CPU CI simulates an 8-chip host; the XLA flag must be in the
+# environment before the first jax backend init (imports below are lazy, so
+# mutating it here still works — same trick as tests/conftest.py).
+if os.environ.get("BENCH_MESH"):
+    _xla = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _xla:
+        os.environ["XLA_FLAGS"] = (
+            _xla + " --xla_force_host_platform_device_count=8").strip()
 SUITE = os.environ.get("BENCH_SUITE", "tpch")
 _DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,33,42,52,55,56,96"}
 QUERIES = [int(x) for x in os.environ.get(
     "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",")]
 REPS = int(os.environ.get("BENCH_REPS", 5))
+
+
+def _derive_mesh_ratio(metric_totals: dict) -> None:
+    """Attach mesh_dispatch_ratio — the mesh share of all device dispatches
+    (mesh + single-chip) — wherever the raw counters landed, so a capture
+    records whether the in-mesh SPMD tier engaged."""
+    mesh_disp = metric_totals.get("mesh_dispatches", 0)
+    single_disp = (metric_totals.get("device_grouped_batches", 0)
+                   + metric_totals.get("device_stage_batches", 0))
+    if mesh_disp or single_disp:
+        metric_totals["mesh_dispatch_ratio"] = round(
+            mesh_disp / max(mesh_disp + single_disp, 1), 4)
 
 
 def _derive_shuffle_ratios(metric_totals: dict) -> None:
@@ -120,6 +141,91 @@ def shuffle_microbench() -> None:
         runner.shutdown()
 
 
+def mesh_microbench() -> None:
+    """BENCH_MESH=1: a TPC-H-shaped groupby executed with its device stage
+    sharded across 8 devices via shard_map, fed by the streaming
+    morsel/coalescer path, checked BIT-IDENTICAL against the single-chip and
+    host paths (quantity aggregates are integer-valued, so every f64 partial
+    is exact in any reduction order). CPU CI invocation (the MULTICHIP
+    harness environment):
+
+        BENCH_MESH=1 JAX_PLATFORMS=cpu \\
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py
+    """
+    # this environment may pre-import jax pinned to a tunneled backend; route
+    # to the env-requested platform via jax.config like tests/conftest.py
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.ops import counters
+    from benchmarking.tpch.datagen import load_dataframes
+
+    tables = {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
+    lineitem = tables["lineitem"]
+    n = lineitem.count_rows()
+
+    def q():
+        return (lineitem
+                .groupby("l_returnflag", "l_linestatus")
+                .agg(col("l_quantity").sum().alias("sum_qty"),
+                     col("l_quantity").mean().alias("avg_qty"),
+                     col("l_quantity").min().alias("min_qty"),
+                     col("l_quantity").max().alias("max_qty"),
+                     col("l_quantity").count().alias("count_order"))
+                .sort("l_returnflag", "l_linestatus"))
+
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=8,
+                              device_min_rows=1):
+        q().to_pydict()  # warmup: compile + shard-resident planes
+        h2d_warm = counters.snapshot().get("hbm_h2d_bytes", 0)
+        elapsed = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            mesh_out = q().to_pydict()
+            elapsed = min(elapsed, time.perf_counter() - t0)
+        h2d_after = counters.snapshot().get("hbm_h2d_bytes", 0)
+    mesh_runs = counters.mesh_grouped_runs
+    mesh_disp = counters.mesh_dispatches
+    assert mesh_runs > 0 and mesh_disp > 0, \
+        "mesh path never executed — BENCH_MESH capture is not a mesh capture"
+    metric_totals = {k: v for k, v in counters.snapshot().items() if v}
+    _derive_mesh_ratio(metric_totals)
+    # repeat-query residency: sharded planes resident => h2d flat after warmup
+    metric_totals["mesh_repeat_h2d_bytes"] = int(h2d_after - h2d_warm)
+    assert metric_totals["mesh_repeat_h2d_bytes"] == 0, \
+        "repeat mesh query re-uploaded bytes — sharded residency broken"
+
+    with execution_config_ctx(device_mode="on", mesh_devices=1,
+                              device_min_rows=1):
+        single_out = q().to_pydict()
+    with execution_config_ctx(device_mode="off"):
+        host_out = q().to_pydict()
+    if not (mesh_out == single_out == host_out):
+        raise AssertionError(
+            "mesh result differs from single-chip/host — parity broken")
+
+    print(json.dumps({
+        "metric": f"tpch_sf{SF}_mesh_groupby_rows_per_sec",
+        "value": round(n / elapsed, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round((n / elapsed) / BASELINE_ROWS_PER_SEC, 4),
+        "mesh_devices": len(jax.devices()),
+        "bit_identical": True,
+        "fact_rows": n,
+        "reps": REPS,
+        "metrics": metric_totals,
+    }))
+
+
 REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
 
 
@@ -187,6 +293,9 @@ def _save_profiles(tables, ALL_QUERIES) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_MESH"):
+        mesh_microbench()
+        return
     if os.environ.get("BENCH_SHUFFLE"):
         shuffle_microbench()
         return
@@ -280,6 +389,12 @@ def main() -> None:
     if morsels_in:
         metric_totals["dispatch_rtts_saved"] = int(
             morsels_in - metric_totals.get("dispatch_coalesced", 0))
+
+    # Mesh-tier attribution: what fraction of device dispatches ran sharded
+    # across the local mesh (the in-mesh SPMD tier) — the next real-chip
+    # SF10/TPC-DS re-capture records mesh engagement alongside the HBM and
+    # coalescing numbers.
+    _derive_mesh_ratio(metric_totals)
 
     # Shuffle transport attribution: compression + overlap ratios derived
     # from the wire/logical byte and cumulative/overlap second counters

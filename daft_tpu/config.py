@@ -153,10 +153,18 @@ class ExecutionConfig:
     pipeline_mode: str = field(
         default_factory=lambda: os.environ.get("DAFT_TPU_PIPELINE", "on")
     )
-    # Multi-chip mesh execution: when >= 2 (and that many JAX devices exist),
-    # qualifying grouped aggregations execute via the mesh-sharded exact groupby
-    # (parallel/distributed.py: per-shard sort/unique + segment-reduce, one
-    # all_gather table merge over ICI). 0 = single-chip only.
+    # Multi-chip in-mesh SPMD execution (ops/mesh_stage.py over the
+    # parallel/distributed.py kernels): qualifying device agg stages execute
+    # sharded across a local device mesh — per-shard compute + one ICI
+    # collective (psum / all_gather table merge) inside ONE jit program.
+    #   - 0 (default) = auto: the cost model's ICI tier decides host vs
+    #     single-chip vs mesh per stage shape; the mesh must WIN its
+    #     placement, never be config-forced.
+    #   - 1 = single-chip only (mesh machinery never imported — the
+    #     zero-overhead off switch).
+    #   - N >= 2 = force an N-device mesh for qualifying stages; if fewer
+    #     local devices exist the stage falls back to single-chip LOUDLY
+    #     (counters.mesh_unavailable_fallbacks + a rejection record).
     mesh_devices: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_MESH_DEVICES", 0)
     )
@@ -195,6 +203,11 @@ class ExecutionConfig:
                 f"shuffle_fetch_parallelism must be >= 1, got "
                 f"{self.shuffle_fetch_parallelism!r} "
                 f"(check DAFT_TPU_SHUFFLE_FETCH_PARALLELISM)")
+        if self.mesh_devices < 0:
+            raise ValueError(
+                f"mesh_devices must be >= 0 (0 auto-tiers, 1 disables mesh, "
+                f"N >= 2 forces an N-device mesh), got "
+                f"{self.mesh_devices!r} (check DAFT_TPU_MESH_DEVICES)")
         if self.shuffle_prefetch_batches < 0:
             raise ValueError(
                 f"shuffle_prefetch_batches must be >= 0 (0 disables prefetch), "

@@ -233,19 +233,63 @@ class Series:
         registry().inc("hbm_h2d_bytes", int(values.nbytes) + int(validity.nbytes))
         return jnp.asarray(values), jnp.asarray(validity)
 
-    def to_device_cached(self, pad_to: Optional[int] = None, f32: bool = False):
+    def to_device_sharded(self, mesh, pad_to: int, f32: bool = False,
+                          axis: str = "dp"):
+        """(values, validity) placed row-sharded over a device mesh
+        (NamedSharding along `axis`): each device holds a contiguous row shard
+        in its own HBM, so a mesh stage reads its shard locally with zero
+        repartition. `pad_to` must be a multiple of the mesh size (padding
+        rows are invalid, same convention as to_device)."""
+        from ..utils import jax_setup  # noqa: F401
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        n_dev = mesh.shape[axis]
+        if pad_to % n_dev != 0:
+            raise ValueError(
+                f"to_device_sharded: pad_to={pad_to} not divisible by the "
+                f"{n_dev}-device mesh")
+        values = self.to_numpy()
+        if f32 and values.dtype == np.float64:
+            values = values.astype(np.float32)
+        validity = self.validity_numpy()
+        if pad_to > len(self):
+            pad = pad_to - len(self)
+            pad_shape = (pad,) + values.shape[1:]
+            values = np.concatenate([values, np.zeros(pad_shape, dtype=values.dtype)])
+            validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
+        from ..observability.metrics import registry
+
+        registry().inc("hbm_h2d_bytes", int(values.nbytes) + int(validity.nbytes))
+        sharding = NamedSharding(mesh, PartitionSpec(axis))
+        return (jax.device_put(values, sharding),
+                jax.device_put(validity, sharding))
+
+    def to_device_cached(self, pad_to: Optional[int] = None, f32: bool = False,
+                         mesh=None, axis: str = "dp"):
         """to_device through the process-wide HBM residency manager.
 
         Collected tables queried repeatedly keep their columns resident in HBM
         (GPU-database-style column cache), so only the first query pays the
         host->device transfer. Series is immutable, so the cached plane never
         stales; the manager evicts it LRU under the DAFT_TPU_HBM_BUDGET.
-        """
+
+        With `mesh`, the plane is placed row-sharded over the mesh
+        (to_device_sharded) and cached under a slot key carrying the sharding
+        spec — mesh and single-chip layouts of the same column are distinct
+        residency entries (different physical placement), each with honest
+        per-device byte accounting, and sharded slots publish in the worker
+        heartbeat digest like any other deps-free plane."""
         from ..device.residency import manager
 
+        if mesh is None:
+            return manager().get_or_build(
+                self, ("col", pad_to, bool(f32)), (),
+                lambda: self.to_device(pad_to, f32=f32))
+        key = ("col", pad_to, bool(f32), "mesh", int(mesh.shape[axis]), axis)
         return manager().get_or_build(
-            self, ("col", pad_to, bool(f32)), (),
-            lambda: self.to_device(pad_to, f32=f32))
+            self, key, (),
+            lambda: self.to_device_sharded(mesh, pad_to, f32=f32, axis=axis))
 
     def __getstate__(self):
         """Pickle for cross-process shipping (distributed tasks/UDF workers):
@@ -259,11 +303,16 @@ class Series:
         object.__setattr__(self, "_arrow", arrow)
         object.__setattr__(self, "_pyobjs", pyobjs)
 
-    def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False) -> bool:
+    def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False,
+                           mesh_devices: int = 0, axis: str = "dp") -> bool:
         """True if this column is already in HBM for the given layout (cost-model
-        hook — resident inputs are costed with zero transfer bytes)."""
+        hook — resident inputs are costed with zero transfer bytes).
+        mesh_devices > 0 probes the row-sharded mesh layout instead."""
         from ..device.residency import manager
 
+        if mesh_devices > 0:
+            return manager().is_resident(
+                self, ("col", pad_to, bool(f32), "mesh", int(mesh_devices), axis))
         return manager().is_resident(self, ("col", pad_to, bool(f32)))
 
     def content_fingerprint(self) -> Optional[int]:

@@ -182,9 +182,20 @@ def device_nbytes(value) -> int:
         x = stack.pop()
         if isinstance(x, arr_t):
             try:
-                total += int(x.nbytes)
+                # sum per-device shard bytes, not the logical global size: a
+                # replicated plane on an 8-chip mesh really holds 8 copies in
+                # HBM, and a row-sharded plane's shards sum back to its global
+                # bytes — either way the budget sees physical occupancy
+                shards = getattr(x, "addressable_shards", None)
+                if shards:
+                    total += sum(int(s.data.nbytes) for s in shards)
+                else:
+                    total += int(x.nbytes)
             except Exception:
-                pass
+                try:
+                    total += int(x.nbytes)
+                except Exception:
+                    pass
         elif isinstance(x, (tuple, list)):
             stack.extend(x)
         elif isinstance(x, dict):

@@ -486,17 +486,29 @@ def _exec_device_agg(node) -> MicroPartition:
         return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
 
     if not use_device:
+        # 3-way auto tier: a compute-bound stage can lose to the host on ONE
+        # chip yet win across the mesh (compute / mesh width). _mesh_wins
+        # requires beating BOTH host and single-chip, so this only flips
+        # stages the mesh genuinely earns.
+        if cfg.device_mode == "auto" and cfg.mesh_devices == 0:
+            import jax
+
+            if jax.default_backend() not in ("cpu",):
+                mesh_n, stream = _select_mesh_tier(node, stream, grouped, cfg)
+                if mesh_n:
+                    return _exec_mesh_stage(node, stream, grouped, mesh_n,
+                                            cfg, _host_agg)
         return _host_agg(stream)
 
     from ..core.series import Series
     from ..device.residency import manager as _residency
 
     in_schema = node.input.schema
-    if grouped and cfg.mesh_devices >= 2:
-        import jax
-
-        if len(jax.devices()) >= cfg.mesh_devices:
-            return _exec_mesh_grouped(node, stream, cfg.mesh_devices)
+    mesh_n = 0
+    if cfg.mesh_devices != 1:
+        mesh_n, stream = _select_mesh_tier(node, stream, grouped, cfg)
+    if mesh_n:
+        return _exec_mesh_stage(node, stream, grouped, mesh_n, cfg, _host_agg)
     if grouped:
         from ..ops.grouped_stage import DeviceFallback, try_build_grouped_agg_stage
 
@@ -940,77 +952,234 @@ def _grouped_output(schema, groupby, aggregations, key_rows, results) -> MicroPa
     return MicroPartition(schema, [out.cast_to_schema(schema)])
 
 
-def _exec_mesh_grouped(node, stream, n_devices: int) -> MicroPartition:
-    """Grouped aggregation over a multi-chip mesh (the engine's scale-out path).
+_MESH_TIER_CACHE: dict = {}
 
-    Group keys are dictionary/factorize-encoded to dense int64 codes on the
-    host (null keys get their own code, preserving host null-group semantics),
-    then the EXACT mesh-sharded groupby runs: each device sort/uniques its row
-    shard and segment-reduces into a fixed-capacity table, merged with one
-    all_gather over the mesh axis (parallel/distributed.py). Counter-asserted
-    via counters.mesh_grouped_runs.
+
+def _select_mesh_tier(node, stream, grouped: bool, cfg):
+    """Pick the mesh width for one device agg stage; 0 = single-chip.
+
+    Forced (cfg.mesh_devices >= 2): exactly that many local devices, with a
+    LOUD fallback (counter + rejection record) when fewer exist — the old
+    gate fell back silently. Auto (mesh_devices == 0): the mesh must WIN its
+    placement, never be config-forced — the first morsel's shape is costed
+    (ops/costmodel.py mesh_*_cost) and the mesh tier is taken only when it
+    beats BOTH the single-chip device and the host; verdicts are cached per
+    stage shape like the join decision cache. Returns (n_devices, stream)
+    with any peeked partition chained back."""
+    import jax
+
+    from ..ops import counters as _counters
+
+    ndev = len(jax.devices())
+    if cfg.mesh_devices >= 2:
+        if ndev >= cfg.mesh_devices:
+            return cfg.mesh_devices, stream
+        _counters.bump("mesh_unavailable_fallbacks")
+        _counters.reject("runtime", "mesh: fewer local devices than mesh_devices",
+                         f"({ndev} < {cfg.mesh_devices})")
+        return 0, stream
+    if ndev < 2:
+        return 0, stream
+    first = next(stream, None)
+    if first is None:
+        return 0, iter(())
+    stream = itertools.chain([first], stream)
+    if first.num_rows < cfg.device_min_rows:
+        return 0, stream
+    from ..ops.stage import pad_bucket
+
+    key = (grouped, ndev, pad_bucket(first.num_rows),
+           cfg.batch_fill_target, cfg.morsel_size_rows,
+           repr(node.predicate),
+           tuple(repr(g) for g in getattr(node, "groupby", ())),
+           tuple(repr(a) for a in node.aggregations))
+    wins = _MESH_TIER_CACHE.get(key)
+    if wins is None:
+        wins = _mesh_wins(node, first, grouped, ndev)
+        _MESH_TIER_CACHE[key] = wins
+        if len(_MESH_TIER_CACHE) > 512:
+            _MESH_TIER_CACHE.pop(next(iter(_MESH_TIER_CACHE)))
+    return (ndev if wins else 0), stream
+
+
+def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int) -> bool:
+    """Cost-model tier decision: mesh vs single-chip vs host for one stage
+    shape. Mesh compute divides by the mesh width but pays a multi-device
+    dispatch premium and the ICI collective; uploads amortize exactly like
+    the single-chip decision when the source table is resident."""
+    from ..config import execution_config
+    from ..ops import costmodel, counters as _counters
+    from ..ops.stage import _decompose_agg, pad_bucket
+
+    batch = next((b for b in first.batches if b.num_rows > 0), None)
+    if batch is None:
+        return False
+    rows = first.num_rows
+    cal = costmodel.calibrate()
+    coal = _coalesce_horizon([first])
+    amort = max(execution_config().device_amortize_runs, 1) \
+        if _resident_source_rec(node.input) else 1
+    # mesh planes shard to a per-device bucket; same quantization as
+    # ops/mesh_stage.mesh_total, computed inline so a rejected tier never
+    # imports the mesh machinery
+    per = pad_bucket(max((batch.num_rows + ndev - 1) // ndev, 1))
+    mesh_pad = per * ndev
+    bucket = pad_bucket(batch.num_rows)
+
+    if grouped:
+        from ..ops.grouped_stage import (MAX_MATMUL_SEGMENTS, _pad_groups,
+                                         estimate_key_cardinality,
+                                         resolve_key_series,
+                                         try_build_grouped_agg_stage)
+
+        stage = try_build_grouped_agg_stage(
+            node.input.schema, node.predicate, node.groupby, node.aggregations)
+        if stage is None:
+            return False
+        key_series = resolve_key_series(batch, stage.groupby, batch.num_rows)
+        card = max(estimate_key_cardinality(key_series), 1)
+        cap_est = _pad_groups(min(card, 2 * MAX_MATMUL_SEGMENTS))
+        nonres_single = sum(
+            batch.num_rows * 5 for c in stage._input_cols
+            if not batch.get_column(c).is_device_resident(bucket, f32=True))
+        # mesh planes are f64 (9B/row with validity) under their own slot keys
+        nonres_mesh = sum(
+            batch.num_rows * 9 for c in stage._input_cols
+            if not batch.get_column(c).is_device_resident(
+                mesh_pad, f32=False, mesh_devices=ndev))
+        n_cols = sum(len(_decompose_agg(agg.op)) for _n, agg in stage.aggs)
+        # mesh keys always host-factorize, but the codes are cached on the
+        # key Series (ops/mesh_stage._batch_group_codes), so resident-table
+        # repeats amortize like uploads
+        mesh_cost = costmodel.mesh_grouped_cost(
+            cal, rows, nonres_mesh // amort, n_cols, cap_est, ndev,
+            factorize_rows=rows // amort, coalesce=coal)
+        # single-chip factorize pricing MUST match _device_wins: dictionary
+        # keys amortize (cached per Series), host-mode keys re-factorize per
+        # run at full price — disagreeing here would under-price one tier
+        if stage.dict_keys:
+            dict_rows = sum(
+                batch.num_rows for s in key_series
+                if getattr(s, "_dict_codes", None) is None)
+            single_fact_rows = dict_rows // amort
+        else:
+            single_fact_rows = batch.num_rows
+        n_planes = (len(stage._mm_specs) + len(stage._ext_specs)
+                    + len(stage._sct_specs))
+        if card > MAX_MATMUL_SEGMENTS:
+            single_cost = costmodel.device_grouped_sort_cost(
+                cal, rows, nonres_single // amort, n_planes=n_planes,
+                factorize_rows=single_fact_rows, coalesce=coal)
+        else:
+            single_cost = costmodel.device_grouped_cost(
+                cal, rows, nonres_single // amort, n_mm=len(stage._mm_specs),
+                n_ext=len(stage._ext_specs), n_sct=len(stage._sct_specs),
+                cap=cap_est, factorize_rows=single_fact_rows, coalesce=coal)
+        host_cost = costmodel.host_agg_cost(
+            cal, rows, len(node.aggregations), grouped=True,
+            has_predicate=node.predicate is not None)
+    else:
+        from ..ops.stage import try_build_filter_agg_stage
+
+        stage = try_build_filter_agg_stage(
+            node.input.schema, node.predicate, node.aggregations)
+        if stage is None:
+            return False
+        n_partials = max(len(stage.aggs), 1)
+        nonres_single = sum(
+            batch.num_rows * 5 for c in stage._input_cols
+            if not batch.get_column(c).is_device_resident(bucket, f32=True))
+        nonres_mesh = sum(
+            batch.num_rows * 9 for c in stage._input_cols
+            if not batch.get_column(c).is_device_resident(
+                mesh_pad, f32=False, mesh_devices=ndev))
+        mesh_cost = costmodel.mesh_ungrouped_cost(
+            cal, rows, nonres_mesh // amort, n_partials, ndev, coalesce=coal)
+        single_cost = costmodel.device_ungrouped_cost(
+            cal, rows, nonres_single // amort, n_partials=n_partials,
+            coalesce=coal)
+        host_cost = costmodel.host_agg_cost(
+            cal, rows, len(node.aggregations), grouped=False,
+            has_predicate=node.predicate is not None)
+    if mesh_cost >= single_cost or mesh_cost >= host_cost:
+        _counters.reject(
+            "cost", "mesh: single-chip/host wins tier decision",
+            f"(mesh {mesh_cost*1e3:.1f}ms vs chip {single_cost*1e3:.1f}ms "
+            f"vs host {host_cost*1e3:.1f}ms est)")
+        return False
+    return True
+
+
+def _exec_mesh_stage(node, stream, grouped: bool, n_devices: int, cfg,
+                     host_agg) -> MicroPartition:
+    """Run a DeviceFilterAgg/DeviceGroupedAgg node sharded across the local
+    mesh (ops/mesh_stage.py) — the engine's scale-out execution tier.
+
+    Identical streaming contract to the single-chip stages: the adaptive
+    morsel stream and DispatchCoalescer feed super-batches (no whole-input
+    materialization), resident planes pin for the query's duration, and a
+    runtime DeviceFallback reruns the buffered stream on host. Attribution:
+    counters.mesh_dispatches / mesh_grouped_runs, the mesh profile-span
+    lanes, and the EXPLAIN ANALYZE operator annotation "mesh: N devices".
     """
-    from ..expressions.eval import eval_expression
-    from ..ops import counters
-    from ..ops.grouped_stage import resolve_key_series
-    from ..parallel.distributed import default_mesh, groupby_host
+    from ..device.residency import manager as _residency
+    from ..observability.runtime_stats import current_collector
+    from ..ops import mesh_stage as ms
+    from ..ops.grouped_stage import DeviceFallback
 
-    batch = _concat_parts(list(stream), node.input.schema)
-    if node.predicate is not None:
-        filtered = _filter_part(
-            MicroPartition(node.input.schema, [batch]), node.predicate)
-        batch = (filtered.batches[0] if filtered.batches
-                 else RecordBatch.empty(node.input.schema))
-    n = batch.num_rows
+    in_schema = node.input.schema
+    c = current_collector()
+    if c is not None:
+        c.annotate(node, f"mesh: {n_devices} devices")
 
-    key_series = resolve_key_series(batch, node.groupby, n)
-    if n == 0:
-        key_rows: List[tuple] = []
-        codes = np.empty(0, dtype=np.int64)
-    else:
-        from ..core.kernels.groupby import make_groups
+    if grouped:
+        stage = ms.try_build_mesh_grouped_agg_stage(
+            in_schema, node.predicate, node.groupby, node.aggregations,
+            n_devices)
+        assert stage is not None, \
+            "planner emitted DeviceGroupedAgg for a non-qualifying plan"
+        run = stage.start_run()
+        coal = _make_coalescer(run.feed_batch, cfg)
+        feed = coal.add if coal is not None else run.feed_batch
+        buffered: List[MicroPartition] = []
+        try:
+            with _residency().pin_scope():
+                for part in stream:
+                    buffered.append(part)
+                    for b in part.batches:
+                        feed(b)
+                if coal is not None:
+                    coal.close()
+                key_rows, results = run.finalize()
+        except DeviceFallback:
+            return host_agg(itertools.chain(buffered, stream))
+        return _grouped_output(node.schema, node.groupby, node.aggregations,
+                               key_rows, results)
 
-        first_idx, group_ids, _ = make_groups(key_series)
-        key_rows = list(zip(*[s.take(first_idx).to_pylist() for s in key_series])) \
-            if len(first_idx) else []
-        codes = group_ids.astype(np.int64)
+    from ..core.series import Series
 
-    ops = []
-    value_cols = []
-    for e in node.aggregations:
-        from ..expressions.expressions import AggExpr, Alias
-
-        inner = e
-        while isinstance(inner, Alias):
-            inner = inner.child
-        assert isinstance(inner, AggExpr)
-        ops.append(inner.op)
-        count_all = inner.op == "count" and inner.params.get("mode", "valid") == "all"
-        s = eval_expression(batch, inner.child)
-        if len(s) == 1 and n != 1:
-            from ..expressions.eval import _broadcast
-
-            s = _broadcast(s, n)
-        vals = s.to_numpy()
-        valid = np.ones(n, dtype=bool) if count_all else s.validity_numpy()
-        value_cols.append((vals, valid))
-
-    if n == 0:
-        gk = np.empty(0, dtype=np.int64)
-        out_cols = [(np.empty(0), np.empty(0, dtype=bool)) for _ in ops]
-    else:
-        # capacity is known exactly (dense codes from make_groups): no
-        # overflow-retry recompiles
-        cap = max(16, int(2 ** np.ceil(np.log2(max(len(key_rows), 1) + 1))))
-        mesh = default_mesh(n_devices)
-        gk, out_cols = groupby_host(mesh, codes, np.ones(n, dtype=bool),
-                                    value_cols, ops, capacity=cap)
-        counters.bump("mesh_grouped_runs")
-
-    # gk is sorted ascending = dense-code order = first-occurrence order
-    ordered_keys = [key_rows[int(k)] for k in gk]
-    return _grouped_output(node.schema, node.groupby, node.aggregations,
-                           ordered_keys, out_cols)
+    stage = ms.try_build_mesh_filter_agg_stage(
+        in_schema, node.predicate, node.aggregations, n_devices)
+    assert stage is not None, \
+        "planner emitted DeviceFilterAgg for a non-qualifying plan"
+    run = stage.start_run()
+    coal = _make_coalescer(run.feed_batch, cfg)
+    feed = coal.add if coal is not None else run.feed_batch
+    # no buffering: the ungrouped mesh run has no DeviceFallback site, so the
+    # stream flows straight through like the single-chip path
+    with _residency().pin_scope():
+        for part in stream:
+            for b in part.batches:
+                feed(b)
+        if coal is not None:
+            coal.close()
+        final = run.finalize()
+    cols = []
+    for name, _agg in stage.aggs:
+        f = node.schema[name]
+        cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
+    out = RecordBatch(node.schema, cols, 1)
+    return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
 
 
 def _device_wins(node, first: MicroPartition, grouped: bool,

@@ -55,6 +55,9 @@ class StatsCollector:
         self._ids: Dict[int, int] = {}
         self._anchors: List[object] = []
         self._seq = 0
+        # nid -> execution-path annotation (e.g. "mesh: 8 devices"), rendered
+        # as a suffix on the operator name in EXPLAIN ANALYZE
+        self._notes: Dict[int, str] = {}
 
     def node_id(self, node) -> int:
         """Stable sequential id for `node` within this collector (1-based in
@@ -122,11 +125,20 @@ class StatsCollector:
         if entry is not None:
             entry[6] += seconds
 
+    def annotate(self, node, note: str) -> None:
+        """Attach an execution-path note to one operator ("mesh: 8 devices");
+        EXPLAIN ANALYZE renders it beside the operator name so the chosen
+        tier is visible in the report, not only in the counters."""
+        self._notes[self.node_id(node)] = note
+
     def finish(self) -> List[OperatorStats]:
         out = []
         for nid, (name, rows, batches, total, child, starve,
                   blocked) in self._nodes.items():
             compute = max(total - child - starve, 0.0)
+            note = self._notes.get(nid)
+            if note:
+                name = f"{name} [{note}]"
             out.append(OperatorStats(
                 node_id=nid, name=name, rows_out=rows, batches_out=batches,
                 seconds=compute + starve + blocked,

@@ -55,6 +55,12 @@ class Calibration:
     host_agg_rate: float          # host value-ops per sec (vectorized numpy)
     host_factorize_rate: float    # host group-key factorize rows per sec
     host_probe_rate: float        # host hash-join probe rows per sec per dim
+    # mesh (multi-chip SPMD) tier: one dispatch spans every local chip, so it
+    # pays an extra multi-device launch/synchronization overhead on top of
+    # rtt_s, and its cross-shard exchange moves bytes over ICI. Defaulted so
+    # single-chip call sites can construct a Calibration without mesh terms.
+    ici_bytes_per_s: float = 4.5e10  # per-link ICI collective bandwidth
+    mesh_dispatch_s: float = 2e-3    # extra fixed cost of a multi-device dispatch
 
 
 _CAL: Optional[Calibration] = None
@@ -122,6 +128,11 @@ def calibrate() -> Calibration:
         host_agg_rate=_env_f("DAFT_TPU_COST_HOST_AGG", 1.5e8),
         host_factorize_rate=_env_f("DAFT_TPU_COST_HOST_FACT", 8e6),
         host_probe_rate=_env_f("DAFT_TPU_COST_HOST_PROBE", 3e7),
+        # v5e ICI is ~45GB/s per direction per link; the conservative default
+        # (and the multi-device dispatch overhead) keep the auto tier honest —
+        # mesh must WIN real compute before paying its launch premium
+        ici_bytes_per_s=_env_f("DAFT_TPU_COST_ICI", 4.5e10),
+        mesh_dispatch_s=_env_f("DAFT_TPU_COST_MESH_DISPATCH", 2e-3),
     )
     return _CAL
 
@@ -213,6 +224,45 @@ def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
     return (cal.rtt_s / max(coalesce, 1.0)
             + nonresident_bytes / cal.h2d_bytes_per_s
             + rows * n_partials / cal.mm_plane_rows_per_s)
+
+
+def mesh_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
+                        n_partials: int, n_devices: int,
+                        coalesce: float = 1.0) -> float:
+    """One mesh filter+ungrouped-agg dispatch: the per-shard reduce runs on
+    rows/N, the combine is one psum of n_partials scalars over ICI, and the
+    dispatch pays the multi-device launch premium on top of the (coalesce-
+    amortized) round trip. Upload bytes are the same as single-chip — shards
+    split the data, they don't duplicate it."""
+    n = max(n_devices, 1)
+    return (cal.rtt_s / max(coalesce, 1.0)
+            + cal.mesh_dispatch_s
+            + nonresident_bytes / cal.h2d_bytes_per_s
+            + rows * max(n_partials, 1) / (cal.mm_plane_rows_per_s * n)
+            + max(n_partials, 1) * 8 * n / cal.ici_bytes_per_s)
+
+
+def mesh_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
+                      n_cols: int, cap: int, n_devices: int,
+                      factorize_rows: int, coalesce: float = 1.0) -> float:
+    """One mesh exact-groupby dispatch (parallel/distributed.py
+    sharded_groupby_step): per shard an O(s log s) sort/unique over s = rows/N
+    plus one segmented reduce per value plane, then an all_gather table merge
+    moving cap x (n_cols + 1) x 8 bytes from every device over ICI. Host key
+    factorize is unchanged (full rows — it happens before sharding)."""
+    import math
+
+    n = max(n_devices, 1)
+    shard = max(rows // n, 1)
+    logn = max(math.log2(max(shard, 2)), 1.0)
+    cap = max(cap, 16)
+    return (cal.rtt_s / max(coalesce, 1.0)
+            + cal.mesh_dispatch_s
+            + nonresident_bytes / cal.h2d_bytes_per_s
+            + shard * logn / cal.mm_plane_rows_per_s
+            + shard * max(n_cols, 1) / cal.mm_plane_rows_per_s
+            + cap * (max(n_cols, 1) + 1) * 8 * n / cal.ici_bytes_per_s
+            + factorize_rows / cal.host_factorize_rate)
 
 
 def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,

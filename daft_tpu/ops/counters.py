@@ -26,6 +26,9 @@ COUNTER_NAMES = (
     "device_grouped_batches",  # batches through GroupedAggStage
     "device_stage_runs",       # completed device agg node executions
     "mesh_grouped_runs",       # grouped aggs executed via the mesh-sharded path
+    "mesh_dispatches",         # multi-device shard_map/pjit dispatches issued
+    "mesh_unavailable_fallbacks",  # forced mesh_devices > local devices -> single-chip
+    "mesh_capacity_growths",   # mesh group-table capacity grown mid-run (recompile)
     "device_join_batches",     # batches through the gather-join device stages
     "device_topn_runs",        # join+agg+TopN fused device programs completed
     "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
@@ -97,6 +100,6 @@ def reset() -> None:
     registry().reset(); per-query attribution uses snapshot/diff instead.
     The bucket_fill_ratio GAUGE (derived from the coalescing counters) is
     dropped along with them so a reset can't leave a stale ratio behind."""
-    registry().reset(COUNTER_NAMES + ("bucket_fill_ratio",))
+    registry().reset(COUNTER_NAMES + ("bucket_fill_ratio", "mesh_devices_used"))
     rejections.clear()
     rejection_log.clear()

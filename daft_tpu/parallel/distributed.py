@@ -36,10 +36,28 @@ from ..schema import Schema
 _KEY_SENTINEL = np.iinfo(np.int64).max
 
 
+_MESH_CACHE: Dict[Tuple[int, str], Mesh] = {}
+
+
 def default_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D data-parallel mesh over the first `n_devices` local devices.
+
+    Raises when more devices are requested than exist: silently building a
+    smaller mesh from the slice (the pre-r7 behavior) made a forced
+    `mesh_devices=N` config lie about its own width — callers that can
+    degrade (the executor tier gate) must decide that themselves and count it
+    (counters.mesh_unavailable_fallbacks)."""
     devs = jax.devices()
     n = n_devices or len(devs)
-    return Mesh(np.array(devs[:n]), (axis,))
+    if n > len(devs):
+        raise ValueError(
+            f"default_mesh: {n} devices requested but only {len(devs)} "
+            f"available (jax.devices())")
+    key = (n, axis)
+    cached = _MESH_CACHE.get(key)
+    if cached is None:
+        cached = _MESH_CACHE[key] = Mesh(np.array(devs[:n]), (axis,))
+    return cached
 
 
 def shard_columns(mesh: Mesh, columns: Dict[str, Tuple[np.ndarray, np.ndarray]],
@@ -141,11 +159,6 @@ def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
        results: tuple of per-column (values[capacity], valid[capacity])).
     Rows with invalid keys (nulls / shard padding) are excluded.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
     ops = list(agg_ops)
     # memoize the compiled step: repeated groupbys at the same (mesh, ops,
     # capacity) reuse one jitted multi-device program instead of rebuilding a
@@ -225,13 +238,115 @@ def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
 
     in_specs = tuple([P(axis), P(axis)] + [P(axis)] * (2 * len(ops)))
     out_specs = (P(), P(), P(), tuple((P(), P()) for _ in ops))
+    step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
+    _STEP_CACHE[cache_key] = step
+    return step
+
+
+def _shard_map(local, mesh: Mesh, in_specs, out_specs):
+    """shard_map across the jax spelling drift (check_vma vs check_rep)."""
     try:
-        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(local, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
     except TypeError:  # pre-0.8 jax spells it check_rep
-        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_rep=False)
-    step = jax.jit(mapped)
+        return shard_map(local, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def sharded_gather_step(mesh: Mesh, n_cols: int, axis: str = "dp") -> Callable:
+    """Build the mesh join-feed probe: fact rows row-sharded, dim planes
+    REPLICATED on every device — the probe is a purely local gather (the
+    'broadcast probe' of the two-tier design; no collective until the reduce).
+
+    Returns fn(idx, row_mask, *[(vals, valid) x n_cols flattened]) ->
+    tuple of (gathered_vals, gathered_valid) pairs, row-sharded like `idx`.
+    idx: int64 fact->dim row indices, < 0 = no dim match (inner-join
+    semantics: the row's gathered validity goes False). Output planes feed
+    straight into sharded_groupby_step / sharded_filter_agg-style reduces.
+    """
+    cache_key = ("gather", mesh, n_cols, axis)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+
+    def local(idx, row_mask, *flat):
+        keep = row_mask & (idx >= 0)
+        safe = jnp.maximum(idx, 0)
+        out = []
+        for i in range(n_cols):
+            v, m = flat[2 * i], flat[2 * i + 1]
+            out.append((v[safe], m[safe] & keep))
+        return tuple(out)
+
+    in_specs = tuple([P(axis), P(axis)] + [P()] * (2 * n_cols))
+    out_specs = tuple((P(axis), P(axis)) for _ in range(n_cols))
+    step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
+    _STEP_CACHE[cache_key] = step
+    return step
+
+
+def sharded_join_agg_step(mesh: Mesh, specs: Sequence[Tuple[str, int]],
+                          n_dims: int, axis: str = "dp") -> Callable:
+    """Sharded star-join fact feed + ungrouped aggregate in ONE program.
+
+    Fact rows are row-sharded along the mesh axis; each dim's value plane is
+    replicated (broadcast) so the probe is a local gather through the dim's
+    sharded fact->dim index plane; the reduce is one ICI collective per
+    partial (psum for sum/count — exact for int64 — pmin/pmax for extremes).
+
+    specs: per aggregate (op, src) with op in {sum, count, mean, min, max}
+    and src = dim index whose replicated value plane the aggregate reads
+    (gathered to fact rows), or -1 for a fact-local row-sharded plane.
+
+    Returns fn(row_mask, idx_planes_tuple, *[(vals, valid) per spec]) ->
+    {(i, partial_op): (value, valid)} replicated — combine across batches on
+    the host with ops.stage._combine_partials.
+    """
+    specs = tuple((op, int(src)) for op, src in specs)
+    cache_key = ("joinagg", mesh, specs, n_dims, axis)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+
+    def local(row_mask, idxs, *flat):
+        keep = row_mask
+        for ix in idxs:
+            keep = keep & (ix >= 0)
+        safe = [jnp.maximum(ix, 0) for ix in idxs]
+        out = {}
+        for i, (op, src) in enumerate(specs):
+            v, m = flat[2 * i], flat[2 * i + 1]
+            if src >= 0:
+                v, m = v[safe[src]], m[safe[src]]
+            mask = m & keep
+            cnt = jax.lax.psum(jnp.sum(mask), axis)
+            for partial in _decompose_agg(op):
+                if partial == "count":
+                    out[(i, "count")] = (cnt, jnp.asarray(True))
+                elif partial == "sum":
+                    pv, _ok = dev.device_agg("sum", v, mask)
+                    out[(i, "sum")] = (jax.lax.psum(pv, axis), cnt > 0)
+                else:  # min / max
+                    big = dev._extreme(v.dtype, partial == "min")
+                    masked = jnp.where(mask, v, big)
+                    red = jnp.min(masked) if partial == "min" else jnp.max(masked)
+                    coll = jax.lax.pmin if partial == "min" else jax.lax.pmax
+                    out[(i, partial)] = (coll(red, axis), cnt > 0)
+        return out
+
+    in_specs = (
+        P(axis),
+        tuple(P(axis) for _ in range(n_dims)),
+    ) + tuple(P(axis) if specs[i // 2][1] < 0 else P()
+              for i in range(2 * len(specs)))
+    out_specs = {(i, partial): (P(), P())
+                 for i, (op, _src) in enumerate(specs)
+                 for partial in _decompose_agg(op)}
+    step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
     _STEP_CACHE[cache_key] = step
     return step
 

@@ -1,0 +1,403 @@
+"""In-mesh SPMD device stages (ops/mesh_stage.py) under 8 forced host devices.
+
+Covers the r7 tentpole: bit-exact parity of mesh vs single-chip vs host for
+grouped/ungrouped aggregation and the sharded join feed (including int64
+exactness — the PR-2 quantization lesson), the group-table capacity-growth
+re-run path, coalesced feeds, sharded resident planes (repeat h2d flat, pin
+scopes under a tiny HBM budget), the cost-model ICI tier flip at calibrated
+boundaries, the loud single-chip fallback when a forced mesh exceeds the
+local device count, and the zero-overhead guard (mesh off => no mesh
+imports). Run standalone via `make test-mesh`.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.config import execution_config_ctx
+from daft_tpu.observability.metrics import registry
+from daft_tpu.ops import counters
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices — see conftest")
+
+
+def _groupby_query(d):
+    return (d.where(col("w") < 900)
+            .groupby("k")
+            .agg(col("v").sum().alias("s"), col("v").mean().alias("m"),
+                 col("v").min().alias("lo"), col("v").max().alias("hi"),
+                 col("v").count().alias("c"), col("big").sum().alias("bs"))
+            .sort("k"))
+
+
+@pytest.fixture(scope="module")
+def df():
+    rng = np.random.default_rng(7)
+    n = 5000
+    return daft_tpu.from_pydict({
+        "k": rng.choice(["a", "b", "c", None, "d"], n).tolist(),
+        "v": [None if i % 13 == 0 else float(i % 101) for i in range(n)],
+        "w": rng.integers(0, 1000, n).tolist(),
+        # > 2^53: any float round-trip of the sum is observable
+        "big": (2**53 + rng.integers(0, 1000, n)).tolist(),
+    })
+
+
+def test_grouped_parity_mesh_vs_single_vs_host(df):
+    """Streaming mesh grouped stage: same results as single-chip and host,
+    with int64 sums EXACT and the mesh counters proving the path ran."""
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        mesh_out = _groupby_query(df).to_pydict()
+    assert counters.mesh_grouped_runs > 0
+    assert counters.mesh_dispatches > 0
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=1):
+        single_out = _groupby_query(df).to_pydict()
+    assert counters.mesh_dispatches == 0, "mesh_devices=1 must stay single-chip"
+    assert counters.device_grouped_batches > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = _groupby_query(df).to_pydict()
+    for out in (mesh_out, single_out):
+        assert out["k"] == host_out["k"]
+        assert out["c"] == host_out["c"]
+        for c in ("s", "m", "lo", "hi"):
+            np.testing.assert_allclose(
+                np.array(out[c], dtype=float),
+                np.array(host_out[c], dtype=float), rtol=1e-12)
+    # int64 sums: the mesh kernel segment-reduces in int64 end to end, so it
+    # is EXACT even though the float min/max in this query forces the
+    # single-chip stage into f64 mode (whose int sums round past 2^53 — a
+    # pre-existing single-chip limitation, asserted only to its tolerance)
+    assert mesh_out["bs"] == host_out["bs"], "mesh int64 sum not exact"
+    np.testing.assert_allclose(np.array(single_out["bs"], dtype=float),
+                               np.array(host_out["bs"], dtype=float),
+                               rtol=1e-12)
+
+
+def test_ungrouped_parity_mesh_vs_host(df):
+    def q(d):
+        return d.where(col("w") < 900).agg(
+            col("v").sum().alias("s"), col("v").count().alias("c"),
+            col("v").min().alias("lo"), col("v").mean().alias("m"),
+            col("big").sum().alias("bs"))
+
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        mesh_out = q(df).to_pydict()
+    assert counters.mesh_dispatches > 0
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    assert mesh_out["c"] == host_out["c"]
+    assert mesh_out["bs"] == host_out["bs"], "int64 sum not exact"
+    np.testing.assert_allclose(mesh_out["s"], host_out["s"], rtol=1e-12)
+    np.testing.assert_allclose(mesh_out["m"], host_out["m"], rtol=1e-12)
+    np.testing.assert_allclose(mesh_out["lo"], host_out["lo"])
+
+
+def test_mesh_empty_after_filter():
+    df = daft_tpu.from_pydict({"k": ["a", "b"], "v": [1.0, 2.0], "w": [1, 2]})
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        out = (df.where(col("w") > 100).groupby("k")
+               .agg(col("v").sum().alias("s")).to_pydict())
+    assert out == {"k": [], "s": []}
+
+
+# ---- sharded join feed ---------------------------------------------------------------
+
+
+def test_sharded_join_feed_ungrouped_int64_exact():
+    """Fact rows sharded, dim planes replicated: probe = local gather,
+    reduce = psum over ICI. int64 dim sums must be bit-exact."""
+    from daft_tpu.ops.mesh_stage import mesh_join_ungrouped_agg
+    from daft_tpu.parallel.distributed import default_mesh
+
+    mesh = default_mesh(8)
+    rng = np.random.default_rng(0)
+    n, dim_n = 10_000, 64
+    idx = rng.integers(-1, dim_n, n).astype(np.int64)  # -1 = no match
+    dim_vals = (2**53 + rng.integers(0, 10_000, dim_n)).astype(np.int64)
+    fact_vals = rng.normal(size=n)
+    fact_valid = rng.random(n) > 0.1
+    before = counters.mesh_dispatches
+    res = mesh_join_ungrouped_agg(
+        mesh, n, [idx],
+        [(dim_vals, np.ones(dim_n, bool)), (fact_vals, fact_valid),
+         (dim_vals, np.ones(dim_n, bool))],
+        [("sum", 0), ("mean", -1), ("max", 0)])
+    assert counters.mesh_dispatches > before
+    keep = idx >= 0
+    assert res[0] == int(dim_vals[idx[keep]].sum()), "int64 join sum not exact"
+    np.testing.assert_allclose(
+        res[1], fact_vals[keep & fact_valid].mean(), rtol=1e-12)
+    assert res[2] == int(dim_vals[idx[keep]].max())
+
+
+def test_sharded_join_feed_grouped_matches_numpy():
+    """Grouped join feed: dim group codes gathered to fact rows (broadcast
+    probe), exact sharded groupby merges per-shard tables over ICI."""
+    from daft_tpu.ops.mesh_stage import mesh_join_grouped_agg
+    from daft_tpu.parallel.distributed import default_mesh
+
+    mesh = default_mesh(8)
+    rng = np.random.default_rng(1)
+    n, dim_n, n_codes = 8_000, 50, 7
+    idx = rng.integers(-1, dim_n, n).astype(np.int64)
+    dim_codes = rng.integers(0, n_codes, dim_n).astype(np.int64)
+    fact_vals = (2**53 + rng.integers(0, 1000, n)).astype(np.int64)
+    gk, cols = mesh_join_grouped_agg(
+        mesh, n, idx, dim_codes,
+        [(fact_vals, np.ones(n, bool), -1)], ["sum"], num_codes=n_codes)
+    keep = idx >= 0
+    codes = dim_codes[idx[keep]]
+    expected = {int(c): int(fact_vals[keep][codes == c].sum())
+                for c in np.unique(codes)}
+    got = dict(zip(gk.tolist(), cols[0][0].tolist()))
+    assert got == expected, "grouped join feed not bit-exact"
+
+
+# ---- capacity growth (overflow re-run) -----------------------------------------------
+
+
+def test_group_table_capacity_growth():
+    """A batch with more groups than the run's table capacity grows the
+    static capacity (recompile at the new shape — the streaming analogue of
+    groupby_host's overflow retry) instead of overflowing on device."""
+    from daft_tpu.ops.mesh_stage import try_build_mesh_grouped_agg_stage
+
+    n_keys = 300
+    df = daft_tpu.from_pydict({"k": list(range(n_keys)) * 10,
+                               "v": list(range(n_keys * 10))}).collect()
+    batch = df._result[0].batches[0]
+    stage = try_build_mesh_grouped_agg_stage(
+        df.schema, None, [col("k")], [col("v").sum().alias("s")], 8,
+        initial_capacity=16)
+    assert stage is not None
+    run = stage.start_run()
+    before = counters.mesh_capacity_growths
+    run.feed_batch(batch)
+    keys, results = run.finalize()
+    assert counters.mesh_capacity_growths > before
+    assert len(keys) == n_keys
+    vals, valid = results[0]
+    assert valid.all()
+    arr_k = np.array(list(range(n_keys)) * 10)
+    arr_v = np.arange(n_keys * 10)
+    for i, (key,) in enumerate(keys[:5]):
+        assert int(vals[i]) == int(arr_v[arr_k == key].sum())
+
+
+# ---- coalesced feed ------------------------------------------------------------------
+
+
+def test_coalesced_feed_into_mesh_stage():
+    """The DispatchCoalescer in front of a mesh run merges N morsels into
+    one super-batch => ONE multi-device dispatch covering them all."""
+    from daft_tpu.ops.mesh_stage import try_build_mesh_grouped_agg_stage
+    from daft_tpu.ops.stage import DispatchCoalescer
+
+    df = daft_tpu.from_pydict({"k": (np.arange(4000) % 3).tolist(),
+                               "v": np.arange(4000, dtype=float).tolist()}).collect()
+    batch = df._result[0].batches[0]
+    morsels = [batch.slice(s, s + 500) for s in range(0, 4000, 500)]
+    stage = try_build_mesh_grouped_agg_stage(
+        df.schema, None, [col("k")], [col("v").sum().alias("s")], 8)
+    run = stage.start_run()
+    coal = DispatchCoalescer(run.feed_batch, target_rows=100_000, latency_s=60.0)
+    d0 = counters.mesh_dispatches
+    for m in morsels:
+        coal.add(m)
+    coal.close()
+    keys, results = run.finalize()
+    assert counters.mesh_dispatches - d0 == 1, "morsels were not coalesced"
+    got = dict(zip((k[0] for k in keys), results[0][0].tolist()))
+    arr = np.arange(4000, dtype=float)
+    for k in range(3):
+        np.testing.assert_allclose(got[k], arr[np.arange(4000) % 3 == k].sum())
+
+
+# ---- sharded resident planes ---------------------------------------------------------
+
+
+def test_repeat_mesh_query_h2d_flat_and_digest():
+    """Second identical mesh query reads sharded resident planes: zero new
+    h2d bytes (counter-asserted), and the sharded slots publish in the
+    residency digest (the heartbeat vocabulary) like any other plane."""
+    from daft_tpu.device.residency import manager
+
+    df = daft_tpu.from_pydict({"k": (np.arange(4000) % 5).tolist(),
+                               "v": np.arange(4000).tolist()})
+
+    def q(d):
+        return d.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        first = q(df).to_pydict()
+        h1 = registry().get("hbm_h2d_bytes")
+        second = q(df).to_pydict()
+        h2 = registry().get("hbm_h2d_bytes")
+    assert first == second
+    assert h2 == h1, f"repeat mesh query re-uploaded {h2 - h1} bytes"
+    assert len(manager().digest()) > 0, "sharded slots missing from digest"
+
+
+def test_mesh_planes_pin_under_tiny_hbm_budget():
+    """Sharded planes built inside a query pin via the executor's pin_scope:
+    a budget far below the working set must not thrash them mid-query."""
+    df = daft_tpu.from_pydict({"k": (np.arange(6000) % 7).tolist(),
+                               "v": (np.arange(6000) % 101).astype(float).tolist()})
+
+    def q(d):
+        return d.groupby("k").agg(col("v").sum().alias("s"),
+                                  col("v").count().alias("c")).sort("k")
+
+    with execution_config_ctx(device_mode="off"):
+        host_out = q(df).to_pydict()
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=8,
+                              hbm_budget_bytes=1024):
+        mesh_out = q(df).to_pydict()
+    assert counters.mesh_grouped_runs > 0
+    assert counters.hbm_pins > 0, "mesh planes never pinned"
+    assert mesh_out["k"] == host_out["k"] and mesh_out["c"] == host_out["c"]
+    np.testing.assert_allclose(mesh_out["s"], host_out["s"], rtol=1e-12)
+
+
+# ---- cost-model ICI tier -------------------------------------------------------------
+
+
+_PINNED = {
+    "DAFT_TPU_COST_RTT": "0.001", "DAFT_TPU_COST_H2D": "1e12",
+    "DAFT_TPU_COST_D2H": "1e9", "DAFT_TPU_COST_MM_RATE": "1e9",
+    "DAFT_TPU_COST_MM_CELL_RATE": "3e7", "DAFT_TPU_COST_MESH_DISPATCH": "0.05",
+    "DAFT_TPU_COST_ICI": "1e12", "DAFT_TPU_COST_HOST_AGG": "1e3",
+    "DAFT_TPU_COST_HOST_FACT": "1e9",
+}
+
+
+def test_auto_tier_flips_at_calibrated_boundary():
+    """mesh_devices=0: the decision cache picks the mesh for a large-shape
+    stage and rejects it for a tiny one — mesh must WIN its placement. Cost
+    knobs are env-pinned so the boundary is deterministic on any host."""
+    from daft_tpu.execution import executor
+    from daft_tpu.ops import costmodel
+
+    os.environ.update(_PINNED)
+    costmodel.reset_calibration()
+    executor._MESH_TIER_CACHE.clear()
+    try:
+        big = daft_tpu.from_pydict({
+            "k": (np.arange(200_000) % 5).tolist(),
+            "v": (np.arange(200_000) % 97).astype(float).tolist()})
+        small = daft_tpu.from_pydict({
+            "k": (np.arange(2_000) % 5).tolist(),
+            "v": (np.arange(2_000) % 97).astype(float).tolist()})
+
+        def q(d):
+            return d.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+
+        counters.reset()
+        with execution_config_ctx(device_mode="on", mesh_devices=0,
+                                  device_min_rows=1):
+            big_out = q(big).to_pydict()
+        assert counters.mesh_grouped_runs > 0, "auto tier rejected the big shape"
+        counters.reset()
+        with execution_config_ctx(device_mode="on", mesh_devices=0,
+                                  device_min_rows=1):
+            q(small).to_pydict()
+        assert counters.mesh_grouped_runs == 0, "auto tier took a tiny shape"
+        assert counters.device_grouped_batches > 0
+        with execution_config_ctx(device_mode="off"):
+            host_out = q(big).to_pydict()
+        assert big_out["k"] == host_out["k"]
+        np.testing.assert_allclose(big_out["s"], host_out["s"], rtol=1e-12)
+    finally:
+        for k in _PINNED:
+            os.environ.pop(k, None)
+        costmodel.reset_calibration()
+        executor._MESH_TIER_CACHE.clear()
+
+
+def test_mesh_cost_functions_scale():
+    """Unit sanity on the ICI tier terms: mesh amortizes compute by the mesh
+    width but pays the dispatch premium and the collective."""
+    from daft_tpu.ops import costmodel
+
+    cal = costmodel.Calibration(
+        rtt_s=0.001, h2d_bytes_per_s=1e9, d2h_bytes_per_s=1e9,
+        mm_plane_rows_per_s=1e9, mm_cell_rate=5e10, scatter_rows_per_s=1e8,
+        ext_cell_rate=5e9, host_agg_rate=1.5e8, host_factorize_rate=8e6,
+        host_probe_rate=3e7, ici_bytes_per_s=4.5e10, mesh_dispatch_s=2e-3)
+    small = costmodel.mesh_ungrouped_cost(cal, 10_000, 0, 2, 8)
+    single_small = costmodel.device_ungrouped_cost(cal, 10_000, 0, 2)
+    assert small > single_small, "tiny shapes must not prefer the mesh"
+    big_mesh = costmodel.mesh_grouped_cost(cal, 500_000_000, 0, 4, 1024, 8,
+                                           factorize_rows=0)
+    big_single = costmodel.device_grouped_sort_cost(cal, 500_000_000, 0,
+                                                    n_planes=4,
+                                                    factorize_rows=0)
+    assert big_mesh < big_single, "huge shapes must amortize across the mesh"
+
+
+# ---- forced-mesh fallback + config ---------------------------------------------------
+
+
+def test_forced_mesh_over_device_count_falls_back_loudly():
+    df = daft_tpu.from_pydict({"k": ["a", "b"] * 100,
+                               "v": list(range(200))})
+    counters.reset()
+    with execution_config_ctx(device_mode="on", mesh_devices=16):
+        out = df.groupby("k").agg(col("v").sum().alias("s")).sort("k").to_pydict()
+    assert counters.mesh_unavailable_fallbacks > 0
+    assert counters.mesh_grouped_runs == 0
+    assert counters.device_grouped_batches > 0, "fallback must still run device"
+    assert out["s"] == [sum(range(0, 200, 2)), sum(range(1, 200, 2))]
+
+
+def test_default_mesh_rejects_oversized_request():
+    from daft_tpu.parallel.distributed import default_mesh
+
+    with pytest.raises(ValueError, match="devices"):
+        default_mesh(len(jax.devices()) + 1)
+
+
+def test_config_rejects_negative_mesh_devices():
+    from daft_tpu.config import ExecutionConfig
+
+    with pytest.raises(ValueError, match="mesh_devices"):
+        ExecutionConfig(mesh_devices=-1)
+
+
+# ---- zero-overhead guard -------------------------------------------------------------
+
+
+def test_mesh_off_means_no_mesh_imports():
+    """mesh_devices=1 (the off switch): a device query must not import the
+    mesh machinery at all — the zero-overhead contract extension."""
+    sys.modules.pop("daft_tpu.ops.mesh_stage", None)
+    df = daft_tpu.from_pydict({"k": ["a", "b"] * 50, "v": list(range(100))})
+    with execution_config_ctx(device_mode="on", mesh_devices=1):
+        df.groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+    assert "daft_tpu.ops.mesh_stage" not in sys.modules, \
+        "mesh stage imported with the mesh disabled"
+
+
+# ---- EXPLAIN ANALYZE -----------------------------------------------------------------
+
+
+def test_explain_analyze_renders_mesh_line():
+    df = daft_tpu.from_pydict({"k": (np.arange(2000) % 4).tolist(),
+                               "v": np.arange(2000, dtype=float).tolist()})
+    with execution_config_ctx(device_mode="on", mesh_devices=8):
+        report = (df.groupby("k").agg(col("v").sum().alias("s"))
+                  .explain_analyze())
+    assert "mesh: 8 devices" in report
+    assert "mesh_dispatches" in report  # engine-counter delta table
