@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test test-mesh bench bench-mesh bench-gate bench-compare
+.PHONY: test test-mesh bench bench-mesh bench-serve bench-gate bench-compare
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -25,6 +25,13 @@ test-mesh:
 bench-mesh:
 	env BENCH_MESH=1 JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PY) bench.py
+
+# Serving-tier capture: a 2-worker ServingSession replaying a mixed
+# repeat-heavy stream from 4 concurrent clients on the CPU backend —
+# p50/p99 + queries/sec, bit-identical vs serial, prepared hits > 0,
+# hbm_h2d flat across repeats (bench.py serve_bench).
+bench-serve:
+	env BENCH_SERVE=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 bench:
 	$(PY) bench.py

@@ -19,6 +19,18 @@ Environment knobs:
                           carries the wire/logical byte counters and the
                           derived compression/overlap ratios
     BENCH_SHUFFLE_ROWS=N  microbench fact rows (default 200_000)
+    BENCH_SERVE=1         run the serving-tier bench instead: a 2-worker
+                          ServingSession replaying a mixed repeat-heavy query
+                          stream from >= 4 concurrent clients (CPU backend,
+                          device_mode=on), reporting p50/p99 latency and
+                          queries/sec, asserting bit-identical results vs
+                          serial execution, prepared-cache hits > 0, and a
+                          FLAT hbm_h2d byte count across the repeat phase
+                          (zero re-upload — warm residency as a product)
+    BENCH_SERVE_WORKERS=N   session worker threads (default 2)
+    BENCH_SERVE_CLIENTS=N   concurrent client threads (default 4)
+    BENCH_SERVE_QUERIES=N   queries per client (default 12)
+    BENCH_SERVE_ROWS=N      table rows (default 200_000)
     BENCH_PROFILE=1       after timing, save a per-query Chrome-trace timeline
                           (explain_analyze(profile=...)) — open in Perfetto
     BENCH_PROFILE_DIR=d   where the trace JSONs land (default ".")
@@ -226,6 +238,126 @@ def mesh_microbench() -> None:
     }))
 
 
+def serve_bench() -> None:
+    """BENCH_SERVE=1: the serving-tier capture (see module docstring). The
+    JSON keeps the capture-record shape bench.py --compare understands:
+    per_query_ms carries each query SHAPE's p99 so a serve capture gates
+    against a prior one exactly like the TPC-H per-query table."""
+    import statistics
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.observability.metrics import registry
+    from daft_tpu.serving import ServingSession
+
+    workers = int(os.environ.get("BENCH_SERVE_WORKERS", 2))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 4))
+    per_client = int(os.environ.get("BENCH_SERVE_QUERIES", 12))
+    n = int(os.environ.get("BENCH_SERVE_ROWS", 200_000))
+
+    df = daft_tpu.from_pydict({
+        "k": [i % 601 for i in range(n)],
+        "v": [float(i % 8191) for i in range(n)],
+        "w": [i % 97 for i in range(n)],
+    })
+    # the mixed stream: three shapes, replayed identically (repeat-heavy —
+    # the marquee serving scenario: many tenants hammering a few prepared
+    # queries over one warm table)
+    shapes = {
+        "groupby_sum": lambda: df.groupby("k").agg(
+            col("v").sum().alias("s"), col("w").max().alias("mw")).sort("k"),
+        "filter_sum": lambda: df.where(col("w") > 48).agg(
+            col("v").sum().alias("s")),
+        "groupby_minmax": lambda: df.groupby("w").agg(
+            col("v").min().alias("lo"), col("v").max().alias("hi")).sort("w"),
+    }
+    with execution_config_ctx(device_mode="on", device_min_rows=1,
+                              mesh_devices=1):
+        ref = {name: q().to_pydict() for name, q in shapes.items()}
+        sess = ServingSession(max_concurrent=workers)
+        try:
+            # warm phase: each shape once through the session — plans enter
+            # the prepared cache, column planes enter HBM residency
+            for name, q in shapes.items():
+                assert sess.run(q()) is not None
+            h2d_warm = registry().get("hbm_h2d_bytes")
+            reg_before = registry().snapshot()
+            lat: dict = {name: [] for name in shapes}
+            mismatches: list = []
+            lock = threading.Lock()
+
+            def client(cid: int) -> None:
+                names = list(shapes)
+                for i in range(per_client):
+                    name = names[(cid + i) % len(names)]
+                    t0 = time.perf_counter()
+                    fut = sess.submit(shapes[name](), tenant=f"client-{cid}")
+                    out = fut.to_pydict()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat[name].append(dt)
+                        if out != ref[name]:
+                            mismatches.append(name)
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            h2d_after = registry().get("hbm_h2d_bytes")
+            diff = registry().diff(reg_before)
+        finally:
+            sess.close()
+
+    assert not mismatches, f"serve results diverged from serial: {mismatches}"
+    total = clients * per_client
+    all_lat = sorted(x for xs in lat.values() for x in xs)
+
+    def pct(xs, q):
+        return xs[min(int(q * len(xs)), len(xs) - 1)] if xs else 0.0
+
+    prepared_hits = int(diff.get("serve_prepared_hits", 0))
+    assert prepared_hits > 0, "no prepared-cache hits in a repeat-heavy stream"
+    repeat_h2d = int(h2d_after - h2d_warm)
+    assert repeat_h2d == 0, \
+        f"repeat queries re-uploaded {repeat_h2d} bytes — warm residency broken"
+    metric_totals = {k: v for k, v in diff.items()
+                     if k.startswith(("serve_", "admission_", "hbm_",
+                                      "device_", "dispatch_"))}
+    metric_totals["serve_repeat_h2d_bytes"] = repeat_h2d
+    rows_per_sec = n * total / elapsed
+    print(json.dumps({
+        "metric": "serve_queries_per_sec",
+        "value": round(total / elapsed, 2),
+        "unit": "queries/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
+        "p50_ms": round(pct(all_lat, 0.5) * 1000, 1),
+        "p99_ms": round(pct(all_lat, 0.99) * 1000, 1),
+        "per_query_ms": {name: round(pct(sorted(xs), 0.99) * 1000, 1)
+                         for name, xs in lat.items()},
+        "mean_ms": round(statistics.mean(all_lat) * 1000, 1) if all_lat else 0,
+        "queries": total,
+        "clients": clients,
+        "serve_workers": workers,
+        "bit_identical": True,
+        "fact_rows": n,
+        "metrics": metric_totals,
+    }))
+
+
 REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
 
 
@@ -298,6 +430,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_SHUFFLE"):
         shuffle_microbench()
+        return
+    if os.environ.get("BENCH_SERVE"):
+        serve_bench()
         return
     if SUITE == "tpcds":
         from benchmarking.tpcds.datagen import load_dataframes

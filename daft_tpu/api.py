@@ -27,7 +27,7 @@ __all__ = [
     "from_pydict", "from_pylist", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "from_glob_path", "sql", "sql_expr",
     "cls", "method", "udf", "Func",
-    "launch_dashboard", "enable_event_log",
+    "launch_dashboard", "enable_event_log", "serving_session",
 ]
 
 
@@ -51,6 +51,22 @@ def enable_event_log(path: str):
     from .observability.event_log import enable_event_log as _enable
 
     return _enable(path)
+
+
+def serving_session(max_concurrent: Optional[int] = None, runner=None,
+                    prepared_cap: int = 64):
+    """Open a ServingSession: N concurrent queries with fair per-tenant
+    admission, an HBM admission controller, and a prepared-query cache
+    (daft_tpu/serving/). Use as a context manager:
+
+        with daft_tpu.serving_session(max_concurrent=4) as sess:
+            fut = sess.submit(df.groupby("k").agg(...), tenant="acme")
+            rows = fut.to_pydict()
+    """
+    from .serving import ServingSession
+
+    return ServingSession(max_concurrent=max_concurrent, runner=runner,
+                          prepared_cap=prepared_cap)
 
 
 def element() -> Expression:

@@ -168,6 +168,20 @@ class ExecutionConfig:
     mesh_devices: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_MESH_DEVICES", 0)
     )
+    # Serving tier (daft_tpu/serving/): how many queries one ServingSession
+    # executes concurrently (session worker threads). Admission beyond this
+    # count queues fairly (per-tenant round-robin, FIFO within a tenant).
+    max_concurrent_queries: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_MAX_CONCURRENT_QUERIES", 4)
+    )
+    # Per-tenant HBM reservation cap for the serving admission controller
+    # (device/residency.py admit()): one tenant's concurrently-admitted
+    # queries may hold at most this many estimated pin-scope bytes; further
+    # queries from that tenant queue while others proceed. 0 = no per-tenant
+    # cap (the global hbm_budget_bytes still applies).
+    tenant_budget_bytes: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_TENANT_BUDGET", 0)
+    )
 
     def __post_init__(self) -> None:
         # Reject unknown mode strings loudly: DAFT_TPU_DEVICE=force (a
@@ -213,6 +227,16 @@ class ExecutionConfig:
                 f"shuffle_prefetch_batches must be >= 0 (0 disables prefetch), "
                 f"got {self.shuffle_prefetch_batches!r} "
                 f"(check DAFT_TPU_SHUFFLE_PREFETCH)")
+        if self.max_concurrent_queries < 1:
+            raise ValueError(
+                f"max_concurrent_queries must be >= 1, got "
+                f"{self.max_concurrent_queries!r} "
+                f"(check DAFT_TPU_MAX_CONCURRENT_QUERIES)")
+        if self.tenant_budget_bytes < 0:
+            raise ValueError(
+                f"tenant_budget_bytes must be >= 0 (0 disables the per-tenant "
+                f"cap), got {self.tenant_budget_bytes!r} "
+                f"(check DAFT_TPU_TENANT_BUDGET)")
 
 
 _default: Optional[ExecutionConfig] = None

@@ -251,6 +251,11 @@ class ResidencyManager:
         # pool opts its children in so planes survive between tasks.
         self._orphans: "OrderedDict[tuple, None]" = OrderedDict()
         self._orphan_cap: Optional[int] = None
+        # admission controller state (serving tier): outstanding pin-scope
+        # reservations of currently-admitted queries, token -> (tenant, bytes)
+        self._adm = threading.Condition(threading.Lock())
+        self._reservations: dict = {}
+        self._rsv_seq = itertools.count(1)
 
     # ---- lookup / build ------------------------------------------------------------
     def get_or_build(self, anchor, key: tuple, deps: tuple,
@@ -399,6 +404,83 @@ class ResidencyManager:
             top.add(full_key)
             e.pins += 1
             registry().inc("hbm_pins")
+
+    # ---- admission control (serving tier) --------------------------------------------
+    @contextlib.contextmanager
+    def admit(self, est_bytes: int, tenant: str = "",
+              tenant_budget: int = 0):
+        """HBM admission controller: bracket one query's execution with a
+        pin-scope byte RESERVATION. A query declares the device bytes its
+        working set is estimated to pin (serving/prepared.py derives the
+        estimate from the cost model's device-bytes probes via the plan
+        fingerprint); admission waits while the SUM of currently-admitted
+        reservations plus this one would exceed the HBM budget — queries
+        queue instead of thrashing the LRU against each other's pinned
+        planes. Yields True when the query had to wait (the caller's
+        admission-wait attribution).
+
+        Deadlock-free by construction: a query is ALWAYS admissible when no
+        other reservation is outstanding, so a single query whose estimate
+        exceeds the whole budget runs alone and degrades exactly like today
+        (pin scope + eviction at scope exit) rather than waiting forever.
+        `tenant_budget` > 0 additionally caps one tenant's concurrent
+        reservations (config.tenant_budget_bytes), with the same
+        no-outstanding-reservation escape per tenant. Estimates of 0 (host-
+        only plans) admit immediately — the controller governs device
+        working sets, not host compute."""
+        est = max(int(est_bytes), 0)
+        budget = self.budget_bytes()
+        waited = False
+        with self._adm:
+            while not self._admissible(est, tenant, budget, tenant_budget):
+                if not waited:
+                    waited = True
+                    registry().inc("admission_waits_total")
+                # timed wait: the budget is re-read so a config change (or an
+                # auto-budget probe landing) unblocks waiters without a signal
+                self._adm.wait(0.05)
+                budget = self.budget_bytes()
+            tok = next(self._rsv_seq)
+            self._reservations[tok] = (tenant, est)
+            registry().set_gauge(
+                "hbm_reserved_bytes",
+                float(sum(b for _t, b in self._reservations.values())))
+        try:
+            yield waited
+        finally:
+            with self._adm:
+                self._reservations.pop(tok, None)
+                registry().set_gauge(
+                    "hbm_reserved_bytes",
+                    float(sum(b for _t, b in self._reservations.values())))
+                self._adm.notify_all()
+
+    def _admissible(self, est: int, tenant: str, budget: int,
+                    tenant_budget: int) -> bool:
+        """Called under self._adm. The escape hatches (empty ledger / empty
+        tenant ledger) are what make over-budget queries serialize instead of
+        deadlock."""
+        if est <= 0:
+            return True
+        if not self._reservations:
+            return True
+        if budget > 0 and sum(
+                b for _t, b in self._reservations.values()) + est > budget:
+            return False
+        if tenant_budget > 0:
+            mine = sum(b for t, b in self._reservations.values() if t == tenant)
+            if mine and mine + est > tenant_budget:
+                return False
+        return True
+
+    def reserved_bytes(self) -> int:
+        """Outstanding admission reservations (introspection/tests)."""
+        with self._adm:
+            return sum(b for _t, b in self._reservations.values())
+
+    def reservation_count(self) -> int:
+        with self._adm:
+            return len(self._reservations)
 
     # ---- budget / eviction ---------------------------------------------------------
     def budget_bytes(self) -> int:

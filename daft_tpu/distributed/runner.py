@@ -51,19 +51,25 @@ class DistributedRunner(Runner):
         self._fetch_server = None
         # QueryTrace of the most recent traced run (distributed EXPLAIN ANALYZE)
         self.last_trace = None
+        # concurrent run_iter callers (serving tier) must not race pool
+        # creation; the pool itself is concurrent-caller safe once built
+        import threading
+
+        self._pool_init_lock = threading.Lock()
 
     def _ensure_pool(self) -> WorkerPool:
-        if self._pool is None:
-            self._pool = WorkerPool(self.num_workers, self.slots_per_worker,
-                                    max_workers=self.max_workers,
-                                    device_workers=self.device_workers)
-            if self._shuffle_dir is None:
-                self._shuffle_dir = tempfile.mkdtemp(prefix="daft_tpu_shuffle_")
-            if self.shuffle_transport == "socket" and self._fetch_server is None:
-                from .fetch_server import ShuffleFetchServer
+        with self._pool_init_lock:
+            if self._pool is None:
+                self._pool = WorkerPool(self.num_workers, self.slots_per_worker,
+                                        max_workers=self.max_workers,
+                                        device_workers=self.device_workers)
+                if self._shuffle_dir is None:
+                    self._shuffle_dir = tempfile.mkdtemp(prefix="daft_tpu_shuffle_")
+                if self.shuffle_transport == "socket" and self._fetch_server is None:
+                    from .fetch_server import ShuffleFetchServer
 
-                self._fetch_server = ShuffleFetchServer(self._shuffle_dir)
-        return self._pool
+                    self._fetch_server = ShuffleFetchServer(self._shuffle_dir)
+            return self._pool
 
     def run_iter(self, builder: LogicalPlanBuilder) -> Iterator[MicroPartition]:
         """Execute with the full observability lifecycle: subscriber events

@@ -16,6 +16,16 @@ scored by estimated transfer-bytes-avoided minus a load penalty, so repeat
 sub-plans stick to the worker that already paid their uploads. The policy is
 SOFT: nothing resident, a saturated preferred worker, or a losing score all
 degrade to the plain spread pick — no task ever waits for locality.
+
+Fair multi-stream extension (serving tier): pending tasks live in PER-STREAM
+heaps keyed by ``stream_key`` (the WorkerPool passes one key per concurrent
+run_tasks call, i.e. per query stage). With one stream the drain is the
+original one-pass greedy order; with several, schedule() deals tasks
+round-robin ONE per stream per rotation, so a query arriving behind a
+100-task stage still gets its first task dispatched after at most one
+rotation — admission fairness extends through to worker slots. The rotation
+start advances across calls so no stream is permanently first. The scheduler
+is NOT internally locked: one owner (the pool's dispatcher thread) drives it.
 """
 
 from __future__ import annotations
@@ -57,7 +67,10 @@ class Scheduler:
         self._workers: Dict[str, WorkerSnapshot] = {
             wid: WorkerSnapshot(wid, slots) for wid, slots in workers.items()
         }
-        self._heap: List[Tuple[int, int, SubPlanTask]] = []
+        # stream_key -> pending heap (insertion-ordered for the rotation)
+        self._queues: "Dict[str, List[Tuple[int, int, SubPlanTask]]]" = {}
+        self._stream_order: List[str] = []
+        self._rr_pos = 0
         self._seq = itertools.count()
         try:
             self._autoscaling_threshold = float(
@@ -107,30 +120,48 @@ class Scheduler:
         return dict(self._stats)
 
     # ---- scheduling ----------------------------------------------------------
-    def submit(self, task: SubPlanTask) -> None:
-        # lower priority value = scheduled first (matches reference heap order)
-        heapq.heappush(self._heap, (task.priority, next(self._seq), task))
+    def submit(self, task: SubPlanTask, stream_key: Optional[str] = None) -> None:
+        # lower priority value = scheduled first (matches reference heap
+        # order) WITHIN a stream; streams deal round-robin against each other
+        key = stream_key if stream_key is not None else (task.stage_id or "")
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = []
+            self._stream_order.append(key)
+        heapq.heappush(q, (task.priority, next(self._seq), task))
+
+    def drop_stream(self, stream_key: str) -> int:
+        """Purge a stream's pending tasks (its stage errored/was abandoned);
+        returns how many were dropped. In-flight tasks are unaffected."""
+        q = self._queues.pop(stream_key, None)
+        if stream_key in self._stream_order:
+            self._stream_order.remove(stream_key)
+        return len(q) if q else 0
 
     def pending_count(self) -> int:
-        return len(self._heap)
+        return sum(len(q) for q in self._queues.values())
+
+    def _pending_tasks(self) -> List[SubPlanTask]:
+        return [t for q in self._queues.values() for _p, _s, t in q]
 
     def needs_autoscaling(self) -> bool:
         """True when pending demand exceeds total capacity by the threshold
         factor (DAFT_TPU_AUTOSCALING_THRESHOLD, default 1.25 — reference:
         default.rs needs_autoscaling). Cheap: called every dispatch loop."""
-        if not self._heap:
+        pending = self.pending_count()
+        if not pending:
             return False
         if not self._workers:
             return True
         total_capacity = sum(w.total_slots for w in self._workers.values())
-        return len(self._heap) > total_capacity * self._autoscaling_threshold
+        return pending > total_capacity * self._autoscaling_threshold
 
     def get_autoscaling_request(self) -> Optional[List[SubPlanTask]]:
         """Pending tasks justifying scale-up, or None (reference:
         default.rs get_autoscaling_request)."""
         if not self.needs_autoscaling():
             return None
-        return [t for _p, _s, t in self._heap]
+        return self._pending_tasks()
 
     def schedule(self) -> List[Tuple[SubPlanTask, str]]:
         """Assign as many pending tasks as current capacity allows.
@@ -141,12 +172,44 @@ class Scheduler:
         worker in a per-pass skip set: later heap entries bound to the same
         worker are re-queued without an eligibility scan instead of spinning
         the heap head-of-line (counted in sched_affinity_skips).
+
+        With several pending streams, assignments rotate one-per-stream so
+        concurrent queries share worker capacity fairly instead of FIFO
+        head-of-line (see the module docstring).
         """
+        live = [k for k in self._stream_order if self._queues.get(k)]
+        blocked_prefs: Set[str] = set()
+        if len(live) <= 1:
+            # single stream: the original one-pass greedy drain
+            return (self._drain_stream(live[0], blocked_prefs, limit=0)
+                    if live else [])
+        # rotate the starting stream across calls so no stream is always first
+        start = self._rr_pos % len(live)
+        self._rr_pos += 1
+        order = live[start:] + live[:start]
+        assigned: List[Tuple[SubPlanTask, str]] = []
+        progress = True
+        while progress:
+            progress = False
+            for key in order:
+                got = self._drain_stream(key, blocked_prefs, limit=1)
+                if got:
+                    assigned.extend(got)
+                    progress = True
+        return assigned
+
+    def _drain_stream(self, key: str, blocked_prefs: Set[str],
+                      limit: int) -> List[Tuple[SubPlanTask, str]]:
+        """Pop schedulable tasks from one stream's heap (at most `limit`;
+        0 = until capacity runs out). Unschedulable entries are re-queued,
+        preserving the head-of-line skip-set behavior within the stream."""
+        heap = self._queues.get(key)
+        if not heap:
+            return []
         assigned: List[Tuple[SubPlanTask, str]] = []
         skipped: List[Tuple[int, int, SubPlanTask]] = []
-        blocked_prefs: Set[str] = set()
-        while self._heap:
-            prio, seq, task = heapq.heappop(self._heap)
+        while heap:
+            prio, seq, task = heapq.heappop(heap)
             strategy = task.strategy
             if (isinstance(strategy, WorkerAffinity) and strategy.hard
                     and strategy.worker_id in blocked_prefs):
@@ -168,8 +231,14 @@ class Scheduler:
                 continue
             self._workers[wid].active_tasks += 1
             assigned.append((task, wid))
+            if limit and len(assigned) >= limit:
+                break
         for item in skipped:
-            heapq.heappush(self._heap, item)
+            heapq.heappush(heap, item)
+        if not heap:
+            self._queues.pop(key, None)
+            if key in self._stream_order:
+                self._stream_order.remove(key)
         return assigned
 
     def _pick_worker(self, task: SubPlanTask) -> Optional[str]:

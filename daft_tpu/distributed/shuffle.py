@@ -249,9 +249,19 @@ class MapOutputWriter:
             d = partition_dir(self.base, self.shuffle_id, partition_idx)
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, f"m{self.map_id}.arrow")
-            w = ipc.new_stream(path, table.schema, options=self._opts)
+            # atomic publish: stream into a per-attempt temp name (readers
+            # filter on the exact m<id>.arrow pattern, so it is invisible)
+            # and os.replace() into place on close. Two attempts of the same
+            # deterministic map task — a speculative duplicate racing the
+            # original, or a retry racing a half-dead worker — then publish
+            # identical content last-writer-wins instead of interleaving
+            # writes into one corrupt file.
+            import uuid as _uuid
+
+            tmp = f"{path}.inprogress-{_uuid.uuid4().hex[:8]}"
+            w = ipc.new_stream(tmp, table.schema, options=self._opts)
             self._writers[partition_idx] = w
-            self._paths[partition_idx] = path
+            self._paths[partition_idx] = (tmp, path)
         w.write_table(table)
         _note_write(self.shuffle_id, partition_idx, batch.num_rows, table.nbytes)
 
@@ -259,8 +269,10 @@ class MapOutputWriter:
         wire = 0
         for p, w in self._writers.items():
             w.close()
+            tmp, path = self._paths[p]
             try:
-                wire += os.path.getsize(self._paths[p])
+                os.replace(tmp, path)
+                wire += os.path.getsize(path)
             except OSError:
                 pass
         self._writers.clear()

@@ -29,6 +29,7 @@ from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Dict, List, Optional
 
+from ..observability.metrics import registry
 from .task import SubPlanTask, TaskResult
 
 
@@ -300,10 +301,16 @@ class WorkerProcess:
         # digest to the scheduler only when it actually changed
         self.last_digest: Dict[int, int] = {}
         self.digest_seq = 0
+        # multiprocessing.Connection framing is not thread-safe: the pool's
+        # dispatcher thread polls while a driver thread may drain heartbeats
+        # (concurrent serving queries), so every send/recv on this connection
+        # goes through one lock
+        self._io_lock = threading.RLock()
 
     def submit(self, task: SubPlanTask) -> None:
-        self.inflight[task.task_id] = task
-        self._conn.send(("task", task))
+        with self._io_lock:
+            self.inflight[task.task_id] = task
+            self._conn.send(("task", task))
 
     def _note_heartbeat(self, hb: dict) -> None:
         # driver-side receive stamp: recv_ts - ts (worker send clock) over a
@@ -318,50 +325,54 @@ class WorkerProcess:
             self.digest_seq += 1
 
     def poll(self, timeout: float = 0.0) -> Optional[TaskResult]:
-        if self._pending_results:
-            res = self._pending_results.popleft()
-            self.inflight.pop(res.task_id, None)
-            return res
-        try:
-            while self._conn.poll(timeout):
-                msg = self._conn.recv()
-                if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
-                    # out-of-band heartbeat: record and keep draining (without
-                    # blocking again — the result may already be queued)
-                    self._note_heartbeat(msg[1])
-                    timeout = 0.0
-                    continue
-                res: TaskResult = msg
+        with self._io_lock:
+            if self._pending_results:
+                res = self._pending_results.popleft()
                 self.inflight.pop(res.task_id, None)
                 return res
-        except (EOFError, BrokenPipeError, OSError):
-            # dead worker: caller's alive-check re-queues its in-flight tasks
-            pass
-        return None
+            try:
+                while self._conn.poll(timeout):
+                    msg = self._conn.recv()
+                    if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
+                        # out-of-band heartbeat: record and keep draining
+                        # (without blocking again — the result may already be
+                        # queued)
+                        self._note_heartbeat(msg[1])
+                        timeout = 0.0
+                        continue
+                    res: TaskResult = msg
+                    self.inflight.pop(res.task_id, None)
+                    return res
+            except (EOFError, BrokenPipeError, OSError):
+                # dead worker: caller's alive-check re-queues its in-flight tasks
+                pass
+            return None
 
     def pump(self) -> None:
         """Drain whatever the connection holds without consuming anything:
         heartbeats land in the window (and refresh last_digest), results are
         stashed for the next poll(). Lets the pool refresh residency digests
         before scheduling a stage."""
-        try:
-            while self._conn.poll(0.0):
-                msg = self._conn.recv()
-                if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
-                    self._note_heartbeat(msg[1])
-                else:
-                    self._pending_results.append(msg)
-        except (EOFError, BrokenPipeError, OSError):
-            pass
+        with self._io_lock:
+            try:
+                while self._conn.poll(0.0):
+                    msg = self._conn.recv()
+                    if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
+                        self._note_heartbeat(msg[1])
+                    else:
+                        self._pending_results.append(msg)
+            except (EOFError, BrokenPipeError, OSError):
+                pass
 
     def drain_heartbeats(self) -> List[dict]:
         """Non-destructively empty the connection: heartbeats are collected;
         any TaskResult encountered is stashed for the next poll() (a stale
         result from an errored stage must not be silently consumed here)."""
-        self.pump()
-        out = list(self.heartbeats)
-        self.heartbeats.clear()
-        return out
+        with self._io_lock:
+            self.pump()
+            out = list(self.heartbeats)
+            self.heartbeats.clear()
+            return out
 
     @property
     def alive(self) -> bool:
@@ -370,7 +381,8 @@ class WorkerProcess:
     def stop(self) -> None:
         try:
             if self.alive:
-                self._conn.send(("stop",))
+                with self._io_lock:
+                    self._conn.send(("stop",))
                 self._proc.wait(timeout=2)
         except (BrokenPipeError, OSError, subprocess.TimeoutExpired):
             pass
@@ -387,13 +399,61 @@ class WorkerProcess:
                 pass
 
 
+class _StageRun:
+    """One run_tasks() call in flight on the pool dispatcher: the caller
+    thread waits on `done` while the dispatcher routes this stage's results
+    here. `key` is the scheduler stream key — one per concurrent stage, so
+    the per-stream round-robin in Scheduler.schedule() interleaves concurrent
+    queries' tasks fairly across the shared workers."""
+
+    __slots__ = ("key", "stage_id", "trace", "tasks", "expected", "results",
+                 "error", "done", "completed_times", "running", "speculated",
+                 "dup_worker", "dispatched_at", "stats_before",
+                 "placement_stats")
+
+    def __init__(self, key: str, tasks: List[SubPlanTask], stage_id: str,
+                 trace) -> None:
+        self.key = key
+        self.stage_id = stage_id
+        self.trace = trace
+        self.tasks: Dict[str, SubPlanTask] = {t.task_id: t for t in tasks}
+        self.expected = set(self.tasks)
+        self.results: Dict[str, TaskResult] = {}
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+        self.completed_times: List[float] = []   # exec seconds (speculation median)
+        self.running: Dict[str, tuple] = {}      # task_id -> (worker_id, dispatch ts)
+        self.speculated: set = set()
+        self.dup_worker: Dict[str, str] = {}     # task_id -> speculative copy's worker
+        self.dispatched_at: Dict[str, float] = {}
+        self.stats_before: Dict[str, int] = {}
+        self.placement_stats: Dict[str, int] = {}
+
+    def fail(self, error: str) -> None:
+        self.error = error
+        self.done.set()
+
+
 class WorkerPool:
     """N local workers + scheduler-driven dispatch with failure re-queue.
 
-    run_tasks() drives a stage to completion: assigns via the Scheduler, polls
-    workers, re-queues tasks whose worker died (excluding that worker, like the
-    reference's snapshot-based retry), and raises the original traceback for
-    task-level errors.
+    run_tasks() drives a stage to completion and is safe to call from
+    CONCURRENT driver threads (the serving tier runs several distributed
+    queries over one pool): all worker-connection I/O and scheduling run on a
+    single pool-level dispatcher thread; each run_tasks call registers a
+    _StageRun and waits. The shared Scheduler deals pending tasks round-robin
+    across concurrent stages, re-queues tasks whose worker died (excluding
+    that worker, like the reference's snapshot-based retry), and raises the
+    original traceback for task-level errors.
+
+    Speculative re-execution (the action half of QueryTrace.straggler_report):
+    once a stage has >= 2 finished tasks, a still-running task whose elapsed
+    time exceeds DAFT_TPU_STRAGGLER_K x the stage's completed-task median
+    (and a floor, DAFT_TPU_SPECULATIVE_MIN_S) is duplicate-dispatched to a
+    different worker; the first result wins and the loser is discarded.
+    DAFT_TPU_SPECULATIVE=0 disables. Shuffle map duplicates are safe because
+    MapOutputWriter publishes atomically (write-temp + rename, identical
+    deterministic content).
     """
 
     def __init__(self, num_workers: int, slots_per_worker: int = 1,
@@ -469,6 +529,20 @@ class WorkerPool:
                     if device_mode != "off" else "auto"
             self.workers[wid] = WorkerProcess(wid, acceptor, sock,
                                               slots_per_worker, env=wenv)
+        # ---- dispatcher state (single thread owns scheduler + worker I/O) ----
+        from .scheduler import Scheduler
+
+        self._pool_lock = threading.RLock()
+        self._sched = Scheduler({w.worker_id: w.slots
+                                 for w in self.workers.values() if w.alive})
+        self._runs: Dict[str, _StageRun] = {}
+        self._task_route: Dict[str, _StageRun] = {}
+        self._incoming: deque = deque()
+        self._stage_seq = 0
+        self._digest_seen: Dict[str, int] = {}
+        self._dispatcher: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._closed = False
 
     def scale_up(self, n: int = 1) -> List[str]:
         """Spawn up to n extra workers (bounded by max_workers over ALIVE
@@ -495,7 +569,7 @@ class WorkerPool:
 
     def run_tasks(self, tasks: List[SubPlanTask], stage_id: str = "",
                   trace=None) -> Dict[str, TaskResult]:
-        """Drive one stage of tasks to completion.
+        """Drive one stage of tasks to completion (concurrent-caller safe).
 
         When `trace` (a distributed.trace.QueryTrace) is given, every task is
         stamped with the query's trace context at dispatch (trace id + parent
@@ -503,18 +577,6 @@ class WorkerPool:
         tasks are recorded into the trace with driver-side queue-wait/dispatch
         timing joined to the worker-side execution record.
         """
-        from .scheduler import Scheduler
-
-        sched = Scheduler({w.worker_id: w.slots
-                           for w in self.workers.values() if w.alive})
-        # seed residency digests from the latest heartbeats so the FIRST
-        # scheduling pass of this stage is already cache-affinity aware
-        digest_seen: Dict[str, int] = {}
-        for w in self.workers.values():
-            if w.alive:
-                w.pump()
-                sched.update_residency(w.worker_id, w.last_digest)
-                digest_seen[w.worker_id] = w.digest_seq
         now = time.time()
         for t in tasks:
             if stage_id and not t.stage_id:
@@ -524,90 +586,279 @@ class WorkerPool:
                 t.trace_id = trace.trace_id
                 t.parent_span_id = trace.root_span_id
             t.submitted_at = now
-            sched.submit(t)
-        results: Dict[str, TaskResult] = {}
-        expected = {t.task_id for t in tasks}
-        dispatched_at: Dict[str, float] = {}
-        task_by_id: Dict[str, SubPlanTask] = {t.task_id: t for t in tasks}
-
-        def _requeue_elsewhere(w: WorkerProcess, task: SubPlanTask) -> None:
-            clone = SubPlanTask(
-                task_id=task.task_id, plan_blob=task.plan_blob,
-                strategy=task.strategy, priority=task.priority,
-                excluded_workers=task.excluded_workers + (w.worker_id,),
-                stage_id=task.stage_id, trace_id=task.trace_id,
-                parent_span_id=task.parent_span_id,
-                collect_stats=task.collect_stats,
-                # keep the FIRST submit time: a retry's queue wait includes
-                # the failed attempt's scheduling delay
-                submitted_at=task.submitted_at,
-                rfingerprint=task.rfingerprint)
-            task_by_id[task.task_id] = clone
-            sched.submit(clone)
-
-        while len(results) < len(expected):
-            # elastic scale-up: when queued demand exceeds capacity by the
-            # autoscaling threshold, grow the pool toward max_workers — ONE
-            # worker per dispatch loop, so result polling of busy workers is
-            # never starved behind a burst of blocking spawns
-            if sched.needs_autoscaling():
-                for wid in self.scale_up(1):
-                    sched.add_worker(wid, self._slots_per_worker)
-            assignments = sched.schedule()
-            for task, wid in assignments:
-                w = self.workers[wid]
-                try:
-                    w.submit(task)
-                    dispatched_at[task.task_id] = time.time()
-                except (BrokenPipeError, OSError):
-                    w.inflight.pop(task.task_id, None)
-                    sched.remove_worker(wid)
-                    _requeue_elsewhere(w, task)
-            progressed = bool(assignments)
-            for w in list(self.workers.values()):
-                res = w.poll(timeout=0.005)
-                # heartbeats may have arrived during the poll: refresh this
-                # worker's residency digest for the next scheduling pass —
-                # but only when it actually changed (seq check), not a dict
-                # copy per worker per 5ms dispatch iteration
-                if digest_seen.get(w.worker_id) != w.digest_seq:
-                    sched.update_residency(w.worker_id, w.last_digest)
-                    digest_seen[w.worker_id] = w.digest_seq
-                if res is not None:
-                    progressed = True
-                    sched.task_finished(res.worker_id)
-                    if res.task_id not in expected:
-                        continue  # stale result from an abandoned earlier stage
-                    if res.error is not None:
-                        raise RuntimeError(
-                            f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}")
-                    results[res.task_id] = res
-                    if trace is not None and res.task_id in task_by_id:
-                        trace.record_task(task_by_id[res.task_id], res,
-                                          dispatched_at.get(res.task_id, 0.0))
-                if not w.alive:
-                    # worker died: re-queue its tasks elsewhere and DROP the
-                    # entry (leaving it would leak its fd and pay a poll
-                    # error every loop; scale_up counts alive workers so the
-                    # slot frees for a replacement)
-                    sched.remove_worker(w.worker_id)
-                    if w.inflight:
-                        for t in list(w.inflight.values()):
-                            _requeue_elsewhere(w, t)
-                        w.inflight.clear()
-                        progressed = True
-                    w.stop()
-                    self.workers.pop(w.worker_id, None)
-                    if not any(ww.alive for ww in self.workers.values()):
-                        raise RuntimeError("all workers died")
-            if not progressed and sched.pending_count() and not any(
-                    w.inflight for w in self.workers.values()):
-                # nothing running, nothing newly assignable -> unschedulable
-                raise RuntimeError(
-                    f"{sched.pending_count()} tasks unschedulable (no eligible workers)")
+        with self._pool_lock:
+            if self._closed:
+                raise RuntimeError("worker pool is shut down")
+            self._stage_seq += 1
+            key = f"{stage_id or 'stage'}#{self._stage_seq}"
+            run = _StageRun(key, tasks, stage_id or "stage", trace)
+            self._incoming.append(run)
+            self._ensure_dispatcher()
+        self._wake.set()
+        while not run.done.wait(timeout=0.5):
+            with self._pool_lock:
+                alive = (self._dispatcher is not None
+                         and self._dispatcher.is_alive())
+            if not alive and not run.done.is_set():
+                raise RuntimeError("worker pool dispatcher died")
+        if run.error is not None:
+            raise RuntimeError(run.error)
         if trace is not None:
-            trace.note_placement(stage_id or "stage", sched.placement_stats())
-        return results
+            trace.note_placement(run.stage_id, run.placement_stats)
+        return dict(run.results)
+
+    # ---- dispatcher ---------------------------------------------------------------
+    def _ensure_dispatcher(self) -> None:
+        """Start the dispatcher lazily (pool lock held by caller)."""
+        if self._dispatcher is None or not self._dispatcher.is_alive():
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True, name="daft-dispatch")
+            self._dispatcher.start()
+
+    def _dispatch_loop(self) -> None:
+        import traceback as _tb
+
+        try:
+            while True:
+                with self._pool_lock:
+                    if self._closed:
+                        return
+                    has_work = bool(self._runs or self._incoming)
+                if not has_work:
+                    self._wake.wait(0.05)
+                    self._wake.clear()
+                    continue
+                self._dispatch_pass()
+        except Exception as e:  # noqa: BLE001 — a dispatcher crash must fail callers loudly
+            err = (f"pool dispatcher crashed: {type(e).__name__}: {e}\n"
+                   f"{_tb.format_exc()}")
+            with self._pool_lock:
+                runs = list(self._runs.values()) + list(self._incoming)
+                self._runs.clear()
+                self._incoming.clear()
+                self._task_route.clear()
+            for r in runs:
+                r.fail(err)
+
+    def _register_incoming(self) -> None:
+        while True:
+            with self._pool_lock:
+                if not self._incoming:
+                    return
+                run = self._incoming.popleft()
+            # seed residency digests from the latest heartbeats so this
+            # stage's FIRST scheduling pass is already cache-affinity aware
+            for w in list(self.workers.values()):
+                if w.alive:
+                    w.pump()
+                    if self._digest_seen.get(w.worker_id) != w.digest_seq:
+                        self._sched.update_residency(w.worker_id, w.last_digest)
+                        self._digest_seen[w.worker_id] = w.digest_seq
+            # sync scheduler membership with the pool (workers added by an
+            # external scale_up() between stages must become schedulable)
+            known = {s.worker_id for s in self._sched.snapshots()}
+            for w in self.workers.values():
+                if w.alive and w.worker_id not in known:
+                    self._sched.add_worker(w.worker_id, w.slots)
+            run.stats_before = self._sched.placement_stats()
+            self._runs[run.key] = run
+            for t in run.tasks.values():
+                self._task_route[t.task_id] = run
+                self._sched.submit(t, stream_key=run.key)
+
+    def _requeue_elsewhere(self, w: WorkerProcess, task: SubPlanTask,
+                           run: _StageRun) -> None:
+        clone = SubPlanTask(
+            task_id=task.task_id, plan_blob=task.plan_blob,
+            strategy=task.strategy, priority=task.priority,
+            excluded_workers=task.excluded_workers + (w.worker_id,),
+            stage_id=task.stage_id, trace_id=task.trace_id,
+            parent_span_id=task.parent_span_id,
+            collect_stats=task.collect_stats,
+            # keep the FIRST submit time: a retry's queue wait includes
+            # the failed attempt's scheduling delay
+            submitted_at=task.submitted_at,
+            rfingerprint=task.rfingerprint)
+        run.tasks[task.task_id] = clone
+        run.running.pop(task.task_id, None)
+        run.speculated.discard(task.task_id)
+        run.dup_worker.pop(task.task_id, None)
+        self._sched.submit(clone, stream_key=run.key)
+
+    def _finish_run(self, run: _StageRun) -> None:
+        now = self._sched.placement_stats()
+        run.placement_stats = {
+            k: now.get(k, 0) - run.stats_before.get(k, 0) for k in now}
+        with self._pool_lock:
+            self._runs.pop(run.key, None)
+            for tid in run.expected:
+                self._task_route.pop(tid, None)
+        run.done.set()
+
+    def _fail_run(self, run: _StageRun, error: str) -> None:
+        self._sched.drop_stream(run.key)
+        with self._pool_lock:
+            self._runs.pop(run.key, None)
+            for tid in run.expected:
+                self._task_route.pop(tid, None)
+        run.fail(error)
+
+    def _dispatch_pass(self) -> None:
+        sched = self._sched
+        self._register_incoming()
+        # elastic scale-up: when queued demand exceeds capacity by the
+        # autoscaling threshold, grow the pool toward max_workers — ONE
+        # worker per dispatch pass, so result polling of busy workers is
+        # never starved behind a burst of blocking spawns
+        if sched.needs_autoscaling():
+            for wid in self.scale_up(1):
+                sched.add_worker(wid, self._slots_per_worker)
+        assignments = sched.schedule()
+        for task, wid in assignments:
+            w = self.workers.get(wid)
+            run = self._task_route.get(task.task_id)
+            if w is None or run is None:
+                # worker vanished between snapshot and submit, or the run
+                # was failed/abandoned: give the slot back
+                sched.task_finished(wid)
+                continue
+            try:
+                w.submit(task)
+            except (BrokenPipeError, OSError):
+                w.inflight.pop(task.task_id, None)
+                sched.remove_worker(wid)
+                self._requeue_elsewhere(w, task, run)
+                continue
+            now = time.time()
+            if (task.task_id in run.running
+                    or task.task_id in run.results):
+                # second concurrent attempt = the speculative copy
+                run.dup_worker[task.task_id] = wid
+            else:
+                run.running[task.task_id] = (wid, now)
+                run.dispatched_at.setdefault(task.task_id, now)
+        progressed = bool(assignments)
+        for w in list(self.workers.values()):
+            res = w.poll(timeout=0.005)
+            # heartbeats may have arrived during the poll: refresh this
+            # worker's residency digest for the next scheduling pass —
+            # but only when it actually changed (seq check), not a dict
+            # copy per worker per 5ms dispatch iteration
+            if self._digest_seen.get(w.worker_id) != w.digest_seq:
+                sched.update_residency(w.worker_id, w.last_digest)
+                self._digest_seen[w.worker_id] = w.digest_seq
+            if res is not None:
+                progressed = True
+                sched.task_finished(res.worker_id)
+                run = self._task_route.get(res.task_id)
+                if run is not None:
+                    self._route_result(run, res)
+            if not w.alive:
+                # worker died: re-queue its tasks elsewhere and DROP the
+                # entry (leaving it would leak its fd and pay a poll
+                # error every loop; scale_up counts alive workers so the
+                # slot frees for a replacement)
+                sched.remove_worker(w.worker_id)
+                if w.inflight:
+                    for t in list(w.inflight.values()):
+                        run = self._task_route.get(t.task_id)
+                        if run is None or t.task_id in run.results:
+                            continue  # result already won elsewhere
+                        self._requeue_elsewhere(w, t, run)
+                    w.inflight.clear()
+                    progressed = True
+                w.stop()
+                self.workers.pop(w.worker_id, None)
+                if not any(ww.alive for ww in self.workers.values()):
+                    for run in list(self._runs.values()):
+                        self._fail_run(run, "all workers died")
+                    return
+        self._maybe_speculate()
+        if not progressed and sched.pending_count() and not any(
+                w.inflight for w in self.workers.values()):
+            # nothing running, nothing newly assignable -> unschedulable;
+            # fail every run that still has unfinished tasks
+            for run in list(self._runs.values()):
+                if len(run.results) < len(run.expected):
+                    self._fail_run(
+                        run, f"{sched.pending_count()} tasks unschedulable "
+                             f"(no eligible workers)")
+
+    def _route_result(self, run: _StageRun, res: TaskResult) -> None:
+        if res.task_id in run.results:
+            return  # speculative loser (or duplicate retry): first result won
+        if res.error is not None:
+            # a failed SPECULATIVE copy must never fail a stage the original
+            # attempt can still win — speculation may only mask stragglers,
+            # not introduce failures
+            if (res.task_id in run.speculated
+                    and res.worker_id == run.dup_worker.get(res.task_id)):
+                run.dup_worker.pop(res.task_id, None)
+                run.speculated.discard(res.task_id)
+                return
+            self._fail_run(
+                run,
+                f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}")
+            return
+        run.results[res.task_id] = res
+        run.running.pop(res.task_id, None)
+        run.completed_times.append(res.exec_seconds or 0.0)
+        if (res.task_id in run.speculated
+                and res.worker_id == run.dup_worker.get(res.task_id)):
+            registry().inc("sched_speculative_wins")
+        if run.trace is not None and res.task_id in run.tasks:
+            run.trace.record_task(run.tasks[res.task_id], res,
+                                  run.dispatched_at.get(res.task_id, 0.0))
+        if len(run.results) == len(run.expected):
+            self._finish_run(run)
+
+    def _maybe_speculate(self) -> None:
+        """Duplicate-dispatch running stragglers (first result wins). A task
+        qualifies once its stage has >= 2 completed tasks and its elapsed
+        time exceeds straggler_threshold() x the completed median and the
+        DAFT_TPU_SPECULATIVE_MIN_S floor (default 0.25s — trivial tasks are
+        never worth a duplicate)."""
+        if os.environ.get("DAFT_TPU_SPECULATIVE", "1") in ("0", "off", "false"):
+            return
+        import statistics
+
+        from .trace import straggler_threshold
+
+        try:
+            floor = float(os.environ.get("DAFT_TPU_SPECULATIVE_MIN_S", "0.25"))
+        except ValueError:
+            floor = 0.25
+        k = straggler_threshold()
+        now = time.time()
+        for run in list(self._runs.values()):
+            if len(run.completed_times) < 2 or not run.running:
+                continue
+            med = statistics.median(run.completed_times)
+            cutoff = max(k * med, floor)
+            for task_id, (wid, t0) in list(run.running.items()):
+                if task_id in run.speculated or task_id in run.results:
+                    continue
+                if now - t0 <= cutoff:
+                    continue
+                task = run.tasks.get(task_id)
+                if task is None:
+                    continue
+                excluded = task.excluded_workers + (wid,)
+                if not any(w.alive and w.worker_id not in excluded
+                           for w in self.workers.values()):
+                    continue  # nowhere else to run the duplicate
+                clone = SubPlanTask(
+                    task_id=task.task_id, plan_blob=task.plan_blob,
+                    strategy=task.strategy, priority=task.priority,
+                    excluded_workers=excluded,
+                    stage_id=task.stage_id, trace_id=task.trace_id,
+                    parent_span_id=task.parent_span_id,
+                    collect_stats=task.collect_stats,
+                    submitted_at=task.submitted_at,
+                    rfingerprint=task.rfingerprint)
+                run.speculated.add(task_id)
+                self._sched.submit(clone, stream_key=run.key)
+                registry().inc("sched_speculative_dispatches")
 
     def drain_heartbeats(self) -> List[dict]:
         """Collect heartbeats received from every live worker since the last
@@ -620,6 +871,18 @@ class WorkerPool:
         return out
 
     def shutdown(self) -> None:
+        with self._pool_lock:
+            self._closed = True
+            dispatcher = self._dispatcher
+            runs = list(self._runs.values()) + list(self._incoming)
+            self._runs.clear()
+            self._incoming.clear()
+            self._task_route.clear()
+        self._wake.set()
+        if dispatcher is not None and dispatcher.is_alive():
+            dispatcher.join(timeout=2.0)
+        for r in runs:
+            r.fail("worker pool shut down mid-stage")
         for w in self.workers.values():
             w.stop()
         self.workers.clear()

@@ -16,6 +16,7 @@ ops/counters.py records which path actually ran.
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -724,9 +725,7 @@ def _run_device_join(node, label: str, make_run, assemble,
             wins = batch0 is not None and _join_device_wins(
                 node, ctx, batch0, first.num_rows, grouped, stage,
                 topn=topn, label=label, coalesce=coal)
-            _DECISION_CACHE[dk] = wins
-            if len(_DECISION_CACHE) > 512:
-                _DECISION_CACHE.pop(next(iter(_DECISION_CACHE)))
+            _DECISION_CACHE.put(dk, wins)
             if not wins:
                 raw_stream.close()
                 return _host()
@@ -771,7 +770,38 @@ def _run_device_join(node, label: str, make_run, assemble,
         return _host()
 
 
-_DECISION_CACHE: dict = {}
+class _BoundedDecisionCache:
+    """Thread-safe bounded FIFO verdict cache. Concurrent serving queries hit
+    the decision/mesh-tier caches from many threads at once; a plain dict's
+    `pop(next(iter(d)))` eviction under concurrent insertion can raise
+    RuntimeError mid-query, so reads and the insert+evict pair are locked
+    (coarse events only — one probe per cost decision, never per row)."""
+
+    def __init__(self, cap: int = 512):
+        self._lock = threading.Lock()
+        self._d: dict = {}
+        self.cap = cap
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._d.get(key, default)
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            while len(self._d) > self.cap:
+                self._d.pop(next(iter(self._d)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+_DECISION_CACHE = _BoundedDecisionCache()
 
 
 def _batch_layout(part: MicroPartition) -> tuple:
@@ -952,7 +982,7 @@ def _grouped_output(schema, groupby, aggregations, key_rows, results) -> MicroPa
     return MicroPartition(schema, [out.cast_to_schema(schema)])
 
 
-_MESH_TIER_CACHE: dict = {}
+_MESH_TIER_CACHE = _BoundedDecisionCache()
 
 
 def _select_mesh_tier(node, stream, grouped: bool, cfg):
@@ -996,9 +1026,7 @@ def _select_mesh_tier(node, stream, grouped: bool, cfg):
     wins = _MESH_TIER_CACHE.get(key)
     if wins is None:
         wins = _mesh_wins(node, first, grouped, ndev)
-        _MESH_TIER_CACHE[key] = wins
-        if len(_MESH_TIER_CACHE) > 512:
-            _MESH_TIER_CACHE.pop(next(iter(_MESH_TIER_CACHE)))
+        _MESH_TIER_CACHE.put(key, wins)
     return (ndev if wins else 0), stream
 
 

@@ -10,6 +10,7 @@ from .events import (
     QueryEnd,
     QueryOptimized,
     QueryStart,
+    ServeQueryRecord,
     ShuffleStats,
     TaskStats,
     WorkerHeartbeat,
@@ -32,6 +33,7 @@ __all__ = [
     "QueryStart",
     "ShuffleStats",
     "TaskStats",
+    "ServeQueryRecord",
     "WorkerHeartbeat",
     "Histogram",
     "MetricsRegistry",

@@ -35,19 +35,24 @@ h2,h3{color:#eee}
 <h2>daft_tpu — live queries</h2>
 <div class="counters" id="eng"></div>
 <div class="counters" id="wk"></div>
+<div class="counters" id="srv"></div>
 <div id="t"></div><div id="detail"></div>
 <script>
 let selected = null;
 function esc(x){ return String(x ?? '').replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;'); }
 async function refresh(){
-  const [qs, eng, wk] = await Promise.all([
+  const [qs, eng, wk, srv] = await Promise.all([
     (await fetch('/api/queries')).json(), (await fetch('/api/engine')).json(),
-    (await fetch('/api/workers')).json()]);
+    (await fetch('/api/workers')).json(), (await fetch('/api/serving')).json()]);
   document.getElementById('eng').innerHTML =
     Object.entries(eng).map(([k,v])=>`<span>${k}: ${v}</span>`).join('');
   document.getElementById('wk').innerHTML =
     Object.entries(wk).map(([k,v])=>`<span>${esc(k)}: busy ${(100*v.busy_fraction).toFixed(0)}% `+
       `done ${v.last?v.last.tasks_completed:0} rss ${v.last?(v.last.rss_bytes/1048576).toFixed(0):0}MiB</span>`).join('');
+  document.getElementById('srv').innerHTML =
+    Object.entries(srv).map(([t,s])=>`<span>tenant ${esc(t)}: ${s.queries}q `+
+      `hit ${(100*s.prepared_hit_rate).toFixed(0)}% waits ${s.admission_waits} `+
+      `p99 ${(1000*s.p99_s).toFixed(0)}ms</span>`).join('');
   let h = '<table><tr><th>id</th><th>status</th><th>rows</th><th>seconds</th><th>top operators</th></tr>';
   for (const q of qs){
     const ops = (q.operators||[]).slice(0,3).map(o=>`${esc(o.name)}: ${o.rows_out}r / ${(o.seconds*1000).toFixed(1)}ms`).join('<br>');
@@ -97,6 +102,11 @@ class DashboardState(Subscriber):
         # per-query wall-clock latency, fixed Prometheus buckets -> p50/p99
         # derivable by any scraper (and locally via .quantile)
         self.query_latency = Histogram()
+        # serving tier: per-tenant latency histograms (the tenant label on
+        # daft_tpu_query_latency_seconds in /metrics) + per-tenant serving
+        # totals for the hit-rate table (/api/serving)
+        self.tenant_latency: dict = {}
+        self._serving: dict = {}
 
     def on_query_start(self, event: QueryStart) -> None:
         rec = {"query_id": event.query_id, "started": time.time(),
@@ -171,6 +181,54 @@ class DashboardState(Subscriber):
         with self._lock:
             return self._traces.get(query_id)
 
+    def on_serve_query(self, rec) -> None:
+        """One ServingSession query: observe latency into the aggregate AND
+        the tenant's labeled histogram, accumulate the per-tenant hit-rate
+        row. Serving's in-process fast path does not emit QueryEnd, so this
+        is where its latency reaches the aggregate histogram; runner-backed
+        serving DOES emit QueryEnd (observed in on_query_end), so only the
+        tenant series records here — never both."""
+        if getattr(rec, "in_process", True):
+            self.query_latency.observe(rec.seconds)
+        with self._lock:
+            h = self.tenant_latency.get(rec.tenant)
+            if h is None:
+                h = self.tenant_latency[rec.tenant] = Histogram()
+            st = self._serving.setdefault(rec.tenant, {
+                "queries": 0, "errors": 0, "prepared_hits": 0,
+                "admission_waits": 0, "wait_s": 0.0, "seconds": 0.0,
+                "rows": 0})
+            st["queries"] += 1
+            st["seconds"] += rec.seconds
+            st["rows"] += rec.rows
+            st["wait_s"] += rec.admission_wait_s
+            if rec.prepared_hit:
+                st["prepared_hits"] += 1
+            if getattr(rec, "admission_waited", False):
+                st["admission_waits"] += 1
+            if rec.error:
+                st["errors"] += 1
+        h.observe(rec.seconds)
+
+    def serving(self) -> dict:
+        """Per-tenant serving rollup: queries, prepared hit RATE, admission
+        waits, mean latency + local p50/p99 from the tenant histogram."""
+        with self._lock:
+            tenants = {k: dict(v) for k, v in self._serving.items()}
+            hists = dict(self.tenant_latency)
+        out = {}
+        for tenant, st in tenants.items():
+            n = max(st["queries"], 1)
+            h = hists.get(tenant)
+            out[tenant] = {
+                **st,
+                "prepared_hit_rate": round(st["prepared_hits"] / n, 4),
+                "mean_s": st["seconds"] / n,
+                "p50_s": h.quantile(0.5) if h else 0.0,
+                "p99_s": h.quantile(0.99) if h else 0.0,
+            }
+        return out
+
     def on_query_end(self, event: QueryEnd) -> None:
         self.query_latency.observe(event.seconds)
         with self._lock:
@@ -221,6 +279,11 @@ class DashboardState(Subscriber):
             return out
 
 
+def _label_escape(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -243,9 +306,20 @@ class _Handler(BaseHTTPRequestHandler):
             extra["hbm_entries"] = st.get("hbm_entries", 0)
         except Exception:  # noqa: BLE001 — a scrape must never 500 on a device-less host
             extra["hbm_bytes_resident"] = 0
+        state = self.server.state
+        with state._lock:
+            tenant_hists = dict(state.tenant_latency)
+        labeled = {}
+        if tenant_hists:
+            # per-tenant label on the query-latency histogram family: the
+            # unlabeled aggregate and the tenant series share one TYPE line
+            labeled["query_latency_seconds"] = {
+                f'tenant="{_label_escape(t)}"': h
+                for t, h in tenant_hists.items()}
         return prometheus_text(
             extra_gauges=extra,
-            histograms={"query_latency_seconds": self.server.state.query_latency})
+            histograms={"query_latency_seconds": state.query_latency},
+            labeled_histograms=labeled)
 
     def do_GET(self):
         if self.path.startswith("/api/queries"):
@@ -280,6 +354,11 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif self.path.startswith("/api/workers"):
             body = json.dumps(self.server.state.workers(), default=str).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/serving"):
+            # per-tenant serving rollup (queries, prepared hit rate,
+            # admission waits, p50/p99) — the hit-rate table's data source
+            body = json.dumps(self.server.state.serving(), default=str).encode()
             ctype = "application/json"
         elif self.path == "/" or self.path.startswith("/index"):
             body = _HTML.encode()

@@ -29,7 +29,9 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # (seconds == their sum); worker_heartbeat gains recv_ts (driver receive
 # stamp backing the Chrome-trace clock-offset estimate); spill counters
 # (spill_batches/spill_bytes) now appear in query_end.metrics.
-SCHEMA_VERSION = 6
+# v7: adds the serve_query record kind (serving tier — tenant, latency,
+# prepared-cache hit, admission wait; see events.ServeQueryRecord).
+SCHEMA_VERSION = 7
 
 
 class EventLogSubscriber(Subscriber):
@@ -70,6 +72,9 @@ class EventLogSubscriber(Subscriber):
     def on_worker_heartbeat(self, qid, hb) -> None:
         self._emit("worker_heartbeat", {"query_id": qid,
                                         **dataclasses.asdict(hb)})
+
+    def on_serve_query(self, rec) -> None:
+        self._emit("serve_query", dataclasses.asdict(rec))
 
     def on_query_end(self, e) -> None:
         d = dataclasses.asdict(e)

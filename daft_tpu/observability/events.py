@@ -130,3 +130,31 @@ class QueryEnd:
     # per-query metrics-registry counter deltas (device batches, shuffle
     # bytes, rejections dropped, ...) — see observability/metrics.py
     metrics: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ServeQueryRecord:
+    """One query served through a ServingSession (daft_tpu/serving/): the
+    per-tenant accounting the dashboard's hit-rate table and the /metrics
+    tenant-labeled latency histogram are built from. Emitted IN ADDITION to
+    the regular lifecycle events — serving executes the prepared physical
+    plan directly, so QueryStart/QueryEnd do not fire for the in-process
+    fast path and this record is the authoritative serving telemetry."""
+
+    query_id: str
+    tenant: str
+    seconds: float             # submit -> result (includes queue + admission)
+    exec_seconds: float        # execution only (post-admission)
+    rows: int
+    prepared_hit: bool         # planning skipped via the prepared-query cache
+    admission_wait_s: float    # time queued at the HBM admission controller
+    est_pin_bytes: int         # declared pin-scope budget estimate
+    error: Optional[str] = None
+    # True only when the admission controller actually made this query WAIT
+    # (the authoritative flag — admission_wait_s is nonzero even on an
+    # immediate admit, it includes the lock acquisition)
+    admission_waited: bool = False
+    # True for the session's in-process fast path (no QueryStart/QueryEnd
+    # fired); False when a runner executed it (QueryEnd fired too — consumers
+    # aggregating both event kinds must not double-count such queries)
+    in_process: bool = True

@@ -115,8 +115,14 @@ _REGISTRY = MetricsRegistry()
 # Prometheus exposition is import-order independent: a scraper must see the
 # series at 0 from the first scrape of a fresh process, not only after the
 # owning module happens to load (execution/memory.py declares these too —
-# declare() is a setdefault — and documents their semantics).
-_REGISTRY.declare("spill_batches", "spill_bytes")
+# declare() is a setdefault — and documents their semantics). The serving
+# tier's admission counters/gauges join them: daft_tpu_admission_waits_total
+# and daft_tpu_serve_queue_depth must be scrapeable from the first scrape
+# even if no ServingSession was ever constructed.
+_REGISTRY.declare("spill_batches", "spill_bytes", "admission_waits_total",
+                  "serve_prepared_hits", "serve_prepared_misses",
+                  "serve_queries_total")
+_REGISTRY.set_gauge("serve_queue_depth", 0.0)
 
 
 def registry() -> MetricsRegistry:
@@ -140,12 +146,21 @@ def _prom_name(name: str) -> str:
 
 def prometheus_text(prefix: str = "daft_tpu_",
                     extra_gauges: Optional[Dict[str, float]] = None,
-                    histograms: Optional[Dict[str, "Histogram"]] = None) -> str:
+                    histograms: Optional[Dict[str, "Histogram"]] = None,
+                    labeled_histograms: Optional[
+                        "Dict[str, Dict[str, Histogram]]"] = None) -> str:
     """The whole registry in Prometheus text exposition format (version
     0.0.4): every counter as `<prefix><name>` TYPE counter, every gauge TYPE
     gauge, plus caller-supplied live gauges (e.g. hbm_bytes_resident read
     straight off the residency manager) and fixed-bucket histograms. Served
-    by the dashboard's /metrics endpoint; scrapeable by any standard infra."""
+    by the dashboard's /metrics endpoint; scrapeable by any standard infra.
+
+    `labeled_histograms` maps a metric name to {label_string: Histogram}
+    (label_string like 'tenant="acme"'): every labeled series shares one
+    metric family — one TYPE line, the label riding each sample — which is
+    how the serving tier exposes its per-tenant query-latency split. A name
+    present in BOTH dicts emits the unlabeled aggregate and the labeled
+    series under a single TYPE line."""
     counters, gauges = _REGISTRY.export()
     if extra_gauges:
         for k, v in extra_gauges.items():
@@ -160,8 +175,15 @@ def prometheus_text(prefix: str = "daft_tpu_",
         m = prefix + _prom_name(name)
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {gauges[name]}")
-    for name in sorted(histograms or ()):
-        lines.extend(histograms[name].prometheus_lines(prefix + _prom_name(name)))
+    labeled = labeled_histograms or {}
+    for name in sorted(set(histograms or ()) | set(labeled)):
+        m = prefix + _prom_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        if histograms and name in histograms:
+            lines.extend(histograms[name].prometheus_lines(m, include_type=False))
+        for label in sorted(labeled.get(name, ())):
+            lines.extend(labeled[name][label].prometheus_lines(
+                m, labels=label, include_type=False))
     return "\n".join(lines) + "\n"
 
 
@@ -207,17 +229,23 @@ class Histogram:
                     return b
             return float("inf")
 
-    def prometheus_lines(self, metric: str) -> list:
+    def prometheus_lines(self, metric: str, labels: str = "",
+                         include_type: bool = True) -> list:
+        """Text-exposition sample lines. `labels` is an optional pre-rendered
+        label string ('tenant="acme"') merged with the le bucket label —
+        per-tenant latency series share one metric family this way."""
         with self._lock:
             counts = list(self._counts)
             total_sum, total_count = self._sum, self._count
-        lines = [f"# TYPE {metric} histogram"]
+        lines = [f"# TYPE {metric} histogram"] if include_type else []
+        sep = f"{labels}," if labels else ""
+        suffix = f"{{{labels}}}" if labels else ""
         cum = 0
         for b, c in zip(self.buckets, counts[:-1]):
             cum += c
-            lines.append(f'{metric}_bucket{{le="{b}"}} {cum}')
+            lines.append(f'{metric}_bucket{{{sep}le="{b}"}} {cum}')
         cum += counts[-1]
-        lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{metric}_sum {total_sum}")
-        lines.append(f"{metric}_count {total_count}")
+        lines.append(f'{metric}_bucket{{{sep}le="+Inf"}} {cum}')
+        lines.append(f"{metric}_sum{suffix} {total_sum}")
+        lines.append(f"{metric}_count{suffix} {total_count}")
         return lines

@@ -194,8 +194,17 @@ class SpanRecorder:
 # engine's instrumentation sites pay one module-attribute read)
 _ACTIVE_SPANS: Optional[SpanRecorder] = None
 
+# per-thread override sentinel: a thread inside span_scope() reads its own
+# slot INSTEAD of the global one, so concurrent serving queries can isolate
+# themselves from a query being profiled elsewhere in the process (their
+# device spans must not bleed into that query's recorder, and vice versa)
+_UNSET = object()
+
 
 def current_spans() -> Optional[SpanRecorder]:
+    rec = getattr(_local, "spans", _UNSET)
+    if rec is not _UNSET:
+        return rec
     return _ACTIVE_SPANS
 
 
@@ -205,12 +214,32 @@ def set_spans(rec: Optional[SpanRecorder]) -> None:
 
 
 @contextmanager
+def span_scope(rec: Optional[SpanRecorder]):
+    """Thread-scoped span recorder override: inside the scope, THIS thread's
+    instrumentation sites record into `rec` (or nowhere, for rec=None)
+    regardless of the process-global slot. ServingSession worker threads run
+    queries under span_scope(None) so a concurrently-profiled query's global
+    recorder never receives another tenant's spans. Spans recorded from
+    pipeline stage/pool threads still follow the global slot — serving
+    documents that per-query profiling is a serialized, opt-in path."""
+    prev = getattr(_local, "spans", _UNSET)
+    _local.spans = rec
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _local.spans
+        else:
+            _local.spans = prev
+
+
+@contextmanager
 def profile_span(name: str, cat: str, **args):
     """Record the enclosed block as a timeline span when a SpanRecorder is
     active; a no-op (no clock read, no record) otherwise. Used at COARSE
     sites only (a device dispatch, a coalescer flush, a shuffle fetch),
     never per row."""
-    rec = _ACTIVE_SPANS
+    rec = current_spans()
     if rec is None:
         yield
         return
@@ -228,7 +257,7 @@ def span_iter(name: str, cat: str, inner, **args):
     top of the caller's. The no-recorder path delegates without timing —
     the streaming counterpart of profile_span, shared by the shuffle
     read/fetch sites."""
-    rec = _ACTIVE_SPANS
+    rec = current_spans()
     if rec is None:
         yield from inner
         return

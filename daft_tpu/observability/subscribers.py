@@ -12,7 +12,8 @@ import threading
 from typing import List
 
 from .events import (OperatorStats, QueryEnd, QueryOptimized, QueryStart,
-                     ShuffleStats, TaskStats, WorkerHeartbeat)
+                     ServeQueryRecord, ShuffleStats, TaskStats,
+                     WorkerHeartbeat)
 
 
 class Subscriber:
@@ -40,6 +41,11 @@ class Subscriber:
         """The distributed run's assembled QueryTrace (distributed/trace.py)
         at query end — the timeline profiler's source object. Subscribers
         that persist it should render via trace.to_chrome_trace()."""
+        pass
+
+    def on_serve_query(self, rec: ServeQueryRecord) -> None:  # pragma: no cover
+        """One query served through a ServingSession (per-tenant latency,
+        prepared-cache hit, admission wait) — see daft_tpu/serving/."""
         pass
 
     def on_query_end(self, event: QueryEnd) -> None:  # pragma: no cover

@@ -53,6 +53,15 @@ COUNTER_NAMES = (
     "sched_affinity_misses",   # fingerprinted tasks spread while planes sat on a full worker
     "sched_bytes_avoided",     # est. h2d bytes saved by affinity placements
     "sched_affinity_skips",    # hard-affinity heap skips (head-of-line guard)
+    # speculative re-execution (distributed/worker.py dispatcher): straggler
+    # tasks duplicate-dispatched to a second worker, first result wins
+    "sched_speculative_dispatches",
+    "sched_speculative_wins",  # races the speculative copy actually won
+    # serving tier (daft_tpu/serving/): admission + prepared-query cache
+    "admission_waits_total",   # queries that queued at the HBM admission controller
+    "serve_queries_total",     # queries executed through a ServingSession
+    "serve_prepared_hits",     # prepared-query cache hits (planning skipped)
+    "serve_prepared_misses",   # prepared-query cache misses (planned + cached)
 )
 
 registry().declare(*COUNTER_NAMES)

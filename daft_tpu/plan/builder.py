@@ -173,6 +173,11 @@ class LogicalPlanBuilder:
 
     # ---- optimize ----------------------------------------------------------------
     def optimize(self, config: Any = None) -> "LogicalPlanBuilder":
+        # prepared-query fast path (daft_tpu/serving/prepared.py): a builder
+        # already holding an optimized plan short-circuits, so a runner
+        # handed a prepared plan never re-runs the optimizer rules
+        if getattr(self, "_preoptimized", False):
+            return self
         from .optimizer import Optimizer
 
         return self._next(Optimizer(config).optimize(self._plan))

@@ -1,0 +1,37 @@
+"""Serving tier: concurrent multi-query sessions over one warm engine.
+
+The driver-side realization of the "warm residency as a product" ROADMAP
+item: a ``ServingSession`` admits N concurrent queries through a fair
+(per-tenant round-robin, FIFO within a tenant) admission queue, brackets each
+execution with an HBM admission-controller reservation (queries queue when
+the budget is spoken for instead of thrashing the residency LRU against each
+other's pinned planes), and serves repeat queries through a prepared-query
+cache that skips optimize+translate entirely and lands directly on the warm
+HBM planes PRs 2-3 built.
+
+    from daft_tpu.serving import ServingSession
+
+    with ServingSession(max_concurrent=4) as sess:
+        fut = sess.submit(df.groupby("k").agg(...), tenant="acme")
+        parts = fut.result()          # list[MicroPartition]
+
+Observability: serve_queue_depth / hbm_reserved_bytes gauges,
+admission_waits_total / serve_prepared_hits / serve_prepared_misses /
+serve_queries_total counters (Prometheus ``/metrics`` via the dashboard),
+per-tenant latency histograms (tenant label on
+daft_tpu_query_latency_seconds), and one ServeQueryRecord per query to
+subscribers (dashboard per-tenant hit-rate table, event log schema v7).
+"""
+
+from .admission import FairAdmissionQueue
+from .prepared import PreparedQueryCache, estimate_pin_bytes, plan_structure
+from .session import ServeFuture, ServingSession
+
+__all__ = [
+    "FairAdmissionQueue",
+    "PreparedQueryCache",
+    "ServeFuture",
+    "ServingSession",
+    "estimate_pin_bytes",
+    "plan_structure",
+]

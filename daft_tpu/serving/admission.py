@@ -1,0 +1,74 @@
+"""Fair admission queue: per-tenant round-robin with FIFO within a tenant.
+
+The serving tier's first gate (the second is the HBM admission controller,
+``device/residency.py ResidencyManager.admit``). Classic fair-queueing shape:
+one FIFO per tenant, served round-robin, so a tenant replaying a 500-query
+batch cannot starve an interactive tenant's single query — the interactive
+query waits at most one rotation, not 500 slots. Tenants enter the rotation
+on their first pending item and leave it when drained; the rotation pointer
+survives drains so service order stays fair across bursts.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, List, Optional
+
+
+class FairAdmissionQueue:
+    """Thread-safe multi-tenant queue: ``push`` from any client thread,
+    ``pop`` from the session's worker threads."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition(threading.Lock())
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._rotation: List[str] = []
+        self._pos = 0
+        self._size = 0
+
+    def push(self, tenant: str, item: Any) -> int:
+        """Enqueue one item for `tenant`; returns the new total depth."""
+        with self._cond:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._rotation.append(tenant)
+            q.append(item)
+            self._size += 1
+            self._cond.notify()
+            return self._size
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Dequeue the next item in per-tenant round-robin order (FIFO within
+        the tenant), waiting up to `timeout` seconds; None on timeout."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._size > 0, timeout):
+                return None
+            n = len(self._rotation)
+            for i in range(n):
+                idx = (self._pos + i) % n
+                tenant = self._rotation[idx]
+                q = self._queues.get(tenant)
+                if not q:
+                    continue
+                item = q.popleft()
+                self._size -= 1
+                if not q:
+                    # drained: leave the rotation; the pointer lands on the
+                    # tenant that was NEXT (now shifted into this slot)
+                    self._rotation.pop(idx)
+                    del self._queues[tenant]
+                    self._pos = idx % max(len(self._rotation), 1)
+                else:
+                    self._pos = (idx + 1) % n
+                return item
+            return None  # unreachable while _size > 0
+
+    def depth(self) -> int:
+        with self._cond:
+            return self._size
+
+    def tenants(self) -> List[str]:
+        with self._cond:
+            return list(self._rotation)

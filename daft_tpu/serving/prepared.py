@@ -1,0 +1,195 @@
+"""Prepared-query cache: optimized plan + physical plan + pin-bytes estimate
+keyed by logical-plan STRUCTURE, reusing the residency manager's
+literal-compare contract (PR 2).
+
+RDBMS prepared-statement shape applied to the engine: a serving session's
+repeat query skips the optimizer and the physical translation entirely and
+executes the cached physical plan, whose device stages then land on the warm
+HBM planes (residency rebinds by content, the decision caches hold the
+cost-model verdicts for the same structural keys, and the jit compile cache
+holds the stage programs) — the repeat path is admission + dispatch + d2h.
+
+Key contract (mirrors device/residency.py expr_structure): the cache key is
+the plan SKELETON — node types, masked expressions, source-table identity
+tokens — with the literal values stored in the entry and compared ON LOOKUP.
+Two fingerprint-equal plans differing only in predicate literals therefore
+NEVER share a prepared entry: the literal mismatch replans and replaces the
+slot (one slot per query shape, like the residency cache), so a varying-
+literal stream is bounded while a stale-literal plan can never be served.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+from ..device.residency import expr_structure, identity_token
+from ..expressions import Expression
+from ..observability.metrics import registry
+
+# prepared entries kept per cache (LRU on lookup order): serving sessions see
+# a bounded set of query shapes; past the cap the coldest shape replans
+DEFAULT_PREPARED_CAP = 64
+
+
+def plan_structure(plan) -> Tuple[tuple, tuple]:
+    """(skeleton, literals) for one LOGICAL plan.
+
+    The skeleton walks the plan preorder; each node contributes its type
+    name plus every public field, with expressions masked to their literal-
+    free skeletons (literals collected separately, in walk order), child
+    plans reduced to arity markers (the preorder walk carries the shape),
+    in-memory partitions reduced to identity tokens (device/residency.py —
+    monotonic, never reused, so a new table can never alias a dead one), and
+    unknown objects (scan operators, UDF handles) likewise identity-keyed.
+    Two queries over the same resident tables differing only in literal
+    values share one skeleton — the prepared cache compares their literals
+    on lookup."""
+    skel: List[tuple] = []
+    lits: List[tuple] = []
+    for node in plan.walk():
+        row: List[Any] = [type(node).__name__]
+        fields = vars(node)
+        for name in sorted(fields):
+            if name.startswith("_"):
+                continue
+            row.append(name)
+            row.append(_field_key(fields[name], lits))
+        skel.append(tuple(row))
+    return tuple(skel), tuple(lits)
+
+
+def _field_key(val, lits: List[tuple]):
+    from ..plan.logical import LogicalPlan
+
+    if isinstance(val, LogicalPlan):
+        return "<child>"  # subtree shape arrives via the preorder walk
+    if isinstance(val, Expression):
+        s, l = expr_structure(val)
+        lits.extend(l)
+        return ("expr", s)
+    if isinstance(val, (list, tuple)):
+        return tuple(_field_key(v, lits) for v in val)
+    if isinstance(val, (str, int, float, bool, bytes, type(None))):
+        return ("p", val)
+    if isinstance(val, dict):
+        return tuple((k, _field_key(v, lits)) for k, v in sorted(val.items()))
+    # data partitions, scan operators, UDF handles: identity-keyed — same
+    # object => same slot; a rebuilt source replans (safe default)
+    return ("id", type(val).__name__, identity_token(val))
+
+
+def estimate_pin_bytes(physical) -> int:
+    """Pin-scope budget estimate for one physical plan: the device bytes its
+    execution is expected to pin, fed to the HBM admission controller
+    (ResidencyManager.admit). Primary source: the cost model's device-bytes
+    probes as exposed through the plan fingerprint (distributed/affinity.py —
+    per-slot byte estimates for every residency slot the device stages would
+    touch). Fallback for device nodes whose columns carry no content
+    fingerprint (and for the join stages, whose identity-dependent slots are
+    deliberately absent from fingerprints): the in-memory input bytes under
+    each device node, a coarse upper bound. Host-only plans estimate 0 and
+    admit immediately."""
+    from ..plan import physical as pp
+
+    try:
+        from ..distributed.affinity import plan_fingerprint
+
+        fp = plan_fingerprint(physical)
+    except Exception:  # noqa: BLE001 — estimate is advisory
+        fp = ()
+    total = sum(est for _k, est in fp)
+    if total:
+        return total
+    device_types = (pp.DeviceGroupedAgg, pp.DeviceFilterAgg,
+                    pp.DeviceJoinAgg, pp.DeviceJoinTopN)
+    try:
+        for node in physical.walk():
+            if isinstance(node, device_types):
+                for scan in (n for n in node.walk()
+                             if isinstance(n, pp.InMemoryScan)):
+                    for part in scan.partitions:
+                        for b in part.batches:
+                            total += b.size_bytes()
+    except Exception:  # noqa: BLE001 — estimate is advisory
+        return total
+    return total
+
+
+class PreparedEntry:
+    __slots__ = ("literals", "builder", "physical", "est_pin_bytes",
+                 "fingerprint", "hits", "plan_seconds")
+
+    def __init__(self, literals, builder, physical, est_pin_bytes: int,
+                 fingerprint, plan_seconds: float):
+        self.literals = literals
+        self.builder = builder          # optimized LogicalPlanBuilder (_preoptimized)
+        self.physical = physical        # cached physical plan (in-process path only)
+        self.est_pin_bytes = est_pin_bytes
+        self.fingerprint = fingerprint  # (stable_slot_key, est_bytes) pairs
+        self.hits = 0
+        self.plan_seconds = plan_seconds
+
+
+class PreparedQueryCache:
+    """Thread-safe bounded cache of prepared queries, one slot per plan
+    skeleton."""
+
+    def __init__(self, cap: int = DEFAULT_PREPARED_CAP):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, PreparedEntry]" = OrderedDict()
+        self.cap = cap
+
+    def get_or_plan(self, builder,
+                    keep_physical: bool = True) -> Tuple[PreparedEntry, bool]:
+        """Return (entry, hit). A hit requires the skeleton to match AND the
+        stored literals to EQUAL the query's (the PR 2 literal-compare
+        contract) AND the entry to carry what the caller executes (a cached
+        physical plan for the in-process path; `keep_physical=False` callers
+        — distributed runners, whose localize() pass mutates translated
+        plans — reuse only the optimized logical plan and re-translate).
+        A literal mismatch replans IN the same slot."""
+        skel, lits = plan_structure(builder.plan)
+        with self._lock:
+            e = self._entries.get(skel)
+            if (e is not None and e.literals == lits
+                    and (e.physical is not None) == keep_physical):
+                self._entries.move_to_end(skel)
+                e.hits += 1
+                registry().inc("serve_prepared_hits")
+                return e, True
+        import time
+
+        from ..plan.physical import translate
+
+        t0 = time.perf_counter()
+        optimized = builder.optimize()
+        # mark so a runner handed this builder skips re-optimizing
+        optimized._preoptimized = True
+        physical = translate(optimized.plan)
+        est = estimate_pin_bytes(physical)
+        try:
+            from ..distributed.affinity import plan_fingerprint
+
+            fp = plan_fingerprint(physical)
+        except Exception:  # noqa: BLE001 — advisory
+            fp = ()
+        e = PreparedEntry(lits, optimized,
+                          physical if keep_physical else None,
+                          est, fp, time.perf_counter() - t0)
+        with self._lock:
+            self._entries[skel] = e
+            self._entries.move_to_end(skel)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+        registry().inc("serve_prepared_misses")
+        return e, False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

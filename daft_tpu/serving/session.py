@@ -1,0 +1,264 @@
+"""ServingSession: the driver-side concurrent query session.
+
+Execution model: ``submit()`` enqueues a ticket into the fair admission queue
+(per-tenant round-robin — admission.py) and returns a ``ServeFuture``;
+``max_concurrent`` session worker threads pull tickets, prepare them through
+the PreparedQueryCache (optimize+translate skipped on a repeat shape), pass
+the HBM admission controller (``ResidencyManager.admit`` — a pin-scope byte
+reservation that QUEUES over-budget queries instead of letting them thrash
+each other's pinned planes out of HBM), and execute:
+
+- in-process (runner=None, the default): the cached physical plan streams
+  through the executor directly — the serving fast path. Device stages pin
+  their working sets per executing thread (pin scopes are thread-local, so
+  concurrent queries' scopes never interleave), the decision caches are
+  locked, and the thread runs under span_scope(None) so a query being
+  profiled elsewhere never receives this query's spans.
+- through a runner (e.g. DistributedRunner): the prepared optimized plan is
+  handed to the runner (re-optimization short-circuits); concurrent sub-plan
+  streams interleave fairly across the shared worker pool (the pool's
+  dispatcher deals tasks round-robin per stage stream).
+
+Every query emits a ServeQueryRecord to subscribers (dashboard per-tenant
+hit-rate table, tenant-labeled /metrics latency histogram, event log) and
+bumps serve_queries_total / serve_prepared_hits / admission_waits_total;
+serve_queue_depth tracks the admission queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, List, Optional
+
+from ..config import execution_config
+from ..device.residency import manager as _residency
+from ..observability import ServeQueryRecord, notify, subscribers_active
+from ..observability.metrics import registry
+from .admission import FairAdmissionQueue
+from .prepared import PreparedQueryCache
+
+
+class ServeFuture:
+    """Result handle for one submitted query."""
+
+    def __init__(self, query_id: str, tenant: str):
+        self.query_id = query_id
+        self.tenant = tenant
+        self._done = threading.Event()
+        self._parts: Optional[List[Any]] = None
+        self._error: Optional[BaseException] = None
+        # filled at resolution for caller-side attribution
+        self.seconds = 0.0
+        self.prepared_hit = False
+        self.admission_wait_s = 0.0
+
+    def result(self, timeout: Optional[float] = None) -> List[Any]:
+        """The query's result MicroPartitions (raises what execution raised)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._parts  # type: ignore[return-value]
+
+    def to_pydict(self, timeout: Optional[float] = None) -> dict:
+        parts = self.result(timeout)
+        out: dict = {}
+        for p in parts:
+            for k, v in p.to_pydict().items():
+                out.setdefault(k, []).extend(v)
+        return out
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _resolve(self, parts: List[Any]) -> None:
+        self._parts = parts
+        self._done.set()
+
+    def _reject(self, err: BaseException) -> None:
+        self._error = err
+        self._done.set()
+
+
+class _Ticket:
+    __slots__ = ("builder", "tenant", "future", "submitted")
+
+    def __init__(self, builder, tenant: str, future: ServeFuture):
+        self.builder = builder
+        self.tenant = tenant
+        self.future = future
+        self.submitted = time.perf_counter()
+
+
+class ServingSession:
+    """N-concurrent-query session over the warm engine (see module doc).
+
+    Args:
+        max_concurrent: session worker threads (defaults to
+            ExecutionConfig.max_concurrent_queries / DAFT_TPU_MAX_CONCURRENT_QUERIES).
+        runner: execute through this Runner instead of the in-process
+            executor (a DistributedRunner fans sub-plans across its pool;
+            concurrent queries share it safely).
+        prepared_cap: prepared-query cache slots (one per plan skeleton).
+    """
+
+    def __init__(self, max_concurrent: Optional[int] = None, runner=None,
+                 prepared_cap: int = 64):
+        cfg = execution_config()
+        self.max_concurrent = (cfg.max_concurrent_queries
+                               if max_concurrent is None else max_concurrent)
+        if self.max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {self.max_concurrent}")
+        self._runner = runner
+        self._queue = FairAdmissionQueue()
+        self.prepared = PreparedQueryCache(prepared_cap)
+        self._closed = threading.Event()
+        self._stats_lock = threading.Lock()
+        # tenant -> {"queries", "errors", "prepared_hits", "admission_waits",
+        #            "wait_s", "seconds", "rows"}
+        self._tenants: dict = {}
+        self._threads = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"daft-serve-{i}")
+            for i in range(self.max_concurrent)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ---- client API ----------------------------------------------------------------
+    def submit(self, query, tenant: str = "default") -> ServeFuture:
+        """Enqueue one query (a DataFrame or LogicalPlanBuilder) for `tenant`;
+        returns a ServeFuture immediately."""
+        if self._closed.is_set():
+            raise RuntimeError("serving session is closed")
+        builder = getattr(query, "_builder", query)
+        fut = ServeFuture(uuid.uuid4().hex[:12], tenant)
+        depth = self._queue.push(tenant, _Ticket(builder, tenant, fut))
+        registry().set_gauge("serve_queue_depth", float(depth))
+        if self._closed.is_set():
+            # close() raced us: it may have drained the queue before our push
+            # landed, leaving this ticket unserved forever — drain and reject
+            # any stragglers (possibly including ours) so no client blocks
+            self._drain_reject()
+        return fut
+
+    def run(self, query, tenant: str = "default",
+            timeout: Optional[float] = None) -> List[Any]:
+        """Synchronous convenience: submit + result."""
+        return self.submit(query, tenant).result(timeout)
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant serving totals (queries, prepared hits, admission
+        waits, cumulative latency) — the dashboard's hit-rate table source."""
+        with self._stats_lock:
+            return {k: dict(v) for k, v in self._tenants.items()}
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain nothing, stop accepting, join workers. Queued-but-unstarted
+        tickets are rejected so no client blocks forever."""
+        self._closed.set()
+        for t in self._threads:
+            t.join(timeout)
+        self._drain_reject()
+
+    def _drain_reject(self) -> None:
+        while True:
+            ticket = self._queue.pop(timeout=0)
+            if ticket is None:
+                break
+            ticket.future._reject(RuntimeError("serving session closed"))
+        registry().set_gauge("serve_queue_depth", 0.0)
+
+    def __enter__(self) -> "ServingSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- worker side ---------------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while not self._closed.is_set():
+            ticket = self._queue.pop(timeout=0.1)
+            if ticket is None:
+                continue
+            registry().set_gauge("serve_queue_depth", float(self._queue.depth()))
+            self._execute(ticket)
+
+    def _execute(self, ticket: _Ticket) -> None:
+        from ..observability.runtime_stats import span_scope
+
+        fut = ticket.future
+        t0 = time.perf_counter()
+        cfg = execution_config()
+        err: Optional[str] = None
+        rows = 0
+        hit = False
+        waited = False
+        wait_s = 0.0
+        exec_s = 0.0
+        est = 0
+        exc: Optional[BaseException] = None
+        parts: List[Any] = []
+        try:
+            entry, hit = self.prepared.get_or_plan(
+                ticket.builder, keep_physical=self._runner is None)
+            est = entry.est_pin_bytes
+            t_adm = time.perf_counter()
+            # HBM admission: reserve this query's estimated pin-scope bytes;
+            # waits (counted) while concurrently-admitted working sets have
+            # the budget spoken for — never evicts a running query's pins
+            with _residency().admit(est, tenant=ticket.tenant,
+                                    tenant_budget=cfg.tenant_budget_bytes) as waited:
+                wait_s = time.perf_counter() - t_adm
+                t_exec = time.perf_counter()
+                # span isolation: this thread's device spans stay out of any
+                # globally-installed profiler recorder (cross-query bleed)
+                with span_scope(None):
+                    if self._runner is None:
+                        from ..execution.executor import execute_plan
+
+                        parts = list(execute_plan(entry.physical))
+                    else:
+                        parts = list(self._runner.run(entry.builder))
+                exec_s = time.perf_counter() - t_exec
+            rows = sum(p.num_rows for p in parts)
+        except BaseException as e:  # noqa: BLE001 — the future carries it to the client
+            err = f"{type(e).__name__}: {e}"
+            exc = e
+        seconds = time.perf_counter() - t0
+        # attribution BEFORE resolution: a client waking from result() must
+        # see the final seconds/prepared_hit, not the defaults
+        fut.seconds = seconds
+        fut.prepared_hit = hit
+        fut.admission_wait_s = wait_s
+        if err is None:
+            fut._resolve(parts)
+        else:
+            fut._reject(exc)
+        registry().inc("serve_queries_total")
+        with self._stats_lock:
+            st = self._tenants.setdefault(ticket.tenant, {
+                "queries": 0, "errors": 0, "prepared_hits": 0,
+                "admission_waits": 0, "wait_s": 0.0, "seconds": 0.0,
+                "rows": 0})
+            st["queries"] += 1
+            st["seconds"] += seconds
+            st["rows"] += rows
+            if hit:
+                st["prepared_hits"] += 1
+            if waited:
+                st["admission_waits"] += 1
+            st["wait_s"] += wait_s
+            if err is not None:
+                st["errors"] += 1
+        if subscribers_active():
+            notify("on_serve_query", ServeQueryRecord(
+                query_id=fut.query_id, tenant=ticket.tenant, seconds=seconds,
+                exec_seconds=exec_s, rows=rows, prepared_hit=hit,
+                admission_wait_s=wait_s, est_pin_bytes=est, error=err,
+                admission_waited=waited,
+                in_process=self._runner is None))

@@ -447,15 +447,15 @@ def test_spill_counters_flow_through_registry():
 
 
 # ---------------------------------------------------------------------------
-# Event log schema v6 round trip (satellite)
+# Event log schema round trip (satellite)
 # ---------------------------------------------------------------------------
 
-def test_event_log_v6_round_trip(tmp_path):
+def test_event_log_round_trip(tmp_path):
     from daft_tpu.observability.event_log import (SCHEMA_VERSION,
                                                   disable_event_log,
                                                   enable_event_log)
 
-    assert SCHEMA_VERSION == 6
+    assert SCHEMA_VERSION == 7
     p = str(tmp_path / "ev.jsonl")
     sub = enable_event_log(p)
     try:
@@ -464,7 +464,7 @@ def test_event_log_v6_round_trip(tmp_path):
     finally:
         disable_event_log(sub)
     events = [json.loads(l) for l in open(p)]
-    assert events and all(e["schema_version"] == 6 for e in events)
+    assert events and all(e["schema_version"] == 7 for e in events)
     ops = [e for e in events if e["event"] == "operator_stats"]
     assert ops
     for o in ops:
