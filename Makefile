@@ -6,11 +6,23 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test test-mesh bench bench-mesh bench-serve bench-gate bench-compare
+.PHONY: test test-mesh test-fault bench bench-mesh bench-serve bench-gate bench-compare
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Elastic fault-tolerance suite: kill -9 / SIGSTOP real pool workers
+# mid-query and assert recovery (detection, lost-map regeneration,
+# respawn, checkpoint resume, serving cancellation). Recovery bugs tend to
+# present as hangs, so the whole run gets a hard timeout; the process-level
+# tests skip cleanly on platforms without POSIX kill/SIGSTOP semantics.
+# GNU timeout is absent on stock macOS — fall back to an unbounded run there
+# (the pytest-level skips still guard the POSIX-signal tests themselves)
+TIMEOUT_CMD := $(shell command -v timeout >/dev/null 2>&1 && echo "timeout -k 10 600")
+test-fault:
+	$(TIMEOUT_CMD) env JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_fault_tolerance.py -q -p no:cacheprovider
 
 # In-mesh SPMD suite under 8 forced host devices (the MULTICHIP harness
 # environment): bit-exact mesh vs single-chip vs host parity, sharded

@@ -1,0 +1,43 @@
+"""Cooperative query cancellation token.
+
+A thread-local cancellation event installed around a query's execution (the
+serving tier installs the ServeFuture's cancel event in its session worker
+thread). Engine layers that reach natural yield points — the distributed
+planner between task stages, the serving executor between streamed result
+partitions — call ``raise_if_cancelled()``; nothing polls, nothing pays when
+no token is installed (one thread-local attribute read).
+
+Cancellation is BEST-EFFORT by design: a stage already running on the worker
+pool completes (its results are simply discarded), device dispatches are never
+interrupted mid-kernel, and a query past its last check point resolves
+normally. What is guaranteed: a cancelled query stops consuming new pool
+stages, and a still-queued serving query never starts at all
+(ServeFuture.cancel pulls it from the FairAdmissionQueue).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class QueryCancelled(RuntimeError):
+    """Raised inside a cancelled query's execution; carried to the caller by
+    whatever future/iterator was driving it."""
+
+
+_TL = threading.local()
+
+
+def set_cancel_event(ev) -> None:
+    """Install (or clear, with None) this thread's cancellation event."""
+    _TL.ev = ev
+
+
+def cancel_event():
+    return getattr(_TL, "ev", None)
+
+
+def raise_if_cancelled(message: str = "query cancelled") -> None:
+    ev = getattr(_TL, "ev", None)
+    if ev is not None and ev.is_set():
+        raise QueryCancelled(message)

@@ -1,0 +1,322 @@
+"""Stage-boundary checkpoint/resume for distributed queries.
+
+Reference parity: the same ``staged -> checkpointed -> committed``
+CheckpointStore lifecycle this package already applies to write pipelines
+(src/daft-checkpoint/src/store.rs:10-50), applied at a coarser grain: the
+unit is a distributed STAGE BOUNDARY — a shuffle stage's materialized
+partition files, or a distributable subtree's gathered result partitions —
+keyed under a query-scoped CheckpointId (the plan's content fingerprint).
+
+Layout under ``DAFT_TPU_CHECKPOINT_DIR``::
+
+    {root}/{query_fp}/subtree-0/shuffle-0/     # payload: copied map files
+    {root}/{query_fp}/subtree-0/shuffle-0/MANIFEST.json
+    {root}/{query_fp}/subtree-0/shuffle-0.committed   # atomic marker
+    {root}/{query_fp}/subtree-0/result/part0.arrow    # final-result IPC
+    {root}/{query_fp}/subtree-0/result.committed
+
+Lifecycle discipline (mirrors the write-pipeline store + the shuffle
+writer's tmp+rename publishing): payloads are STAGED into a
+``.staging-{uuid}`` directory invisible to readers, sealed by an atomic
+``os.replace`` into place, and COMMITTED by renaming an empty marker file
+next to them — a crash at any point leaves either nothing, an unreadable
+staging dir, or a fully committed stage; never a torn one. Resume
+(``DistributedRunner`` re-submitting the same plan fingerprint) treats only
+``committed()`` stages as skippable.
+
+Result partitions are written in the shuffle transport's wire format —
+compressed Arrow IPC stream files (ExecutionConfig.shuffle_compression) —
+and decoded with the same ``iter_ipc_batches`` reader.
+
+Zero-overhead contract: this module is imported ONLY when
+DAFT_TPU_CHECKPOINT_DIR is set (runner-side gate); with it unset no
+checkpoint code runs, no counters move, nothing touches the hot path
+(guard-tested in tests/test_fault_tolerance.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import struct
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..observability.metrics import registry
+
+_MANIFEST = "MANIFEST.json"
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    try:
+        os.link(src, dst)
+    except OSError:  # cross-device / FS without hardlinks
+        shutil.copy2(src, dst)
+
+
+# ======================================================================================
+# Query fingerprint
+# ======================================================================================
+
+def query_fingerprint(phys) -> Optional[str]:
+    """Content-derived CheckpointId for a physical plan, stable across
+    processes and re-submissions: sha256 over the plan's structural walk
+    (node types + expression reprs + primitive fields) joined with the
+    CONTENT fingerprints of every in-memory source column
+    (Series.content_fingerprint — the same cross-process identity the
+    distributed residency protocol uses).
+
+    Returns None — checkpointing disabled for this query — when any node
+    carries state we cannot key by content (file-scan task objects, UDF
+    handles, python-object columns): resuming on a guessed identity could
+    serve a stale result, so the safe default is to not checkpoint at all.
+    """
+    from ..expressions import Expression
+    from ..plan import physical as pp
+    from ..schema import Schema
+
+    h = hashlib.sha256()
+
+    def _feed(val) -> bool:
+        if isinstance(val, pp.PhysicalPlan):
+            return True  # subtree shape arrives via the preorder walk
+        if isinstance(val, Expression):
+            h.update(b"e")
+            h.update(repr(val).encode())
+            return True
+        if isinstance(val, Schema):
+            for f in val:
+                h.update(f.name.encode())
+                h.update(str(f.dtype).encode())
+            return True
+        if isinstance(val, (list, tuple)):
+            h.update(b"[")
+            for v in val:
+                if not _feed(v):
+                    return False
+            h.update(b"]")
+            return True
+        if isinstance(val, dict):
+            for k in sorted(val, key=str):
+                h.update(str(k).encode())
+                if not _feed(val[k]):
+                    return False
+            return True
+        if isinstance(val, (str, int, float, bool, bytes, type(None))):
+            h.update(repr(val).encode())
+            return True
+        return False  # opaque object: no stable identity
+
+    try:
+        for node in phys.walk():
+            h.update(b"\x00")
+            h.update(type(node).__name__.encode())
+            if isinstance(node, pp.InMemoryScan):
+                names = node.schema.column_names()
+                for part in node.partitions:
+                    for b in part.batches:
+                        h.update(struct.pack("<q", b.num_rows))
+                        for name in names:
+                            s = b.get_column(name)
+                            fp = s.content_fingerprint()
+                            if fp is None:
+                                return None
+                            # fingerprints are unsigned 64-bit hashes
+                            h.update(struct.pack("<Q", fp & ((1 << 64) - 1)))
+                continue
+            for fname in sorted(vars(node)):
+                if fname.startswith("_") or fname in ("input", "left", "right",
+                                                      "inputs"):
+                    continue
+                h.update(fname.encode())
+                if not _feed(vars(node)[fname]):
+                    return None
+    except Exception:  # noqa: BLE001 — advisory: no fingerprint, no resume
+        return None
+    return h.hexdigest()[:24]
+
+
+# ======================================================================================
+# Stage checkpointer
+# ======================================================================================
+
+class StageCheckpointer:
+    """One query fingerprint's stage-boundary checkpoint store (see module
+    doc). Safe against concurrent writers of the SAME stage (atomic staging +
+    last-committer-wins markers over deterministic content); the driver is
+    single-threaded per query so no locking is needed beyond the filesystem's.
+    """
+
+    def __init__(self, root: str, query_fp: str):
+        self.root = os.path.join(root, query_fp)
+        self.query_fp = query_fp
+
+    # ---- paths ---------------------------------------------------------------------
+    def _payload(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def _marker(self, key: str) -> str:
+        return self._payload(key) + ".committed"
+
+    # ---- lifecycle -----------------------------------------------------------------
+    def committed(self, key: str) -> bool:
+        return os.path.exists(self._marker(key)) \
+            and os.path.isdir(self._payload(key))
+
+    def _seal(self, staging: str, key: str) -> None:
+        """Atomically publish a staged payload dir and its committed marker."""
+        payload = self._payload(key)
+        if os.path.isdir(payload):
+            # stale staged payload from a crashed run (no marker, or a racing
+            # duplicate of identical deterministic content): replace it
+            shutil.rmtree(payload, ignore_errors=True)
+        os.replace(staging, payload)
+        tmp = self._marker(key) + f".tmp-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            f.write("")
+        os.replace(tmp, self._marker(key))
+
+    # ---- shuffle stages ------------------------------------------------------------
+    def commit_shuffle(self, key: str, shuffle_dir: str, shuffle_id: str,
+                       expected: Dict[int, Tuple[int, ...]]) -> None:
+        """Checkpoint one completed shuffle stage: copy its partition files
+        out of the (temporary, per-run) shuffle dir and seal them with the
+        per-partition expected-map manifest the reduce side needs.
+
+        Commits are ADVISORY, matching the restore side: a sink I/O error
+        (full/readonly checkpoint volume) must never fail a query whose real
+        stage results completed — the stage just goes uncheckpointed."""
+        staging = self._payload(key) + f".staging-{uuid.uuid4().hex[:8]}"
+        try:
+            src = os.path.join(shuffle_dir, shuffle_id)
+            os.makedirs(os.path.dirname(staging) or ".", exist_ok=True)
+            if os.path.isdir(src):
+                # hardlink when same-filesystem (the common layout: live
+                # shuffle dir and checkpoint root on one disk) so committing
+                # never doubles the shuffle's write volume; restore_shuffle
+                # uses the same link-or-copy discipline
+                shutil.copytree(src, staging, copy_function=_link_or_copy)
+            else:
+                os.makedirs(staging)
+            with open(os.path.join(staging, _MANIFEST), "w") as f:
+                json.dump({"kind": "shuffle",
+                           "expected": {str(p): list(v)
+                                        for p, v in expected.items()}}, f)
+            self._seal(staging, key)
+        except Exception:  # noqa: BLE001 — advisory: never fail a completed query
+            shutil.rmtree(staging, ignore_errors=True)
+            registry().inc("checkpoint_commit_failures")
+            return
+        registry().inc("checkpoint_stages_committed")
+
+    def restore_shuffle(self, key: str,
+                        shuffle_dir: str) -> Optional[Tuple[str, Dict[int, tuple]]]:
+        """Rehydrate a committed shuffle stage into the live shuffle dir
+        under a fresh shuffle id (hardlinks when same-filesystem, copies
+        otherwise — the fetch server serves the live dir, so restored stages
+        work over both transports). Returns (shuffle_id, expected-per-
+        partition) or None when the stage is not committed/readable."""
+        if not self.committed(key):
+            return None
+        payload = self._payload(key)
+        try:
+            with open(os.path.join(payload, _MANIFEST)) as f:
+                man = json.load(f)
+            expected = {int(p): tuple(v)
+                        for p, v in man.get("expected", {}).items()}
+            sid = f"ckpt{uuid.uuid4().hex[:12]}"
+            dst_root = os.path.join(shuffle_dir, sid)
+            for dirpath, _dirnames, filenames in os.walk(payload):
+                rel = os.path.relpath(dirpath, payload)
+                for name in filenames:
+                    if name == _MANIFEST:
+                        continue
+                    dst_dir = os.path.join(dst_root, rel) if rel != "." \
+                        else dst_root
+                    os.makedirs(dst_dir, exist_ok=True)
+                    src = os.path.join(dirpath, name)
+                    dst = os.path.join(dst_dir, name)
+                    try:
+                        os.link(src, dst)
+                    except OSError:
+                        shutil.copy2(src, dst)
+            registry().inc("checkpoint_stages_skipped")
+            return sid, expected
+        except Exception:  # noqa: BLE001 — unreadable/corrupt (incl. pyarrow
+            # errors outside the OSError/ValueError hierarchies): re-run the
+            # stage rather than fail the query on its own checkpoint
+            return None
+
+    # ---- subtree results -----------------------------------------------------------
+    def commit_result(self, key: str, parts: List) -> None:
+        """Checkpoint a distributed subtree's gathered result partitions as
+        compressed Arrow IPC stream files (one per MicroPartition, batch
+        boundaries preserved). Advisory like commit_shuffle: sink I/O errors
+        skip the checkpoint, never fail the query."""
+        import pyarrow.ipc as ipc
+
+        from ..config import execution_config
+
+        compression = execution_config().shuffle_compression
+        opts = ipc.IpcWriteOptions(
+            compression=None if compression == "none" else compression)
+        staging = self._payload(key) + f".staging-{uuid.uuid4().hex[:8]}"
+        try:
+            os.makedirs(os.path.dirname(staging) or ".", exist_ok=True)
+            os.makedirs(staging)
+            rows = []
+            for i, part in enumerate(parts):
+                rows.append(part.num_rows)
+                batches = [b for b in part.batches if b.num_rows > 0]
+                if not batches:
+                    continue
+                tables = [b.to_arrow() for b in batches]
+                with ipc.new_stream(os.path.join(staging, f"part{i}.arrow"),
+                                    tables[0].schema, options=opts) as w:
+                    for t in tables:
+                        w.write_table(t)
+            with open(os.path.join(staging, _MANIFEST), "w") as f:
+                json.dump({"kind": "result", "parts": len(parts),
+                           "rows": rows}, f)
+            self._seal(staging, key)
+        except Exception:  # noqa: BLE001 — advisory: a commit failure (sink
+            # I/O, or a pyarrow error like an unavailable codec that raises
+            # outside OSError) skips the checkpoint, never fails the query
+            shutil.rmtree(staging, ignore_errors=True)
+            registry().inc("checkpoint_commit_failures")
+            return
+        registry().inc("checkpoint_stages_committed")
+
+    def restore_result(self, key: str, schema) -> Optional[List]:
+        """Load a committed subtree result (cast onto the live plan's schema),
+        or None when not committed/readable."""
+        if not self.committed(key):
+            return None
+        from ..core.micropartition import MicroPartition
+        from ..core.recordbatch import RecordBatch
+        from ..distributed.shuffle import iter_ipc_batches
+
+        payload = self._payload(key)
+        try:
+            with open(os.path.join(payload, _MANIFEST)) as f:
+                man = json.load(f)
+            n = int(man["parts"])
+            out: List = []
+            for i in range(n):
+                path = os.path.join(payload, f"part{i}.arrow")
+                if not os.path.exists(path):
+                    out.append(MicroPartition.empty(schema))
+                    continue
+                batches = []
+                with open(path, "rb") as f:
+                    for rb in iter_ipc_batches(f):
+                        batches.append(
+                            RecordBatch.from_arrow(rb).cast_to_schema(schema))
+                out.append(MicroPartition(schema, batches)
+                           if batches else MicroPartition.empty(schema))
+            registry().inc("checkpoint_stages_skipped")
+            return out
+        except Exception:  # noqa: BLE001 — unreadable checkpoint: re-run
+            return None

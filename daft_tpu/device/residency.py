@@ -431,8 +431,14 @@ class ResidencyManager:
         est = max(int(est_bytes), 0)
         budget = self.budget_bytes()
         waited = False
+        from ..cancellation import raise_if_cancelled
+
         with self._adm:
             while not self._admissible(est, tenant, budget, tenant_budget):
+                # a cancelled query must not camp in the admission queue: the
+                # raise unwinds BEFORE any reservation exists, so nothing
+                # leaks (no-op for threads without a cancellation token)
+                raise_if_cancelled("query cancelled while awaiting admission")
                 if not waited:
                     waited = True
                     registry().inc("admission_waits_total")

@@ -51,7 +51,10 @@ from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
 from ..observability.metrics import registry
 from ..schema import Schema
-from .shuffle import (_note_fetch, _note_fetch_wall, iter_ipc_batches,
+from ..utils.env import env_int
+from . import faults
+from .shuffle import (ShuffleDataLost, ShufflePeerUnreachable, _note_fetch,
+                      _note_fetch_wall, check_expected_maps, iter_ipc_batches,
                       partition_dir)
 
 _SAFE_ID = re.compile(r"^[A-Za-z0-9_\-]+$")
@@ -77,6 +80,50 @@ def _recv_interruptible(conn, stop):
         if stop.is_set():
             raise _FetchAborted()
     return conn.recv()
+
+
+# transient-connect retry schedule: first retry after _RETRY_BASE_S, doubling,
+# capped — a peer mid-restart answers within a few hundred ms; a DEAD peer
+# should be classified quickly so map regeneration can start
+_RETRY_BASE_S = 0.05
+_RETRY_CAP_S = 0.5
+
+_TRANSIENT_CONNECT_ERRORS = (EOFError, OSError)  # OSError covers every Connection*Error
+
+
+def _fetch_retries() -> int:
+    return env_int("DAFT_TPU_FETCH_RETRIES", 2, lo=0)
+
+
+def _connect_retrying(ep: Endpoint, shuffle_id: str, stop=None):
+    """Connect to a fetch peer, retrying refused/reset handshakes with capped
+    exponential backoff (DAFT_TPU_FETCH_RETRIES, default 2) so a peer
+    mid-restart doesn't immediately classify as dead and trigger map
+    regeneration. Exhaustion raises ShufflePeerUnreachable — the signal the
+    driver's recovery path regenerates from."""
+    host, port, key_hex = ep
+    retries = _fetch_retries()
+    delay = _RETRY_BASE_S
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return Client((host, port), family="AF_INET",
+                          authkey=bytes.fromhex(key_hex))
+        except _TRANSIENT_CONNECT_ERRORS as e:
+            last = e
+            if attempt >= retries:
+                break
+            registry().inc("fetch_retries_total")
+            if stop is not None:
+                if stop.wait(delay):
+                    raise _FetchAborted()
+            else:
+                time.sleep(delay)
+            delay = min(delay * 2, _RETRY_CAP_S)
+    raise ShufflePeerUnreachable(
+        shuffle_id,
+        f"shuffle {shuffle_id}: peer {host}:{port} unreachable after "
+        f"{retries + 1} attempts ({type(last).__name__}: {last})")
 
 
 class ShuffleFetchServer:
@@ -265,7 +312,8 @@ class _FrameStream(io.RawIOBase):
 
 def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,
                     schema: Schema, parallelism: Optional[int] = None,
-                    prefetch: Optional[int] = None) -> Iterator[MicroPartition]:
+                    prefetch: Optional[int] = None,
+                    expected_maps=None) -> Iterator[MicroPartition]:
     """Stream one shuffle partition by fetching every map file from every
     endpoint (the reference's flight-client fan-in, get_flight_client +
     do_get per partition). Fetch volume/latency is recorded into the active
@@ -274,9 +322,20 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
     `parallelism`/`prefetch` default from ExecutionConfig
     (shuffle_fetch_parallelism / shuffle_prefetch_batches). parallelism<=1
     with prefetch==0 selects the serial compatibility path — one endpoint at
-    a time, one whole-file request in flight, no threads, no queue."""
+    a time, one whole-file request in flight, no threads, no queue.
+
+    `expected_maps` arms the completeness check: once every endpoint has
+    listed its files, any expected map file seen on NO endpoint raises
+    ShuffleDataLost (missing files never silently shrink a reduce input).
+    Peer failures classify distinctly: a connect that stays refused past the
+    retry budget, or a connection reset mid-stream, raises
+    ShufflePeerUnreachable — both are the driver's regeneration triggers."""
     if not endpoints:
+        check_expected_maps(shuffle_id, expected_maps, ())
         return
+    if faults.ENABLED:
+        # stage filter resolves via faults.set_stage (worker loop)
+        faults.maybe_trip("fetch")
     if parallelism is None or prefetch is None:
         from ..config import execution_config
 
@@ -286,10 +345,11 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
         if prefetch is None:
             prefetch = cfg.shuffle_prefetch_batches
     if parallelism <= 1 and prefetch == 0:
-        inner = _fetch_serial(endpoints, shuffle_id, partition_idx, schema)
+        inner = _fetch_serial(endpoints, shuffle_id, partition_idx, schema,
+                              expected_maps)
     else:
         inner = _fetch_pipelined(endpoints, shuffle_id, partition_idx,
-                                 schema, parallelism, prefetch)
+                                 schema, parallelism, prefetch, expected_maps)
     # timeline profiling: one "shuffle.fetch" slice per partition fan-in,
     # covering the whole consumption window (transfer overlapped with the
     # consumer's reduce work — the wall window, same axis as fetch_wall)
@@ -301,22 +361,37 @@ def fetch_partition(endpoints: List[Endpoint], shuffle_id: str, partition_idx: i
 
 
 def _fetch_serial(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int,
-                  schema: Schema) -> Iterator[MicroPartition]:
+                  schema: Schema, expected_maps=None) -> Iterator[MicroPartition]:
     """The original serial transport: every file from every endpoint, one
     request at a time over one connection. Batches still decode one IPC
     message at a time (bounded memory), but nothing overlaps."""
-    for host, port, key_hex in endpoints:
-        conn = Client((host, port), family="AF_INET", authkey=bytes.fromhex(key_hex))
+    seen: set = set()
+    for ep in endpoints:
+        host, port, _key = ep
+        conn = _connect_retrying(ep, shuffle_id)
+
+        def _peer_io(fn, *args):
+            # sends fail too (BrokenPipeError on a dead peer), not just
+            # recvs: every wire op on an established connection classifies
+            # uniformly so the driver regenerates instead of failing
+            try:
+                return fn(*args)
+            except (EOFError, OSError) as e:
+                raise ShufflePeerUnreachable(
+                    shuffle_id, f"shuffle {shuffle_id}: peer {host}:{port} "
+                                f"connection lost mid-fetch ({e})")
+
         try:
-            conn.send(("list", shuffle_id, partition_idx))
-            kind, names = conn.recv()
+            _peer_io(conn.send, ("list", shuffle_id, partition_idx))
+            kind, names = _peer_io(conn.recv)
             if kind == "error":
                 raise RuntimeError(f"shuffle fetch refused: {names}")
             assert kind == "files", kind
+            seen.update(names)
             for name in names:
                 t0 = time.perf_counter()
-                conn.send(("fetch", shuffle_id, partition_idx, name))
-                kind, data = conn.recv()
+                _peer_io(conn.send, ("fetch", shuffle_id, partition_idx, name))
+                kind, data = _peer_io(conn.recv)
                 if kind == "error":
                     raise RuntimeError(f"shuffle fetch refused: {data}")
                 assert kind == "file", kind
@@ -338,14 +413,18 @@ def _fetch_serial(endpoints: List[Endpoint], shuffle_id: str, partition_idx: int
                     spent += time.perf_counter() - t_seg
                 finally:
                     _note_fetch(rows, len(data), spent)
-            conn.send(("bye",))
+            try:
+                conn.send(("bye",))
+            except (EOFError, OSError):
+                pass  # courtesy close only — every file already arrived
         finally:
             conn.close()
+    check_expected_maps(shuffle_id, expected_maps, seen)
 
 
 def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                      partition_idx: int, schema: Schema, parallelism: int,
-                     prefetch: int) -> Iterator[MicroPartition]:
+                     prefetch: int, expected_maps=None) -> Iterator[MicroPartition]:
     """Parallel multi-peer fetch with bounded prefetch.
 
     One thread per endpoint (endpoints round-robined when there are more than
@@ -367,6 +446,7 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
     stop = threading.Event()
     agg_lock = threading.Lock()
     agg = {"cum": 0.0, "first_send": None, "last_end": None, "hw": 0}
+    seen: set = set()  # file names listed across every endpoint (agg_lock)
 
     def _put(item) -> bool:
         # never block forever: a consumer that stopped draining (closed
@@ -392,17 +472,23 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                 agg["last_end"] = t_end
 
     def _fetch_endpoint(ep: Endpoint) -> None:
-        host, port, key_hex = ep
-        conn = Client((host, port), family="AF_INET",
-                      authkey=bytes.fromhex(key_hex))
+        host, port, _key = ep
+        conn = _connect_retrying(ep, shuffle_id, stop)
         try:
             conn.send(("list", shuffle_id, partition_idx))
+            # socket-level failures propagate to _run, which classifies them
+            # as ShufflePeerUnreachable — one classification site, not three
             kind, names = _recv_interruptible(conn, stop)
             if kind == "error":
                 raise RuntimeError(f"shuffle fetch refused: {names}")
             assert kind == "files", kind
+            with agg_lock:
+                seen.update(names)
             if not names:
-                conn.send(("bye",))
+                try:
+                    conn.send(("bye",))
+                except (EOFError, OSError):
+                    pass  # courtesy close only — nothing was owed
                 return
             send_at: dict = {}
             sent_blocked: dict = {}
@@ -450,7 +536,12 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                     - (tally["blocked"] - sent_blocked[i]), 0.0)
                 _note_done(in_flight, t_end)
                 _note_fetch(rows, frames.total, in_flight)
-            conn.send(("bye",))
+            try:
+                conn.send(("bye",))
+            except (EOFError, OSError):
+                pass  # courtesy close only — every file already arrived; a
+                # peer exiting now must NOT classify as unreachable (that
+                # would trigger spurious full-shuffle regeneration)
         finally:
             conn.close()
 
@@ -459,7 +550,18 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
             for ep in eps:
                 if stop.is_set():
                     return
-                _fetch_endpoint(ep)
+                try:
+                    _fetch_endpoint(ep)
+                except (EOFError, OSError) as e:
+                    # peer vanished mid-stream (EOF, reset, broken pipe,
+                    # timeout — ANY socket-level failure on an established
+                    # connection): classify distinctly so the driver
+                    # regenerates instead of failing the query
+                    host, port, _k = ep
+                    raise ShufflePeerUnreachable(
+                        shuffle_id,
+                        f"shuffle {shuffle_id}: peer {host}:{port} "
+                        f"connection lost mid-fetch ({e})")
             _put(("done", None))
         except _FetchAborted:
             return  # consumer closed the generator; nothing to report
@@ -478,9 +580,14 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
             if kind == "done":
                 done += 1
             elif kind == "err":
+                if isinstance(payload, (ShuffleDataLost, ShufflePeerUnreachable)):
+                    raise payload  # typed recovery triggers survive the fan-in
                 raise RuntimeError(f"shuffle fetch failed: {payload}") from payload
             else:
                 yield payload
+        with agg_lock:
+            listed = set(seen)
+        check_expected_maps(shuffle_id, expected_maps, listed)
     finally:
         stop.set()
         while True:  # unblock producers wedged in put()

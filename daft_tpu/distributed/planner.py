@@ -24,13 +24,17 @@ from __future__ import annotations
 import itertools
 import uuid
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ..cancellation import raise_if_cancelled
 from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
 from ..expressions import ColumnRef
 from ..expressions.expressions import Alias
+from ..observability.metrics import registry
 from ..plan import physical as pp
+from ..utils.env import env_int
+from .shuffle import ShuffleDataLost, ShufflePeerUnreachable
 from .task import SubPlanTask
 
 
@@ -45,18 +49,39 @@ class DistContext:
     # QueryTrace (distributed/trace.py): when set, every stage's tasks are
     # stamped with the query's trace context and their runtime stats recorded
     trace: Optional[object] = None
+    # StageCheckpointer (checkpoint/stages.py) — None unless
+    # DAFT_TPU_CHECKPOINT_DIR is set AND the plan fingerprinted (the
+    # zero-overhead gate lives in DistributedRunner)
+    ckpt: Optional[object] = None
     _task_seq: itertools.count = None  # type: ignore[assignment]
     _stage_seq: itertools.count = None  # type: ignore[assignment]
     _run_tag: str = ""
     shuffle_ids: List[str] = None  # type: ignore[assignment]
+    # shuffle lineage: shuffle_id -> {map_id: SubPlanTask}, retained for the
+    # query's lifetime so lost map outputs can be re-executed from their
+    # original plan blobs (the task -> plan_blob -> output partitions chain)
+    lineage: Dict[str, dict] = None  # type: ignore[assignment]
+    # bounded regeneration budget (DAFT_TPU_SHUFFLE_REGEN_ROUNDS, default 2):
+    # each lost-data recovery consumes one round; exhaustion fails the query
+    # cleanly instead of looping against a flapping cluster
+    regen_rounds_left: int = 0
+    # checkpoint keying state: subtree-scoped so stage keys are deterministic
+    # regardless of how many earlier subtrees were resumed from checkpoints
+    _subtree_seq: itertools.count = None  # type: ignore[assignment]
+    ckpt_subtree: str = ""
+    ckpt_shuffle_seq: int = 0
 
     def __post_init__(self):
         self._task_seq = itertools.count()
         self._stage_seq = itertools.count()
+        self._subtree_seq = itertools.count()
         # unique per context: a reused pool must never confuse this run's task
         # ids with a previous query's (stale-result isolation)
         self._run_tag = uuid.uuid4().hex[:8]
         self.shuffle_ids = []
+        self.lineage = {}
+        self.regen_rounds_left = env_int("DAFT_TPU_SHUFFLE_REGEN_ROUNDS", 2,
+                                         lo=0)
 
     def task_id(self, prefix: str) -> str:
         return f"{prefix}-{self._run_tag}-{next(self._task_seq)}"
@@ -139,30 +164,117 @@ def localize(ctx: DistContext, node: pp.PhysicalPlan) -> pp.PhysicalPlan:
     return node
 
 
+def _run_stage_recovering(ctx: DistContext, make_tasks, stage: str):
+    """Run one task stage with lost-shuffle recovery: a reduce-side
+    ShuffleDataLost (precise missing map ids) or ShufflePeerUnreachable
+    (whole peer gone — every map of the shuffle is suspect) re-executes the
+    lost map tasks from lineage on the surviving workers, then retries the
+    stage with FRESH task ids (a stale in-flight result from the aborted
+    attempt can never be mistaken for the retry's). Bounded by
+    ctx.regen_rounds_left; exhaustion raises the final loss cleanly.
+
+    `make_tasks` builds the stage's task list — called once per attempt so
+    retries are new task objects, never mutated reruns. Returns
+    (tasks, results)."""
+    while True:
+        raise_if_cancelled()
+        tasks = make_tasks()
+        try:
+            return tasks, ctx.pool.run_tasks(tasks, stage_id=stage,
+                                             trace=ctx.trace)
+        except (ShuffleDataLost, ShufflePeerUnreachable) as e:
+            if ctx.regen_rounds_left <= 0:
+                raise
+            ctx.regen_rounds_left -= 1
+            lost = e.map_ids if isinstance(e, ShuffleDataLost) else None
+            _regenerate_maps(ctx, e.shuffle_id, lost, cause=e)
+
+
+def _regenerate_maps(ctx: DistContext, shuffle_id: str,
+                     map_ids: Optional[Tuple[int, ...]], cause) -> None:
+    """Re-execute lost map tasks (map_ids; None = all of the shuffle) from
+    lineage on the surviving workers. The regenerated outputs publish under
+    the same deterministic file names (atomic tmp+rename), so the retried
+    reduce simply finds them."""
+    lin = ctx.lineage.get(shuffle_id)
+    if lin is None:
+        raise RuntimeError(
+            f"shuffle {shuffle_id} data lost and no lineage retained — "
+            f"cannot regenerate") from cause
+    originals = lin["tasks"]
+    wanted = sorted(originals) if map_ids is None else sorted(map_ids)
+    missing = [m for m in wanted if m not in originals]
+    if missing:
+        raise RuntimeError(
+            f"shuffle {shuffle_id}: lost map ids {missing} unknown to "
+            f"lineage — cannot regenerate") from cause
+    stage = ctx.stage_id("regen")
+
+    def make_tasks():
+        return [
+            SubPlanTask(task_id=ctx.task_id("regen"),
+                        plan_blob=originals[m].plan_blob,
+                        strategy=originals[m].strategy,
+                        priority=originals[m].priority,
+                        stage_id=stage,
+                        rfingerprint=originals[m].rfingerprint)
+            for m in wanted
+        ]
+
+    # the regen stage runs under the same recovery wrapper: its map tasks may
+    # themselves read an EARLIER shuffle whose files were on the dead worker
+    # (cascading lineage replay, still bounded by regen_rounds_left)
+    _run_stage_recovering(ctx, make_tasks, stage)
+    registry().inc("shuffle_maps_regenerated_total", len(wanted))
+    if ctx.trace is not None:
+        ctx.trace.note_recovery("maps_regenerated", len(wanted))
+
+
 def run_distributed(ctx: DistContext, node: pp.PhysicalPlan) -> List[MicroPartition]:
     """Distribute a subtree and run its final fragments as a task stage.
 
     Shuffle intermediates for this subtree are deleted once the results are
     gathered (reference: cluster-wide shuffle dir cleanup on plan end,
     daft/runners/flotilla.py:70-106).
+
+    With checkpointing armed (ctx.ckpt), a subtree whose result was committed
+    by a previous run of the same plan fingerprint is restored wholesale —
+    no stages run; otherwise the gathered result is committed at the
+    boundary so a later re-submission can skip it.
     """
     from . import shuffle as shf
 
+    subtree_key = None
+    if ctx.ckpt is not None:
+        idx = next(ctx._subtree_seq)
+        ctx.ckpt_subtree = f"subtree-{idx}"
+        ctx.ckpt_shuffle_seq = 0
+        subtree_key = f"{ctx.ckpt_subtree}/result"
+        restored = ctx.ckpt.restore_result(subtree_key, node.schema)
+        if restored is not None:
+            return restored
     try:
         dist = distribute(ctx, node)
         stage = ctx.stage_id("final")
-        tasks = [SubPlanTask.from_plan(ctx.task_id("final"), frag,
-                                       stage_id=stage,
-                                       rfingerprint=_fingerprint(ctx, frag))
-                 for frag in dist.fragments]
-        results = ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)
+
+        def make_tasks():
+            return [SubPlanTask.from_plan(ctx.task_id("final"), frag,
+                                          stage_id=stage,
+                                          rfingerprint=_fingerprint(ctx, frag))
+                    for frag in dist.fragments]
+
+        tasks, results = _run_stage_recovering(ctx, make_tasks, stage)
         parts: List[MicroPartition] = []
         for t in tasks:  # preserve fragment order
             parts.extend(results[t.task_id].partitions)
-        return parts or [MicroPartition.empty(node.schema)]
+        parts = parts or [MicroPartition.empty(node.schema)]
+        if subtree_key is not None:
+            ctx.ckpt.commit_result(subtree_key, parts)
+        return parts
     finally:
         for sid in ctx.shuffle_ids:
             shf.cleanup(ctx.shuffle_dir, sid)
+            ctx.lineage.pop(sid, None)
         ctx.shuffle_ids.clear()
 
 
@@ -329,25 +441,79 @@ def _two_phase_agg(ctx: DistContext, node, make_leaf, raw_frag) -> Partitioned:
 def _shuffle(ctx: DistContext, fragments: List[pp.PhysicalPlan], by,
              schema) -> List[pp.PhysicalPlan]:
     """Run a shuffle stage: wrap each fragment in ShuffleWrite, execute on the
-    pool, return per-partition ShuffleRead fragments."""
+    pool, return per-partition ShuffleRead fragments.
+
+    Fault-tolerance bookkeeping: the map tasks are registered in
+    ctx.lineage[sid] BEFORE the stage runs (regeneration source), and each
+    reduce partition's ShuffleRead carries the expected map ids derived from
+    the map results' rows-per-partition — the completeness contract that
+    turns a dead worker's missing files into a ShuffleDataLost the recovery
+    loop can act on. With checkpointing armed, a committed stage restores
+    its files instead of re-running, and a fresh run commits at the boundary.
+    """
+    ckpt_key = None
+    if ctx.ckpt is not None:
+        ckpt_key = f"{ctx.ckpt_subtree}/shuffle-{ctx.ckpt_shuffle_seq}"
+        ctx.ckpt_shuffle_seq += 1
+        restored = ctx.ckpt.restore_shuffle(ckpt_key, ctx.shuffle_dir)
+        if restored is not None:
+            rsid, rexpected = restored
+            ctx.shuffle_ids.append(rsid)
+            return _shuffle_reads(ctx, rsid, schema, rexpected)
     sid = uuid.uuid4().hex[:12]
     ctx.shuffle_ids.append(sid)
     stage = ctx.stage_id("shuffle")
-    tasks = [
-        SubPlanTask.from_plan(
-            ctx.task_id("shuffle"),
-            pp.ShuffleWrite(frag, sid, map_id=i, num_partitions=ctx.n_partitions,
-                            by=list(by), shuffle_dir=ctx.shuffle_dir, schema=schema),
-            stage_id=stage,
-            # residency fingerprint of the map fragment (the device planes its
-            # partial-agg stage would probe): repeat shuffles of a resident
-            # table stick to the workers already holding those planes
-            rfingerprint=_fingerprint(ctx, frag))
-        for i, frag in enumerate(fragments)
-    ]
-    ctx.pool.run_tasks(tasks, stage_id=stage, trace=ctx.trace)
-    return [pp.ShuffleRead(sid, p, "" if ctx.fetch_endpoints else ctx.shuffle_dir,
-                           schema, ctx.fetch_endpoints)
+
+    def make_tasks():
+        tasks = [
+            SubPlanTask.from_plan(
+                ctx.task_id("shuffle"),
+                pp.ShuffleWrite(frag, sid, map_id=i,
+                                num_partitions=ctx.n_partitions,
+                                by=list(by), shuffle_dir=ctx.shuffle_dir,
+                                schema=schema),
+                stage_id=stage,
+                # residency fingerprint of the map fragment (the device planes
+                # its partial-agg stage would probe): repeat shuffles of a
+                # resident table stick to the workers already holding them
+                rfingerprint=_fingerprint(ctx, frag))
+            for i, frag in enumerate(fragments)
+        ]
+        # lineage registered pre-run: keyed by map id so a retried attempt
+        # (fresh task ids) overwrites in place
+        ctx.lineage[sid] = {"stage": stage,
+                            "tasks": {i: t for i, t in enumerate(tasks)}}
+        return tasks
+
+    tasks, results = _run_stage_recovering(ctx, make_tasks, stage)
+    # derive per-partition expected maps from the lineage records the map
+    # tasks shipped back (rows written per partition — a map that wrote no
+    # rows for partition p legitimately has no file there)
+    rows_by_map: Dict[int, List[int]] = {}
+    for t in tasks:
+        res = results[t.task_id]
+        for mo in res.map_outputs:
+            if mo.get("shuffle_id") == sid:
+                rows_by_map[int(mo["map_id"])] = list(mo.get("rows", ()))
+    expected = {
+        p: tuple(sorted(m for m, rows in rows_by_map.items()
+                        if p < len(rows) and rows[p] > 0))
+        for p in range(ctx.n_partitions)
+    }
+    if ckpt_key is not None:
+        ctx.ckpt.commit_shuffle(ckpt_key, ctx.shuffle_dir, sid, expected)
+    return _shuffle_reads(ctx, sid, schema, expected)
+
+
+def _shuffle_reads(ctx: DistContext, sid: str, schema,
+                   expected: Dict[int, tuple]) -> List[pp.PhysicalPlan]:
+    """The reduce-side fragments of a shuffle — ONE construction site for
+    both the fresh and checkpoint-restored paths, so transport selection and
+    the expected-maps completeness contract can never drift between them."""
+    return [pp.ShuffleRead(sid, p,
+                           "" if ctx.fetch_endpoints else ctx.shuffle_dir,
+                           schema, ctx.fetch_endpoints,
+                           expected_maps=expected.get(p))
             for p in range(ctx.n_partitions)]
 
 

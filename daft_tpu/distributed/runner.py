@@ -94,8 +94,10 @@ class DistributedRunner(Runner):
         # discard beats buffered in the worker pipes since the LAST drain
         # (the idle gap between queries): the end-of-query window filter
         # below judges by driver receive time, and these would all be
-        # stamped inside THIS query's window at the first poll
-        pool.drain_heartbeats()
+        # stamped inside THIS query's window at the first poll. Queued
+        # worker-DEATH events survive this discard (preserve_deaths) — they
+        # are one-shot and the dashboard's dead-worker latch needs them
+        pool.drain_heartbeats(preserve_deaths=True)
         observed = subscribers_active()
         prev = current_collector()
         # trace when anyone is watching: attached subscribers OR an ambient
@@ -128,7 +130,8 @@ class DistributedRunner(Runner):
         endpoints = [self._fetch_server.endpoint] if self._fetch_server else None
         ctx = DistContext(pool=pool, shuffle_dir=self._shuffle_dir,
                           n_partitions=self.n_partitions,
-                          fetch_endpoints=endpoints, trace=trace)
+                          fetch_endpoints=endpoints, trace=trace,
+                          ckpt=self._make_checkpointer(phys))
         collector = prev if prev is not None \
             else (StatsCollector() if observed else None)
         rows = 0
@@ -168,7 +171,19 @@ class DistributedRunner(Runner):
                     # clock_offsets() estimates from these beats, so a
                     # worker-clock filter would drop the skewed beats it
                     # needs (send-ts fallback for beats predating the stamp)
-                    if hb.get("recv_ts", hb.get("ts", 0.0)) >= t_wall0 - 0.5:
+                    # dead=True synthetic beats are kept regardless of the
+                    # window: a death during the idle gap before this query
+                    # must still reach the dashboard's dead-worker latch
+                    if hb.get("dead") or \
+                            hb.get("recv_ts", hb.get("ts", 0.0)) >= t_wall0 - 0.5:
+                        trace.add_heartbeat(hb)
+                # a warm pool can run a whole query in less than one
+                # heartbeat period, leaving NO beat inside the window; fall
+                # back to each silent worker's latest known beat so the
+                # dashboard reflects the full pool after fast queries too
+                seen = {h.worker_id for h in trace.heartbeats}
+                for wid, hb in pool.latest_heartbeats().items():
+                    if wid not in seen:
                         trace.add_heartbeat(hb)
             if observed and trace is not None:
                 for ts in list(trace.tasks):
@@ -187,6 +202,31 @@ class DistributedRunner(Runner):
                 notify("on_query_end", QueryEnd(
                     qid, rows, time.perf_counter() - t_start, err, stats,
                     metrics=registry().diff(reg_before)))
+
+    def _make_checkpointer(self, phys):
+        """Stage-boundary checkpoint/resume, armed ONLY by
+        DAFT_TPU_CHECKPOINT_DIR (the zero-overhead gate: with it unset the
+        checkpoint subsystem is never imported and no checkpoint counters
+        move). The CheckpointId is the plan's content fingerprint — a
+        re-submission of the same plan over the same data resumes past every
+        committed stage; a plan we cannot fingerprint by content (opaque scan
+        tasks, UDF handles) safely runs uncheckpointed."""
+        root = os.environ.get("DAFT_TPU_CHECKPOINT_DIR", "")
+        if not root:
+            return None
+        try:
+            from ..checkpoint.stages import StageCheckpointer, query_fingerprint
+
+            fp = query_fingerprint(phys)
+            if fp is None:
+                return None
+            # the partition count is part of the checkpoint identity: a
+            # committed shuffle's p0..pN-1 files are only complete for the
+            # SAME fan-out — resuming an 8-partition checkpoint on a
+            # 4-partition runner would silently drop half the rows
+            return StageCheckpointer(root, f"{fp}-p{self.n_partitions}")
+        except Exception:  # noqa: BLE001 — checkpointing is advisory
+            return None
 
     def shutdown(self) -> None:
         if self._fetch_server is not None:

@@ -38,8 +38,36 @@ from ..core.micropartition import MicroPartition
 from ..core.recordbatch import RecordBatch
 from ..observability.metrics import registry
 from ..schema import Schema
+from . import faults
 
 _ARROW_FILE_MAGIC = b"ARROW1"
+
+
+class ShuffleDataLost(RuntimeError):
+    """A reduce task found shuffle map outputs MISSING that the map stage is
+    known to have produced (`ShuffleRead.expected_maps`): the worker/host that
+    wrote them is gone along with its files. Carries the precise lost map ids
+    so the driver can re-execute exactly those map tasks from lineage
+    (planner._regenerate_maps) instead of failing — or hanging on — the query.
+    """
+
+    def __init__(self, shuffle_id: str, map_ids, message: Optional[str] = None):
+        self.shuffle_id = shuffle_id
+        self.map_ids = tuple(map_ids)
+        super().__init__(message or (
+            f"shuffle {shuffle_id}: map outputs {sorted(self.map_ids)} "
+            f"missing (worker storage lost)"))
+
+
+class ShufflePeerUnreachable(RuntimeError):
+    """A fetch peer refused/reset connections past the transient-retry budget
+    (DAFT_TPU_FETCH_RETRIES): the host serving part of this shuffle is dead.
+    Which map outputs it held is unknown to the client, so the driver's
+    recovery path regenerates every map of the shuffle (bounded rounds)."""
+
+    def __init__(self, shuffle_id: str, message: Optional[str] = None):
+        self.shuffle_id = shuffle_id
+        super().__init__(message or f"shuffle {shuffle_id}: peer unreachable")
 
 
 def partition_dir(base: str, shuffle_id: str, partition_idx: int) -> str:
@@ -130,6 +158,27 @@ def set_recorder(r: Optional[ShuffleRecorder]) -> None:
 
 def current_recorder() -> Optional[ShuffleRecorder]:
     return _ACTIVE_RECORDER
+
+
+# Map-output lineage sink: the worker loop installs a fresh list per task
+# (ALWAYS, independent of stats collection — this is correctness-bearing, not
+# telemetry); MapOutputWriter.close() records one entry per map task
+# ({shuffle_id, map_id, rows-per-partition, published paths}) and the entry
+# ships back in TaskResult.map_outputs. The driver derives each reduce
+# partition's expected_maps from these rows, which is what lets a reduce
+# DETECT silently-missing files instead of producing wrong results.
+_ACTIVE_MAP_OUTPUTS: Optional[list] = None
+
+
+def set_map_outputs(sink: Optional[list]) -> None:
+    global _ACTIVE_MAP_OUTPUTS
+    _ACTIVE_MAP_OUTPUTS = sink
+
+
+def _note_map_output(entry: dict) -> None:
+    sink = _ACTIVE_MAP_OUTPUTS
+    if sink is not None:
+        sink.append(entry)
 
 
 def _note_write(shuffle_id: str, partition_idx: int, rows: int, nbytes: int) -> None:
@@ -242,6 +291,9 @@ class MapOutputWriter:
     def append(self, partition_idx: int, batch: RecordBatch) -> None:
         if batch.num_rows == 0:
             return
+        if faults.ENABLED and not self._writers:
+            # stage filter resolves via faults.set_stage (worker loop)
+            faults.maybe_trip("shuffle_map")
         self.rows[partition_idx] += batch.num_rows
         table = batch.to_arrow()
         w = self._writers.get(partition_idx)
@@ -267,18 +319,25 @@ class MapOutputWriter:
 
     def close(self) -> List[int]:
         wire = 0
+        published: List[str] = []
         for p, w in self._writers.items():
             w.close()
             tmp, path = self._paths[p]
             try:
                 os.replace(tmp, path)
                 wire += os.path.getsize(path)
+                published.append(path)
             except OSError:
                 pass
         self._writers.clear()
         self._paths.clear()
         if wire:
             _note_write_wire(wire)
+        # lineage record — emitted even for an all-empty map output (the
+        # driver must learn the map ran and produced nothing, so no reduce
+        # partition waits for files that will never exist)
+        _note_map_output({"shuffle_id": self.shuffle_id, "map_id": self.map_id,
+                          "rows": list(self.rows), "paths": published})
         return self.rows
 
 
@@ -294,12 +353,34 @@ def write_map_output(base: str, shuffle_id: str, map_id: int,
     return out.close()
 
 
+def check_expected_maps(shuffle_id: str, expected_maps, present) -> None:
+    """Raise ShuffleDataLost naming exactly the map ids whose files are
+    missing from `present` (an iterable of file names). The completeness
+    gate that turns silent data loss — a dead worker's shuffle files gone —
+    into a recoverable, attributable error."""
+    if not expected_maps:
+        return
+    have = set(present)
+    missing = [m for m in expected_maps if f"m{m}.arrow" not in have]
+    if missing:
+        raise ShuffleDataLost(shuffle_id, missing)
+
+
 def read_partition(base: str, shuffle_id: str, partition_idx: int,
-                   schema: Schema) -> Iterator[MicroPartition]:
+                   schema: Schema, expected_maps=None) -> Iterator[MicroPartition]:
     """Stream every map's output for one shuffle partition, one IPC batch at a
     time (peak memory is a batch, not a map file). Fetch time excludes the
-    consumer's processing between yields (segmented timing)."""
+    consumer's processing between yields (segmented timing).
+
+    `expected_maps` (ShuffleRead.expected_maps — the map ids the driver's
+    lineage says wrote rows for this partition) arms the completeness check:
+    a missing file raises ShuffleDataLost instead of silently yielding a
+    partial reduce input. None/() preserves the legacy read-what-exists
+    behavior (direct callers, pre-lineage shuffle dirs)."""
     d = partition_dir(base, shuffle_id, partition_idx)
+    if expected_maps:
+        present = os.listdir(d) if os.path.isdir(d) else ()
+        check_expected_maps(shuffle_id, expected_maps, present)
     if not os.path.isdir(d):
         return
     # timeline profiling: one "shuffle.read" slice per partition (local

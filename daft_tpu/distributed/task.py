@@ -89,6 +89,19 @@ class TaskResult:
     rows: int = 0
     error: Optional[str] = None
     error_tb: Optional[str] = None
+    # structured error classification for recoverable failures: "" (generic),
+    # "shuffle_data_lost" (error_data: {shuffle_id, map_ids}) or
+    # "shuffle_peer_unreachable" (error_data: {shuffle_id}). The pool
+    # re-raises these as their typed exceptions so the planner's recovery
+    # path can regenerate lost map outputs instead of failing the query.
+    error_kind: str = ""
+    error_data: Optional[dict] = None
+    # shuffle map-output lineage records produced while this task ran
+    # (shuffle.py _note_map_output: {shuffle_id, map_id, rows-per-partition,
+    # paths}); ALWAYS populated for ShuffleWrite tasks, independent of
+    # collect_stats — the driver derives each reduce partition's
+    # expected_maps from the rows lists (correctness, not telemetry)
+    map_outputs: Tuple[dict, ...] = ()
     # ---- runtime stats (populated when the task asked for collect_stats) ---------
     bytes_out: int = 0
     exec_seconds: float = 0.0

@@ -65,6 +65,11 @@ class QueryTrace:
         # stage_id -> scheduler placement totals (affinity hits/misses,
         # bytes avoided, head-of-line skips) — see Scheduler.placement_stats
         self._placement: Dict[str, Dict[str, int]] = {}
+        # fault-recovery totals for this query (worker_failures,
+        # tasks_requeued, maps_regenerated) — the pool's liveness monitor and
+        # the planner's regeneration loop note into these; EXPLAIN ANALYZE
+        # renders the "recovery:" line when any is nonzero
+        self._recovery: Dict[str, int] = {}
 
     # ---- recording (called by WorkerPool.run_tasks) ------------------------------
     def record_task(self, task, result, dispatched_at: float) -> None:
@@ -155,6 +160,8 @@ class QueryTrace:
             hbm_h2d_bytes=hb.get("hbm_h2d_bytes", 0),
             hbm_digest_entries=len(hb.get("hbm_digest") or ()),
             recv_ts=hb.get("recv_ts", 0.0),
+            dead=bool(hb.get("dead", False)),
+            death_reason=hb.get("death_reason", ""),
         )
         with self._lock:
             self.heartbeats.append(rec)
@@ -184,6 +191,16 @@ class QueryTrace:
         when the stage drains)."""
         with self._lock:
             self._placement[stage_id] = dict(stats)
+
+    def note_recovery(self, key: str, n: int = 1) -> None:
+        """Accumulate one fault-recovery event (worker_failures /
+        tasks_requeued / maps_regenerated) into this query's totals."""
+        with self._lock:
+            self._recovery[key] = self._recovery.get(key, 0) + n
+
+    def recovery_totals(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._recovery)
 
     # ---- aggregation -------------------------------------------------------------
     def shuffle_stats(self) -> List[ShuffleStats]:
@@ -490,6 +507,20 @@ class QueryTrace:
                     f"  {'':<20} (cache affinity: {s['affinity_hits']} hits, "
                     f"{s['affinity_misses']} misses, "
                     f"{_fmt_bytes(s['sched_bytes_avoided'])} transfer avoided)")
+        recovery = self.recovery_totals()
+        if recovery:
+            pieces = []
+            for key, label in (("worker_failures", "worker failures"),
+                               ("tasks_requeued", "tasks requeued"),
+                               ("maps_regenerated", "maps regenerated")):
+                if recovery.get(key):
+                    pieces.append(f"{recovery[key]} {label}")
+            for key in sorted(recovery):
+                if key not in ("worker_failures", "tasks_requeued",
+                               "maps_regenerated"):
+                    pieces.append(f"{recovery[key]} {key}")
+            lines.append("")
+            lines.append("recovery: " + ", ".join(pieces))
         stragglers = self.straggler_report()
         if stragglers:
             k = straggler_threshold()

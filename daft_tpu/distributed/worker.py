@@ -30,6 +30,8 @@ from multiprocessing.connection import Client, Listener
 from typing import Dict, List, Optional
 
 from ..observability.metrics import registry
+from ..utils.env import env_float, env_int
+from . import faults
 from .task import SubPlanTask, TaskResult
 
 
@@ -47,13 +49,24 @@ def _rss_bytes() -> int:
             return 0
 
 
+def _residency_module():
+    """The already-imported residency module, or None — the heartbeat thread
+    must NEVER trigger an import itself: the main thread's first task import
+    (daft_tpu executor + jax, seconds on a cold cache) holds per-module import
+    locks, and a heartbeat thread blocked on them falls silent exactly long
+    enough for the driver's liveness monitor to declare this worker dead."""
+    import sys
+
+    return sys.modules.get("daft_tpu.device.residency") \
+        or sys.modules.get(f"{__package__.rsplit('.', 1)[0]}.device.residency")
+
+
 def _hbm_bytes() -> int:
     """Device bytes held by this worker's HBM residency manager (0 when the
     worker never touched a device)."""
     try:
-        from ..device.residency import manager
-
-        return manager().bytes_resident()
+        mod = _residency_module()
+        return mod.manager().bytes_resident() if mod is not None else 0
     except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
         return 0
 
@@ -63,9 +76,8 @@ def _hbm_digest() -> list:
     planes this worker holds (capped). The driver drains these into scheduler
     WorkerSnapshots for cache-affinity placement."""
     try:
-        from ..device.residency import manager
-
-        return manager().digest()
+        mod = _residency_module()
+        return mod.manager().digest() if mod is not None else []
     except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
         return []
 
@@ -75,8 +87,6 @@ def _hbm_h2d_bytes() -> int:
     counter) — a repeat sub-plan served from resident planes shows a zero
     delta, which the affinity tests assert end to end."""
     try:
-        from ..observability.metrics import registry
-
         return registry().get("hbm_h2d_bytes")
     except Exception:  # noqa: BLE001 — heartbeat must never fail the worker
         return 0
@@ -93,6 +103,12 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
 
     collector = recorder = span_rec = None
     reg_before = None
+    # map-output lineage sink: installed for EVERY task (not just traced
+    # ones) — the driver's reduce-side completeness check and the lost-map
+    # regeneration path depend on these records, so they are correctness
+    # state, not telemetry. Costs one list per task.
+    map_sink: list = []
+    shf.set_map_outputs(map_sink)
     if task.collect_stats:
         from ..observability.metrics import registry
         from ..observability.otlp import _span_id
@@ -116,6 +132,7 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
         res = TaskResult(task_id=task.task_id, worker_id=worker_id,
                          partitions=parts, rows=rows,
                          exec_seconds=exec_s, started_at=started_at)
+        res.map_outputs = tuple(map_sink)
         if collector is not None:
             res.bytes_out = sum(p.size_bytes() for p in parts)
             res.op_stats = tuple(collector.finish())
@@ -133,12 +150,26 @@ def _run_task(task: SubPlanTask, worker_id: str) -> TaskResult:
             res.engine_counters = registry().diff(reg_before)
         return res
     finally:
+        shf.set_map_outputs(None)
         if task.collect_stats:
             from ..observability.runtime_stats import set_collector, set_spans
 
             set_collector(None)
             set_spans(None)
             shf.set_recorder(None)
+
+
+def _classify_error(e: BaseException):
+    """(error_kind, error_data) for recoverable failure classes the driver
+    can act on; ("", None) for everything else."""
+    from . import shuffle as shf
+
+    if isinstance(e, shf.ShuffleDataLost):
+        return "shuffle_data_lost", {"shuffle_id": e.shuffle_id,
+                                     "map_ids": list(e.map_ids)}
+    if isinstance(e, shf.ShufflePeerUnreachable):
+        return "shuffle_peer_unreachable", {"shuffle_id": e.shuffle_id}
+    return "", None
 
 
 def _worker_loop(conn, worker_id: str) -> None:
@@ -152,13 +183,19 @@ def _worker_loop(conn, worker_id: str) -> None:
     t_start = time.time()
 
     def _send(msg) -> None:
-        with send_lock:
-            conn.send(msg)
+        # serialize OUTSIDE the lock: pickling a large TaskResult can take
+        # whole seconds, and the heartbeat thread shares this lock — holding
+        # it through the dumps would silence beats long enough for the
+        # driver's liveness monitor to SIGKILL a healthy worker mid-send.
+        # send_bytes(ForkingPickler.dumps(x)) is exactly what conn.send(x)
+        # does internally, so the driver's recv() decodes it unchanged.
+        from multiprocessing.reduction import ForkingPickler
 
-    try:
-        total_slots = max(int(os.environ.get("DAFT_TPU_WORKER_SLOTS", "1")), 1)
-    except ValueError:
-        total_slots = 1
+        buf = bytes(ForkingPickler.dumps(msg))
+        with send_lock:
+            conn.send_bytes(buf)
+
+    total_slots = env_int("DAFT_TPU_WORKER_SLOTS", 1, lo=1)
 
     def _heartbeat_loop(interval: float) -> None:
         # first beat immediately so even sub-second queries observe >=1
@@ -180,10 +217,7 @@ def _worker_loop(conn, worker_id: str) -> None:
             stop.wait(interval)
 
     _send(("hello", worker_id))
-    try:
-        interval = float(os.environ.get("DAFT_TPU_HEARTBEAT_S", "2.0"))
-    except ValueError:
-        interval = 2.0
+    interval = env_float("DAFT_TPU_HEARTBEAT_S", 2.0)
     if interval > 0:
         threading.Thread(target=_heartbeat_loop, args=(interval,),
                          daemon=True, name="daft-heartbeat").start()
@@ -199,14 +233,28 @@ def _worker_loop(conn, worker_id: str) -> None:
             assert kind == "task"
             state["busy"] = 1
             try:
+                if faults.ENABLED:
+                    faults.set_stage(task.stage_id)
+                    faults.maybe_trip("task_start", stage_id=task.stage_id)
                 res = _run_task(task, worker_id)
                 state["completed"] += 1
                 _send(res)
+                if faults.ENABLED:
+                    # the post-publish window: the task's result is already on
+                    # the wire, so a trip here simulates a host that finished
+                    # its map work and THEN died (optionally taking its
+                    # shuffle files with it — the regeneration trigger)
+                    faults.maybe_trip(
+                        "task_sent", stage_id=task.stage_id,
+                        paths=[p for mo in res.map_outputs
+                               for p in mo.get("paths", ())])
             except Exception as e:  # noqa: BLE001 — errors must cross the process boundary
                 state["failed"] += 1
+                err_kind, err_data = _classify_error(e)
                 _send(TaskResult(task_id=task.task_id, worker_id=worker_id,
                                  error=f"{type(e).__name__}: {e}",
-                                 error_tb=traceback.format_exc()))
+                                 error_tb=traceback.format_exc(),
+                                 error_kind=err_kind, error_data=err_data))
             finally:
                 state["busy"] = 0
     finally:
@@ -215,6 +263,8 @@ def _worker_loop(conn, worker_id: str) -> None:
 
 def main(argv: List[str]) -> None:
     address, worker_id = argv[0], argv[1]
+    # exported so fault tripwires (faults.py) can target one worker by id
+    os.environ["DAFT_TPU_WORKER_ID"] = worker_id
     authkey = bytes.fromhex(os.environ["DAFT_TPU_WORKER_AUTHKEY"])
     conn = Client(address, family="AF_UNIX", authkey=authkey)
     try:
@@ -230,6 +280,11 @@ class WorkerProcess:
                  env: Optional[Dict[str, str]] = None):
         self.worker_id = worker_id
         self.slots = slots
+        # the extra env this worker was spawned with (device lease, fault
+        # tripwires): a respawned replacement must inherit it, or a dead
+        # device-leased worker comes back host-only and the pool silently
+        # loses device capability for its remaining lifetime
+        self.spawn_env: Dict[str, str] = dict(env or {})
         child_env = dict(os.environ)
         child_env.setdefault("DAFT_TPU_DEVICE", "off")
         child_env["DAFT_TPU_WORKER_SLOTS"] = str(slots)
@@ -301,11 +356,40 @@ class WorkerProcess:
         # digest to the scheduler only when it actually changed
         self.last_digest: Dict[int, int] = {}
         self.digest_seq = 0
+        # most recent heartbeat payload, surviving window drains: a warm pool
+        # can finish a whole query in less than one heartbeat period, and the
+        # runner falls back to this so /api/workers never shows an empty pool
+        # after a sub-period query
+        self.last_hb: Optional[dict] = None
         # multiprocessing.Connection framing is not thread-safe: the pool's
         # dispatcher thread polls while a driver thread may drain heartbeats
         # (concurrent serving queries), so every send/recv on this connection
         # goes through one lock
         self._io_lock = threading.RLock()
+        # ---- liveness state (driver-side failure detection) -----------------
+        # last time ANY traffic arrived from this worker (heartbeat or
+        # result): results prove liveness as much as beats do, and a poll()
+        # returning a result may leave beats buffered behind it — judging by
+        # beats alone would false-positive on a busy, healthy worker
+        self.last_beat = time.time()
+        # the connection EOF'd while the process still looks alive (hung
+        # worker that closed its socket) — treated as a failure by the pool
+        self.conn_dead = False
+        # set by WorkerPool when the liveness monitor declares this worker
+        # dead (heartbeat timeout / connection EOF); the reason string flows
+        # to counters, the query trace, and the dashboard's dead-worker list
+        self.failed_reason: Optional[str] = None
+
+    def mark_failed(self, reason: str) -> None:
+        """Declare this worker dead: record the reason and SIGKILL the
+        process (SIGKILL acts even on a SIGSTOP'd process — the case the
+        heartbeat timeout exists to catch)."""
+        if self.failed_reason is None:
+            self.failed_reason = reason
+        try:
+            self._proc.kill()
+        except OSError:
+            pass
 
     def submit(self, task: SubPlanTask) -> None:
         with self._io_lock:
@@ -319,6 +403,7 @@ class WorkerProcess:
         hb = dict(hb)
         hb["recv_ts"] = time.time()
         self.heartbeats.append(hb)
+        self.last_hb = hb
         digest = hb.get("hbm_digest")
         if digest is not None:
             self.last_digest = dict(digest)
@@ -333,6 +418,7 @@ class WorkerProcess:
             try:
                 while self._conn.poll(timeout):
                     msg = self._conn.recv()
+                    self.last_beat = time.time()  # any traffic = alive
                     if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
                         # out-of-band heartbeat: record and keep draining
                         # (without blocking again — the result may already be
@@ -344,8 +430,10 @@ class WorkerProcess:
                     self.inflight.pop(res.task_id, None)
                     return res
             except (EOFError, BrokenPipeError, OSError):
-                # dead worker: caller's alive-check re-queues its in-flight tasks
-                pass
+                # dead worker: the pool's liveness pass re-queues its
+                # in-flight tasks (conn_dead catches the hung-but-running
+                # process whose exit code never changes)
+                self.conn_dead = True
             return None
 
     def pump(self) -> None:
@@ -357,12 +445,13 @@ class WorkerProcess:
             try:
                 while self._conn.poll(0.0):
                     msg = self._conn.recv()
+                    self.last_beat = time.time()
                     if isinstance(msg, tuple) and msg and msg[0] == "heartbeat":
                         self._note_heartbeat(msg[1])
                     else:
                         self._pending_results.append(msg)
             except (EOFError, BrokenPipeError, OSError):
-                pass
+                self.conn_dead = True
 
     def drain_heartbeats(self) -> List[dict]:
         """Non-destructively empty the connection: heartbeats are collected;
@@ -407,7 +496,8 @@ class _StageRun:
     queries' tasks fairly across the shared workers."""
 
     __slots__ = ("key", "stage_id", "trace", "tasks", "expected", "results",
-                 "error", "done", "completed_times", "running", "speculated",
+                 "error", "error_kind", "error_data", "done",
+                 "completed_times", "running", "speculated",
                  "dup_worker", "dispatched_at", "stats_before",
                  "placement_stats")
 
@@ -420,6 +510,12 @@ class _StageRun:
         self.expected = set(self.tasks)
         self.results: Dict[str, TaskResult] = {}
         self.error: Optional[str] = None
+        # structured classification of the failing task's error (see
+        # TaskResult.error_kind): run_tasks re-raises typed exceptions from
+        # these so the planner's recovery loop can regenerate lost shuffle
+        # maps instead of failing the whole query
+        self.error_kind: str = ""
+        self.error_data: Optional[dict] = None
         self.done = threading.Event()
         self.completed_times: List[float] = []   # exec seconds (speculation median)
         self.running: Dict[str, tuple] = {}      # task_id -> (worker_id, dispatch ts)
@@ -429,8 +525,11 @@ class _StageRun:
         self.stats_before: Dict[str, int] = {}
         self.placement_stats: Dict[str, int] = {}
 
-    def fail(self, error: str) -> None:
+    def fail(self, error: str, kind: str = "",
+             data: Optional[dict] = None) -> None:
         self.error = error
+        self.error_kind = kind
+        self.error_data = data
         self.done.set()
 
 
@@ -445,6 +544,15 @@ class WorkerPool:
     across concurrent stages, re-queues tasks whose worker died (excluding
     that worker, like the reference's snapshot-based retry), and raises the
     original traceback for task-level errors.
+
+    Failure detection (elastic fault tolerance): workers heartbeat on their
+    connections; the dispatcher declares a worker DEAD on process exit,
+    connection EOF, or DAFT_TPU_HEARTBEAT_TIMEOUT_S of silence (default ~= 3
+    missed DAFT_TPU_HEARTBEAT_S beats — catches SIGSTOP'd/hung workers that
+    neither exit nor EOF). A dead worker's in-flight tasks requeue with it
+    excluded (worker_failures_total / tasks_requeued_total), and with
+    DAFT_TPU_WORKER_RESPAWN > 0 the pool spawns up to that many replacements
+    over its lifetime, spaced by a doubling backoff.
 
     Speculative re-execution (the action half of QueryTrace.straggler_report):
     once a stage has >= 2 finished tasks, a still-running task whose elapsed
@@ -494,6 +602,16 @@ class WorkerPool:
                        str(cfg.shuffle_fetch_parallelism))
         env.setdefault("DAFT_TPU_SHUFFLE_PREFETCH",
                        str(cfg.shuffle_prefetch_batches))
+        # heartbeat cadence: driver (liveness timeout) and workers (beat
+        # interval) must agree — mirror the effective interval into the
+        # children; an explicit env entry passed by the caller wins
+        hb = env_float("DAFT_TPU_HEARTBEAT_S", 2.0)
+        try:
+            hb = float(env.get("DAFT_TPU_HEARTBEAT_S", hb))
+        except ValueError:
+            pass
+        env.setdefault("DAFT_TPU_HEARTBEAT_S", str(hb))
+        self._hb_interval = hb
         from ..utils.sockets import DeadlineAcceptor
 
         acceptor = DeadlineAcceptor(self._listener)
@@ -543,8 +661,46 @@ class WorkerPool:
         self._dispatcher: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._closed = False
+        # ---- liveness monitor + elastic respawn knobs -----------------------
+        hb = self._hb_interval
+        # a worker silent for this long is DEAD (default ~= 3 missed beats,
+        # floored so a worker busy importing jax on its first task is never
+        # declared dead by an aggressive beat interval); 0/heartbeats-off
+        # disables the timeout (EOF and exit-code detection still apply)
+        self._hb_timeout = env_float("DAFT_TPU_HEARTBEAT_TIMEOUT_S",
+                                     max(3 * hb, 6.0))
+        if hb <= 0:
+            self._hb_timeout = 0.0
+        # elastic respawn: replace up to this many dead workers over the
+        # pool's lifetime (0 = off), spaced by a doubling backoff so a
+        # crash-looping environment can't hot-spin spawns
+        self._respawn_cap = env_int("DAFT_TPU_WORKER_RESPAWN", 0, lo=0)
+        self._respawn_attempts = 0
+        self._respawn_backoff = 0.5
+        self._respawn_next_t = 0.0
+        # replacements still owed (one per death, so N deaths in one pass
+        # respawn N workers, budget allowing — a boolean would coalesce them)
+        self._pending_respawns = 0
+        # spawn-env of each dead worker awaiting replacement (device leases
+        # must survive respawn; FIFO pairs deaths with replacements)
+        self._respawn_envs: deque = deque()
+        # death ledger: worker_id -> {ts, reason} (dashboard dead-worker
+        # marking); _death_events drains into synthetic heartbeats
+        self.dead_workers: Dict[str, dict] = {}
+        self._death_events: deque = deque()
+        # cancellation requests from client threads (ServeFuture.cancel):
+        # the DISPATCHER performs the actual _fail_run/drop_stream on its
+        # next pass — the scheduler has no lock of its own, so only the
+        # dispatcher thread may mutate it
+        self._cancel_requests: set = set()
+        # recovery notes that found no traced run active when the death was
+        # detected (a worker can die BETWEEN stages — the EOF surfaces on the
+        # next dispatch pass): drained into the next traced run so EXPLAIN
+        # ANALYZE still renders the failure its recovery responded to
+        self._unattributed_recovery: List[tuple] = []
 
-    def scale_up(self, n: int = 1) -> List[str]:
+    def scale_up(self, n: int = 1,
+                 env: Optional[Dict[str, str]] = None) -> List[str]:
         """Spawn up to n extra workers (bounded by max_workers over ALIVE
         workers, so crashed workers free headroom); returns the new worker
         ids. Spawn failures are non-fatal — the pool keeps serving with what
@@ -558,7 +714,8 @@ class WorkerPool:
             try:
                 self.workers[wid] = WorkerProcess(
                     wid, self._acceptor, self._sock,
-                    self._slots_per_worker, env=self._env)
+                    self._slots_per_worker,
+                    env=env if env is not None else self._env)
             except Exception:
                 # a failed spawn (resource limits — exactly when demand
                 # spikes) must not abort the stage the existing pool can run
@@ -595,13 +752,39 @@ class WorkerPool:
             self._incoming.append(run)
             self._ensure_dispatcher()
         self._wake.set()
+        # the calling thread's cancellation token (serving ServeFuture.cancel
+        # installs one; bare runner threads have none): checked while waiting
+        # so a cancelled query's stage stops consuming the pool — its pending
+        # stream is dropped (best-effort Scheduler.drop_stream; tasks already
+        # on workers finish and their results are discarded)
+        from ..cancellation import QueryCancelled, cancel_event
+
+        cancel_ev = cancel_event()
         while not run.done.wait(timeout=0.5):
+            if cancel_ev is not None and cancel_ev.is_set():
+                self._cancel_run(run)
             with self._pool_lock:
                 alive = (self._dispatcher is not None
                          and self._dispatcher.is_alive())
             if not alive and not run.done.is_set():
                 raise RuntimeError("worker pool dispatcher died")
+        if run.error_kind == "cancelled":
+            raise QueryCancelled(run.error or "query cancelled")
         if run.error is not None:
+            # re-raise recoverable failure classes TYPED so the planner's
+            # recovery loop can regenerate lost shuffle maps (worker.py
+            # _classify_error is the other end of this contract)
+            if run.error_kind == "shuffle_data_lost" and run.error_data:
+                from .shuffle import ShuffleDataLost
+
+                raise ShuffleDataLost(
+                    run.error_data.get("shuffle_id", ""),
+                    run.error_data.get("map_ids", ()), run.error)
+            if run.error_kind == "shuffle_peer_unreachable" and run.error_data:
+                from .shuffle import ShufflePeerUnreachable
+
+                raise ShufflePeerUnreachable(
+                    run.error_data.get("shuffle_id", ""), run.error)
             raise RuntimeError(run.error)
         if trace is not None:
             trace.note_placement(run.stage_id, run.placement_stats)
@@ -649,7 +832,7 @@ class WorkerPool:
             # seed residency digests from the latest heartbeats so this
             # stage's FIRST scheduling pass is already cache-affinity aware
             for w in list(self.workers.values()):
-                if w.alive:
+                if w.alive and w.failed_reason is None:
                     w.pump()
                     if self._digest_seen.get(w.worker_id) != w.digest_seq:
                         self._sched.update_residency(w.worker_id, w.last_digest)
@@ -658,10 +841,17 @@ class WorkerPool:
             # external scale_up() between stages must become schedulable)
             known = {s.worker_id for s in self._sched.snapshots()}
             for w in self.workers.values():
-                if w.alive and w.worker_id not in known:
+                if w.alive and w.failed_reason is None \
+                        and w.worker_id not in known:
                     self._sched.add_worker(w.worker_id, w.slots)
             run.stats_before = self._sched.placement_stats()
             self._runs[run.key] = run
+            if run.trace is not None and self._unattributed_recovery:
+                # deaths detected while no traced run was active land on the
+                # next traced run's report (see _note_worker_death)
+                for key, n in self._unattributed_recovery:
+                    run.trace.note_recovery(key, n)
+                self._unattributed_recovery.clear()
             for t in run.tasks.values():
                 self._task_route[t.task_id] = run
                 self._sched.submit(t, stream_key=run.key)
@@ -683,6 +873,7 @@ class WorkerPool:
         run.running.pop(task.task_id, None)
         run.speculated.discard(task.task_id)
         run.dup_worker.pop(task.task_id, None)
+        registry().inc("tasks_requeued_total")
         self._sched.submit(clone, stream_key=run.key)
 
     def _finish_run(self, run: _StageRun) -> None:
@@ -695,17 +886,40 @@ class WorkerPool:
                 self._task_route.pop(tid, None)
         run.done.set()
 
-    def _fail_run(self, run: _StageRun, error: str) -> None:
+    def _cancel_run(self, run: _StageRun) -> None:
+        """Best-effort mid-stage cancellation (ServeFuture.cancel while the
+        stage runs), called from the CLIENT thread: park a request for the
+        dispatcher, which drops the run's pending stream and fails it on its
+        next pass — in-flight tasks complete on their workers and their late
+        results are dropped by the routing table. The scheduler is only ever
+        touched by the dispatcher thread (it has no lock; a client-side
+        drop_stream racing the dispatcher's own _fail_run corrupted the
+        stream rotation)."""
+        with self._pool_lock:
+            if run.key in self._runs:
+                self._cancel_requests.add(run.key)
+        self._wake.set()
+
+    def _fail_run(self, run: _StageRun, error: str, kind: str = "",
+                  data: Optional[dict] = None) -> None:
         self._sched.drop_stream(run.key)
         with self._pool_lock:
             self._runs.pop(run.key, None)
             for tid in run.expected:
                 self._task_route.pop(tid, None)
-        run.fail(error)
+        run.fail(error, kind, data)
 
     def _dispatch_pass(self) -> None:
         sched = self._sched
         self._register_incoming()
+        # client-thread cancellations parked by _cancel_run: performed here
+        # so every scheduler mutation stays on this thread
+        with self._pool_lock:
+            cancelled = [self._runs[k] for k in self._cancel_requests
+                         if k in self._runs]
+            self._cancel_requests.clear()
+        for run in cancelled:
+            self._fail_run(run, "query cancelled", kind="cancelled")
         # elastic scale-up: when queued demand exceeds capacity by the
         # autoscaling threshold, grow the pool toward max_workers — ONE
         # worker per dispatch pass, so result polling of busy workers is
@@ -753,29 +967,42 @@ class WorkerPool:
                 run = self._task_route.get(res.task_id)
                 if run is not None:
                     self._route_result(run, res)
-            if not w.alive:
+            # ---- liveness monitor: ACT on missing heartbeats ----------------
+            # the poll above just drained whatever the connection held, so a
+            # stale last_beat here is real silence, not an undrained buffer.
+            # A SIGSTOP'd/hung worker never EOFs and never exits — the beat
+            # timeout is the only detector that catches it.
+            if w.alive and w.failed_reason is None:
+                if w.conn_dead:
+                    w.mark_failed("connection closed")
+                elif (self._hb_timeout > 0
+                        and time.time() - w.last_beat > self._hb_timeout):
+                    w.mark_failed(
+                        f"no heartbeat for {self._hb_timeout:.1f}s "
+                        f"(interval {self._hb_interval:.1f}s)")
+            if not w.alive or w.failed_reason is not None:
                 # worker died: re-queue its tasks elsewhere and DROP the
                 # entry (leaving it would leak its fd and pay a poll
                 # error every loop; scale_up counts alive workers so the
                 # slot frees for a replacement)
-                sched.remove_worker(w.worker_id)
-                if w.inflight:
-                    for t in list(w.inflight.values()):
-                        run = self._task_route.get(t.task_id)
-                        if run is None or t.task_id in run.results:
-                            continue  # result already won elsewhere
-                        self._requeue_elsewhere(w, t, run)
-                    w.inflight.clear()
+                if self._note_worker_death(w):
                     progressed = True
-                w.stop()
-                self.workers.pop(w.worker_id, None)
-                if not any(ww.alive for ww in self.workers.values()):
-                    for run in list(self._runs.values()):
-                        self._fail_run(run, "all workers died")
-                    return
+                if not any(ww.alive and ww.failed_reason is None
+                           for ww in self.workers.values()):
+                    # last worker gone: an immediate respawn (cap allowing)
+                    # is the only alternative to failing every run
+                    self._maybe_respawn(force=True)
+                    if not self.workers:
+                        for run in list(self._runs.values()):
+                            self._fail_run(run, "all workers died")
+                        return
         self._maybe_speculate()
-        if not progressed and sched.pending_count() and not any(
-                w.inflight for w in self.workers.values()):
+        if self._pending_respawns > 0:
+            self._maybe_respawn()
+        respawn_pending = (self._pending_respawns > 0
+                           and self._respawn_attempts < self._respawn_cap)
+        if not progressed and sched.pending_count() and not respawn_pending \
+                and not any(w.inflight for w in self.workers.values()):
             # nothing running, nothing newly assignable -> unschedulable;
             # fail every run that still has unfinished tasks
             for run in list(self._runs.values()):
@@ -783,6 +1010,87 @@ class WorkerPool:
                     self._fail_run(
                         run, f"{sched.pending_count()} tasks unschedulable "
                              f"(no eligible workers)")
+
+    def _note_worker_death(self, w: WorkerProcess) -> bool:
+        """Handle one dead worker: counters + death ledger, requeue its
+        in-flight tasks (excluding it), drop it from scheduler and pool, and
+        arm a respawn. Returns True when tasks were requeued (dispatch
+        progress)."""
+        now = time.time()
+        rc = w._proc.poll()
+        reason = w.failed_reason or f"process exited (code {rc})"
+        registry().inc("worker_failures_total")
+        self.dead_workers[w.worker_id] = {"ts": now, "reason": reason}
+        self._death_events.append(
+            {"worker_id": w.worker_id, "ts": now, "reason": reason})
+        self._sched.remove_worker(w.worker_id)
+        progressed = False
+        requeued = 0
+        if w.inflight:
+            for t in list(w.inflight.values()):
+                run = self._task_route.get(t.task_id)
+                if run is None or t.task_id in run.results:
+                    continue  # result already won elsewhere
+                self._requeue_elsewhere(w, t, run)
+                requeued += 1
+                if run.trace is not None:
+                    run.trace.note_recovery("tasks_requeued", 1)
+            w.inflight.clear()
+            progressed = requeued > 0
+        # the failure is an event of the QUERIES sharing this pool: note it
+        # once per distinct active trace so EXPLAIN ANALYZE can render
+        # "recovery: N worker failures, ..."
+        seen_traces = set()
+        for run in self._runs.values():
+            tr = run.trace
+            if tr is not None and id(tr) not in seen_traces:
+                seen_traces.add(id(tr))
+                tr.note_recovery("worker_failures", 1)
+        if not seen_traces:
+            # no traced run was active at detection time (death between
+            # stages): park the note for the next traced run's report
+            self._unattributed_recovery.append(("worker_failures", 1))
+        w.stop()
+        self.workers.pop(w.worker_id, None)
+        if self._respawn_cap > 0:
+            self._pending_respawns += 1
+            # the replacement inherits the dead worker's spawn env (device
+            # lease above all) so recovery restores capability, not just count
+            self._respawn_envs.append(dict(w.spawn_env))
+        return progressed
+
+    def _maybe_respawn(self, force: bool = False) -> None:
+        """Spawn a replacement for a dead worker, bounded by
+        DAFT_TPU_WORKER_RESPAWN total attempts with a doubling backoff
+        between them (force=True skips the backoff wait — the all-workers-
+        dead case where the alternative is failing every run)."""
+        if self._respawn_cap <= 0 or self._respawn_attempts >= self._respawn_cap:
+            self._pending_respawns = 0
+            self._respawn_envs.clear()
+            return
+        alive = sum(1 for w in self.workers.values()
+                    if w.alive and w.failed_reason is None)
+        if alive >= self.max_workers:
+            # capacity already restored — queue-pressure autoscaling raced
+            # the respawn for the dead worker's freed headroom. The pool is
+            # whole again; a no-op scale_up here would silently burn a
+            # capped attempt.
+            self._pending_respawns = 0
+            self._respawn_envs.clear()
+            return
+        now = time.time()
+        if not force and now < self._respawn_next_t:
+            return  # backoff window; retried on a later pass
+        self._respawn_attempts += 1
+        self._respawn_next_t = now + self._respawn_backoff
+        self._respawn_backoff = min(self._respawn_backoff * 2, 30.0)
+        env = self._respawn_envs.popleft() if self._respawn_envs else None
+        added = self.scale_up(1, env=env)
+        for wid in added:
+            self._sched.add_worker(wid, self._slots_per_worker)
+            registry().inc("worker_respawns_total")
+        if added:
+            self._pending_respawns = max(0, self._pending_respawns - 1)
 
     def _route_result(self, run: _StageRun, res: TaskResult) -> None:
         if res.task_id in run.results:
@@ -798,7 +1106,8 @@ class WorkerPool:
                 return
             self._fail_run(
                 run,
-                f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}")
+                f"task {res.task_id} failed on {res.worker_id}:\n{res.error_tb}",
+                res.error_kind, res.error_data)
             return
         run.results[res.task_id] = res
         run.running.pop(res.task_id, None)
@@ -824,10 +1133,7 @@ class WorkerPool:
 
         from .trace import straggler_threshold
 
-        try:
-            floor = float(os.environ.get("DAFT_TPU_SPECULATIVE_MIN_S", "0.25"))
-        except ValueError:
-            floor = 0.25
+        floor = env_float("DAFT_TPU_SPECULATIVE_MIN_S", 0.25)
         k = straggler_threshold()
         now = time.time()
         for run in list(self._runs.values()):
@@ -860,15 +1166,45 @@ class WorkerPool:
                 self._sched.submit(clone, stream_key=run.key)
                 registry().inc("sched_speculative_dispatches")
 
-    def drain_heartbeats(self) -> List[dict]:
+    def drain_heartbeats(self, preserve_deaths: bool = False) -> List[dict]:
         """Collect heartbeats received from every live worker since the last
         drain (the runner forwards them to subscribers / the dashboard).
-        Task results encountered while draining are preserved for poll()."""
+        Task results encountered while draining are preserved for poll().
+        Worker deaths since the last drain are appended as synthetic final
+        beats carrying dead=True + the failure reason, so the dashboard MARKS
+        dead workers instead of silently letting them go stale.
+        preserve_deaths=True empties only the worker pipes and leaves queued
+        death events for the next full drain — the runner's start-of-query
+        DISCARD drain must not swallow the one-shot dead=True records the
+        dashboard's latch depends on."""
         out: List[dict] = []
-        for w in self.workers.values():
+        # snapshot: the dispatcher thread pops dead workers / inserts
+        # respawns concurrently with this (runner-thread) drain
+        for w in list(self.workers.values()):
             out.extend(w.drain_heartbeats())
+        if preserve_deaths:
+            out.sort(key=lambda h: h.get("ts", 0.0))
+            return out
+        while self._death_events:
+            try:
+                ev = self._death_events.popleft()
+            except IndexError:
+                break
+            out.append({"worker_id": ev["worker_id"], "ts": ev["ts"],
+                        "recv_ts": ev["ts"], "busy_slots": 0,
+                        "total_slots": 0, "tasks_completed": 0,
+                        "tasks_failed": 0, "rss_bytes": 0, "uptime_s": 0.0,
+                        "dead": True, "death_reason": ev["reason"]})
         out.sort(key=lambda h: h.get("ts", 0.0))
         return out
+
+    def latest_heartbeats(self) -> Dict[str, dict]:
+        """worker_id -> most recent heartbeat payload for every live worker
+        that has ever beaten. The runner's end-of-query window filter can
+        come up empty for a query faster than one heartbeat period; these
+        survive that filter so the dashboard still sees the whole pool."""
+        return {w.worker_id: w.last_hb
+                for w in list(self.workers.values()) if w.last_hb is not None}
 
     def shutdown(self) -> None:
         with self._pool_lock:

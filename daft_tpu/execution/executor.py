@@ -405,16 +405,19 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.ShuffleRead):
+        expected = getattr(node, "expected_maps", None)
         if node.fetch_endpoints:
             from ..distributed.fetch_server import fetch_partition
 
             yield from fetch_partition(node.fetch_endpoints, node.shuffle_id,
-                                       node.partition_idx, node.schema)
+                                       node.partition_idx, node.schema,
+                                       expected_maps=expected)
             return
         from ..distributed import shuffle as shf
 
         yield from shf.read_partition(node.shuffle_dir, node.shuffle_id,
-                                      node.partition_idx, node.schema)
+                                      node.partition_idx, node.schema,
+                                      expected_maps=expected)
         return
 
     raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")

@@ -93,6 +93,9 @@ class DashboardState(Subscriber):
         self._by_id: dict = {}
         self._max_heartbeats = max_heartbeats
         self._workers: dict = {}  # worker_id -> deque of heartbeat dicts
+        # worker_id -> {ts, reason}: latched by the liveness monitor's
+        # synthetic dead beat; cleared if the id beats again (respawn reuse)
+        self._dead_workers: dict = {}
         # query_id -> QueryTrace (bounded separately from the query records:
         # traces hold per-task spans and are served as downloads, not JSON'd
         # into /api/queries)
@@ -160,6 +163,13 @@ class DashboardState(Subscriber):
             if dq is None:
                 dq = self._workers[hb.worker_id] = deque(
                     maxlen=self._max_heartbeats)
+            # idempotent on re-delivery: the runner's fast-query fallback
+            # (WorkerPool.latest_heartbeats survives window drains) can hand
+            # a later query the SAME beat an earlier query already notified;
+            # per-worker beat ts is monotonic, so a duplicate appends nothing
+            # and the busy-fraction window never double-counts a beat
+            if dq and dq[-1]["ts"] >= hb.ts and not getattr(hb, "dead", False):
+                return
             dq.append({"ts": hb.ts, "busy_slots": hb.busy_slots,
                        "total_slots": hb.total_slots,
                        "tasks_completed": hb.tasks_completed,
@@ -168,6 +178,15 @@ class DashboardState(Subscriber):
                        "hbm_bytes": getattr(hb, "hbm_bytes", 0),
                        "hbm_h2d_bytes": getattr(hb, "hbm_h2d_bytes", 0),
                        "hbm_digest_entries": getattr(hb, "hbm_digest_entries", 0)})
+            # a dead beat is the liveness monitor's synthetic FINAL report:
+            # latch it per worker so /api/workers marks the row dead instead
+            # of silently letting it go stale (and a later respawn under the
+            # same id un-latches by sending real beats again)
+            if getattr(hb, "dead", False):
+                self._dead_workers[hb.worker_id] = {
+                    "ts": hb.ts, "reason": getattr(hb, "death_reason", "")}
+            elif hb.worker_id in self._dead_workers:
+                self._dead_workers.pop(hb.worker_id, None)
 
     def on_query_trace(self, query_id: str, trace) -> None:
         with self._lock:
@@ -261,11 +280,16 @@ class DashboardState(Subscriber):
                 beats = list(dq)
                 recent = [b for b in beats if b["ts"] >= now - window_s]
                 busy = sum(1 for b in recent if b["busy_slots"] > 0)
+                dead = self._dead_workers.get(wid)
                 out[wid] = {
                     "last": beats[-1] if beats else None,
                     "heartbeats": len(beats),
                     "recent": len(recent),
                     "busy_fraction": busy / len(recent) if recent else 0.0,
+                    # liveness-monitor verdict: a dead worker stays in the
+                    # table, MARKED, with its failure classification
+                    "dead": dead is not None,
+                    "death_reason": dead["reason"] if dead else "",
                     # HBM residency gauges from the latest beat: device-buffer
                     # bytes held across queries, cumulative h2d upload bytes
                     # (flat across repeats = served from residency), and the

@@ -31,7 +31,12 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # (spill_batches/spill_bytes) now appear in query_end.metrics.
 # v7: adds the serve_query record kind (serving tier — tenant, latency,
 # prepared-cache hit, admission wait; see events.ServeQueryRecord).
-SCHEMA_VERSION = 7
+# v8: worker_heartbeat gains dead + death_reason (synthetic final beat from
+# the pool's liveness monitor — elastic fault tolerance); query_end.metrics
+# may now carry the recovery counters (worker_failures_total,
+# tasks_requeued_total, shuffle_maps_regenerated_total, worker_respawns_total,
+# fetch_retries_total, checkpoint_stages_committed/skipped).
+SCHEMA_VERSION = 8
 
 
 class EventLogSubscriber(Subscriber):

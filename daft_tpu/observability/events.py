@@ -118,6 +118,12 @@ class WorkerHeartbeat:
     # Cristian bound) used to align worker span timestamps in the Chrome
     # trace export (QueryTrace.clock_offsets)
     recv_ts: float = 0.0
+    # synthetic FINAL beat emitted by the pool's liveness monitor when it
+    # declares this worker dead (heartbeat timeout / connection EOF / process
+    # exit) — the dashboard marks dead workers instead of silently letting
+    # their last real beat go stale; death_reason carries the classification
+    dead: bool = False
+    death_reason: str = ""
 
 
 @dataclass(frozen=True)

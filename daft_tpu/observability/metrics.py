@@ -121,7 +121,16 @@ _REGISTRY = MetricsRegistry()
 # even if no ServingSession was ever constructed.
 _REGISTRY.declare("spill_batches", "spill_bytes", "admission_waits_total",
                   "serve_prepared_hits", "serve_prepared_misses",
-                  "serve_queries_total")
+                  "serve_queries_total", "serve_cancelled_total")
+# Elastic fault tolerance (distributed/worker.py liveness monitor,
+# distributed/planner.py lost-map regeneration, checkpoint/stages.py,
+# fetch_server.py transient retry): recovery is exactly the regime where a
+# scraper must see the series from scrape one — declared here, not in the
+# lazily-imported owners.
+_REGISTRY.declare("worker_failures_total", "tasks_requeued_total",
+                  "worker_respawns_total", "shuffle_maps_regenerated_total",
+                  "fetch_retries_total", "checkpoint_stages_committed",
+                  "checkpoint_stages_skipped", "checkpoint_commit_failures")
 _REGISTRY.set_gauge("serve_queue_depth", 0.0)
 
 

@@ -357,13 +357,20 @@ class ShuffleRead(PhysicalPlan):
     sockets instead of the local filesystem (multi-host topology)."""
 
     def __init__(self, shuffle_id: str, partition_idx: int, shuffle_dir: str,
-                 schema: Schema, fetch_endpoints=None):
+                 schema: Schema, fetch_endpoints=None, expected_maps=None):
         super().__init__()
         self.shuffle_id = shuffle_id
         self.partition_idx = partition_idx
         self.shuffle_dir = shuffle_dir
         self.schema = schema
         self.fetch_endpoints = fetch_endpoints  # [(host, port, authkey_hex)]
+        # map ids the driver's lineage says wrote rows for THIS partition
+        # (distributed/planner.py derives them from TaskResult.map_outputs).
+        # Readers verify the files exist and raise ShuffleDataLost naming the
+        # missing ids — a dead worker's lost outputs become a recoverable
+        # event instead of a silently-short reduce input. None = no check
+        # (legacy dirs, direct callers).
+        self.expected_maps = tuple(expected_maps) if expected_maps else None
 
 
 # ======================================================================================

@@ -23,6 +23,7 @@ daft_tpu_query_latency_seconds), and one ServeQueryRecord per query to
 subscribers (dashboard per-tenant hit-rate table, event log schema v7).
 """
 
+from ..cancellation import QueryCancelled
 from .admission import FairAdmissionQueue
 from .prepared import PreparedQueryCache, estimate_pin_bytes, plan_structure
 from .session import ServeFuture, ServingSession
@@ -30,6 +31,7 @@ from .session import ServeFuture, ServingSession
 __all__ = [
     "FairAdmissionQueue",
     "PreparedQueryCache",
+    "QueryCancelled",
     "ServeFuture",
     "ServingSession",
     "estimate_pin_bytes",

@@ -65,6 +65,30 @@ class FairAdmissionQueue:
                 return item
             return None  # unreachable while _size > 0
 
+    def remove(self, tenant: str, item: Any) -> bool:
+        """Remove one still-queued item (identity match) — the cancellation
+        path: True only if the item was present, so exactly one of remove()
+        and pop() ever owns a given ticket. Rotation fairness is preserved:
+        removing a tenant's last item retires it from the rotation with the
+        pointer re-aimed at whoever was next."""
+        with self._cond:
+            q = self._queues.get(tenant)
+            if q is None:
+                return False
+            try:
+                q.remove(item)
+            except ValueError:
+                return False
+            self._size -= 1
+            if not q:
+                idx = self._rotation.index(tenant)
+                self._rotation.pop(idx)
+                del self._queues[tenant]
+                if idx < self._pos:
+                    self._pos -= 1
+                self._pos = self._pos % max(len(self._rotation), 1)
+            return True
+
     def depth(self) -> int:
         with self._cond:
             return self._size

@@ -32,6 +32,8 @@ import time
 import uuid
 from typing import Any, List, Optional
 
+from ..cancellation import (QueryCancelled, raise_if_cancelled,
+                            set_cancel_event)
 from ..config import execution_config
 from ..device.residency import manager as _residency
 from ..observability import ServeQueryRecord, notify, subscribers_active
@@ -49,10 +51,18 @@ class ServeFuture:
         self._done = threading.Event()
         self._parts: Optional[List[Any]] = None
         self._error: Optional[BaseException] = None
+        # cancellation: the event is installed as the executing thread's
+        # cancellation token (daft_tpu/cancellation.py) for the running case;
+        # _queue/_ticket let cancel() pull a still-queued query out of the
+        # admission queue before it ever starts
+        self._cancel_ev = threading.Event()
+        self._queue: Optional[FairAdmissionQueue] = None
+        self._ticket: Optional["_Ticket"] = None
         # filled at resolution for caller-side attribution
         self.seconds = 0.0
         self.prepared_hit = False
         self.admission_wait_s = 0.0
+        self.cancelled = False
 
     def result(self, timeout: Optional[float] = None) -> List[Any]:
         """The query's result MicroPartitions (raises what execution raised)."""
@@ -73,6 +83,29 @@ class ServeFuture:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Cancel this query. A still-QUEUED query is removed from the
+        admission queue, never reserves HBM, and resolves immediately with
+        QueryCancelled. A RUNNING query is cancelled best-effort: its
+        cancellation token trips the engine's cooperative checks (between
+        distributed task stages — where the pool also drops the query's
+        pending stream — inside the HBM admission wait, and between streamed
+        result partitions in-process); a stage already on the workers
+        completes and its results are discarded. Returns False only when the
+        query already resolved (its result stands); True means the
+        cancellation was delivered (for a queued query, guaranteed)."""
+        if self._done.is_set():
+            return False
+        self._cancel_ev.set()
+        q, t = self._queue, self._ticket
+        if q is not None and t is not None and q.remove(t.tenant, t):
+            registry().set_gauge("serve_queue_depth", float(q.depth()))
+            registry().inc("serve_cancelled_total")
+            self.cancelled = True
+            self._reject(QueryCancelled(
+                f"query {self.query_id} cancelled while queued"))
+        return True
 
     def _resolve(self, parts: List[Any]) -> None:
         self._parts = parts
@@ -137,7 +170,10 @@ class ServingSession:
             raise RuntimeError("serving session is closed")
         builder = getattr(query, "_builder", query)
         fut = ServeFuture(uuid.uuid4().hex[:12], tenant)
-        depth = self._queue.push(tenant, _Ticket(builder, tenant, fut))
+        ticket = _Ticket(builder, tenant, fut)
+        fut._queue = self._queue
+        fut._ticket = ticket
+        depth = self._queue.push(tenant, ticket)
         registry().set_gauge("serve_queue_depth", float(depth))
         if self._closed.is_set():
             # close() raced us: it may have drained the queue before our push
@@ -203,7 +239,15 @@ class ServingSession:
         est = 0
         exc: Optional[BaseException] = None
         parts: List[Any] = []
+        # this thread's cancellation token for the query's whole execution:
+        # the engine's cooperative checks (distributed planner between
+        # stages, pool run_tasks wait loop, HBM admission wait, and the
+        # per-partition check below) all read it thread-locally
+        set_cancel_event(fut._cancel_ev)
         try:
+            # a cancel() that lost the queue-removal race (worker popped the
+            # ticket first) still wins here, before planning or admission
+            raise_if_cancelled(f"query {fut.query_id} cancelled")
             entry, hit = self.prepared.get_or_plan(
                 ticket.builder, keep_physical=self._runner is None)
             est = entry.est_pin_bytes
@@ -221,7 +265,12 @@ class ServingSession:
                     if self._runner is None:
                         from ..execution.executor import execute_plan
 
-                        parts = list(execute_plan(entry.physical))
+                        # cooperative check between streamed partitions: the
+                        # in-process path's natural yield points
+                        for p in execute_plan(entry.physical):
+                            raise_if_cancelled(
+                                f"query {fut.query_id} cancelled")
+                            parts.append(p)
                     else:
                         parts = list(self._runner.run(entry.builder))
                 exec_s = time.perf_counter() - t_exec
@@ -229,12 +278,17 @@ class ServingSession:
         except BaseException as e:  # noqa: BLE001 — the future carries it to the client
             err = f"{type(e).__name__}: {e}"
             exc = e
+        finally:
+            set_cancel_event(None)
         seconds = time.perf_counter() - t0
         # attribution BEFORE resolution: a client waking from result() must
         # see the final seconds/prepared_hit, not the defaults
         fut.seconds = seconds
         fut.prepared_hit = hit
         fut.admission_wait_s = wait_s
+        if isinstance(exc, QueryCancelled):
+            fut.cancelled = True
+            registry().inc("serve_cancelled_total")
         if err is None:
             fut._resolve(parts)
         else:

@@ -1,0 +1,29 @@
+"""Defensive environment-variable parsing.
+
+One site for the parse-or-default idiom the distributed knobs repeat
+(heartbeat cadence, respawn caps, retry budgets): a malformed value NEVER
+raises — production knobs must degrade to their defaults, not crash a worker
+or driver at import/spawn time. `lo` clamps the floor where a knob has one
+(slot counts >= 1, retry budgets >= 0).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: int, lo: Optional[int] = None) -> int:
+    try:
+        v = int(os.environ.get(name, default))
+    except ValueError:
+        v = default
+    return v if lo is None else max(v, lo)
+
+
+def env_float(name: str, default: float, lo: Optional[float] = None) -> float:
+    try:
+        v = float(os.environ.get(name, default))
+    except ValueError:
+        v = default
+    return v if lo is None else max(v, lo)

@@ -1,0 +1,95 @@
+"""Driver-side fault-injection harness (the test half; the worker-side
+tripwires live in daft_tpu/distributed/faults.py).
+
+Faults are armed entirely through the environment: WorkerProcess children
+inherit ``os.environ`` at spawn, so a test sets the ``DAFT_TPU_FAULT_*``
+variables (monkeypatch) BEFORE constructing the pool/runner and the chosen
+worker trips at the named point — no production code path changes per test.
+
+Helpers here cover the second half of the harness: acting on a LIVE worker
+process from the driver (kill -9 mid-query, SIGSTOP to simulate a hung host)
+and the polling/skip plumbing the recovery tests share.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+import uuid
+
+import pytest
+
+# SIGKILL/SIGSTOP semantics (and the multiprocessing fork/AF_UNIX worker
+# transport these tests drive) are POSIX-only; skip cleanly elsewhere.
+HAVE_POSIX_SIGNALS = (os.name == "posix" and hasattr(signal, "SIGKILL")
+                      and hasattr(signal, "SIGSTOP"))
+
+requires_fault_injection = pytest.mark.skipif(
+    not HAVE_POSIX_SIGNALS,
+    reason="fault injection needs POSIX kill/SIGSTOP semantics")
+
+
+def fault_env(point: str, mode: str = "kill", worker: str = "",
+              stage: str = "", once_dir: str = "") -> dict:
+    """The env-var set that arms one tripwire (see faults.py for the point
+    and mode vocabulary). ``once_dir`` non-empty adds a fresh once-file so
+    the fault fires at most ONCE across every worker process sharing it —
+    without it a regenerated map task re-trips forever."""
+    env = {"DAFT_TPU_FAULT_POINT": point, "DAFT_TPU_FAULT_MODE": mode}
+    if worker:
+        env["DAFT_TPU_FAULT_WORKER"] = worker
+    if stage:
+        env["DAFT_TPU_FAULT_STAGE"] = stage
+    if once_dir:
+        env["DAFT_TPU_FAULT_ONCE_FILE"] = os.path.join(
+            once_dir, f"fault-once-{uuid.uuid4().hex[:8]}")
+    return env
+
+
+def arm_fault(monkeypatch, point: str, mode: str = "kill", worker: str = "",
+              stage: str = "", once_dir: str = "") -> None:
+    """Arm a tripwire for every worker spawned AFTER this call (children
+    inherit os.environ). The driver process itself is immune: faults.py reads
+    DAFT_TPU_FAULT_POINT once at import, which for the driver happened before
+    the test set it."""
+    for k, v in fault_env(point, mode, worker=worker, stage=stage,
+                          once_dir=once_dir).items():
+        monkeypatch.setenv(k, v)
+
+
+def kill9(pool, worker_id: str) -> int:
+    """SIGKILL one live pool worker (the hard mid-query crash). Returns the
+    killed pid."""
+    pid = pool.workers[worker_id]._proc.pid
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def sigstop(pool, worker_id: str) -> int:
+    """SIGSTOP one live pool worker: the process neither exits nor EOFs its
+    connection — only the heartbeat-timeout detector can catch it. Returns
+    the stopped pid (SIGCONT or pool shutdown cleans it up)."""
+    pid = pool.workers[worker_id]._proc.pid
+    os.kill(pid, signal.SIGSTOP)
+    return pid
+
+
+def sigcont(pid: int) -> None:
+    try:
+        os.kill(pid, signal.SIGCONT)
+    except (OSError, ProcessLookupError):
+        pass
+
+
+def wait_until(predicate, timeout_s: float = 15.0, interval_s: float = 0.05,
+               what: str = "condition") -> None:
+    """Poll until predicate() is truthy; pytest.fail on timeout (recovery is
+    asynchronous — detection, requeue, and respawn all happen on the pool's
+    dispatcher thread)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    pytest.fail(f"timed out after {timeout_s}s waiting for {what}")

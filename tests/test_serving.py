@@ -423,7 +423,13 @@ def test_speculative_duplicate_dispatch_first_result_wins(tmp_path, monkeypatch)
         straggler = SubPlanTask.from_plan(
             "straggler",
             _scan_plan(_LatchTask(10, latch=str(tmp_path / "latch"),
-                                  delay=8.0)))
+                                  # wide margin: the duplicate ends the stage
+                                  # the moment it sees the latch, so a big
+                                  # delay costs nothing on the passing path —
+                                  # it only keeps a loaded machine (cold
+                                  # worker imports) from letting the stalled
+                                  # original finish first
+                                  delay=45.0)))
         results = pool.run_tasks(tasks + [straggler], stage_id="spec")
         assert set(results) == {"fast-0", "fast-1", "fast-2", "straggler"}
         assert all(r.rows == 10 for r in results.values())
