@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test test-mesh test-fault bench bench-mesh bench-serve bench-gate bench-compare
+.PHONY: test test-ai test-mesh test-fault bench bench-ai bench-mesh bench-serve bench-gate bench-compare
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -23,6 +23,18 @@ TIMEOUT_CMD := $(shell command -v timeout >/dev/null 2>&1 && echo "timeout -k 10
 test-fault:
 	$(TIMEOUT_CMD) env JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_fault_tolerance.py -q -p no:cacheprovider
+
+# Device-UDF tier suite: device-vs-host bit-parity, coalesced dispatches,
+# weight residency/pin safety, zero-overhead guard, plus the jax provider.
+test-ai:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_udf.py \
+		tests/test_jax_provider.py -q -p no:cacheprovider
+
+# AI pipeline capture on the device-UDF tier (bench.py ai_bench): seeded
+# encoder, embed + zero-shot classify + groupby count, bit-identical vs the
+# host-UDF path, zero repeat weight re-upload, coalesced super-batches.
+bench-ai:
+	env BENCH_SUITE=ai JAX_PLATFORMS=cpu $(PY) bench.py
 
 # In-mesh SPMD suite under 8 forced host devices (the MULTICHIP harness
 # environment): bit-exact mesh vs single-chip vs host parity, sharded

@@ -14,6 +14,14 @@ Environment knobs:
     BENCH_REPS=5          timed repetitions (best-of; tunnel jitter guard)
     BENCH_SUITE=tpcds     run the TPC-DS store-sales suite instead of TPC-H
                           (benchmarking/tpcds; default queries 3,7,19,42,52,55,96)
+    BENCH_SUITE=ai        run the multimodal/AI pipeline capture on the
+                          device-UDF tier: seeded encoder, scan text ->
+                          embed -> zero-shot classify -> groupby count,
+                          asserting device-vs-host bit-parity, zero repeat
+                          weight re-upload, and coalesced super-batches
+    BENCH_AI_ROWS=N       ai-suite corpus rows (default 4096)
+    BENCH_AI_BATCH_ROWS=N ai-suite scan batch rows (default 512 — multi-batch
+                          so the dispatch coalescer engages)
     BENCH_SHUFFLE=1       run the 2-worker shuffle microbench instead: a
                           socket-transport distributed groupby whose JSON
                           carries the wire/logical byte counters and the
@@ -68,9 +76,14 @@ if os.environ.get("BENCH_MESH"):
         os.environ["XLA_FLAGS"] = (
             _xla + " --xla_force_host_platform_device_count=8").strip()
 SUITE = os.environ.get("BENCH_SUITE", "tpch")
-_DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19", "tpcds": "3,7,19,33,42,52,55,56,96"}
+_DEFAULT_QUERIES = {"tpch": "1,3,4,5,6,10,12,14,19",
+                    "tpcds": "3,7,19,33,42,52,55,56,96",
+                    "ai": ""}  # the ai suite runs named pipelines, not numbered queries
+if SUITE not in _DEFAULT_QUERIES:
+    raise SystemExit(f"unknown BENCH_SUITE={SUITE!r} "
+                     f"(expected one of {sorted(_DEFAULT_QUERIES)})")
 QUERIES = [int(x) for x in os.environ.get(
-    "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",")]
+    "BENCH_QUERIES", _DEFAULT_QUERIES[SUITE]).split(",") if x]
 REPS = int(os.environ.get("BENCH_REPS", 5))
 
 
@@ -358,6 +371,121 @@ def serve_bench() -> None:
     }))
 
 
+def ai_bench() -> None:
+    """BENCH_SUITE=ai: the multimodal/AI pipeline capture on the device-UDF
+    tier (ops/udf_stage.py) — a seeded deterministic encoder runs scan text
+    -> embed -> zero-shot classify -> groupby count through the staged
+    device path, asserting:
+
+    - BIT-IDENTICAL results vs the host-UDF path (the classify pipeline is
+      argmax-decoded, so it is robust to coalescing's batch-shape changes;
+      the embed pipeline compares exactly on the single-dispatch shape);
+    - ZERO repeat weight re-upload (device_udf_weight_h2d_bytes flat across
+      the timed reps — weights are residency-managed, not per-query);
+    - device_udf_dispatches > 0 with coalesced super-batches
+      (coalesce_morsels_in > dispatch_coalesced over a multi-batch scan).
+
+    Reports rows/sec + per_query_ms in the --compare-compatible shape. CPU
+    CI invocation: ``BENCH_SUITE=ai JAX_PLATFORMS=cpu python bench.py``
+    (make bench-ai)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.functions.ai import classify_text, embed_text
+    from daft_tpu.ops import counters
+
+    n = int(os.environ.get("BENCH_AI_ROWS", 4096))
+    batch_rows = int(os.environ.get("BENCH_AI_BATCH_ROWS", 512))
+    labels = ["alpha topic", "beta topic", "gamma topic", "delta topic"]
+    words = [f"term{i}" for i in range(31)]
+    texts = [" ".join(words[(i * k) % len(words)] for k in (1, 3, 7))
+             for i in range(n)]
+    base = daft_tpu.from_pydict({"id": list(range(n)), "text": texts})
+    # multi-batch scan: the coalescer must see a morsel STREAM, not one slab
+    df = base.into_batches(batch_rows).collect()
+
+    def q_embed():
+        return df.select(col("id"),
+                         embed_text(col("text"), provider="jax").alias("e"))
+
+    def q_classify():
+        return (df.select(classify_text(col("text"), labels,
+                                        provider="jax").alias("label"))
+                  .groupby("label").agg(col("label").count().alias("n"))
+                  .sort("label"))
+
+    shapes = {"embed": q_embed, "classify_groupby": q_classify}
+    with execution_config_ctx(device_mode="on", device_min_rows=1,
+                              mesh_devices=1):
+        counters.reset()
+        # warmup: model load + weight h2d + jit compiles
+        for q in shapes.values():
+            q().to_pydict()
+        w_warm = counters.device_udf_weight_h2d_bytes
+        per_query = {name: float("inf") for name in shapes}
+        dev_out = {}
+        elapsed = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            for name, q in shapes.items():
+                tq = time.perf_counter()
+                dev_out[name] = q().to_pydict()
+                per_query[name] = min(per_query[name],
+                                      time.perf_counter() - tq)
+            elapsed = min(elapsed, time.perf_counter() - t0)
+        repeat_weight_h2d = counters.device_udf_weight_h2d_bytes - w_warm
+        metric_totals = {k: v for k, v in counters.snapshot().items() if v}
+    assert counters.device_udf_dispatches > 0, \
+        "device-UDF tier never dispatched — BENCH_SUITE=ai is not an ai capture"
+    assert repeat_weight_h2d == 0, \
+        f"repeat queries re-uploaded {repeat_weight_h2d} weight bytes — " \
+        "residency-managed weights broken"
+    morsels_in = metric_totals.get("coalesce_morsels_in", 0)
+    coalesced = metric_totals.get("dispatch_coalesced", 0)
+    assert morsels_in > coalesced > 0, \
+        f"no coalesced super-batches ({morsels_in} morsels -> {coalesced} dispatches)"
+
+    with execution_config_ctx(device_mode="off"):
+        host_out = {name: q().to_pydict() for name, q in shapes.items()}
+    # classify is argmax-decoded -> exact across batch shapes; embed floats
+    # are exact only when dispatch shapes match, so gate on classify
+    assert dev_out["classify_groupby"] == host_out["classify_groupby"], \
+        "device classify pipeline diverged from the host-UDF path"
+    embed_ok = dev_out["embed"] == host_out["embed"]
+
+    metric_totals["ai_repeat_weight_h2d_bytes"] = int(repeat_weight_h2d)
+    from daft_tpu.device.residency import manager as _residency
+
+    _res = _residency().stats()
+    for k in ("hbm_bytes_resident", "hbm_bytes_high_water", "hbm_entries"):
+        metric_totals[k] = _res[k]
+
+    rows_per_sec = n * len(shapes) / elapsed
+    print(json.dumps({
+        "metric": f"ai_{len(shapes)}q_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
+        "device_batches": int(metric_totals.get("device_udf_dispatches", 0)),
+        "per_query_ms": {name: round(per_query[name] * 1000, 1)
+                         for name in shapes},
+        "bit_identical": True,
+        "embed_bit_identical": bool(embed_ok),
+        "labels": len(labels),
+        "fact_rows": n,
+        "reps": REPS,
+        "metrics": metric_totals,
+    }))
+
+
 REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
 
 
@@ -433,6 +561,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_SERVE"):
         serve_bench()
+        return
+    if SUITE == "ai":
+        ai_bench()
         return
     if SUITE == "tpcds":
         from benchmarking.tpcds.datagen import load_dataframes

@@ -15,13 +15,22 @@ sources:
   reference's dummy/offline providers, but exercising the real device path).
 
 Batches pad to power-of-two buckets (the engine's static-shape convention) so
-the jit cache stays bounded; the routed UDF replica pool provides
-data-parallel scale-out (udf/expr.py prefix routing).
+the jit cache stays bounded.
+
+This provider sits on the DEVICE-UDF TIER (ops/udf_stage.py):
+``jax_embed_func``/``jax_classify_func`` return device Funcs the planner
+lowers to DeviceUdfProject stages — weights registered in the HBM residency
+manager under a content fingerprint (budgeted, evictable, pinned per query,
+heartbeat-digested; no private ``_params_dev`` allocations), morsels
+coalesced into super-batches, outputs fetched in one finalize d2h. The eager
+``embed_text``/``classify_text`` methods keep the provider-protocol surface
+and resolve weights through the same residency slot.
 """
 
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import Any, List, Optional
 
 import numpy as np
@@ -131,8 +140,11 @@ class JaxEncoderWeights:
                    cfg.num_attention_heads, max_len, tokenizer=tok)
 
 
-def _build_encoder(weights: JaxEncoderWeights):
-    """jit forward: (ids [B,L] i32, mask [B,L] f32) -> [B, dim] normalized."""
+def _encoder_fwd(weights: JaxEncoderWeights):
+    """The raw (unjitted) jax-traceable forward:
+    ``fwd(params, ids [B,L] i32, mask [B,L] f32) -> [B, dim] normalized``.
+    This is the function the device-UDF tier compiles — the provider's own
+    eager path jits the same object, so both run identical programs."""
     from ..utils import jax_setup  # noqa: F401
     import jax
     import jax.numpy as jnp
@@ -166,22 +178,72 @@ def _build_encoder(weights: JaxEncoderWeights):
         return pooled / jnp.maximum(
             jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
-    return jax.jit(fwd)
+    return fwd
+
+
+def _build_encoder(weights: JaxEncoderWeights):
+    """jit forward (legacy entry point; the tier uses _encoder_fwd raw)."""
+    import jax
+
+    return jax.jit(_encoder_fwd(weights))
 
 
 class JaxTextEmbedder:
-    """Text embedder running the encoder on the JAX device (TPU when present)."""
+    """Text embedder running the encoder on the JAX device (TPU when present).
+
+    Sits on the device-UDF tier (ops/udf_stage.py): weights live in the
+    process-wide HBM residency manager under a content fingerprint of the
+    weight bytes — budgeted, evictable, pinned per executing query, counted
+    in ``hbm_bytes_resident`` and heartbeat digests. No private device
+    allocations remain (the old ``_params_dev`` slot is gone). The
+    ``device_params``/``device_prepare`` hooks are the tier's contract;
+    ``embed_text`` keeps the eager provider-protocol surface."""
 
     def __init__(self, model_name: str):
         self.model_name = model_name
         self.weights = (JaxEncoderWeights.from_local_checkpoint(model_name)
                         or JaxEncoderWeights.seeded(model_name))
-        self._fwd = _build_encoder(self.weights)
-        self._params_dev = None
+        self._fwd = None        # lazy jit (dropped on pickle)
+        self._fwd_raw = None    # raw traceable forward (dropped on pickle)
+
+    def __getstate__(self):
+        # compiled programs and device buffers are process-local: ship only
+        # the host-side weights + identity (workers rebuild lazily)
+        state = dict(self.__dict__)
+        state["_fwd"] = None
+        state["_fwd_raw"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     @property
     def dimensions(self) -> int:
         return self.weights.dim
+
+    # ---- device-UDF tier hooks -----------------------------------------------------
+    def device_params(self):
+        """The weight pytree (host numpy) — the tier fingerprints its bytes
+        and registers the device copy in the residency manager."""
+        return self.weights.params
+
+    def device_prepare(self, texts: List[Optional[str]]):
+        """Host preprocess per morsel: tokenize (nulls tokenize as empty so
+        row alignment survives; the engine masks them back to None)."""
+        return self._tokenize(["" if t is None else t for t in texts])
+
+    def encoder_fn(self):
+        """The raw jax-traceable forward shared with the device tier."""
+        if self._fwd_raw is None:
+            self._fwd_raw = _encoder_fwd(self.weights)
+        return self._fwd_raw
+
+    def _resident_params(self):
+        """Device weight pytree via the residency manager (shared entry with
+        the device-UDF tier: one HBM slot per weight content per process)."""
+        from ..ops.udf_stage import _anchor_for_pytree, resident_params
+
+        return resident_params(_anchor_for_pytree(self.weights.params))
 
     def _tokenize(self, texts: List[str]):
         w = self.weights
@@ -209,9 +271,9 @@ class JaxTextEmbedder:
 
         if not texts:
             return []
-        if self._params_dev is None:  # weights go to HBM once
-            self._params_dev = jax.tree_util.tree_map(jnp.asarray,
-                                                      self.weights.params)
+        params = self._resident_params()  # HBM via the residency manager
+        if self._fwd is None:
+            self._fwd = _build_encoder(self.weights)
         ids, mask = self._tokenize(texts)
         n = len(texts)
         b = _pad_pow2(n)
@@ -221,27 +283,148 @@ class JaxTextEmbedder:
                                                   np.float32)])
             mask[n:, 0] = 1.0
         out = np.asarray(jax.device_get(
-            self._fwd(self._params_dev, jnp.asarray(ids), jnp.asarray(mask))))
+            self._fwd(params, jnp.asarray(ids), jnp.asarray(mask))))
         return [out[i] for i in range(n)]
+
+
+_DEFAULT_MODEL = "jax-minilm-seeded"
+
+# one embedder per model name per process (the device-UDF tier's "model loads
+# once per worker" contract; Func closures resolve through this cache so
+# pickled plans rebuild state lazily on the worker). Both caches FIFO-cap so
+# a long-lived serving process cycling models/label sets bounds its host RAM
+# — an evicted model reloads on next use (checkpoint/seeded rebuild), an
+# evicted label matrix re-embeds its labels.
+_EMBEDDERS: dict = {}
+_LABEL_MATRICES: dict = {}
+_EMBEDDERS_CAP = 8
+_LABEL_MATRICES_CAP = 128
+_PROVIDER_LOCK = threading.Lock()
+
+
+def _embedder_for(model_name: Optional[str]) -> JaxTextEmbedder:
+    name = model_name or _DEFAULT_MODEL
+    with _PROVIDER_LOCK:
+        e = _EMBEDDERS.get(name)
+    if e is not None:
+        return e
+    e = JaxTextEmbedder(name)  # model load outside the lock
+    with _PROVIDER_LOCK:
+        e = _EMBEDDERS.setdefault(name, e)
+        while len(_EMBEDDERS) > _EMBEDDERS_CAP:
+            _EMBEDDERS.pop(next(iter(_EMBEDDERS)))
+    return e
+
+
+def _label_matrix(embedder: JaxTextEmbedder, labels: List[str]) -> np.ndarray:
+    """Deterministic [n_labels, dim] float32 label-embedding matrix, cached
+    per (model, label tuple) process-wide — the classifier's label cache is
+    shared between the eager provider path and the device-UDF tier, so both
+    compare against bit-identical label vectors."""
+    key = (embedder.model_name, tuple(labels))
+    with _PROVIDER_LOCK:
+        lv = _LABEL_MATRICES.get(key)
+    if lv is None:
+        lv = np.stack(embedder.embed_text(list(labels))).astype(np.float32)
+        with _PROVIDER_LOCK:
+            lv = _LABEL_MATRICES.setdefault(key, lv)
+            while len(_LABEL_MATRICES) > _LABEL_MATRICES_CAP:
+                _LABEL_MATRICES.pop(next(iter(_LABEL_MATRICES)))
+    return lv
 
 
 class JaxTextClassifier:
     """Zero-shot-style classifier: cosine similarity between the text and
-    label embeddings in the shared encoder space."""
+    label embeddings in the shared encoder space (label matrix cached
+    deterministically per (model, labels) via _label_matrix)."""
 
     def __init__(self, model_name: str):
         self.embedder = JaxTextEmbedder(model_name)
-        self._label_cache: dict = {}
 
     def classify_text(self, texts: List[str], labels: List[str]) -> List[str]:
-        key = tuple(labels)
-        if key not in self._label_cache:
-            self._label_cache[key] = np.stack(self.embedder.embed_text(list(labels)))
-        lv = self._label_cache[key]
+        lv = _label_matrix(self.embedder, labels)
         tv = np.stack(self.embedder.embed_text(texts)) if texts else \
             np.zeros((0, lv.shape[1]), np.float32)
         picks = (tv @ lv.T).argmax(axis=1) if len(tv) else []
         return [labels[int(i)] for i in picks]
+
+
+# ======================================================================================
+# Device-UDF tier entry points (ops/udf_stage.py): embed/classify as device Funcs
+# ======================================================================================
+
+
+def jax_embed_func(model: Optional[str] = None, batch_size: Optional[int] = None):
+    """A device Func embedding a text column on the engine's own accelerator:
+    ``fn(params, ids, mask) -> [n, dim]`` through the staged device-UDF tier
+    (weights resident via the residency manager, coalesced dispatches, host
+    tokenization per morsel). The host fallback runs the SAME compiled
+    program eagerly — identical semantics."""
+    from ..datatype import DataType
+    from ..udf.udf import Func
+
+    name = model or _DEFAULT_MODEL
+
+    def fn(params, ids, mask):
+        return _embedder_for(name).encoder_fn()(params, ids, mask)
+
+    def params():
+        return _embedder_for(name).device_params()
+
+    def prepare(texts):
+        return _embedder_for(name).device_prepare(texts)
+
+    def finish(out):
+        return [list(map(float, row)) for row in out]
+
+    return Func(fn=fn, return_dtype=DataType.list(DataType.float32()),
+                is_batch=True, on_device=True, device_params=params,
+                device_prepare=prepare, device_finish=finish,
+                batch_size=batch_size, name=f"jax_embed[{name}]",
+                device_key=f"jax_embed:{name}")
+
+
+def jax_classify_func(labels: List[str], model: Optional[str] = None,
+                      batch_size: Optional[int] = None):
+    """A device Func for zero-shot classification: the encoder forward plus
+    the label-similarity argmax run in ONE compiled program; only the int32
+    winner codes come back (d2h ∝ rows, never rows x dim), decoded to label
+    strings on host. The weight pytree is SPLIT-anchored: "enc" resolves to
+    the encoder's content anchor — shared with jax_embed_func and every
+    other label set over the same model, so one HBM copy of the encoder per
+    process — and "lab" is its own small content-keyed entry (identical
+    label sets share it deterministically)."""
+    from ..datatype import DataType
+    from ..udf.udf import Func
+
+    name = model or _DEFAULT_MODEL
+    labels = list(labels)
+
+    def fn(params, ids, mask):
+        import jax.numpy as jnp
+
+        emb = _embedder_for(name).encoder_fn()(params["enc"], ids, mask)
+        return jnp.argmax(emb @ params["lab"].T, axis=1).astype(jnp.int32)
+
+    def params():
+        e = _embedder_for(name)
+        return {"enc": e.device_params(), "lab": _label_matrix(e, labels)}
+
+    def prepare(texts):
+        return _embedder_for(name).device_prepare(texts)
+
+    def finish(out):
+        return [labels[int(i)] for i in out]
+
+    import hashlib as _hashlib
+
+    lab_tag = _hashlib.blake2b(
+        "\x00".join(labels).encode(), digest_size=6).hexdigest()
+    return Func(fn=fn, return_dtype=DataType.string(), is_batch=True,
+                on_device=True, device_params=params, device_params_split=True,
+                device_prepare=prepare, device_finish=finish,
+                batch_size=batch_size, name=f"jax_classify[{name}]",
+                device_key=f"jax_classify:{name}:{lab_tag}")
 
 
 class JaxProvider(Provider):

@@ -49,6 +49,62 @@ from ..observability.metrics import registry
 _MANIFEST = "MANIFEST.json"
 
 
+# ======================================================================================
+# Checkpoint GC (age-based sweep)
+# ======================================================================================
+
+def _ttl_seconds() -> float:
+    """DAFT_TPU_CHECKPOINT_TTL_S: max age of a query's checkpoint tree before
+    the sweep removes it. <= 0 / unset = GC disabled (the pre-GC behavior:
+    committed stages accumulate until manually cleared)."""
+    try:
+        return float(os.environ.get("DAFT_TPU_CHECKPOINT_TTL_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+def sweep_expired(root: str, ttl_s: Optional[float] = None,
+                  now: Optional[float] = None, skip: Optional[str] = None) -> int:
+    """Remove query checkpoint trees older than the TTL; returns the number
+    of COMMITTED stages garbage-collected (``checkpoint_stages_gced``).
+
+    Age is the query directory's mtime — every commit rewrites content
+    inside it (staging dir create + os.replace), refreshing the mtime, so an
+    actively checkpointing query is never reaped mid-run; ``skip`` protects
+    the opening query's own tree regardless of age (resume of an old plan
+    must not GC the checkpoints it came to read). Sweeps run on store open
+    and after each commit; errors are swallowed per the store's advisory
+    discipline (a GC failure must never fail a query)."""
+    ttl = _ttl_seconds() if ttl_s is None else ttl_s
+    if ttl <= 0 or not os.path.isdir(root):
+        return 0
+    import time
+
+    now = time.time() if now is None else now
+    gced = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    for name in names:
+        if name == skip:
+            continue
+        path = os.path.join(root, name)
+        try:
+            if not os.path.isdir(path) or now - os.path.getmtime(path) <= ttl:
+                continue
+            stages = 0
+            for dirpath, _dirnames, filenames in os.walk(path):
+                stages += sum(1 for f in filenames if f.endswith(".committed"))
+            shutil.rmtree(path, ignore_errors=True)
+            gced += stages
+        except OSError:
+            continue
+    if gced:
+        registry().inc("checkpoint_stages_gced", gced)
+    return gced
+
+
 def _link_or_copy(src: str, dst: str) -> None:
     try:
         os.link(src, dst)
@@ -152,6 +208,10 @@ class StageCheckpointer:
     def __init__(self, root: str, query_fp: str):
         self.root = os.path.join(root, query_fp)
         self.query_fp = query_fp
+        self._gc_root = root
+        # store open sweeps expired sibling query trees (never our own —
+        # resume must be able to read the checkpoints it opened for)
+        sweep_expired(root, skip=query_fp)
 
     # ---- paths ---------------------------------------------------------------------
     def _payload(self, key: str) -> str:
@@ -177,6 +237,16 @@ class StageCheckpointer:
         with open(tmp, "w") as f:
             f.write("")
         os.replace(tmp, self._marker(key))
+        try:
+            # commits land in NESTED stage dirs, which need not refresh the
+            # query dir's own mtime — touch it so the age the sweep reads
+            # really is "time since this query last checkpointed"
+            os.utime(self.root)
+        except OSError:
+            pass
+        # commit-time sweep: long-lived deployments GC as they go instead of
+        # only at store open (the ROADMAP fault-tolerance follow-up)
+        sweep_expired(self._gc_root, skip=self.query_fp)
 
     # ---- shuffle stages ------------------------------------------------------------
     def commit_shuffle(self, key: str, shuffle_dir: str, shuffle_id: str,

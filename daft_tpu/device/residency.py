@@ -72,7 +72,7 @@ import sys
 import threading
 import weakref
 from collections import OrderedDict
-from typing import Any, Callable, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 from ..observability.metrics import registry
 
@@ -208,6 +208,52 @@ def device_nbytes(value) -> int:
                 except Exception:
                     pass
     return total
+
+
+# ---- pin-scope observation (serving admission calibration) -------------------------
+
+# Pin scopes open on whichever thread DRIVES a device stage — the session
+# worker for simple plans, but usually a spawn_stage producer thread — so the
+# observation handle lives in a module-level thread-local that
+# pipeline.spawn_stage propagates to stage threads exactly like the ambient
+# stats collector. One _PinObservation per observed query; stage threads are
+# per-query (never pooled), so concurrent queries' scopes can't cross-note.
+_OBS_TL = threading.local()
+
+
+class _PinObservation:
+    """Pinned-byte high-water across every pin scope of one query.
+
+    A plan can hold SEVERAL scopes open at once (pipelined device stages on
+    separate stage threads), so each exiting scope notes the sum over ALL of
+    the observation's currently-open scopes — max-of-individual-scopes would
+    under-state concurrent demand and mis-calibrate admission packing.
+    ``open_scopes`` maps id(pinned set) -> pinned set; entries are added at
+    scope entry (CPython dict set is atomic) and summed/removed under the
+    manager lock at scope exit."""
+
+    __slots__ = ("high_water", "open_scopes")
+
+    def __init__(self) -> None:
+        self.high_water = 0
+        self.open_scopes: Dict[int, set] = {}
+
+    def note(self, nbytes: int) -> None:
+        if nbytes > self.high_water:
+            self.high_water = nbytes
+
+
+def current_pin_observation() -> Optional["_PinObservation"]:
+    """This thread's active observation handle (None = not observing)."""
+    return getattr(_OBS_TL, "obs", None)
+
+
+def set_pin_observation(obs: Optional["_PinObservation"]) -> None:
+    """Install `obs` as this thread's observation handle (stage threads call
+    this with the handle captured at spawn time; None is a cheap no-op so
+    unobserved pipelines pay nothing)."""
+    if obs is not None:
+        _OBS_TL.obs = obs
 
 
 # ---- the manager -------------------------------------------------------------------
@@ -384,16 +430,50 @@ class ResidencyManager:
             scopes = self._tl.scopes = []
         pinned: set = set()
         scopes.append(pinned)
+        obs = current_pin_observation()
+        if obs is not None:
+            # under the manager lock: concurrent scope EXITS iterate
+            # open_scopes under that lock, and a bare dict insert mid-
+            # iteration would raise (failing the query before its pins
+            # decrement — permanently pinned HBM)
+            with self._lock:
+                obs.open_scopes[id(pinned)] = pinned
         try:
             yield self
         finally:
             scopes.pop()
             with self._lock:
+                if obs is not None:
+                    # admission calibration (serving/prepared.py): record the
+                    # pinned bytes across ALL of the query's open scopes (this
+                    # one included) so fingerprint-derived upper-bound
+                    # reservations shrink toward observed CONCURRENT demand
+                    keys = set().union(*obs.open_scopes.values())
+                    obs.note(sum(
+                        e.nbytes for k in keys
+                        if (e := self._entries.get(k)) is not None))
+                    obs.open_scopes.pop(id(pinned), None)
                 for k in pinned:
                     e = self._entries.get(k)
                     if e is not None and e.pins > 0:
                         e.pins -= 1
                 self._evict_over_budget()
+
+    @contextlib.contextmanager
+    def observe_pins(self):
+        """Observe the pinned-byte high-water of every pin scope this query
+        opens inside the context — on this thread AND on the stage threads
+        its pipeline spawns (spawn_stage propagates the handle alongside the
+        ambient stats collector, so the device stages' scopes are seen even
+        though they run on producer threads). Yields a zero-arg callable
+        returning the high-water so far; zero cost when not observing —
+        pin_scope only sums bytes when a handle is installed."""
+        prev = getattr(_OBS_TL, "obs", None)
+        obs = _OBS_TL.obs = _PinObservation()
+        try:
+            yield lambda: obs.high_water
+        finally:
+            _OBS_TL.obs = prev
 
     def _pin(self, full_key: tuple, e: _Entry) -> None:
         scopes = getattr(self._tl, "scopes", None)

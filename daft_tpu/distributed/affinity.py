@@ -18,6 +18,9 @@ Mirrored slot shapes (must track the executors' registration sites):
   bucket = pad_bucket(batch rows)).
 - ``("dictcodes", bucket)`` — grouped_stage.cached_dict_code_plane group-key
   dictionary planes (dict-keyed stages only).
+- ``("udf_params",)`` — device-UDF model weight pytrees (ops/udf_stage.py),
+  content-fingerprinted over the weight bytes so embedding sub-plans route
+  to workers already holding the model warm.
 
 Join-stage slots (index planes, packed dim matrices) are identity-dependent
 (non-empty deps) and never rebind across processes, so they are deliberately
@@ -46,19 +49,39 @@ def plan_fingerprint(plan) -> Tuple[Tuple[int, int], ...]:
     try:
         device_nodes = [
             n for n in plan.walk()
-            if isinstance(n, (pp.DeviceGroupedAgg, pp.DeviceFilterAgg))
+            if isinstance(n, (pp.DeviceGroupedAgg, pp.DeviceFilterAgg,
+                              pp.DeviceUdfProject))
         ]
         if not device_nodes:
             return ()
         slots: Dict[int, int] = {}
         for node in device_nodes:
-            _node_slots(node, slots)
+            if isinstance(node, pp.DeviceUdfProject):
+                _udf_slots(node, slots)
+            else:
+                _node_slots(node, slots)
             if len(slots) >= MAX_FINGERPRINT_SLOTS:
                 break
         items = list(slots.items())[:MAX_FINGERPRINT_SLOTS]
         return tuple(items)
     except Exception:  # noqa: BLE001 — advisory: never fail task creation
         return ()
+
+
+def _udf_slots(node, slots: Dict[int, int]) -> None:
+    """The model-weight slots of a DeviceUdfProject: each part's
+    content-derived key equals the key a worker registered when it uploaded
+    the same weights (ops/udf_stage.py weight_slots), so repeat embedding
+    sub-plans score onto workers whose HBM already holds the model warm.
+    Loading the weights here is a once-per-process cost (the same load any
+    execution pays)."""
+    call = pp.device_udf_call(node.udf_expr)
+    if call is None:
+        return
+    from ..ops.udf_stage import weight_slots
+
+    for sk, est in weight_slots(call.func):
+        slots[sk] = est
 
 
 def _node_slots(node, slots: Dict[int, int]) -> None:

@@ -99,8 +99,8 @@ class Partitioned:
     partitioned_by: Optional[Tuple[str, ...]] = None
 
 
-_MAP_NODES = (pp.Project, pp.PhysFilter, pp.UDFProject, pp.PhysExplode,
-              pp.PhysUnpivot, pp.PhysSample)
+_MAP_NODES = (pp.Project, pp.PhysFilter, pp.UDFProject, pp.DeviceUdfProject,
+              pp.PhysExplode, pp.PhysUnpivot, pp.PhysSample)
 _SUPPORTED = _MAP_NODES + (pp.InMemoryScan, pp.TaskScan, pp.HashJoin,
                            pp.HashAggregate, pp.PhysRepartition, pp.Dedup,
                            pp.DeviceGroupedAgg)

@@ -161,6 +161,10 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
             yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
         return
 
+    if isinstance(node, pp.DeviceUdfProject):
+        yield from _exec_device_udf(node)
+        return
+
     if isinstance(node, pp.PhysFilter):
         yield from _map_op(_exec(node.input),
                            lambda part, _i: _filter_part(part, node.predicate,
@@ -459,6 +463,16 @@ def _exec_device_agg(node) -> MicroPartition:
 
     cfg = execution_config()
     grouped = isinstance(node, pp.DeviceGroupedAgg)
+    if (not grouped and cfg.device_mode == "on"
+            and _unwrap_udf_agg_input(node.input)[0] is not None):
+        # device-UDF -> device-agg fusion: the UDF's output plane feeds the
+        # agg program on device with no intermediate d2h (the split rule's
+        # rename Project between the two is seen through). Qualification
+        # failures return None before any input executes; grouped stages run
+        # unfused (keys factorize on host anyway).
+        fused = _try_fused_udf_agg(node, cfg)
+        if fused is not None:
+            return fused
     stream = _exec(node.input)
 
     use_device = cfg.device_mode == "on"
@@ -558,6 +572,256 @@ def _exec_device_agg(node) -> MicroPartition:
         final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
+        f = node.schema[name]
+        cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
+    out = RecordBatch(node.schema, cols, 1)
+    return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
+
+
+def _exec_device_udf(node) -> Iterator[MicroPartition]:
+    """Run a DeviceUdfProject (ops/udf_stage.py): the staged device-UDF tier,
+    or the plain batch-UDF host path with identical semantics.
+
+    Device when device_mode == "on", or "auto" on a real accelerator when
+    ``device_udf_cost`` (model flops at the device rate + per-morsel input
+    h2d + RTT divided by the coalesce horizon; weights amortized to zero via
+    residency) beats the host flop rate — cached per (fn fingerprint, batch
+    layout) under the usual decision-cache discipline. The device path feeds
+    the stage through the DispatchCoalescer (super-batches at the configured
+    fill target, capped by Func.batch_size), pins weights for the query via
+    the residency pin scope, and d2h's every output in one finalize fetch.
+    """
+    from ..config import execution_config
+    from ..ops import counters as _counters
+
+    cfg = execution_config()
+    call = pp.device_udf_call(node.udf_expr)
+    stream = _exec(node.input)
+
+    def _host(s):
+        exprs = list(node.passthrough) + [node.udf_expr]
+        for part in s:
+            batches = [eval_projection(b, exprs) for b in part.batches]
+            yield MicroPartition(node.schema,
+                                 batches or [RecordBatch.empty(node.schema)])
+
+    if call is None or cfg.device_mode == "off":
+        yield from _host(stream)
+        return
+    if cfg.device_mode == "auto":
+        import jax
+
+        if jax.default_backend() in ("cpu",):
+            _counters.reject("cost", "device udf: cpu backend")
+            _counters.bump("device_udf_fallbacks")
+            yield from _host(stream)
+            return
+        first = next(stream, None)
+        if first is None:
+            yield MicroPartition.empty(node.schema)
+            return
+        stream = itertools.chain([first], stream)
+        from ..ops.udf_stage import func_fingerprint
+
+        dk = ("udf", func_fingerprint(call.func), cfg.device_mode,
+              cfg.batch_fill_target, cfg.morsel_size_rows,
+              _batch_layout(first))
+        wins = _DECISION_CACHE.get(dk)
+        if wins is None:
+            wins = _udf_device_wins(call.func, first,
+                                    _coalesce_horizon([first]))
+            _DECISION_CACHE.put(dk, wins)
+        if not wins:
+            _counters.reject("cost", "device udf: host wins cost model")
+            _counters.bump("device_udf_fallbacks")
+            yield from _host(stream)
+            return
+    yield _run_device_udf_stage(node, call, stream, cfg)
+
+
+def _udf_device_wins(func, first: MicroPartition, coal: float) -> bool:
+    """Cost decision for one device-UDF stage. The flops estimate is coarse
+    (2 x weight scalars per row — a dense forward's order of magnitude); both
+    sides use the same estimate, so the verdict hangs on the measured rates,
+    the per-morsel input upload, and the coalesce-amortized RTT. Weight
+    upload is priced at zero: it is a residency-managed one-time investment
+    (flat across repeats), exactly like resident column planes."""
+    from ..ops import costmodel
+    from ..ops.udf_stage import func_weight_nbytes
+
+    cal = costmodel.calibrate()
+    rows = first.num_rows
+    w_nbytes = func_weight_nbytes(func)  # loads the model once per process
+    w_scalars = (w_nbytes // 4) if w_nbytes else 1 << 20
+    flops = 2.0 * w_scalars * rows
+    in_bytes = rows * 1024        # tokenized ids+mask order of magnitude
+    fetch_bytes = rows * 512      # output rows (embedding dim order)
+    dev = costmodel.device_udf_cost(cal, rows, in_bytes, flops, fetch_bytes,
+                                    coalesce=coal)
+    host = costmodel.host_udf_cost(cal, flops)
+    return dev < host
+
+
+def _run_device_udf_stage(node, call, stream, cfg) -> MicroPartition:
+    """Drive one DeviceUdfProject on the device tier: coalesced dispatch-only
+    feeds under a residency pin scope, one finalize d2h, output assembled as
+    passthrough columns + the decoded UDF column. A runtime DeviceFallback
+    (misaligned prepare output, non-array result) reruns the buffered stream
+    on the host path — results identical, fallback counted."""
+    from ..core.series import Series
+    from ..device.residency import manager as _residency
+    from ..observability.runtime_stats import current_collector
+    from ..ops import counters as _counters
+    from ..ops.grouped_stage import DeviceFallback
+    from ..ops.udf_stage import (_finish_values, build_device_udf_stage,
+                                 func_weight_nbytes)
+
+    func = call.func
+    out_name = node.udf_expr.name()
+    stage = build_device_udf_stage(func, call.args, out_name)
+    buffered: List[MicroPartition] = []
+    try:
+        with _residency().pin_scope():
+            run = stage.start_run()
+            coal = _make_coalescer(run.feed_batch, cfg)
+            feed = coal.add if coal is not None else run.feed_batch
+            for part in stream:
+                buffered.append(part)
+                for b in part.batches:
+                    if b.num_rows:
+                        feed(b)
+            if coal is not None:
+                coal.close()
+            out, valid = run.finalize()
+    except DeviceFallback as e:
+        _counters.bump("device_udf_fallbacks")
+        _counters.reject("runtime", "device udf: fallback", str(e))
+        exprs = list(node.passthrough) + [node.udf_expr]
+        batches = [eval_projection(b, exprs)
+                   for p in itertools.chain(buffered, stream)
+                   for b in p.batches]
+        return MicroPartition(node.schema,
+                              batches or [RecordBatch.empty(node.schema)])
+    c = current_collector()
+    if c is not None:
+        mb = func_weight_nbytes(func) / 1e6
+        c.annotate(node, f"device udf: {func.name}, weights {mb:.1f}MB resident")
+    big = _concat_parts(buffered, node.input.schema)
+    vals = _finish_values(func, out, valid)
+    f = node.schema[out_name]
+    udf_col = Series.from_pylist(vals, f.name, dtype=f.dtype)
+    cols = [eval_expression(big, e) for e in node.passthrough] + [udf_col]
+    out_batch = RecordBatch(node.schema, cols, big.num_rows)
+    return MicroPartition(node.schema, [out_batch.cast_to_schema(node.schema)])
+
+
+def _unwrap_udf_agg_input(agg_input):
+    """(udf_node, rename) when `agg_input` is a DeviceUdfProject — possibly
+    under a pure rename/selection Project (the split-UDF rule always leaves
+    one: Project([col(__udf__x).alias(x), ...]) over the UDFProject).
+    `rename` maps each agg-visible column name to its source name in the UDF
+    node's OUTPUT schema. (None, None) when the shape doesn't match."""
+    from ..expressions.expressions import Alias
+
+    if isinstance(agg_input, pp.DeviceUdfProject):
+        return agg_input, {c: c for c in agg_input.schema.column_names()}
+    if isinstance(agg_input, pp.Project) \
+            and isinstance(agg_input.input, pp.DeviceUdfProject):
+        rename = {}
+        for e in agg_input.projection:
+            ref = e.child if isinstance(e, Alias) else e
+            if not isinstance(ref, ColumnRef):
+                return None, None
+            rename[e.name()] = ref.name()
+        return agg_input.input, rename
+    return None, None
+
+
+def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
+    """Fuse a DeviceUdfProject feeding a DeviceFilterAgg: each coalesced
+    batch dispatches the UDF program and hands its OUTPUT device plane
+    straight into the agg program's column dict (ops/udf_stage.py
+    FusedUdfAggFeeder) — the score column never round-trips to host between
+    the stages. Engages under device_mode="on" for scalar-numeric UDF
+    outputs; every qualification failure returns None BEFORE any input
+    executes, so the caller's unfused path starts clean."""
+    from ..core.series import Series
+    from ..device.residency import manager as _residency
+    from ..observability.runtime_stats import current_collector
+    from ..ops import counters as _counters
+    from ..ops.grouped_stage import DeviceFallback
+    from ..ops.stage import try_build_filter_agg_stage
+
+    udf_node, rename = _unwrap_udf_agg_input(node.input)
+    if udf_node is None:
+        return None
+    call = pp.device_udf_call(udf_node.udf_expr)
+    if call is None:
+        return None
+    internal = udf_node.udf_expr.name()
+    agg_stage = try_build_filter_agg_stage(node.input.schema, node.predicate,
+                                           node.aggregations)
+    if agg_stage is None:
+        return None
+    # split the agg program's columns into the UDF output plane(s) and the
+    # passthrough columns, mapping agg-visible names to UDF-input sources
+    udf_plane_names = [c for c in agg_stage._input_cols
+                       if rename.get(c) == internal]
+    other = {c: rename.get(c, c) for c in agg_stage._input_cols
+             if rename.get(c) != internal}
+    if not udf_plane_names:
+        return None  # the agg never reads the UDF output: nothing to fuse
+    if not all(node.input.schema[c].dtype.is_numeric()
+               for c in udf_plane_names):
+        return None  # only scalar planes slot into the agg program
+    in_cols = set(udf_node.input.schema.column_names())
+    if not all(src in in_cols for src in other.values()):
+        return None
+    from ..ops.udf_stage import FusedUdfAggFeeder, build_device_udf_stage
+
+    udf_stage = build_device_udf_stage(call.func, call.args, internal)
+    agg_run = agg_stage.start_run()
+    in_stream = _exec(udf_node.input)
+    buffered: List[MicroPartition] = []
+    try:
+        with _residency().pin_scope():
+            udf_run = udf_stage.start_run()
+            feeder = FusedUdfAggFeeder(udf_run, agg_run, udf_plane_names,
+                                       other, f32=not agg_stage._use_f64)
+            coal = _make_coalescer(feeder.feed_batch, cfg)
+            feed = coal.add if coal is not None else feeder.feed_batch
+            for part in in_stream:
+                buffered.append(part)
+                for b in part.batches:
+                    if b.num_rows:
+                        feed(b)
+            if coal is not None:
+                coal.close()
+            final = agg_run.finalize()
+    except DeviceFallback as e:
+        _counters.bump("device_udf_fallbacks")
+        _counters.reject("runtime", "fused device udf: fallback", str(e))
+        exprs = list(udf_node.passthrough) + [udf_node.udf_expr]
+
+        def _udf_parts():
+            for p in itertools.chain(buffered, in_stream):
+                bs = [eval_projection(b, exprs) for b in p.batches]
+                if node.input is not udf_node:  # reapply the rename Project
+                    bs = [eval_projection(b, node.input.projection) for b in bs]
+                yield MicroPartition(node.input.schema,
+                                     bs or [RecordBatch.empty(node.input.schema)])
+
+        s = _udf_parts()
+        if node.predicate is not None:
+            s = (_filter_part(p, node.predicate) for p in s)
+        host = _two_phase_agg(node.input, [], node.aggregations,
+                              ungrouped=True, stream=s)
+        return MicroPartition(node.schema, [host.cast_to_schema(node.schema)])
+    c = current_collector()
+    if c is not None:
+        c.annotate(node, f"fused device udf: {call.func.name}")
+    cols = []
+    for name, _agg in agg_stage.aggs:
         f = node.schema[name]
         cols.append(Series.from_pylist([final[name]], f.name, dtype=f.dtype))
     out = RecordBatch(node.schema, cols, 1)

@@ -135,15 +135,20 @@ def spawn_stage(gen: Iterator, maxsize: int = 4, node=None) -> Iterator:
     built but never iterated (caller bails before next()) must not leak
     producer threads — the channel's cancel flag is only ever set by the
     consumer iterator, which would otherwise never run."""
+    from ..device.residency import current_pin_observation, set_pin_observation
     from ..observability.runtime_stats import current_collector, set_collector
 
     collector = current_collector()
+    # serving admission calibration: device pin scopes open on THIS stage
+    # thread, so the observing query's handle rides along like the collector
+    pin_obs = current_pin_observation()
     profile = (collector, collector.node_id(node)) \
         if collector is not None and node is not None else None
     ch = Channel(maxsize, profile=profile)
 
     def run():
         set_collector(collector)
+        set_pin_observation(pin_obs)
         err: Optional[BaseException] = None
         try:
             for item in gen:

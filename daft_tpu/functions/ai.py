@@ -19,7 +19,21 @@ def _batch_func(fn, name: str, return_dtype: DataType, max_concurrency=None,
 
 def embed_text(expr: Expression, provider: str = "transformers",
                model: Optional[str] = None, **options) -> Expression:
-    """Embed a text column via the named provider; model loads lazily per worker."""
+    """Embed a text column via the named provider; model loads lazily per worker.
+
+    ``provider="jax"`` returns a DEVICE UDF (ops/udf_stage.py): the encoder
+    runs as a staged device dispatch with weights resident in HBM, and the
+    planner can fuse it into downstream device stages. Other providers stay
+    plain host batch UDFs."""
+    if provider == "jax":
+        from ..ai.jax_provider import jax_embed_func
+
+        batch_size = options.pop("batch_size", None)
+        if options:
+            raise TypeError(
+                f"embed_text(provider='jax') got unsupported options "
+                f"{sorted(options)}; the device tier accepts batch_size only")
+        return jax_embed_func(model, batch_size=batch_size)(expr)
     from ..ai.provider import get_provider
     from ..core.series import Series
 
@@ -40,6 +54,18 @@ def embed_text(expr: Expression, provider: str = "transformers",
 
 def classify_text(expr: Expression, labels: List[str], provider: str = "dummy",
                   model: Optional[str] = None, **options) -> Expression:
+    """Zero-shot classify a text column. ``provider="jax"`` runs encoder +
+    label argmax as ONE device-UDF program (only int32 winner codes leave
+    the device); other providers stay host batch UDFs."""
+    if provider == "jax":
+        from ..ai.jax_provider import jax_classify_func
+
+        batch_size = options.pop("batch_size", None)
+        if options:
+            raise TypeError(
+                f"classify_text(provider='jax') got unsupported options "
+                f"{sorted(options)}; the device tier accepts batch_size only")
+        return jax_classify_func(labels, model, batch_size=batch_size)(expr)
     from ..ai.provider import get_provider
     from ..core.series import Series
 

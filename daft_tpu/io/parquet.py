@@ -21,8 +21,15 @@ from ..schema import Schema
 from .paths import expand_paths
 from .scan import Pushdowns, ScanOperator, ScanTask
 
-# target rows per emitted MicroPartition batch chunk
-_MORSEL_ROWS = 128 * 1024
+def _scan_batch_rows() -> int:
+    """Target rows per emitted MicroPartition batch chunk — the config's
+    morsel_size_rows (read at READ time, not plan time, so DAFT_TPU_MORSEL_SIZE
+    and batching-strategy resizes reach scan-fed pipelines). Was a hardcoded
+    128Ki that silently ignored the knob (PR 4 unified the executor's
+    partial-agg splitter; this closes the scan side)."""
+    from ..config import execution_config
+
+    return max(execution_config().morsel_size_rows, 1)
 
 
 class ParquetScanOperator(ScanOperator):
@@ -174,7 +181,7 @@ def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
             # False for remote tasks)
             pf = pq.ParquetFile(open_input(path))
             produced = 0
-            for rb in pf.iter_batches(batch_size=_MORSEL_ROWS, columns=columns):
+            for rb in pf.iter_batches(batch_size=_scan_batch_rows(), columns=columns):
                 if limit is not None and produced >= limit:
                     return
                 t = pa.Table.from_batches([rb])
@@ -187,7 +194,8 @@ def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
 
     def read():
         ds = pads.dataset(path, format="parquet")
-        scanner = ds.scanner(columns=columns, filter=arrow_filter, batch_size=_MORSEL_ROWS)
+        scanner = ds.scanner(columns=columns, filter=arrow_filter,
+                             batch_size=_scan_batch_rows())
         produced = 0
         for rb in scanner.to_batches():
             if limit is not None and produced >= limit:

@@ -61,6 +61,11 @@ class Calibration:
     # single-chip call sites can construct a Calibration without mesh terms.
     ici_bytes_per_s: float = 4.5e10  # per-link ICI collective bandwidth
     mesh_dispatch_s: float = 2e-3    # extra fixed cost of a multi-device dispatch
+    # device-UDF tier (ops/udf_stage.py): model-forward throughput on the
+    # accelerator vs the host. Coarse flop-rate constants (the decision only
+    # needs to be right within ~2x); defaulted so old call sites construct.
+    udf_device_flops_per_s: float = 2e11
+    udf_host_flops_per_s: float = 5e9
 
 
 _CAL: Optional[Calibration] = None
@@ -133,6 +138,8 @@ def calibrate() -> Calibration:
         # mesh must WIN real compute before paying its launch premium
         ici_bytes_per_s=_env_f("DAFT_TPU_COST_ICI", 4.5e10),
         mesh_dispatch_s=_env_f("DAFT_TPU_COST_MESH_DISPATCH", 2e-3),
+        udf_device_flops_per_s=_env_f("DAFT_TPU_COST_UDF_FLOPS", 2e11),
+        udf_host_flops_per_s=_env_f("DAFT_TPU_COST_UDF_HOST_FLOPS", 5e9),
     )
     return _CAL
 
@@ -292,6 +299,25 @@ def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
         c += (rows * logn / cal.mm_plane_rows_per_s
               + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
     return c
+
+
+def device_udf_cost(cal: Calibration, rows: int, h2d_bytes: int, flops: float,
+                    fetch_bytes: int, coalesce: float = 1.0) -> float:
+    """One device-UDF stage run: the (coalesce-amortized) dispatch round trip
+    + per-morsel input uploads (token ids / masks — derived arrays, never
+    resident) + the model forward at the device flop rate + the finalize
+    fetch of the output rows. Weight uploads are absent on purpose: they are
+    residency-managed one-time investments (flat across repeat queries), so
+    pricing them per run would mis-reject every warm repeat."""
+    return (cal.rtt_s / max(coalesce, 1.0)
+            + h2d_bytes / cal.h2d_bytes_per_s
+            + flops / cal.udf_device_flops_per_s
+            + fetch_bytes / cal.d2h_bytes_per_s)
+
+
+def host_udf_cost(cal: Calibration, flops: float) -> float:
+    """The same model forward on the host path (today's plain batch UDF)."""
+    return flops / cal.udf_host_flops_per_s
 
 
 def host_join_agg_cost(cal: Calibration, rows: int, n_dims: int, n_aggs: int,

@@ -31,6 +31,12 @@ COUNTER_NAMES = (
     "mesh_capacity_growths",   # mesh group-table capacity grown mid-run (recompile)
     "device_join_batches",     # batches through the gather-join device stages
     "device_topn_runs",        # join+agg+TopN fused device programs completed
+    # device-UDF tier (ops/udf_stage.py): jax-traceable model UDFs as stages
+    "device_udf_dispatches",   # compiled UDF program dispatches (super-batches)
+    "device_udf_rows",         # real rows through device UDF dispatches
+    "device_udf_runs",         # completed DeviceUdfProject device executions
+    "device_udf_fallbacks",    # device-UDF stages rerouted to the host path
+    "device_udf_weight_h2d_bytes",  # model weight bytes uploaded (flat on repeats)
     "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
     # adaptive batching + device dispatch coalescing (execution/batching.py,
     # ops/stage.py DispatchCoalescer)
@@ -62,6 +68,10 @@ COUNTER_NAMES = (
     "serve_queries_total",     # queries executed through a ServingSession
     "serve_prepared_hits",     # prepared-query cache hits (planning skipped)
     "serve_prepared_misses",   # prepared-query cache misses (planned + cached)
+    "serve_pin_calibrations",  # prepared entries whose reservation shrank toward
+                               # the observed pin-scope high-water (admission packing)
+    # checkpoint store GC (checkpoint/stages.py sweep_expired)
+    "checkpoint_stages_gced",  # committed stages removed by the TTL sweep
 )
 
 registry().declare(*COUNTER_NAMES)

@@ -1,0 +1,552 @@
+"""Device-UDF tier: jax-traceable batch UDFs as first-class device stages.
+
+The reference's marquee wins are AI pipelines (embedding, transcription,
+classification — SURVEY §6 beats Ray Data/Spark 4-10x via actor-pool model
+UDFs). This module makes ``df.with_column(embed(col("text")))`` a device
+stage with the same machinery the relational device path earned in PRs 2-8:
+
+- **Contract**: a ``Func`` with ``on_device=True`` wraps a jax-traceable
+  batch function ``fn(params, *arrays) -> array`` (row-aligned output). The
+  weight pytree comes from ``Func.device_params()`` — called once per worker
+  process, like any stateful UDF — and host-side tokenization/decoding ride
+  the optional ``device_prepare``/``device_finish`` hooks.
+
+- **Stage**: ``DeviceUdfStage``/``DeviceUdfRun`` sit behind the exact
+  ``start_run()/feed_batch()/finalize()`` contract the single-chip and mesh
+  agg stages share, so the executor's morsel stream + ``DispatchCoalescer``
+  feed super-batches: host preprocess per morsel, dispatch-only feeds (the
+  H2D of super-batch k+1 overlaps device compute of batch k — outputs stay
+  on device until ONE finalize ``device_get``), ``Func.batch_size`` caps the
+  dispatch bucket (chunking over-large super-batches), and the jit-program
+  cache is keyed by the fn fingerprint with per-bucket traces inside
+  (bounded O(log max rows) compilations per fn, the engine's quantized-
+  padding convention — ``udf_pad_bucket``).
+
+- **Residency**: weights register in the process-wide ``ResidencyManager``
+  under a CONTENT fingerprint of the weight bytes (``_WeightAnchor``), so
+  they are budgeted, evictable, pinned per query pin scope, counted in
+  ``hbm_bytes_resident``, published in heartbeat digests (deps-free slots
+  carry stable keys), and repeat queries re-upload NOTHING
+  (``device_udf_weight_h2d_bytes`` stays flat — counter-asserted in
+  ``BENCH_SUITE=ai``). No private ``_params_dev`` allocations remain.
+
+- **Fusion**: when a ``DeviceUdfProject`` feeds a device agg stage, the
+  ``FusedUdfAggFeeder`` hands the UDF's OUTPUT device plane straight into
+  the agg program's column dict — no intermediate d2h.
+
+Host fallback (``host_eval_device_func``) shares the same jit program,
+prepare/pad/finish pipeline and null semantics, executed eagerly per batch
+without stage/coalescer/residency machinery — bit-identical to the device
+tier whenever the dispatch shapes match (single-batch inputs; the
+``BENCH_SUITE=ai`` classify pipeline is shape-robust via argmax).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import jax_setup  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from ..observability.metrics import registry
+from ..observability.runtime_stats import profile_span
+from . import counters
+from .grouped_stage import DeviceFallback
+
+# model batches pad from 8 (matching the historical provider convention) so
+# tiny batches don't balloon to the relational stages' 512 floor
+_MIN_UDF_BUCKET = 8
+
+
+def udf_pad_bucket(n: int) -> int:
+    """Smallest power-of-two >= n (>= 8) — the UDF tier's quantized padding."""
+    b = _MIN_UDF_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ======================================================================================
+# Weight residency: content-fingerprinted pytrees in the residency manager
+# ======================================================================================
+
+
+class _WeightAnchor:
+    """Long-lived anchor object for one model's weight pytree.
+
+    The residency manager keys entries by (anchor identity token, slot key)
+    and derives cross-process STABLE keys from the anchor's
+    ``content_fingerprint()`` — for weights that is a hash of the raw weight
+    bytes, so the same model produces the same slot key in the driver and in
+    every worker: the weight key lands in heartbeat digests and sub-plan
+    fingerprints, and the affinity scheduler routes embedding sub-plans to
+    workers already holding the weights warm."""
+
+    def __init__(self, fp: int, host_params, nbytes: int):
+        self._fp = fp
+        self.host_params = host_params
+        self.nbytes = nbytes
+
+    def content_fingerprint(self) -> int:
+        return self._fp
+
+
+# serving sessions run queries concurrently, so every module-level cache
+# below mutates under this lock (the PR 8 _BoundedDecisionCache discipline)
+_TIER_LOCK = threading.Lock()
+
+# fingerprint -> anchor: one anchor per distinct weight CONTENT per process
+# (identical label sets / model names share one anchor and one HBM entry).
+# FIFO-capped: anchors hold the HOST weight copy (the rebuild source after an
+# HBM eviction), so unbounded growth across many models would pin every model
+# ever seen in RAM for process lifetime. Evicting an anchor only drops the
+# memo — a re-request builds a new anchor whose content-stable slot key
+# REBINDS to any still-resident HBM entry with zero re-upload.
+_ANCHORS: Dict[int, _WeightAnchor] = {}
+_ANCHORS_CAP = 64
+
+
+def _cap_fifo(cache: dict, cap: int) -> None:
+    """Drop oldest-inserted entries beyond `cap` (call under _TIER_LOCK)."""
+    while len(cache) > cap:
+        cache.pop(next(iter(cache)))
+# id(host pytree) -> (pytree, anchor): providers hand out one stable params
+# object per process (model loads once per worker), so repeat queries resolve
+# their anchor by object identity instead of re-hashing hundreds of MB of
+# weight bytes per query. The memo holds ITS OWN pytree strongly — a
+# content-duplicate pytree is not the one the anchor retains, and keying a
+# GC'd object's reused id would silently bind a new model to old weights —
+# so the cap stays small and eviction just re-hashes.
+_ANCHOR_BY_ID: Dict[int, Tuple[Any, _WeightAnchor]] = {}
+_ANCHOR_MEMO_CAP = 32
+
+
+def _leaves(params) -> List[np.ndarray]:
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
+
+
+def weight_fingerprint(params) -> int:
+    """64-bit content hash of a weight pytree (leaf dtypes + shapes + bytes,
+    in tree order)."""
+    h = hashlib.blake2b(digest_size=8)
+    for leaf in _leaves(params):
+        h.update(str(leaf.dtype).encode())
+        h.update(str(leaf.shape).encode())
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def _anchor_for_pytree(host) -> _WeightAnchor:
+    """The content anchor for one host weight pytree: identity memo first
+    (skips the full-byte hash on repeat queries over the provider's stable
+    params object), then content dedupe — same bytes, one anchor, one HBM
+    entry, in every thread."""
+    with _TIER_LOCK:
+        hit = _ANCHOR_BY_ID.get(id(host))
+        if hit is not None and hit[0] is host:
+            return hit[1]
+    fp = weight_fingerprint(host)  # outside the lock: hashing is the slow part
+    nbytes = sum(x.nbytes for x in _leaves(host))
+    with _TIER_LOCK:
+        a = _ANCHORS.get(fp)
+        if a is None:
+            a = _ANCHORS[fp] = _WeightAnchor(fp, host, nbytes)
+            _cap_fifo(_ANCHORS, _ANCHORS_CAP)
+        if len(_ANCHOR_BY_ID) >= _ANCHOR_MEMO_CAP:
+            _ANCHOR_BY_ID.clear()
+        _ANCHOR_BY_ID[id(host)] = (host, a)
+        return a
+
+
+def _func_anchors(func) -> Optional[Dict[Optional[str], _WeightAnchor]]:
+    """The weight anchors of one device Func (None = stateless fn).
+
+    Plain ``device_params`` yields one anchor under the ``None`` part name.
+    With ``device_params_split`` the hook's dict anchors PER TOP-LEVEL KEY,
+    so parts shared between Funcs (the encoder under both embed and every
+    classify label set) resolve to ONE anchor and one HBM entry each."""
+    if func.device_params is None:
+        return None
+    cache = getattr(func, "_weight_anchor_cache", None)
+    if cache is None:
+        cache = func._weight_anchor_cache = {}
+    anchors = cache.get("anchors")
+    if anchors is not None:
+        return anchors
+    host = func.device_params()
+    if host is None:
+        return None
+    if getattr(func, "device_params_split", False):
+        anchors = {name: _anchor_for_pytree(sub) for name, sub in host.items()}
+    else:
+        anchors = {None: _anchor_for_pytree(host)}
+    cache["anchors"] = anchors
+    return anchors
+
+
+def func_weight_nbytes(func) -> int:
+    """Total host bytes of the Func's weight parts (0 = stateless)."""
+    anchors = _func_anchors(func)
+    return sum(a.nbytes for a in anchors.values()) if anchors else 0
+
+
+def resident_weights(func):
+    """The Func's weight pytree as device arrays, via the residency manager.
+
+    The upload happens at most once per process per PART (repeat queries hit
+    the registered entries with ZERO h2d, and split parts shared with other
+    Funcs — e.g. the encoder under both embed and classify — upload once
+    total); inside an executor pin scope the entries are pinned for the
+    query's duration, so a tight HBM budget can never evict weights a
+    dispatched program still reads."""
+    anchors = _func_anchors(func)
+    if anchors is None:
+        return None
+    if set(anchors) == {None}:
+        return resident_params(anchors[None])
+    return {name: resident_params(a) for name, a in anchors.items()}
+
+
+def resident_params(anchor: _WeightAnchor):
+    """Upload-or-hit one weight anchor's pytree through the residency
+    manager (shared by the tier and the provider-level embed/classify APIs,
+    so NO weight bytes live on device outside the manager's accounting)."""
+    from ..device.residency import manager
+
+    def _upload():
+        with profile_span("device.udf_h2d", "device", op="weights",
+                          bytes=anchor.nbytes):
+            dev = jax.tree_util.tree_map(jnp.asarray, anchor.host_params)
+        registry().inc("hbm_h2d_bytes", anchor.nbytes)
+        counters.bump("device_udf_weight_h2d_bytes", anchor.nbytes)
+        return dev
+
+    return manager().get_or_build(anchor, ("udf_params",), (), _upload)
+
+
+def weight_slots(func) -> List[Tuple[int, int]]:
+    """(stable slot key, estimated device bytes) of each of the Func's weight
+    parts — the vocabulary entries the distributed affinity fingerprint
+    advertises so repeat embedding sub-plans route to workers whose HBM
+    already holds the model. Empty when the Func is stateless."""
+    from ..device.residency import stable_slot_key
+
+    anchors = _func_anchors(func)
+    if not anchors:
+        return []
+    out = []
+    for a in anchors.values():
+        sk = stable_slot_key(a, ("udf_params",))
+        if sk is not None:
+            out.append((sk, a.nbytes))
+    return out
+
+
+# ======================================================================================
+# Programs: one jit cache entry per fn fingerprint (per-bucket traces inside)
+# ======================================================================================
+
+_PROGRAM_CACHE: Dict[str, Callable] = {}
+
+
+def func_fingerprint(func) -> str:
+    """Stable identity of one device Func's compiled program: the declared
+    device_key when present (cross-process stable — providers set it from
+    the model name, @cls methods derive one from the class), else
+    module.qualname + a hash over the code object AND its closure cells —
+    bytecode alone collides for identical-source closures over different
+    constants, and the jit-program cache keyed by this string would then
+    silently run the wrong compiled model."""
+    if func.device_key:
+        return func.device_key
+    fn = func.fn
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        h = hashlib.blake2b(digest_size=6)
+        h.update(code.co_code)
+        h.update(repr(code.co_consts).encode())
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                h.update(repr(cell.cell_contents)[:4096].encode())
+            except Exception:
+                h.update(b"?")
+        tail = h.hexdigest()
+    else:
+        tail = ""
+    return f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', func.name)}:{tail}"
+
+
+def _program(fingerprint: str, fn: Callable) -> Callable:
+    with _TIER_LOCK:
+        prog = _PROGRAM_CACHE.get(fingerprint)
+        if prog is None:
+            # jax.jit is cheap here (tracing happens at first call, outside);
+            # capped so a serving process cycling many models/label sets
+            # doesn't retain every compiled program forever
+            prog = _PROGRAM_CACHE[fingerprint] = jax.jit(fn)
+            _cap_fifo(_PROGRAM_CACHE, 64)
+        return prog
+
+
+# ======================================================================================
+# Host-side prepare / finish (shared by the stage and the host fallback)
+# ======================================================================================
+
+
+def _prepare_arrays(func, arg_series: Sequence) -> Tuple[List[np.ndarray], np.ndarray, int]:
+    """(arrays, validity, n) for one morsel: the host preprocess step.
+
+    ``device_prepare`` (tokenization) receives the raw python lists; without
+    it each arg Series converts via to_numpy. Validity follows the engine's
+    UDF convention: a row is null when its FIRST argument is null (the
+    functions/ai contract — embed(None) -> None); prepared arrays still
+    cover every row (nulls tokenize as empty) so row alignment survives."""
+    if not arg_series:
+        raise DeviceFallback("device udf: no arguments")
+    n = len(arg_series[0])
+    valid = arg_series[0].validity_numpy()
+    if func.device_prepare is not None:
+        arrays = func.device_prepare(*[s.to_pylist() for s in arg_series])
+    else:
+        arrays = tuple(s.to_numpy() for s in arg_series)
+    if not isinstance(arrays, (tuple, list)):
+        arrays = (arrays,)
+    arrays = [np.asarray(a) for a in arrays]
+    for a in arrays:
+        if a.ndim < 1 or a.shape[0] != n:
+            raise DeviceFallback(
+                f"device udf: prepare output not row-aligned "
+                f"({a.shape} vs {n} rows)")
+    return arrays, valid, n
+
+
+def _pad_rows(a: np.ndarray, bucket: int) -> np.ndarray:
+    if a.shape[0] >= bucket:
+        return a
+    pad = np.zeros((bucket - a.shape[0],) + a.shape[1:], dtype=a.dtype)
+    return np.concatenate([a, pad])
+
+
+def _finish_values(func, out: np.ndarray, valid: np.ndarray) -> List:
+    """Decode one run's device output rows into python values (None where the
+    input row was null) — shared null semantics for device and host paths."""
+    if func.device_finish is not None:
+        vals = func.device_finish(out)
+    elif out.ndim == 1:
+        vals = [v.item() for v in out]
+    else:
+        vals = [list(map(float, row)) for row in out]
+    return [v if ok else None for v, ok in zip(vals, valid)]
+
+
+def _chunks(n: int, cap: Optional[int]):
+    """(start, end) chunk bounds: whole morsel, or batch_size-capped slices
+    (the model's latency-knee bucket cap)."""
+    step = n if not cap or cap <= 0 else min(cap, n)
+    for s in range(0, n, max(step, 1)):
+        yield s, min(s + step, n)
+
+
+# ======================================================================================
+# The stage
+# ======================================================================================
+
+
+class DeviceUdfStage:
+    """Compiled device-UDF stage: immutable program + per-run accumulators,
+    the same split as FilterAggStage. Cached process-wide per (fingerprint,
+    arg structure) so repeated queries reuse the jitted executables."""
+
+    def __init__(self, func, arg_exprs: Sequence, out_name: str):
+        self.func = func
+        self.arg_exprs = list(arg_exprs)
+        self.out_name = out_name
+        self.fingerprint = func_fingerprint(func)
+
+    def start_run(self) -> "DeviceUdfRun":
+        return DeviceUdfRun(self)
+
+
+_STAGE_CACHE: Dict[tuple, DeviceUdfStage] = {}
+
+
+def build_device_udf_stage(func, arg_exprs: Sequence, out_name: str) -> DeviceUdfStage:
+    # batch_size is part of the identity: the same program at a different
+    # bucket cap is a different stage (chunking differs), even though the
+    # compiled executables still share one _PROGRAM_CACHE entry
+    key = (func_fingerprint(func), func.batch_size, out_name,
+           tuple(repr(e) for e in arg_exprs))
+    with _TIER_LOCK:
+        stage = _STAGE_CACHE.get(key)
+        if stage is None:
+            stage = _STAGE_CACHE[key] = DeviceUdfStage(func, arg_exprs, out_name)
+            while len(_STAGE_CACHE) > 256:
+                _STAGE_CACHE.pop(next(iter(_STAGE_CACHE)))
+        return stage
+
+
+class DeviceUdfRun:
+    """Per-run accumulator: feed host RecordBatches (possibly coalescer
+    super-batches), dispatch-only; finalize fetches every output in ONE
+    device_get. Output rows align 1:1 with fed rows in feed order."""
+
+    def __init__(self, stage: DeviceUdfStage):
+        self.stage = stage
+        # weights resolve at run start so the executor's pin scope pins them
+        self._params = resident_weights(stage.func)
+        self._outs: List[Tuple[Any, int]] = []   # (device out, real rows)
+        self._valids: List[np.ndarray] = []
+
+    # ---- streaming feed (standalone DeviceUdfProject) ----------------------------
+    def feed_batch(self, batch) -> None:
+        from ..expressions.eval import eval_expression
+
+        n = batch.num_rows
+        if n == 0:
+            return
+        series = [eval_expression(batch, e) for e in self.stage.arg_exprs]
+        arrays, valid, n = _prepare_arrays(self.stage.func, series)
+        for s, e in _chunks(n, self.stage.func.batch_size):
+            m = e - s
+            out = self._dispatch([a[s:e] for a in arrays], m)
+            self._outs.append((out, m))
+            self._valids.append(valid[s:e])
+
+    def _dispatch(self, arrays: List[np.ndarray], m: int):
+        """Pad one chunk to its bucket, upload, dispatch the compiled
+        program; the result STAYS on device (fetched at finalize)."""
+        bucket = udf_pad_bucket(m)
+        with profile_span("device.udf_h2d", "device", rows=m, bucket=bucket):
+            padded = [_pad_rows(a, bucket) for a in arrays]
+            dev_args = [jnp.asarray(a) for a in padded]
+            registry().inc("hbm_h2d_bytes", sum(int(a.nbytes) for a in padded))
+        with profile_span("device.udf_dispatch", "device",
+                          op=self.stage.func.name, rows=m, bucket=bucket):
+            out = _program(self.stage.fingerprint,
+                           self.stage.func.fn)(self._params, *dev_args)
+        counters.bump("device_udf_dispatches")
+        counters.bump("device_udf_rows", m)
+        return out
+
+    # ---- fused feed (UDF output plane consumed by a device agg program) ----------
+    def dispatch_plane(self, batch, bucket: int):
+        """Dispatch the UDF over one batch padded to the AGG stage's bucket
+        and return ``(values_plane, validity_plane, n)`` as DEVICE arrays —
+        the downstream agg program consumes them directly, no intermediate
+        d2h. Raises DeviceFallback when the output is not a scalar plane."""
+        from ..expressions.eval import eval_expression
+
+        n = batch.num_rows
+        series = [eval_expression(batch, e) for e in self.stage.arg_exprs]
+        arrays, valid, n = _prepare_arrays(self.stage.func, series)
+        with profile_span("device.udf_h2d", "device", rows=n, bucket=bucket):
+            padded = [_pad_rows(a, bucket) for a in arrays]
+            dev_args = [jnp.asarray(a) for a in padded]
+            registry().inc("hbm_h2d_bytes", sum(int(a.nbytes) for a in padded))
+        with profile_span("device.udf_dispatch", "device",
+                          op=self.stage.func.name, rows=n, bucket=bucket,
+                          fused=True):
+            out = _program(self.stage.fingerprint,
+                           self.stage.func.fn)(self._params, *dev_args)
+        if out.ndim != 1:
+            raise DeviceFallback(
+                f"fused device udf: output not a scalar plane (ndim={out.ndim})")
+        counters.bump("device_udf_dispatches")
+        counters.bump("device_udf_rows", n)
+        vplane = jnp.asarray(_pad_rows(valid.astype(bool), bucket))
+        return out, vplane, n
+
+    # ---- finalize ----------------------------------------------------------------
+    def finalize(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(output rows, validity) across every fed row, in feed order — ONE
+        d2h round trip for the whole run."""
+        if not self._outs:
+            return np.empty((0,), np.float32), np.empty((0,), bool)
+        with profile_span("device.udf_d2h", "device",
+                          batches=len(self._outs)):
+            fetched = jax.device_get([o for o, _m in self._outs])
+        parts = [np.asarray(o)[:m] for o, (_d, m) in zip(fetched, self._outs)]
+        out = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        valid = np.concatenate(self._valids) if len(self._valids) > 1 \
+            else self._valids[0]
+        self._outs = []
+        self._valids = []
+        counters.bump("device_udf_runs")
+        return out, valid
+
+
+class FusedUdfAggFeeder:
+    """Feed a device agg run with the device-UDF output plane: for each
+    (coalesced) batch, the UDF dispatch's output device array slots into the
+    agg program's column dict alongside the other (residency-cached) input
+    planes — the embedding/score column never leaves the device.
+
+    Feeds stay dispatch-only (both the UDF and agg programs defer fetches to
+    finalize), so H2D of batch k+1 still overlaps device compute of batch k.
+    """
+
+    def __init__(self, udf_run: DeviceUdfRun, agg_run,
+                 udf_cols: Sequence[str], other_cols: Dict[str, str],
+                 f32: bool):
+        self._udf_run = udf_run
+        self._agg_run = agg_run
+        # agg-visible names the UDF output plane serves under (a rename
+        # Project may alias it; duplicates share one dispatch's plane)
+        self._udf_cols = list(udf_cols)
+        # agg-visible name -> source column in the UDF node's INPUT schema
+        self._other_cols = dict(other_cols)
+        self._f32 = f32
+
+    def feed_batch(self, batch) -> None:
+        from .stage import pad_bucket
+
+        n = batch.num_rows
+        if n == 0:
+            return
+        cap = self._udf_run.stage.func.batch_size
+        for s, e in _chunks(n, cap):
+            chunk = batch if (s == 0 and e == n) else batch.slice(s, e)
+            m = chunk.num_rows
+            bucket = pad_bucket(m)
+            vals, valid, m = self._udf_run.dispatch_plane(chunk, bucket)
+            if not self._f32 and vals.dtype == jnp.float32:
+                vals = vals.astype(jnp.float64)
+            dcols = {name: (vals, valid) for name in self._udf_cols}
+            for name, src in self._other_cols.items():
+                dcols[name] = chunk.get_column(src).to_device_cached(
+                    bucket, f32=self._f32)
+            self._agg_run._run(dcols, m, bucket)
+
+
+# ======================================================================================
+# Host fallback: same program, same pipeline, no stage machinery
+# ======================================================================================
+
+
+def host_eval_device_func(func, arg_series: Sequence, num_rows: int):
+    """Execute a device Func as a plain batch UDF (the pre-tier behavior and
+    the tier's semantics-identical fallback): prepare -> pad to the UDF
+    bucket -> the SAME jit program -> unpad -> finish. Runs on the default
+    jax backend eagerly per batch; weights still resolve through the
+    residency manager so no path holds device bytes outside its accounting.
+
+    Returns the python value list (None for null input rows)."""
+    arrays, valid, n = _prepare_arrays(func, arg_series)
+    if n == 0:
+        return []
+    params = resident_weights(func)
+    fp = func_fingerprint(func)
+    outs = []
+    for s, e in _chunks(n, func.batch_size):
+        m = e - s
+        bucket = udf_pad_bucket(m)
+        dev_args = [jnp.asarray(_pad_rows(a[s:e], bucket)) for a in arrays]
+        out = _program(fp, func.fn)(params, *dev_args)
+        outs.append(np.asarray(jax.device_get(out))[:m])
+    out = np.concatenate(outs) if len(outs) > 1 else outs[0]
+    return _finish_values(func, out, valid)

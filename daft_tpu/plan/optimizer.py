@@ -713,6 +713,15 @@ def _prune(node: lp.LogicalPlan, needed: Optional[List[str]]) -> lp.LogicalPlan:
     return node.with_children([_prune(c, None) for c in node.children()])
 
 
+def _contains_device_udf(expr) -> bool:
+    """True when any UDF call inside `expr` is a device Func
+    (``on_device=True``) — structural check only, no tier imports."""
+    from ..udf.expr import UdfCall
+
+    return any(isinstance(sub, UdfCall) and getattr(sub.func, "on_device", False)
+               for sub in expr.walk())
+
+
 def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     """Isolate UDF-bearing expressions into their own UDFProject nodes
     (reference: rules/split_udfs.rs) so host UDFs don't break device stage fusion.
@@ -725,7 +734,13 @@ def rule_split_udfs(node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
     if not isinstance(node, lp.Project):
         return None
     udf_exprs = [e for e in node.projection if e.has_udf()]
-    if not udf_exprs or len(node.projection) == len(udf_exprs) == 1:
+    if not udf_exprs:
+        return None
+    if len(node.projection) == len(udf_exprs) == 1 \
+            and not _contains_device_udf(udf_exprs[0]):
+        # a lone host-UDF projection gains nothing from isolation; a lone
+        # DEVICE-UDF projection must still land in a UDFProject node so the
+        # device-UDF tier (plan/physical.py DeviceUdfProject) can capture it
         return None
     current = node.input
     projection = list(node.projection)

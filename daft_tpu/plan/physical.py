@@ -83,6 +83,46 @@ class UDFProject(_Unary):
         self.passthrough = passthrough
 
 
+class DeviceUdfProject(_Unary):
+    """A UDFProject whose UDF is a jax-traceable device Func
+    (``@daft_tpu.func(on_device=True)``) — eligible for the device-UDF tier
+    (ops/udf_stage.py): weights resident in HBM via the residency manager,
+    morsels coalesced into super-batches, one compiled dispatch per
+    super-batch, and fusion into a downstream device agg stage with no
+    intermediate d2h. The executor decides device vs host per run (cost
+    model / backend / config); the host fallback is the plain batch-UDF
+    evaluation with identical semantics."""
+
+    def __init__(self, input: PhysicalPlan, udf_expr: Expression,
+                 passthrough: List[Expression], schema: Schema):
+        super().__init__(input, schema)
+        self.udf_expr = udf_expr
+        self.passthrough = passthrough
+
+    def name(self) -> str:
+        return f"DeviceUdfProject({self.udf_expr.name()})"
+
+
+def device_udf_call(expr: Expression):
+    """The UdfCall at the root of `expr` (aliases unwrapped) when it is a
+    kwarg-free device Func call — the shape the device-UDF tier lowers.
+    None otherwise. Pure structural check: imports nothing from the tier, so
+    host-UDF-only plans keep the zero-overhead contract."""
+    from ..expressions.expressions import Alias
+
+    e = expr
+    while isinstance(e, Alias):
+        e = e.child
+    func = getattr(e, "func", None)
+    if func is None or not getattr(func, "on_device", False):
+        return None
+    if getattr(e, "kwargs", None):
+        return None  # kwargs don't cross the array contract
+    if not getattr(e, "args", None):
+        return None
+    return e
+
+
 class PhysFilter(_Unary):
     def __init__(self, input: PhysicalPlan, predicate: Expression, schema: Schema,
                  keep=None):
@@ -408,6 +448,15 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         return Project(translate(plan.input, config), plan.projection, plan.schema)
 
     if isinstance(plan, lp.UDFProject):
+        from ..config import execution_config
+
+        cfg = config or execution_config()
+        if getattr(cfg, "device_mode", "off") != "off" \
+                and device_udf_call(plan.udf_expr) is not None:
+            # device-UDF tier capture; the executor re-checks mode/cost at
+            # run time and falls back to the plain UDF path loudly
+            return DeviceUdfProject(translate(plan.input, config), plan.udf_expr,
+                                    plan.passthrough, plan.schema)
         return UDFProject(translate(plan.input, config), plan.udf_expr, plan.passthrough, plan.schema)
 
     if isinstance(plan, lp.Filter):

@@ -119,7 +119,8 @@ def estimate_pin_bytes(physical) -> int:
 
 class PreparedEntry:
     __slots__ = ("literals", "builder", "physical", "est_pin_bytes",
-                 "fingerprint", "hits", "plan_seconds")
+                 "fingerprint", "hits", "plan_seconds", "observed_pin_bytes",
+                 "_est_upper_bytes")
 
     def __init__(self, literals, builder, physical, est_pin_bytes: int,
                  fingerprint, plan_seconds: float):
@@ -130,6 +131,34 @@ class PreparedEntry:
         self.fingerprint = fingerprint  # (stable_slot_key, est_bytes) pairs
         self.hits = 0
         self.plan_seconds = plan_seconds
+        # admission calibration: max pin-scope byte high-water OBSERVED across
+        # this entry's executions (None until the first completed run), and
+        # the original fingerprint-derived upper bound the calibrated
+        # estimate can recover toward if a later repeat observes more
+        self.observed_pin_bytes = None
+        self._est_upper_bytes = est_pin_bytes
+
+    def note_observed_pin(self, observed: int) -> None:
+        """Calibrate the reservation toward the observed pin-scope
+        high-water: ``est = min(fingerprint upper bound, max observed)``.
+        Warm repeats reserve what repeats actually pin, admission packs
+        tighter, ``hbm_reserved_bytes`` drops — and because the observation
+        floor is the max seen so far, a cold run's PARTIAL working set (a
+        mid-query fallback) can't permanently under-reserve: a later repeat
+        observing more raises the estimate back toward the upper bound. The
+        estimate stays advisory: the pin scope still degrades safely if a
+        run pins more than reserved. A ZERO observation is discarded — a run
+        that pinned nothing executed on the host path and says nothing about
+        the device working set a later repeat would reserve for."""
+        observed = int(observed)
+        if observed <= 0:
+            return
+        prev = self.observed_pin_bytes
+        self.observed_pin_bytes = observed if prev is None else max(prev, observed)
+        new_est = min(self._est_upper_bytes, self.observed_pin_bytes)
+        if new_est < self.est_pin_bytes:
+            registry().inc("serve_pin_calibrations")
+        self.est_pin_bytes = new_est
 
 
 class PreparedQueryCache:

@@ -265,12 +265,19 @@ class ServingSession:
                     if self._runner is None:
                         from ..execution.executor import execute_plan
 
-                        # cooperative check between streamed partitions: the
-                        # in-process path's natural yield points
-                        for p in execute_plan(entry.physical):
-                            raise_if_cancelled(
-                                f"query {fut.query_id} cancelled")
-                            parts.append(p)
+                        # observe the query's pin-scope HBM high-water so the
+                        # prepared entry's reservation calibrates toward what
+                        # repeats actually pin (admission packs tighter over
+                        # time); pin scopes are thread-local, so concurrent
+                        # queries' observations never mix
+                        with _residency().observe_pins() as observed_pins:
+                            # cooperative check between streamed partitions:
+                            # the in-process path's natural yield points
+                            for p in execute_plan(entry.physical):
+                                raise_if_cancelled(
+                                    f"query {fut.query_id} cancelled")
+                                parts.append(p)
+                        entry.note_observed_pin(observed_pins())
                     else:
                         parts = list(self._runner.run(entry.builder))
                 exec_s = time.perf_counter() - t_exec

@@ -52,6 +52,24 @@ class UdfCall(Expression):
         f = self.func
         out_name = self.name()
 
+        if getattr(f, "on_device", False):
+            # device Func on the host path (device_mode off / cost model chose
+            # host / non-isolated expression): the same prepare -> jit program
+            # -> finish pipeline, run eagerly per batch with no stage,
+            # coalescer, or pin scope — semantics identical to the tier
+            if self.kwargs:
+                # the device contract is positional arrays only; silently
+                # dropping kwargs here would run fn without them and produce
+                # wrong results with no error
+                raise TypeError(
+                    f"device UDF {f.name!r} does not accept keyword "
+                    f"arguments (got {sorted(self.kwargs)}); the contract is "
+                    f"fn(params, *arrays)")
+            from ..ops.udf_stage import host_eval_device_func
+
+            vals = host_eval_device_func(f, arg_series, num_rows)
+            return Series.from_pylist(vals, out_name, f.return_dtype)
+
         if f.use_process:
             from ..execution.udf_process import get_pool
 

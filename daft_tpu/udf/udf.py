@@ -29,6 +29,46 @@ class Func:
     # prefix-affinity routing for replicated stateful operators (vLLM-style):
     # rows sharing the first N chars of the first argument go to one replica
     route_prefix_len: Optional[int] = None
+    # ---- device-UDF tier (ops/udf_stage.py) --------------------------------
+    # on_device=True marks `fn` as a jax-traceable BATCH function with the
+    # signature ``fn(params, *arrays) -> array`` (row-aligned output). The
+    # executor lowers it to a DeviceUdfProject stage: weights resident in HBM
+    # via the residency manager, morsels coalesced into super-batches, one
+    # compiled dispatch per super-batch. batch_size caps the dispatch bucket.
+    on_device: bool = False
+    # () -> numpy pytree of model weights, called once per worker process;
+    # the tier registers the pytree in the residency manager under a content
+    # fingerprint of the weight bytes. None = stateless fn (params is None).
+    device_params: Optional[Callable] = None
+    # True: device_params() returns a dict whose TOP-LEVEL entries anchor
+    # independently in the residency manager — parts shared between Funcs
+    # (e.g. one encoder under both embed and every classify label set)
+    # resolve to a single HBM entry and upload once per process total.
+    device_params_split: bool = False
+    # host preprocess per morsel (tokenization): (*arg_pylists) -> tuple of
+    # row-aligned numpy arrays fed to `fn`. None = each arg Series' to_numpy.
+    device_prepare: Optional[Callable] = None
+    # host postprocess: (np_out_rows) -> list of python values (e.g. label
+    # strings from argmax codes). None = rows of the output array as-is.
+    device_finish: Optional[Callable] = None
+    # stable fingerprint for the jit-program cache and cost-decision cache;
+    # None derives one from fn.__module__/__qualname__ (process-local only).
+    device_key: Optional[str] = None
+
+    @property
+    def is_device(self) -> bool:
+        return self.on_device
+
+    def __getstate__(self):
+        # the weight-anchor cache (ops/udf_stage.py) holds the model's host
+        # pytree: process-local, rebuilt lazily per worker — shipping it in
+        # every pickled plan blob would move the whole model per task
+        state = dict(self.__dict__)
+        state.pop("_weight_anchor_cache", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     def __call__(self, *args, **kwargs):
         from .expr import UdfCall
@@ -46,11 +86,22 @@ def func(
     batch_size: Optional[int] = None,
     max_concurrency: Optional[int] = None,
     use_process: bool = False,
+    on_device: bool = False,
+    device_params: Optional[Callable] = None,
+    device_prepare: Optional[Callable] = None,
+    device_finish: Optional[Callable] = None,
+    device_key: Optional[str] = None,
 ):
     """``@daft_tpu.func`` decorator — wrap a Python function as a scalar UDF.
 
     Row-wise by default; ``is_batch=True`` passes Series in / expects Series out.
     The return dtype is taken from ``return_dtype`` or inferred from the type hint.
+
+    ``on_device=True`` marks a jax-traceable batch UDF ``fn(params, *arrays) ->
+    array`` for the device-UDF tier (ops/udf_stage.py): weights from
+    ``device_params()`` live in HBM under the residency manager, morsels
+    coalesce into super-batches, and ``df.with_column(embed(col))`` becomes a
+    first-class device stage with a semantics-identical host fallback.
     """
 
     def wrap(f: Callable) -> Func:
@@ -61,14 +112,19 @@ def func(
         return Func(
             fn=f,
             return_dtype=rdt,
-            is_batch=is_batch,
+            is_batch=is_batch or on_device,
             is_async=inspect.iscoroutinefunction(f),
             # batch fns return whole Series — generator semantics apply row-wise only
-            is_generator=inspect.isgeneratorfunction(f) and not is_batch,
+            is_generator=inspect.isgeneratorfunction(f) and not (is_batch or on_device),
             batch_size=batch_size,
             max_concurrency=max_concurrency,
             use_process=use_process,
             name=getattr(f, "__name__", "udf"),
+            on_device=on_device,
+            device_params=device_params,
+            device_prepare=device_prepare,
+            device_finish=device_finish,
+            device_key=device_key,
         )
 
     if fn is not None:
@@ -165,15 +221,55 @@ class _ClsInstance:
         def bound(*vals, **kw):
             return getattr(inst._materialize(), name)(*vals, **kw)
 
+        on_device = bool(getattr(target, "__udf_on_device__", False))
+        device_params = None
+        device_prepare = None
+        device_finish = None
+        if on_device:
+            # device-UDF hooks resolve off the (lazily materialized) instance:
+            # device_params() declares the weight pytree — the model loads once
+            # per worker, exactly like any other @cls state — and the optional
+            # device_prepare/device_finish methods do host tokenization and
+            # output decoding around the jax-traceable method itself
+            klass = self._wrapper._klass
+            if getattr(klass, "device_params", None) is not None:
+                def device_params():
+                    return inst._materialize().device_params()
+            if getattr(klass, "device_prepare", None) is not None:
+                def device_prepare(*lists):
+                    return inst._materialize().device_prepare(*lists)
+            if getattr(klass, "device_finish", None) is not None:
+                def device_finish(out):
+                    return inst._materialize().device_finish(out)
+
+        # the jit-program/cost-cache identity: every @cls method's `bound`
+        # wrapper shares one code object, so the code-hash fallback would
+        # collide two different classes' device methods onto one compiled
+        # program — derive a key from the TARGET's class+method instead.
+        # Instances of one class share the program deliberately: the traced
+        # body is (self, params, *arrays) with all weights flowing through
+        # params, so per-instance state must ride device_params().
+        device_key = None
+        if on_device:
+            klass = self._wrapper._klass
+            device_key = getattr(target, "__udf_device_key__", None) or \
+                f"{klass.__module__}.{klass.__qualname__}.{name}"
+
         f = Func(
             fn=bound,
             return_dtype=rdt,
-            is_batch=bool(getattr(target, "__udf_is_batch__", False)),
+            is_batch=bool(getattr(target, "__udf_is_batch__", False)) or on_device,
             is_async=inspect.iscoroutinefunction(target),
             is_generator=inspect.isgeneratorfunction(target),
             max_concurrency=self._wrapper._max_concurrency,
             use_process=self._wrapper._use_process,
             name=f"{self._wrapper._klass.__name__}.{name}",
+            on_device=on_device,
+            device_params=device_params,
+            device_prepare=device_prepare,
+            device_finish=device_finish,
+            device_key=device_key,
+            batch_size=getattr(target, "__udf_batch_size__", None),
         )
         self._method_funcs[name] = f
         return f
@@ -202,14 +298,27 @@ def cls(klass=None, *, max_concurrency: Optional[int] = None, use_process: bool 
 
 
 def method(fn: Optional[Callable] = None, *, return_dtype: Optional[DataType] = None,
-           is_batch: bool = False):
+           is_batch: bool = False, on_device: bool = False,
+           batch_size: Optional[int] = None, device_key: Optional[str] = None):
     """Mark a method of a ``@cls`` class as a UDF entrypoint with an explicit
-    return dtype (otherwise inferred from the annotation)."""
+    return dtype (otherwise inferred from the annotation).
+
+    ``on_device=True`` marks the method jax-traceable — signature
+    ``(self, params, *arrays) -> array`` — and routes it through the
+    device-UDF tier; the class's ``device_params()`` hook declares the weight
+    pytree (optional ``device_prepare``/``device_finish`` do host
+    tokenization/decoding). ``batch_size`` caps the dispatch bucket;
+    ``device_key`` overrides the program-cache identity (defaults to the
+    class's module.qualname.method — instances share one compiled program,
+    so per-instance state must flow through ``device_params()``)."""
 
     def wrap(f):
         f.__udf_method__ = True
         f.__udf_return_dtype__ = return_dtype
         f.__udf_is_batch__ = is_batch
+        f.__udf_on_device__ = on_device
+        f.__udf_batch_size__ = batch_size
+        f.__udf_device_key__ = device_key
         return f
 
     if fn is not None:
