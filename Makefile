@@ -6,11 +6,23 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test test-ai test-mesh test-fault bench bench-ai bench-mesh bench-serve bench-gate bench-compare
+.PHONY: test lint lint-json test-ai test-mesh test-fault bench bench-ai bench-mesh bench-serve bench-gate bench-compare
 
+# `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Engine-invariant linter (daft_tpu/tools/lint): lock discipline, env-knob
+# discipline, counter pre-declaration, tier import discipline, broad-except
+# audit, atomic publish, event-schema drift. Exits non-zero on any
+# non-baselined finding.
+lint:
+	$(PY) -m daft_tpu.tools.lint
+
+# Machine-readable finding counts (diff across PRs like bench.py captures).
+lint-json:
+	@$(PY) -m daft_tpu.tools.lint --json
 
 # Elastic fault-tolerance suite: kill -9 / SIGSTOP real pool workers
 # mid-query and assert recovery (detection, lost-map regeneration,

@@ -54,8 +54,8 @@ class _Http:
                     detail = ""
                     try:
                         detail = e.read().decode("utf-8", "replace")[:500]
-                    except Exception:
-                        pass
+                    except Exception:  # lint: ignore[broad-except] -- detail enriches the outer
+                        pass  # RuntimeError; its absence must not mask it
                     raise RuntimeError(
                         f"openai-compatible server returned {e.code}: {detail}") from e
             except (urllib.error.URLError, TimeoutError, ConnectionError) as e:

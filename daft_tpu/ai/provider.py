@@ -10,9 +10,13 @@ to torch-CPU otherwise.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional
 
 _PROVIDERS: Dict[str, "Provider"] = {}
+# get_provider lazily registers built-ins from whichever serving/executor
+# thread asks first; the dict mutation must not race a concurrent lookup.
+_PROVIDERS_LOCK = threading.Lock()
 
 
 class Provider:
@@ -32,7 +36,8 @@ class Provider:
 
 
 def register_provider(provider: Provider, name: Optional[str] = None) -> None:
-    _PROVIDERS[(name or provider.name).lower()] = provider
+    with _PROVIDERS_LOCK:
+        _PROVIDERS[(name or provider.name).lower()] = provider
 
 
 def get_provider(name: str) -> Provider:

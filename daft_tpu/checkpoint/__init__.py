@@ -121,6 +121,8 @@ class FileCheckpointStore(CheckpointStore):
         with self._lock:
             keys = self._mem._staged_keys.get(checkpoint_id, [])
             files = self._mem._staged_files.get(checkpoint_id, [])
+            # lint: ignore[blocking-under-lock] -- the lock exists to order WAL
+            # appends with the in-memory state; no hot/liveness path shares it
             with open(self.path, "a") as f:
                 f.write(json.dumps({"op": "seal", "id": checkpoint_id,
                                     "keys": list(keys), "files": list(files)}) + "\n")
@@ -131,6 +133,8 @@ class FileCheckpointStore(CheckpointStore):
     def mark_committed(self, checkpoint_id: str) -> None:
         with self._lock:
             self._mem.mark_committed(checkpoint_id)
+            # lint: ignore[blocking-under-lock] -- same WAL-ordering lock as
+            # checkpoint(): commit records must serialize after seal records
             with open(self.path, "a") as f:
                 f.write(json.dumps({"op": "commit", "id": checkpoint_id}) + "\n")
                 f.flush()

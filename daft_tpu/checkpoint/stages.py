@@ -45,6 +45,7 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from ..observability.metrics import registry
+from ..utils.env import env_float
 
 _MANIFEST = "MANIFEST.json"
 
@@ -57,10 +58,7 @@ def _ttl_seconds() -> float:
     """DAFT_TPU_CHECKPOINT_TTL_S: max age of a query's checkpoint tree before
     the sweep removes it. <= 0 / unset = GC disabled (the pre-GC behavior:
     committed stages accumulate until manually cleared)."""
-    try:
-        return float(os.environ.get("DAFT_TPU_CHECKPOINT_TTL_S", "0"))
-    except ValueError:
-        return 0.0
+    return env_float("DAFT_TPU_CHECKPOINT_TTL_S", 0.0)
 
 
 def sweep_expired(root: str, ttl_s: Optional[float] = None,
@@ -189,7 +187,7 @@ def query_fingerprint(phys) -> Optional[str]:
                 h.update(fname.encode())
                 if not _feed(vars(node)[fname]):
                     return None
-    except Exception:  # noqa: BLE001 — advisory: no fingerprint, no resume
+    except Exception:  # lint: ignore[broad-except] -- advisory: no fingerprint, no resume
         return None
     return h.hexdigest()[:24]
 
@@ -317,6 +315,7 @@ class StageCheckpointer:
         except Exception:  # noqa: BLE001 — unreadable/corrupt (incl. pyarrow
             # errors outside the OSError/ValueError hierarchies): re-run the
             # stage rather than fail the query on its own checkpoint
+            registry().inc("checkpoint_restore_failures")
             return None
 
     # ---- subtree results -----------------------------------------------------------
@@ -389,4 +388,5 @@ class StageCheckpointer:
             registry().inc("checkpoint_stages_skipped")
             return out
         except Exception:  # noqa: BLE001 — unreadable checkpoint: re-run
+            registry().inc("checkpoint_restore_failures")
             return None

@@ -20,18 +20,7 @@ from dataclasses import dataclass, field, replace
 from typing import Iterator, Optional
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+from .utils.env import env_float as _env_float, env_int as _env_int
 
 
 @dataclass(frozen=True)

@@ -61,7 +61,7 @@ class TableStatistics:
                     mn = s.min().to_pylist()[0]
                     mx = s.max().to_pylist()[0]
                     cols[s.name] = ColumnStats(mn, mx, s.null_count())
-                except Exception:
+                except Exception:  # lint: ignore[broad-except] -- stats are advisory pruning input
                     pass
         return cls(cols)
 

@@ -349,7 +349,7 @@ class Series:
             # dense values + validity mask is content-exact
             h.update(np.ascontiguousarray(vals).tobytes())
             h.update(self.validity_numpy().tobytes())
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- falls through to the Arrow IPC hash
             try:
                 # strings/nested: hash the Arrow IPC serialization. Distinct
                 # logical values can never collide; equal arrays in unusual
@@ -360,8 +360,8 @@ class Series:
                         sink, pa.schema([pa.field("c", self._arrow.type)])) as w:
                     w.write_batch(pa.record_batch([self._arrow], names=["c"]))
                 h.update(sink.getvalue())
-            except Exception:
-                return None
+            except Exception:  # lint: ignore[broad-except] -- unhashable: no content fingerprint,
+                return None  # caller keys by identity instead
         fp = int.from_bytes(h.digest(), "little")
         cache["__content_fp__"] = fp
         return fp

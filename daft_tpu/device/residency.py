@@ -151,7 +151,7 @@ def stable_slot_key(anchor, key: tuple) -> Optional[int]:
         return None
     try:
         fp = fp_fn()
-    except Exception:
+    except Exception:  # lint: ignore[broad-except] -- no stable key; slot stays anchor-scoped
         return None
     if fp is None:
         return None
@@ -191,10 +191,10 @@ def device_nbytes(value) -> int:
                     total += sum(int(s.data.nbytes) for s in shards)
                 else:
                     total += int(x.nbytes)
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- byte accounting is best-effort
                 try:
                     total += int(x.nbytes)
-                except Exception:
+                except Exception:  # lint: ignore[broad-except] -- unmeasurable value counts as 0
                     pass
         elif isinstance(x, (tuple, list)):
             stack.extend(x)
@@ -205,7 +205,7 @@ def device_nbytes(value) -> int:
             if hook is not None:
                 try:
                     total += int(hook())
-                except Exception:
+                except Exception:  # lint: ignore[broad-except] -- lazy-plane hook: best-effort bytes
                     pass
     return total
 
@@ -591,7 +591,7 @@ class ResidencyManager:
             stats = jax_mod.devices()[0].memory_stats() or {}
             limit = int(stats.get("bytes_limit", 0) or 0)
             return (limit * 3) // 4 if limit > 0 else 0
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- backend without memory_stats: unbounded
             return 0
 
     # entries per recency bucket: eviction considers the least-recently-used
@@ -694,13 +694,9 @@ class ResidencyManager:
         its device planes; WorkerPool sets a positive cap in worker
         environments so planes outlive the transient per-task plan objects."""
         if self._orphan_cap is None:
-            import os
+            from ..utils.env import env_int
 
-            try:
-                self._orphan_cap = max(
-                    int(os.environ.get("DAFT_TPU_HBM_ORPHANS", "0")), 0)
-            except ValueError:
-                self._orphan_cap = 0
+            self._orphan_cap = env_int("DAFT_TPU_HBM_ORPHANS", 0, lo=0)
         return self._orphan_cap
 
     # ---- introspection -------------------------------------------------------------

@@ -64,7 +64,7 @@ def plan_fingerprint(plan) -> Tuple[Tuple[int, int], ...]:
                 break
         items = list(slots.items())[:MAX_FINGERPRINT_SLOTS]
         return tuple(items)
-    except Exception:  # noqa: BLE001 — advisory: never fail task creation
+    except Exception:  # lint: ignore[broad-except] -- advisory: never fail task creation
         return ()
 
 
@@ -129,7 +129,7 @@ def _add_slot(slots: Dict[int, int], batch, cname: str, key: tuple,
               est_bytes: int, stable_slot_key) -> None:
     try:
         s = batch.get_column(cname)
-    except Exception:  # noqa: BLE001 — column introduced above the scan
+    except Exception:  # lint: ignore[broad-except] -- column introduced above the scan
         return
     sk = stable_slot_key(s, key)
     if sk is not None:

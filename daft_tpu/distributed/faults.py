@@ -40,7 +40,9 @@ import signal
 import time
 from typing import Iterable, Optional
 
-_POINT = os.environ.get("DAFT_TPU_FAULT_POINT", "")
+from ..utils.env import env_str
+
+_POINT = env_str("DAFT_TPU_FAULT_POINT")
 
 # read once at import: fault injection is armed per-process via spawn env
 ENABLED = bool(_POINT)
@@ -64,13 +66,13 @@ def maybe_trip(point: str, stage_id: str = "",
     Never raises — a misconfigured tripwire must not fail a healthy worker."""
     if point != _POINT:
         return
-    want_worker = os.environ.get("DAFT_TPU_FAULT_WORKER", "")
-    if want_worker and os.environ.get("DAFT_TPU_WORKER_ID", "") != want_worker:
+    want_worker = env_str("DAFT_TPU_FAULT_WORKER")
+    if want_worker and env_str("DAFT_TPU_WORKER_ID") != want_worker:
         return
-    want_stage = os.environ.get("DAFT_TPU_FAULT_STAGE", "")
+    want_stage = env_str("DAFT_TPU_FAULT_STAGE")
     if want_stage and not (stage_id or _STAGE).startswith(want_stage):
         return
-    once = os.environ.get("DAFT_TPU_FAULT_ONCE_FILE", "")
+    once = env_str("DAFT_TPU_FAULT_ONCE_FILE")
     if once:
         try:
             fd = os.open(once, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -79,7 +81,7 @@ def maybe_trip(point: str, stage_id: str = "",
             return  # already fired somewhere
         except OSError:
             return
-    mode = os.environ.get("DAFT_TPU_FAULT_MODE", "kill")
+    mode = env_str("DAFT_TPU_FAULT_MODE", "kill")
     if mode.startswith("delay:"):
         try:
             time.sleep(float(mode.split(":", 1)[1]))

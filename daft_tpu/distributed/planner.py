@@ -142,7 +142,7 @@ def _fingerprint(ctx: DistContext, frag: pp.PhysicalPlan):
         workers = getattr(ctx.pool, "workers", {}).values()
         if not any(getattr(w, "last_digest", None) for w in workers):
             return ()
-    except Exception:  # noqa: BLE001 — advisory
+    except Exception:  # lint: ignore[broad-except] -- affinity fingerprint is advisory
         return ()
     return plan_fingerprint(frag)
 

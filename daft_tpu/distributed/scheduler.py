@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..observability.metrics import registry
+from ..utils.env import env_float, env_int
 from .task import Spread, SubPlanTask, WorkerAffinity
 
 
@@ -62,8 +63,6 @@ class Scheduler:
     """
 
     def __init__(self, workers: Dict[str, int]):
-        import os
-
         self._workers: Dict[str, WorkerSnapshot] = {
             wid: WorkerSnapshot(wid, slots) for wid, slots in workers.items()
         }
@@ -72,20 +71,13 @@ class Scheduler:
         self._stream_order: List[str] = []
         self._rr_pos = 0
         self._seq = itertools.count()
-        try:
-            self._autoscaling_threshold = float(
-                os.environ.get("DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25))
-        except ValueError:
-            self._autoscaling_threshold = 1.25
+        self._autoscaling_threshold = env_float(
+            "DAFT_TPU_AUTOSCALING_THRESHOLD", 1.25)
         # load penalty per active task when scoring affinity candidates: an
         # affinity pick must beat spread by more than this many bytes per unit
         # of load, or locality is not worth queueing behind a busy worker
-        try:
-            self._affinity_penalty_bytes = int(
-                os.environ.get("DAFT_TPU_AFFINITY_PENALTY_BYTES",
-                               8 * 1024 * 1024))
-        except ValueError:
-            self._affinity_penalty_bytes = 8 * 1024 * 1024
+        self._affinity_penalty_bytes = env_int(
+            "DAFT_TPU_AFFINITY_PENALTY_BYTES", 8 * 1024 * 1024)
         # per-scheduler placement totals (the pool snapshots these into the
         # query trace; the same increments go to the process registry)
         self._stats = {"affinity_hits": 0, "affinity_misses": 0,

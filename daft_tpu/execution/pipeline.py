@@ -160,8 +160,8 @@ def spawn_stage(gen: Iterator, maxsize: int = 4, node=None) -> Iterator:
         finally:
             try:
                 gen.close()  # unwind upstream finally blocks on this thread
-            except BaseException:
-                pass
+            except BaseException:  # lint: ignore[broad-except] -- teardown: close() may re-raise
+                pass  # the propagating error; ch.close(err) reports it
             ch.close(err)
 
     def consume():

@@ -304,7 +304,7 @@ class UdfProcessPool:
             try:
                 conn.send(None)
                 conn.close()
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- shutdown: peer may already be gone
                 pass
         for p, _ in self.workers:
             if p is None:

@@ -610,7 +610,7 @@ class IfElse(Expression):
     def name(self) -> str:
         try:
             return self.if_true.name()
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- nameless branch: fall back to predicate
             return self.predicate.name()
 
     def children(self):

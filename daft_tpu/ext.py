@@ -206,7 +206,7 @@ class Extension:
                 f = ExtensionFunction(vt, self)
                 self.functions[f.name] = f
                 return 0
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- C ABI boundary: error surfaces as rc=1
                 return 1
 
         self._define_cb = _DEFINE_FN(_define)  # keep alive

@@ -164,7 +164,7 @@ def _try(fn):
             return fn(v, kwargs)
         except ValueError:
             raise
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- row-level best-effort: errors are nulls
             return None
 
     return wrapped

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -21,6 +22,9 @@ from ..core.series import Series, _combine
 from ..datatype import DataType, Field
 
 _REGISTRY: Dict[str, "FunctionSpec"] = {}
+# Registration is mostly import-time (the `from . import extra` side effects)
+# but register() is public API callable from any thread in a live session.
+_REGISTRY_LOCK = threading.Lock()
 
 
 @dataclasses.dataclass
@@ -33,9 +37,10 @@ class FunctionSpec:
 
 def register(name: str, return_type, host, device=None, aliases=()):
     spec = FunctionSpec(name, return_type, host, device)
-    _REGISTRY[name] = spec
-    for a in aliases:
-        _REGISTRY[a] = spec
+    with _REGISTRY_LOCK:
+        _REGISTRY[name] = spec
+        for a in aliases:
+            _REGISTRY[a] = spec
     return spec
 
 
@@ -960,13 +965,15 @@ register(
 # ===================================================================================
 
 _TOKENIZERS: Dict[str, object] = {}
+_TOKENIZERS_LOCK = threading.Lock()
 
 
 def _load_tokenizer(name: str):
     """'bytes' builtin (UTF-8 byte ids, reversible, dependency-free) or a path
     to a HuggingFace tokenizers JSON file (BPE etc., no network needed)."""
-    if name in _TOKENIZERS:
-        return _TOKENIZERS[name]
+    with _TOKENIZERS_LOCK:
+        if name in _TOKENIZERS:
+            return _TOKENIZERS[name]
     if name == "bytes":
         tok = None
     else:
@@ -975,8 +982,11 @@ def _load_tokenizer(name: str):
         except ImportError as e:  # pragma: no cover
             raise ValueError(
                 "tokenize with a model file requires the 'tokenizers' package") from e
+        # loaded OUTSIDE the lock (file IO); a racing loader just builds the
+        # same immutable tokenizer and last-write-wins below
         tok = Tokenizer.from_file(name)
-    _TOKENIZERS[name] = tok
+    with _TOKENIZERS_LOCK:
+        _TOKENIZERS[name] = tok
     return tok
 
 

@@ -172,5 +172,5 @@ class HudiScanOperator(ScanOperator):
 
             total = sum(pq.read_metadata(p).num_rows for p in self._files)
             return float(total)
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- row estimate is advisory
             return None

@@ -19,6 +19,7 @@ import hashlib
 import hmac
 import os
 import random
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -742,6 +743,8 @@ class RangedObjectFile:
 
 
 _HTTP_BODY_CACHE: "dict[str, Tuple[bytes, float]]" = {}
+# concurrent queries (serving tier) share this module-level cache
+_HTTP_BODY_CACHE_LOCK = threading.Lock()
 
 
 def open_input(path: str, config: Optional[IOConfig] = None):
@@ -757,13 +760,15 @@ def open_input(path: str, config: Optional[IOConfig] = None):
         # A tiny TTL'd body cache stops schema inference + row-count estimation
         # + the actual scan from downloading the same file repeatedly within
         # one query, without serving stale bytes across sessions.
-        entry = _HTTP_BODY_CACHE.get(path)
+        with _HTTP_BODY_CACHE_LOCK:
+            entry = _HTTP_BODY_CACHE.get(path)
         if entry is not None and time.time() - entry[1] < 60.0:
             body = entry[0]
         else:
-            body = source.get(rel)
-            _HTTP_BODY_CACHE[path] = (body, time.time())
-            while len(_HTTP_BODY_CACHE) > 2:
-                _HTTP_BODY_CACHE.pop(next(iter(_HTTP_BODY_CACHE)))
+            body = source.get(rel)  # downloaded outside the lock
+            with _HTTP_BODY_CACHE_LOCK:
+                _HTTP_BODY_CACHE[path] = (body, time.time())
+                while len(_HTTP_BODY_CACHE) > 2:
+                    _HTTP_BODY_CACHE.pop(next(iter(_HTTP_BODY_CACHE)))
         return pa.BufferReader(body)
     return pa.PythonFile(RangedObjectFile(source, rel), mode="r")

@@ -69,7 +69,7 @@ class ParquetScanOperator(ScanOperator):
         for p in self._paths:
             try:
                 total += pq.ParquetFile(open_input(p)).metadata.num_rows
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- row estimate is advisory
                 return None
         if pushdowns.limit is not None:
             total = min(total, pushdowns.limit)
@@ -165,8 +165,8 @@ def _file_prunable(path: str, conjuncts: List[tuple]) -> bool:
             if not excluded:
                 return False  # this row group might match
         return md.num_row_groups > 0
-    except Exception:
-        return False  # never prune on metadata trouble
+    except Exception:  # lint: ignore[broad-except] -- never prune on metadata trouble
+        return False
 
 
 def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
@@ -265,5 +265,5 @@ def _expr_to_arrow_filter(expr) -> Optional[pads.Expression]:
 
     try:
         return conv(expr)
-    except Exception:
+    except Exception:  # lint: ignore[broad-except] -- unconvertible filter: scan without pushdown
         return None

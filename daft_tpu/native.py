@@ -37,8 +37,8 @@ def _try_build() -> None:
              os.path.join(src_dir, "src", "kernels.cpp"), "-o", _SO_PATH],
             check=True, capture_output=True, timeout=120,
         )
-    except Exception:
-        pass
+    except Exception:  # lint: ignore[broad-except] -- native kernels are optional acceleration;
+        pass  # get_lib() returns None and every caller has a python path
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

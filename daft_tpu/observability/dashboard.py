@@ -328,7 +328,7 @@ class _Handler(BaseHTTPRequestHandler):
             extra["hbm_bytes_resident"] = st.get("hbm_bytes_resident", 0)
             extra["hbm_bytes_high_water"] = st.get("hbm_bytes_high_water", 0)
             extra["hbm_entries"] = st.get("hbm_entries", 0)
-        except Exception:  # noqa: BLE001 — a scrape must never 500 on a device-less host
+        except Exception:  # lint: ignore[broad-except] -- a scrape must never 500 on a device-less host
             extra["hbm_bytes_resident"] = 0
         state = self.server.state
         with state._lock:

@@ -47,6 +47,8 @@ class EventLogSubscriber(Subscriber):
     def _emit(self, kind: str, payload: dict) -> None:
         rec = {"ts": time.time(), "schema_version": SCHEMA_VERSION,
                "event": kind, **payload}
+        # lint: ignore[blocking-under-lock] -- the lock exists to serialize
+        # appends to this log file; subscribers are off the engine hot path
         with self._lock, open(self.path, "a") as f:
             f.write(json.dumps(rec, default=str) + "\n")
 

@@ -111,27 +111,134 @@ class MetricsRegistry:
 
 _REGISTRY = MetricsRegistry()
 
-# Counters owned by lazily-imported subsystems, pre-declared here so the
-# Prometheus exposition is import-order independent: a scraper must see the
-# series at 0 from the first scrape of a fresh process, not only after the
-# owning module happens to load (execution/memory.py declares these too —
-# declare() is a setdefault — and documents their semantics). The serving
-# tier's admission counters/gauges join them: daft_tpu_admission_waits_total
-# and daft_tpu_serve_queue_depth must be scrapeable from the first scrape
-# even if no ServingSession was ever constructed.
-_REGISTRY.declare("spill_batches", "spill_bytes", "admission_waits_total",
-                  "serve_prepared_hits", "serve_prepared_misses",
-                  "serve_queries_total", "serve_cancelled_total")
+# ---- the metric-name vocabulary -----------------------------------------------------
+# Single home for every counter/gauge name the engine writes. The lint rule
+# `counter-discipline` (daft_tpu/tools/lint/) checks each literal
+# registry().inc()/set_gauge()/bump() name in the codebase against the
+# DECLARED_COUNTERS / DECLARED_GAUGES tuples below, and everything here is
+# pre-declared at import time so the Prometheus exposition is import-order
+# independent: a scraper sees every series at 0 from the first scrape of a
+# fresh process, not only after the owning (often lazily-imported) module
+# happens to load or the first increment lands.
+
+# Device/mesh/UDF path attribution. ops/counters.py re-exports this group as
+# COUNTER_NAMES (PEP 562 attribute views + the scoped test/bench reset).
+DEVICE_COUNTER_NAMES = (
+    "device_stage_batches",    # batches through FilterAggStage (ungrouped)
+    "device_grouped_batches",  # batches through GroupedAggStage
+    "device_stage_runs",       # completed device agg node executions
+    "mesh_grouped_runs",       # grouped aggs executed via the mesh-sharded path
+    "mesh_dispatches",         # multi-device shard_map/pjit dispatches issued
+    "mesh_unavailable_fallbacks",  # forced mesh_devices > local devices -> single-chip
+    "mesh_capacity_growths",   # mesh group-table capacity grown mid-run (recompile)
+    "device_join_batches",     # batches through the gather-join device stages
+    "device_topn_runs",        # join+agg+TopN fused device programs completed
+    # device-UDF tier (ops/udf_stage.py): jax-traceable model UDFs as stages
+    "device_udf_dispatches",   # compiled UDF program dispatches (super-batches)
+    "device_udf_rows",         # real rows through device UDF dispatches
+    "device_udf_runs",         # completed DeviceUdfProject device executions
+    "device_udf_fallbacks",    # device-UDF stages rerouted to the host path
+    "device_udf_weight_h2d_bytes",  # model weight bytes uploaded (flat on repeats)
+    "rejection_log_dropped",   # reject() entries dropped once rejection_log filled
+    # adaptive batching + device dispatch coalescing (execution/batching.py,
+    # ops/stage.py DispatchCoalescer)
+    "dispatch_coalesced",      # super-batch dispatches issued by the coalescer
+    "coalesce_morsels_in",     # morsels consumed (÷ dispatch_coalesced = amortization)
+    "bucket_fill_rows",        # real rows covered by coalesced dispatches
+    "bucket_capacity_rows",    # padded bucket rows (fill ratio denominator)
+    "morsel_resize",           # adaptive batching morsel-size changes
+    # HBM residency manager (daft_tpu/device/residency.py)
+    "hbm_cache_hits",          # residency lookups served from HBM
+    "hbm_cache_misses",        # residency lookups that built/uploaded
+    "hbm_evictions",           # entries evicted under the HBM budget
+    "hbm_eviction_bytes",      # device bytes released by evictions
+    "hbm_pins",                # entries pinned by an executing query
+    "hbm_h2d_bytes",           # host->device column upload bytes
+    "hbm_stable_rehits",       # slots rebound by content identity (repeat sub-plans)
+    "hbm_evict_cost_saved",    # µs of rebuild cost avoided vs pure-LRU eviction
+    # distributed cache-affinity scheduling (distributed/scheduler.py)
+    "sched_affinity_hits",     # tasks placed on a worker holding their planes
+    "sched_affinity_misses",   # fingerprinted tasks spread off a full preferred worker
+    "sched_affinity_skips",    # hard-affinity heap skips (head-of-line guard)
+    "sched_bytes_avoided",     # est. h2d bytes saved by affinity placements
+    # speculative re-execution (distributed/worker.py dispatcher)
+    "sched_speculative_dispatches",
+    "sched_speculative_wins",  # races the speculative copy actually won
+    # serving tier (daft_tpu/serving/): admission + prepared-query cache
+    "admission_waits_total",   # queries queued at the HBM admission controller
+    "serve_queries_total",     # queries executed through a ServingSession
+    "serve_prepared_hits",     # prepared-query cache hits (planning skipped)
+    "serve_prepared_misses",   # prepared-query cache misses (planned + cached)
+    "serve_pin_calibrations",  # reservations shrunk toward observed pin high-water
+    # checkpoint store GC (checkpoint/stages.py sweep_expired)
+    "checkpoint_stages_gced",  # committed stages removed by the TTL sweep
+)
+
+# Serving-tier counters OUTSIDE the ops/counters.py reset scope (cancellation
+# is resolved on the session thread; a bench/test device-counter reset must
+# not wipe it mid-session).
+SERVING_COUNTER_NAMES = ("serve_cancelled_total",)
+
+# Shuffle/transport volume (distributed/shuffle.py ShuffleRecorder rollups,
+# distributed/fetch_server.py).
+SHUFFLE_COUNTER_NAMES = (
+    "shuffle_bytes_written",      # logical Arrow bytes into map files
+    "shuffle_logical_bytes",      # alias kept distinct for compression ratio
+    "shuffle_rows_written",
+    "shuffle_wire_bytes",         # bytes that actually hit disk/the wire
+    "shuffle_bytes_fetched",      # wire bytes received by reduce fetches
+    "shuffle_rows_fetched",
+    "shuffle_fetch_seconds",      # cumulative per-request in-flight time
+    "shuffle_fetch_wall_seconds", # union transfer window
+    "shuffle_overlap_seconds",    # cumulative - wall = transfer overlapped
+    "shuffle_fetch_server_requests",
+    "shuffle_fetch_server_bytes",
+)
+
 # Elastic fault tolerance (distributed/worker.py liveness monitor,
 # distributed/planner.py lost-map regeneration, checkpoint/stages.py,
 # fetch_server.py transient retry): recovery is exactly the regime where a
-# scraper must see the series from scrape one — declared here, not in the
-# lazily-imported owners.
-_REGISTRY.declare("worker_failures_total", "tasks_requeued_total",
-                  "worker_respawns_total", "shuffle_maps_regenerated_total",
-                  "fetch_retries_total", "checkpoint_stages_committed",
-                  "checkpoint_stages_skipped", "checkpoint_commit_failures")
-_REGISTRY.set_gauge("serve_queue_depth", 0.0)
+# scraper must see the series from scrape one.
+FAULT_COUNTER_NAMES = (
+    "worker_failures_total", "tasks_requeued_total", "worker_respawns_total",
+    "shuffle_maps_regenerated_total", "fetch_retries_total",
+    "checkpoint_stages_committed", "checkpoint_stages_skipped",
+    "checkpoint_commit_failures",
+    "checkpoint_restore_failures",  # committed stage unreadable -> stage re-run
+)
+
+# Observability self-monitoring: subscriber callbacks that raised (swallowed
+# so a broken subscriber can't fail a query — counted so it isn't invisible).
+OBS_COUNTER_NAMES = ("subscriber_errors",)
+
+# Host memory manager spill (execution/memory.py documents the semantics).
+SPILL_COUNTER_NAMES = ("spill_batches", "spill_bytes")
+
+DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
+                     SHUFFLE_COUNTER_NAMES + FAULT_COUNTER_NAMES +
+                     SPILL_COUNTER_NAMES + OBS_COUNTER_NAMES)
+
+DECLARED_GAUGES = (
+    "serve_queue_depth",       # admission queue depth (serving/session.py)
+    "hbm_bytes_resident",      # device bytes the residency manager holds
+    "hbm_bytes_high_water",
+    "hbm_reserved_bytes",      # admission-controller reservations outstanding
+    "shuffle_fetch_inflight",  # high-water concurrent fetch requests
+    "mesh_devices_used",       # devices of the last mesh dispatch
+    "bucket_fill_ratio",       # coalescer padding efficiency (per run)
+)
+
+
+def declare_vocabulary(reg: "MetricsRegistry") -> None:
+    """Pre-register the full vocabulary (counters at 0, gauges seeded 0.0) —
+    called on the process registry at import; tests call it on fresh
+    registries to assert first-scrape visibility."""
+    reg.declare(*DECLARED_COUNTERS)
+    for g in DECLARED_GAUGES:
+        reg.set_gauge(g, 0.0)
+
+
+declare_vocabulary(_REGISTRY)
 
 
 def registry() -> MetricsRegistry:

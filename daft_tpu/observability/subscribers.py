@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import List
 
+from .metrics import registry
 from .events import (OperatorStats, QueryEnd, QueryOptimized, QueryStart,
                      ServeQueryRecord, ShuffleStats, TaskStats,
                      WorkerHeartbeat)
@@ -78,4 +79,7 @@ def notify(method: str, *args) -> None:
         try:
             getattr(s, method)(*args)
         except Exception:
-            pass  # a broken subscriber must never fail the query
+            # a broken subscriber must never fail the query — but its
+            # failures must be visible somewhere, so they hit the scrape
+            # surface instead of vanishing
+            registry().inc("subscriber_errors")

@@ -36,11 +36,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+from ..utils.env import env_float as _env_f
 
 
 @dataclass(frozen=True)

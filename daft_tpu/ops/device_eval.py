@@ -72,7 +72,7 @@ def is_device_evaluable(expr: Expression, schema: Schema) -> bool:
     """True if the whole expression tree can run on device for this input schema."""
     try:
         out_dt = expr.to_field(schema).dtype
-    except Exception:
+    except Exception:  # lint: ignore[broad-except] -- untypeable = not device-evaluable
         return False
     if not _dtype_on_device(out_dt):
         return False
@@ -128,7 +128,7 @@ def _temporal_operands_aligned(exprs, schema: Schema) -> bool:
     for e in exprs:
         try:
             dts.append(e.to_field(schema).dtype)
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- untypeable = not device-evaluable
             return False
     temporal = [dt for dt in dts if dt.is_temporal()]
     if not temporal:

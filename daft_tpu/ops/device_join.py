@@ -329,7 +329,7 @@ def try_capture_join_agg(agg_plan) -> Optional[JoinAggSpec]:
                 return None  # numeric dim math can gather its leaves directly
             try:
                 node.to_field(dim_schema)
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- untypeable = not capturable
                 return None
             syn = f"__syn_{s}_{counter[0]}__"
             counter[0] += 1
@@ -1148,7 +1148,7 @@ class _FactorizedCodes:
                 if vals.dtype.kind in "biufM":
                     _u, inv = np.unique(vals[valid], return_inverse=True)
                     dense = inv
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- falls back to python comparison
                 dense = None
             if dense is None:  # strings/objects: python comparison
                 arr = s_first.to_pylist()
@@ -1277,7 +1277,7 @@ class DeviceJoinGroupedRun(GroupedAggRun):
                 else self.ctx._dim_source(side, name)
             try:
                 _c, _v, k = src.dict_codes()
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- estimate only; caller treats None as unknown
                 return None
             total *= max(k, 1)
         return total

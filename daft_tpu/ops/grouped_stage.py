@@ -36,6 +36,7 @@ failed or interrupted runs can never corrupt subsequent runs of the same query.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -976,6 +977,8 @@ class _Decode:
 
 
 _STAGE_CACHE: Dict[tuple, GroupedAggStage] = {}
+# concurrent serving queries share this cache (PR 8 discipline)
+_CACHE_LOCK = threading.Lock()
 
 
 def try_build_grouped_agg_stage(schema: Schema, predicate: Optional[Expression],
@@ -1018,5 +1021,6 @@ def try_build_grouped_agg_stage(schema: Schema, predicate: Optional[Expression],
             if isinstance(node, AggExpr):
                 return None
     stage = GroupedAggStage(schema, predicate, groupby, aggs)
-    _STAGE_CACHE[key] = stage
+    with _CACHE_LOCK:
+        _STAGE_CACHE[key] = stage
     return stage

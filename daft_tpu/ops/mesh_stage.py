@@ -34,6 +34,7 @@ allocations).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -65,6 +66,8 @@ def mesh_total(n: int, n_devices: int) -> int:
 
 
 _ROW_MASK_CACHE: Dict[tuple, jax.Array] = {}
+# concurrent serving queries share this module's caches (PR 8 discipline)
+_CACHE_LOCK = threading.Lock()
 
 
 def mesh_row_mask(mesh, n: int, total: int) -> jax.Array:
@@ -72,14 +75,16 @@ def mesh_row_mask(mesh, n: int, total: int) -> jax.Array:
     mask depends only on (n, total, mesh size), and re-uploading it per
     dispatch would ship `total` bytes for nothing)."""
     key = (n, total, int(mesh.shape[_MESH_AXIS]))
-    cached = _ROW_MASK_CACHE.get(key)
+    with _CACHE_LOCK:
+        cached = _ROW_MASK_CACHE.get(key)
     if cached is None:
         m = np.zeros(total, dtype=bool)
         m[:n] = True
         cached = jax.device_put(m, NamedSharding(mesh, P(_MESH_AXIS)))
-        _ROW_MASK_CACHE[key] = cached
-        if len(_ROW_MASK_CACHE) > 64:
-            _ROW_MASK_CACHE.pop(next(iter(_ROW_MASK_CACHE)))
+        with _CACHE_LOCK:
+            _ROW_MASK_CACHE[key] = cached
+            while len(_ROW_MASK_CACHE) > 64:
+                _ROW_MASK_CACHE.pop(next(iter(_ROW_MASK_CACHE)))
     return cached
 
 
@@ -493,7 +498,8 @@ def try_build_mesh_filter_agg_stage(schema: Schema,
     if single is None:
         return None
     stage = MeshFilterAggStage(schema, predicate, single.aggs, n_devices)
-    _FILTER_STAGE_CACHE[key] = stage
+    with _CACHE_LOCK:
+        _FILTER_STAGE_CACHE[key] = stage
     return stage
 
 
@@ -519,7 +525,8 @@ def try_build_mesh_grouped_agg_stage(schema: Schema,
         return None
     stage = MeshGroupedStage(schema, predicate, single.groupby, single.aggs,
                              n_devices, initial_capacity=initial_capacity)
-    _GROUPED_STAGE_CACHE[key] = stage
+    with _CACHE_LOCK:
+        _GROUPED_STAGE_CACHE[key] = stage
     return stage
 
 

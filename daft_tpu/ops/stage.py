@@ -20,6 +20,7 @@ identical queries never share accumulators.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,6 +47,8 @@ def pad_bucket(n: int) -> int:
 
 
 _ROW_MASK_CACHE: Dict[Tuple[int, int], object] = {}
+# concurrent serving queries share this module's caches (PR 8 discipline)
+_CACHE_LOCK = threading.Lock()
 
 
 def device_row_mask(n: int, bucket: int):
@@ -55,13 +58,18 @@ def device_row_mask(n: int, bucket: int):
     re-uploads bucket bytes (8MB at bucket=8M — ~0.1s over a tunneled link).
     """
     key = (n, bucket)
-    if key not in _ROW_MASK_CACHE:
-        m = np.zeros(bucket, dtype=bool)
-        m[:n] = True
-        _ROW_MASK_CACHE[key] = jnp.asarray(m)
-        if len(_ROW_MASK_CACHE) > 64:
+    with _CACHE_LOCK:
+        cached = _ROW_MASK_CACHE.get(key)
+    if cached is not None:
+        return cached
+    m = np.zeros(bucket, dtype=bool)
+    m[:n] = True
+    dev_mask = jnp.asarray(m)  # h2d upload stays outside the lock
+    with _CACHE_LOCK:
+        _ROW_MASK_CACHE[key] = dev_mask
+        while len(_ROW_MASK_CACHE) > 64:
             _ROW_MASK_CACHE.pop(next(iter(_ROW_MASK_CACHE)))
-    return _ROW_MASK_CACHE[key]
+    return dev_mask
 
 
 def _decompose_agg(op: str) -> List[str]:
@@ -364,5 +372,6 @@ def try_build_filter_agg_stage(schema: Schema, predicate: Optional[Expression],
             return None
         aggs.append((name, inner))
     stage = FilterAggStage(schema, predicate, aggs)
-    _STAGE_CACHE[key] = stage
+    with _CACHE_LOCK:
+        _STAGE_CACHE[key] = stage
     return stage

@@ -273,7 +273,7 @@ def func_fingerprint(func) -> str:
         for cell in getattr(fn, "__closure__", None) or ():
             try:
                 h.update(repr(cell.cell_contents)[:4096].encode())
-            except Exception:
+            except Exception:  # lint: ignore[broad-except] -- unreprable cell still feeds the hash
                 h.update(b"?")
         tail = h.hexdigest()
     else:

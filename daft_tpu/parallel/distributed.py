@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import threading
+
 import numpy as np
 
 from ..utils import jax_setup  # noqa: F401
@@ -37,6 +39,8 @@ _KEY_SENTINEL = np.iinfo(np.int64).max
 
 
 _MESH_CACHE: Dict[Tuple[int, str], Mesh] = {}
+# kernels are built from concurrent serving/executor threads (PR 8 discipline)
+_CACHE_LOCK = threading.Lock()
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
@@ -54,9 +58,10 @@ def default_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
             f"default_mesh: {n} devices requested but only {len(devs)} "
             f"available (jax.devices())")
     key = (n, axis)
-    cached = _MESH_CACHE.get(key)
-    if cached is None:
-        cached = _MESH_CACHE[key] = Mesh(np.array(devs[:n]), (axis,))
+    with _CACHE_LOCK:
+        cached = _MESH_CACHE.get(key)
+        if cached is None:
+            cached = _MESH_CACHE[key] = Mesh(np.array(devs[:n]), (axis,))
     return cached
 
 
@@ -239,7 +244,8 @@ def sharded_groupby_step(mesh: Mesh, agg_ops: Sequence[str], capacity: int,
     in_specs = tuple([P(axis), P(axis)] + [P(axis)] * (2 * len(ops)))
     out_specs = (P(), P(), P(), tuple((P(), P()) for _ in ops))
     step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
-    _STEP_CACHE[cache_key] = step
+    with _CACHE_LOCK:
+        _STEP_CACHE[cache_key] = step
     return step
 
 
@@ -285,7 +291,8 @@ def sharded_gather_step(mesh: Mesh, n_cols: int, axis: str = "dp") -> Callable:
     in_specs = tuple([P(axis), P(axis)] + [P()] * (2 * n_cols))
     out_specs = tuple((P(axis), P(axis)) for _ in range(n_cols))
     step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
-    _STEP_CACHE[cache_key] = step
+    with _CACHE_LOCK:
+        _STEP_CACHE[cache_key] = step
     return step
 
 
@@ -347,7 +354,8 @@ def sharded_join_agg_step(mesh: Mesh, specs: Sequence[Tuple[str, int]],
                  for i, (op, _src) in enumerate(specs)
                  for partial in _decompose_agg(op)}
     step = jax.jit(_shard_map(local, mesh, in_specs, out_specs))
-    _STEP_CACHE[cache_key] = step
+    with _CACHE_LOCK:
+        _STEP_CACHE[cache_key] = step
     return step
 
 

@@ -93,7 +93,7 @@ def simplify_expr(e: Expression, schema=None) -> Expression:
         try:
             if out.to_field(schema).dtype != node.to_field(schema).dtype:
                 return None  # rewrite would change the output dtype
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- untypeable rewrite: keep the original
             return None
         return out
 
@@ -148,7 +148,7 @@ def simplify_expr(e: Expression, schema=None) -> Expression:
                 # masked-where semantics), not the if_false branch
                 try:
                     return Literal(None).cast(node.to_field(schema).dtype)
-                except Exception:
+                except Exception:  # lint: ignore[broad-except] -- uncastable: skip the rewrite
                     return None
         return None
 
@@ -173,7 +173,7 @@ def _fold_literal_binop(node) -> Optional[Expression]:
         if out.dtype != s.dtype and not out.dtype.is_null():
             return None  # dtype would change (e.g. int literal for float result)
         return out
-    except Exception:
+    except Exception:  # lint: ignore[broad-except] -- unfoldable expression: keep the original
         return None
 
 

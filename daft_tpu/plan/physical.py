@@ -493,12 +493,16 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
 
         cfg = config or execution_config()
         if getattr(cfg, "device_mode", "off") != "off":
+            from ..ops import counters
             from ..ops.device_join import try_capture_join_topn
 
             try:
                 cap3 = try_capture_join_topn(plan)
             except Exception:
-                cap3 = None  # capture must never break planning
+                # capture must never break planning, but a capture BUG must
+                # not silently cost every query its device tier either
+                counters.reject("capture", "join TopN capture raised")
+                cap3 = None
             if cap3 is not None:
                 jspec, topn, out_map = cap3
                 host = PhysTopN(translate(plan.input, config), plan.sort_by,
@@ -520,12 +524,15 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
 
         cfg = config or execution_config()
         if getattr(cfg, "device_mode", "off") != "off":
+            from ..ops import counters
             from ..ops.device_join import try_capture_join_agg
 
             try:
                 jspec = try_capture_join_agg(plan)
             except Exception:
-                jspec = None  # capture must never break planning
+                # same contract as the TopN capture above: degrade AND count
+                counters.reject("capture", "join agg capture raised")
+                jspec = None
             if jspec is not None:
                 host = _translate_agg_host(plan, config)
                 return DeviceJoinAgg(

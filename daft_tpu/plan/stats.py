@@ -66,7 +66,7 @@ def estimate_rows(plan: lp.LogicalPlan) -> Optional[float]:
     if isinstance(plan, lp.ScanSource):
         try:
             return plan.scan_op.approx_num_rows(plan.pushdowns)
-        except Exception:
+        except Exception:  # lint: ignore[broad-except] -- row estimate is advisory
             return None
     if isinstance(plan, lp.Filter):
         child = estimate_rows(plan.input)
@@ -182,7 +182,7 @@ def _chao1_distinct(series, n_rows: int) -> float:
     try:
         vals = sample.to_numpy()
         _, counts = np.unique(vals, return_counts=True)
-    except Exception:
+    except Exception:  # lint: ignore[broad-except] -- falls through to the python-object path
         from collections import Counter
 
         counts = np.array(list(Counter(sample.to_pylist()).values()))

@@ -97,7 +97,7 @@ def estimate_pin_bytes(physical) -> int:
         from ..distributed.affinity import plan_fingerprint
 
         fp = plan_fingerprint(physical)
-    except Exception:  # noqa: BLE001 — estimate is advisory
+    except Exception:  # lint: ignore[broad-except] -- estimate is advisory
         fp = ()
     total = sum(est for _k, est in fp)
     if total:
@@ -112,7 +112,7 @@ def estimate_pin_bytes(physical) -> int:
                     for part in scan.partitions:
                         for b in part.batches:
                             total += b.size_bytes()
-    except Exception:  # noqa: BLE001 — estimate is advisory
+    except Exception:  # lint: ignore[broad-except] -- estimate is advisory
         return total
     return total
 
@@ -202,7 +202,7 @@ class PreparedQueryCache:
             from ..distributed.affinity import plan_fingerprint
 
             fp = plan_fingerprint(physical)
-        except Exception:  # noqa: BLE001 — advisory
+        except Exception:  # lint: ignore[broad-except] -- affinity fingerprint is advisory
             fp = ()
         e = PreparedEntry(lits, optimized,
                           physical if keep_physical else None,

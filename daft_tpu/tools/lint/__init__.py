@@ -1,0 +1,27 @@
+"""Engine-invariant linter (`python -m daft_tpu.tools.lint`).
+
+A single-parse AST rule engine that makes the engine's hard-won disciplines
+permanent instead of tribal. Rules (see each module's docstring for the bug
+class it encodes):
+
+- ``lock-discipline``      concurrency.py  module caches mutated without locks
+- ``blocking-under-lock``  concurrency.py  pickling/IO inside a with-lock body
+- ``env-discipline``       config_rules.py raw int/float over os.environ
+- ``knob-registry``        config_rules.py DAFT_TPU_* knobs absent from README
+- ``import-discipline``    config_rules.py top-level tier/jax imports outside the tier
+- ``counter-discipline``   obs_rules.py    metric names not pre-declared
+- ``broad-except``         obs_rules.py    silent except Exception
+- ``atomic-publish``       publish.py      shared-dir writes without tmp+os.replace
+- ``schema-drift``         obs_rules.py    event fields changed, version not bumped
+- ``bad-suppression``      engine.py       unjustified / stale ignore markers
+
+Per-line escape hatch (justification required):
+
+    cache[k] = v  # lint: ignore[lock-discipline] -- populated before threads start
+
+``baseline.json`` grandfathers pre-existing findings per (file, rule) count;
+anything beyond the baseline fails. Wired into tier-1 via tests/test_lint.py
+and `make lint`.
+"""
+
+from .engine import Finding, LintResult, lint, lint_source  # noqa: F401

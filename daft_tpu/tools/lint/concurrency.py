@@ -1,0 +1,177 @@
+"""Concurrency rules: lock-discipline and its dual, blocking-under-lock.
+
+lock-discipline — the PR 8/10 review-cycle bug class: a module-level mutable
+container (the _ANCHORS/_PROGRAM_CACHE/_STAGE_CACHE pattern) mutated from a
+function without holding a lock defined in the same module races under the
+serving tier's concurrent query threads (dict iteration during eviction was
+the observed failure).
+
+blocking-under-lock — the PR 9 heartbeat-silencing bug class: blocking work
+(pickling a multi-second result, socket sends, file IO, device_get) inside a
+``with <lock>`` body starves every other acquirer; when the lock is shared
+with a liveness path the stall reads as death and a healthy worker gets
+SIGKILLed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from . import policy
+from .engine import Finding, ModuleContext, ProjectContext
+
+
+def _module_assignments(ctx: ModuleContext):
+    for stmt in ctx.module_level_stmts():
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            yield stmt.targets[0].id, stmt.value, stmt
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None and \
+                isinstance(stmt.target, ast.Name):
+            yield stmt.target.id, stmt.value, stmt
+
+
+def module_locks(ctx: ModuleContext) -> Set[str]:
+    locks: Set[str] = set()
+    for name, value, _ in _module_assignments(ctx):
+        if isinstance(value, ast.Call):
+            dotted = ModuleContext.dotted(value.func)
+            if dotted in policy.LOCK_FACTORIES:
+                locks.add(name)
+    return locks
+
+
+def module_containers(ctx: ModuleContext) -> Dict[str, int]:
+    """{name: lineno} of module-level mutable containers."""
+    out: Dict[str, int] = {}
+    for name, value, stmt in _module_assignments(ctx):
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                              ast.ListComp, ast.SetComp)):
+            out[name] = stmt.lineno
+        elif isinstance(value, ast.Call):
+            dotted = ModuleContext.dotted(value.func)
+            if dotted in policy.CONTAINER_FACTORIES:
+                out[name] = stmt.lineno
+    return out
+
+
+def _held_locks(ctx: ModuleContext, node: ast.AST,
+                locks: Set[str]) -> Set[str]:
+    """Module-lock names held at `node` via enclosing `with` statements.
+    The walk stops at the nearest function boundary: a `with` outside the
+    function defines when the function OBJECT was created, not when its body
+    runs, so locks beyond it are never credited."""
+    held: Set[str] = set()
+    cur, child = ModuleContext.parent(node), node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(cur, (ast.With, ast.AsyncWith)) and child in cur.body:
+            for item in cur.items:
+                dotted = ModuleContext.dotted(item.context_expr)
+                if dotted in locks:
+                    held.add(dotted)
+        cur, child = ModuleContext.parent(cur), cur
+    return held
+
+
+def _mutated_container(node: ast.AST,
+                       containers: Dict[str, int]) -> Optional[str]:
+    """The container name this statement/expression mutates, if any."""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name) \
+                    and t.value.id in containers:
+                return t.value.id
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name) \
+                    and t.value.id in containers:
+                return t.value.id
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        recv = node.func.value
+        if isinstance(recv, ast.Name) and recv.id in containers and \
+                node.func.attr in policy.MUTATOR_METHODS:
+            return recv.id
+    return None
+
+
+def check_lock_discipline(ctx: ModuleContext,
+                          project: ProjectContext) -> List[Finding]:
+    containers = module_containers(ctx)
+    if not containers:
+        return []
+    locks = module_locks(ctx)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        name = _mutated_container(node, containers)
+        if name is None:
+            continue
+        if ModuleContext.enclosing_function(node) is None:
+            continue  # import-time population runs under the import lock
+        if _held_locks(ctx, node, locks):
+            continue
+        if locks:
+            hint = f"guard it with `with {sorted(locks)[0]}:`"
+        else:
+            hint = ("define a module-level threading.Lock and guard every "
+                    "mutation site")
+        findings.append(Finding(
+            ctx.rel, node.lineno, "lock-discipline",
+            f"module-level mutable `{name}` mutated without holding a "
+            f"module lock — {hint}"))
+    return findings
+
+
+def _is_lockish(dotted: Optional[str], locks: Set[str]) -> bool:
+    if dotted is None:
+        return False
+    if dotted in locks:
+        return True
+    last = dotted.rsplit(".", 1)[-1]
+    return "lock" in last.lower()
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    dotted = ModuleContext.dotted(node.func)
+    if dotted is not None:
+        for suffix in policy.BLOCKING_CALL_SUFFIXES:
+            if dotted == suffix or dotted.endswith("." + suffix):
+                return dotted
+        if dotted in policy.BLOCKING_NAMES:
+            return dotted
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in policy.BLOCKING_ATTRS:
+        return dotted or node.func.attr
+    return None
+
+
+def check_blocking_under_lock(ctx: ModuleContext,
+                              project: ProjectContext) -> List[Finding]:
+    locks = module_locks(ctx)
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, lock: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            lock = None  # closure bodies don't run under the enclosing with
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                dotted = ModuleContext.dotted(item.context_expr)
+                if _is_lockish(dotted, locks):
+                    lock = dotted
+        if lock is not None and isinstance(node, ast.Call):
+            blocked = _blocking_call(node)
+            if blocked is not None:
+                findings.append(Finding(
+                    ctx.rel, node.lineno, "blocking-under-lock",
+                    f"`{blocked}(...)` inside `with {lock}:` — do the "
+                    "blocking work outside the lock (the PR 9 "
+                    "heartbeat-silencing bug class)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, lock)
+
+    visit(ctx.tree, None)
+    return findings

@@ -1,0 +1,132 @@
+"""Config-discipline rules: env parsing, knob documentation, tier imports.
+
+env-discipline — raw ``int(os.environ...)``/``float(os.environ...)`` crashes
+a worker or driver at import/spawn time on a typo'd value; utils/env.py
+exists so every knob degrades to its default instead. Any parse outside that
+module is a regression.
+
+knob-registry — every ``DAFT_TPU_*`` name that appears in code must appear in
+README.md's configuration reference: 64 knobs existed in code when only ~31
+were documented, which is how operators end up cargo-culting env vars out of
+the source.
+
+import-discipline — the zero-overhead contract, statically: modules outside
+the device/mesh/checkpoint/udf tier must not import the tier (or jax) at
+module top level, or a host-only query pays the tier's import cost.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from . import policy
+from .engine import Finding, ModuleContext, ProjectContext
+
+_KNOB_RE = re.compile(policy.KNOB_PREFIX + r"[A-Z0-9_]+")
+
+
+def _contains_environ(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("environ", "getenv"):
+            return True
+        if isinstance(n, ast.Name) and n.id in ("environ", "getenv"):
+            return True
+    return False
+
+
+def check_env_discipline(ctx: ModuleContext,
+                         project: ProjectContext) -> List[Finding]:
+    if ctx.rel == policy.ENV_HELPER_MODULE:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float")):
+            continue
+        if any(_contains_environ(a) for a in node.args):
+            helper = "env_int" if node.func.id == "int" else "env_float"
+            findings.append(Finding(
+                ctx.rel, node.lineno, "env-discipline",
+                f"raw `{node.func.id}(os.environ...)` parse — use "
+                f"`daft_tpu.utils.env.{helper}` so a malformed value "
+                "degrades to the default instead of raising"))
+    return findings
+
+
+def check_knob_registry(ctx: ModuleContext,
+                        project: ProjectContext) -> List[Finding]:
+    """Scans raw source lines (docstrings and comments reference knobs too —
+    a knob only mentioned in a comment is still part of the operator-facing
+    vocabulary and belongs in the README table)."""
+    findings: List[Finding] = []
+    seen: Dict[str, int] = {}
+    for i, line in enumerate(ctx.lines, start=1):
+        for knob in _KNOB_RE.findall(line):
+            if knob not in seen:
+                seen[knob] = i
+    for knob, line in sorted(seen.items(), key=lambda kv: kv[1]):
+        if knob not in project.readme_knobs:
+            findings.append(Finding(
+                ctx.rel, line, "knob-registry",
+                f"`{knob}` is read in code but absent from README.md's "
+                "configuration reference — document it (name, default, "
+                "what it does)"))
+    return findings
+
+
+def _resolve_import(ctx: ModuleContext, node: ast.ImportFrom) -> List[str]:
+    """Absolute dotted names a `from ... import ...` may bind, resolving
+    relative levels against the module's package."""
+    if node.level == 0:
+        base = node.module or ""
+    else:
+        parts = ctx.module.split(".")
+        if not ctx.is_package:
+            parts = parts[:-1]
+        if node.level > 1:
+            parts = parts[:-(node.level - 1)] if node.level - 1 <= len(parts) else []
+        base = ".".join(parts)
+        if node.module:
+            base = f"{base}.{node.module}" if base else node.module
+    names = [base] if base else []
+    for alias in node.names:
+        if base and alias.name != "*":
+            names.append(f"{base}.{alias.name}")
+    return names
+
+
+def _forbidden(name: str) -> bool:
+    return any(name == p or name.startswith(p + ".")
+               for p in policy.TIER_FORBIDDEN)
+
+
+def _tier_member(module: str) -> bool:
+    return any(module == p or module.startswith(p + ".")
+               for p in policy.TIER_MEMBERS)
+
+
+def check_import_discipline(ctx: ModuleContext,
+                            project: ProjectContext) -> List[Finding]:
+    if _tier_member(ctx.module):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if ModuleContext.enclosing_function(node) is not None:
+            continue  # lazy function-local import: exactly the blessed idiom
+        if ctx.in_type_checking_block(node):
+            continue  # annotation-only imports never execute
+        if isinstance(node, ast.Import):
+            hit = [a.name for a in node.names if _forbidden(a.name)]
+        else:
+            hit = [n for n in _resolve_import(ctx, node) if _forbidden(n)]
+        if hit:
+            findings.append(Finding(
+                ctx.rel, node.lineno, "import-discipline",
+                f"top-level import of tier module `{hit[0]}` from outside "
+                "the device/mesh/checkpoint/udf tier — import it inside the "
+                "function that needs it (zero-overhead contract)"))
+    return findings

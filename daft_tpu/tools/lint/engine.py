@@ -1,0 +1,471 @@
+"""Single-parse AST lint engine over the daft_tpu package.
+
+Each file is parsed once into a ModuleContext (tree with parent links,
+tokenized suppression comments); rule modules walk the tree and yield
+Findings. The engine then applies per-line suppressions
+(``# lint: ignore[rule-id] -- justification``), subtracts the grandfathered
+baseline (baseline.json: per-(file, rule) counts with a justification), and
+reports what's left as ``file:line rule-id message`` lines (or ``--json``).
+
+A suppression without a justification, or one that never matched a finding,
+is itself a finding (``bad-suppression``) — the escape hatch stays honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import policy
+
+# Composed so this file's own source never contains the live marker sequence
+# (the tokenizer only reads comments, but fixture snippets embed the marker in
+# string literals that ARE comments once written to disk).
+_SUPPRESS_RE = re.compile(
+    r"lint:\s*" + r"ignore\[([a-z0-9_,\s-]+)\]\s*(?:(?:--|—|:)\s*(\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str      # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.rule} {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int          # line the comment sits on
+    rules: Tuple[str, ...]
+    justification: str
+    target: int = 0    # code line the marker covers (== line for inline)
+    used: bool = False
+
+
+class ModuleContext:
+    """One parsed source file: tree with parent links + suppression map."""
+
+    def __init__(self, rel: str, module: str, source: str,
+                 is_package: bool = False):
+        self.rel = rel
+        self.module = module
+        self.source = source
+        self.lines = source.splitlines()
+        self.is_package = is_package
+        self.tree = ast.parse(source)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._lint_parent = node  # type: ignore[attr-defined]
+        self.suppressions: List[Suppression] = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> List[Suppression]:
+        out: List[Suppression] = []
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                line = tok.start[0]
+                out.append(Suppression(line, rules,
+                                       (m.group(2) or "").strip(),
+                                       target=self._marker_target(line)))
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def _marker_target(self, line: int) -> int:
+        """The code line a marker covers: its own line when inline with code;
+        for a standalone comment, the next line that isn't blank or another
+        comment (so a justification may wrap over several comment lines)."""
+        text = self.lines[line - 1] if line <= len(self.lines) else ""
+        if text.split("#", 1)[0].strip():
+            return line  # inline comment: code shares the line
+        for i in range(line, len(self.lines)):
+            nxt = self.lines[i].strip()
+            if nxt and not nxt.startswith("#"):
+                return i + 1
+        return line
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A suppression covers the code line it targets: its own line when
+        inline, else the first code line after the comment block."""
+        for s in self.suppressions:
+            if finding.line in (s.line, s.target) and finding.rule in s.rules:
+                s.used = True
+                return True
+        return False
+
+    # ---- shared AST helpers rules lean on ------------------------------------------
+
+    @staticmethod
+    def parent(node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_lint_parent", None)
+
+    @classmethod
+    def enclosing_function(cls, node: ast.AST) -> Optional[ast.AST]:
+        cur = cls.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = cls.parent(cur)
+        return None
+
+    @staticmethod
+    def dotted(expr: ast.AST) -> Optional[str]:
+        """'a.b.c' for Name/Attribute chains (Call at the base resolves
+        through: registry().inc -> 'registry().inc' is NOT produced; the base
+        call renders as its own dotted func + '()')."""
+        parts: List[str] = []
+        cur = expr
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+        elif isinstance(cur, ast.Call):
+            base = ModuleContext.dotted(cur.func)
+            if base is None:
+                return None
+            parts.append(base + "()")
+        else:
+            return None
+        return ".".join(reversed(parts))
+
+    def module_level_stmts(self) -> Iterable[ast.stmt]:
+        """Statements executed at import time: the module body plus bodies of
+        top-level If/Try blocks (the `if TYPE_CHECKING:` / try-import idiom)."""
+        def walk(body):
+            for stmt in body:
+                yield stmt
+                if isinstance(stmt, ast.If):
+                    yield from walk(stmt.body)
+                    yield from walk(stmt.orelse)
+                elif isinstance(stmt, ast.Try):
+                    yield from walk(stmt.body)
+                    yield from walk(stmt.orelse)
+                    yield from walk(stmt.finalbody)
+                    for h in stmt.handlers:
+                        yield from walk(h.body)
+        yield from walk(self.tree.body)
+
+    def in_type_checking_block(self, node: ast.AST) -> bool:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.If):
+                t = cur.test
+                name = self.dotted(t)
+                if name in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+                    return True
+            cur = self.parent(cur)
+        return False
+
+
+_KNOB_RE = re.compile(policy.KNOB_PREFIX + r"[A-Z0-9_]+")
+
+
+class ProjectContext:
+    """Cross-file facts rules need: the README's documented knob set, the
+    metric names metrics.py declares, and the pinned event-schema fingerprint."""
+
+    def __init__(self, root: str, modules: List[ModuleContext],
+                 readme_text: str = "",
+                 declared_counters: Optional[Set[str]] = None,
+                 declared_gauges: Optional[Set[str]] = None,
+                 schema_pin: Optional[dict] = None):
+        self.root = root
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+        self.readme_knobs: Set[str] = set(_KNOB_RE.findall(readme_text))
+        if declared_counters is None or declared_gauges is None:
+            c, g = self._collect_declared()
+            if declared_counters is None:
+                declared_counters = c
+            if declared_gauges is None:
+                declared_gauges = g
+        self.declared_counters = declared_counters
+        self.declared_gauges = declared_gauges
+        self.schema_pin = schema_pin
+
+    def _collect_declared(self) -> Tuple[Set[str], Set[str]]:
+        """String literals metrics.py pre-declares: DECLARED_COUNTERS /
+        DECLARED_GAUGES tuple elements plus direct declare()/set_gauge()
+        literals at module scope."""
+        counters: Set[str] = set()
+        gauges: Set[str] = set()
+        mod = self.by_rel.get(policy.METRICS_MODULE)
+        if mod is None:
+            return counters, gauges
+        # first pass: every module-level name -> the string literals its value
+        # holds, so DECLARED_COUNTERS = GROUP_A + GROUP_B resolves through
+        by_name: Dict[str, Set[str]] = {}
+        assigns: List[Tuple[str, ast.AST]] = []
+        for stmt in mod.module_level_stmts():
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                assigns.append((stmt.targets[0].id, stmt.value))
+        for name, value in assigns:
+            lits: Set[str] = set()
+            for n in ast.walk(value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    lits.add(n.value)
+                elif isinstance(n, ast.Name) and n.id in by_name:
+                    lits |= by_name[n.id]
+            by_name[name] = lits
+        counters |= by_name.get("DECLARED_COUNTERS", set())
+        gauges |= by_name.get("DECLARED_GAUGES", set())
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ModuleContext.dotted(node.func) or ""
+            attr = name.rsplit(".", 1)[-1]
+            if attr == "declare":
+                for a in node.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        counters.add(a.value)
+            elif attr in ("set_gauge", "set_gauge_max") and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    gauges.add(a.value)
+        return counters, gauges
+
+
+# ---- file discovery + project assembly ---------------------------------------------
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _module_name(root: str, fpath: str) -> Tuple[str, bool]:
+    rel = os.path.relpath(fpath, root)
+    parts = rel.replace(os.sep, "/").split("/")
+    is_package = parts[-1] == "__init__.py"
+    if is_package:
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts), is_package
+
+
+def build_project(root: str, paths: Iterable[str]) -> ProjectContext:
+    modules: List[ModuleContext] = []
+    for p in paths:
+        for f in _iter_py_files(p):
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            modname, is_pkg = _module_name(root, f)
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                modules.append(ModuleContext(rel, modname, src, is_pkg))
+            except SyntaxError as e:  # a broken file is a finding, not a crash
+                ctx = ModuleContext.__new__(ModuleContext)
+                ctx.rel, ctx.module, ctx.source = rel, modname, src
+                ctx.lines, ctx.is_package = src.splitlines(), is_pkg
+                ctx.tree, ctx.suppressions = None, []
+                ctx._syntax_error = e  # type: ignore[attr-defined]
+                modules.append(ctx)
+    readme = os.path.join(root, policy.README)
+    readme_text = ""
+    if os.path.exists(readme):
+        with open(readme, "r", encoding="utf-8") as fh:
+            readme_text = fh.read()
+    pin_path = os.path.join(os.path.dirname(__file__), "schema_pin.json")
+    schema_pin = None
+    if os.path.exists(pin_path):
+        with open(pin_path, "r", encoding="utf-8") as fh:
+            schema_pin = json.load(fh)
+    return ProjectContext(root, modules, readme_text, schema_pin=schema_pin)
+
+
+# ---- rule registry ------------------------------------------------------------------
+
+def all_rules():
+    from . import concurrency, config_rules, obs_rules, publish
+
+    module_rules = (
+        concurrency.check_lock_discipline,
+        concurrency.check_blocking_under_lock,
+        config_rules.check_env_discipline,
+        config_rules.check_knob_registry,
+        config_rules.check_import_discipline,
+        obs_rules.check_counter_discipline,
+        obs_rules.check_broad_except,
+        publish.check_atomic_publish,
+    )
+    project_rules = (obs_rules.check_schema_drift,)
+    return module_rules, project_rules
+
+
+RULE_IDS = (
+    "lock-discipline", "blocking-under-lock", "env-discipline",
+    "knob-registry", "counter-discipline", "import-discipline",
+    "broad-except", "atomic-publish", "schema-drift", "bad-suppression",
+    "syntax-error",
+)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)      # actionable
+    grandfathered: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    suppressed: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "counts": self.counts(),
+            "suppressed": self.suppressed,
+            "grandfathered": {f"{file}:{rule}": n for (file, rule), n
+                              in sorted(self.grandfathered.items())},
+            "findings": [{"file": f.file, "line": f.line, "rule": f.rule,
+                          "message": f.message} for f in self.findings],
+        }
+
+
+def run_rules(project: ProjectContext) -> List[Finding]:
+    """Raw findings (before suppression/baseline)."""
+    module_rules, project_rules = all_rules()
+    findings: List[Finding] = []
+    for ctx in project.modules:
+        if getattr(ctx, "_syntax_error", None) is not None:
+            e = ctx._syntax_error  # type: ignore[attr-defined]
+            findings.append(Finding(ctx.rel, e.lineno or 1, "syntax-error",
+                                    str(e.msg)))
+            continue
+        for rule in module_rules:
+            findings.extend(rule(ctx, project))
+    for rule in project_rules:
+        findings.extend(rule(project))
+    return findings
+
+
+def apply_suppressions(project: ProjectContext,
+                       findings: List[Finding]) -> Tuple[List[Finding], int]:
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in findings:
+        ctx = project.by_rel.get(f.file)
+        if ctx is not None and ctx.suppressed(f):
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    # suppression hygiene: every marker needs a justification and a matching
+    # finding — a stale or bare marker would silently disable future checks
+    for ctx in project.modules:
+        for s in ctx.suppressions:
+            if not s.justification:
+                kept.append(Finding(
+                    ctx.rel, s.line, "bad-suppression",
+                    f"suppression of {list(s.rules)} has no justification "
+                    "(append `-- <why this site is exempt>`)"))
+            elif not s.used:
+                kept.append(Finding(
+                    ctx.rel, s.line, "bad-suppression",
+                    f"unused suppression of {list(s.rules)}: nothing fires "
+                    "here anymore — delete the marker"))
+    return kept, n_suppressed
+
+
+# ---- baseline -----------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str], dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {(e["file"], e["rule"]): e for e in data.get("entries", ())}
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str], dict],
+                   result: LintResult) -> List[Finding]:
+    grouped: Dict[Tuple[str, str], List[Finding]] = {}
+    for f in findings:
+        grouped.setdefault((f.file, f.rule), []).append(f)
+    kept: List[Finding] = []
+    for key, group in grouped.items():
+        entry = baseline.get(key)
+        allowed = int(entry.get("count", 0)) if entry else 0
+        if len(group) <= allowed:
+            result.grandfathered[key] = len(group)
+        else:
+            kept.extend(group)
+            if allowed:
+                kept.append(Finding(
+                    key[0], group[0].line, group[0].rule,
+                    f"({len(group)} findings exceed the baseline of "
+                    f"{allowed} — fix the new ones or re-justify)"))
+    kept.sort(key=lambda f: (f.file, f.line, f.rule))
+    return kept
+
+
+def lint(root: str, paths: Iterable[str],
+         baseline_path: Optional[str] = None) -> LintResult:
+    project = build_project(root, paths)
+    raw = run_rules(project)
+    kept, n_sup = apply_suppressions(project, raw)
+    result = LintResult(suppressed=n_sup)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    result.findings = apply_baseline(kept, baseline, result)
+    return result
+
+
+# ---- fixture-test entry point -------------------------------------------------------
+
+def lint_source(source: str, rel: str = "daft_tpu/_fixture.py",
+                module: str = "daft_tpu._fixture",
+                readme_text: str = "",
+                declared_counters: Optional[Set[str]] = None,
+                declared_gauges: Optional[Set[str]] = None,
+                schema_pin: Optional[dict] = None,
+                project_rules: bool = False) -> List[Finding]:
+    """Run every rule over one in-memory snippet (tests/test_lint.py fixtures).
+    Suppressions apply; baseline does not."""
+    ctx = ModuleContext(rel, module, source,
+                        is_package=rel.endswith("__init__.py"))
+    project = ProjectContext("", [ctx], readme_text,
+                             declared_counters=declared_counters or set(),
+                             declared_gauges=declared_gauges or set(),
+                             schema_pin=schema_pin)
+    module_rules, proj_rules = all_rules()
+    findings: List[Finding] = []
+    for rule in module_rules:
+        findings.extend(rule(ctx, project))
+    if project_rules:
+        for rule in proj_rules:
+            findings.extend(rule(project))
+    kept, _ = apply_suppressions(project, findings)
+    kept.sort(key=lambda f: (f.file, f.line, f.rule))
+    return kept

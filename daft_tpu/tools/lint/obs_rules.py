@@ -1,0 +1,162 @@
+"""Observability rules: counter discipline, broad-except audit, schema drift.
+
+counter-discipline — a counter incremented but never pre-declared in
+observability/metrics.py only materializes after its first increment, so a
+Prometheus scrape of a fresh process misses the series and every
+rate()/increase() over the gap reads as garbage.
+
+broad-except — an ``except Exception:`` that neither re-raises, logs, counts,
+nor even reads the exception swallows failures silently; 70 such sites were
+unaudited when this rule landed.
+
+schema-drift — the event-log consumer contract: the set of fields each event
+record carries is fingerprinted and pinned against SCHEMA_VERSION
+(schema_pin.json). Adding a field without bumping the version (or bumping
+without re-pinning) fails the lint, so v1..v8 stays an honest history.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+from . import policy
+from .engine import Finding, ModuleContext, ProjectContext
+
+
+# ---- counter-discipline -------------------------------------------------------------
+
+_METRIC_WRITE_ATTRS = {"inc": "counter", "set_gauge": "gauge",
+                       "set_gauge_max": "gauge"}
+
+
+def check_counter_discipline(ctx: ModuleContext,
+                             project: ProjectContext) -> List[Finding]:
+    if ctx.rel == policy.METRICS_MODULE:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        kind: Optional[str] = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _METRIC_WRITE_ATTRS:
+            kind = _METRIC_WRITE_ATTRS[node.func.attr]
+        elif isinstance(node.func, ast.Name) and node.func.id == "bump":
+            kind = "counter"
+        if kind is None:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue  # dynamic names (trace re-homing) are out of scope
+        name = arg.value
+        declared = (project.declared_counters if kind == "counter"
+                    else project.declared_gauges)
+        if name not in declared:
+            tup = ("DECLARED_COUNTERS" if kind == "counter"
+                   else "DECLARED_GAUGES")
+            findings.append(Finding(
+                ctx.rel, node.lineno, "counter-discipline",
+                f"{kind} `{name}` written here but not pre-declared in "
+                f"observability/metrics.py {tup} — a fresh process's "
+                "/metrics scrape would miss the series"))
+    return findings
+
+
+# ---- broad-except -------------------------------------------------------------------
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    return isinstance(handler.type, ast.Name) and \
+        handler.type.id in ("Exception", "BaseException")
+
+
+def _handler_handles(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if handler.name and isinstance(node, ast.Name) and \
+                node.id == handler.name:
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if attr in policy.EXCEPT_HANDLER_CALLS:
+                return True
+    return False
+
+
+def check_broad_except(ctx: ModuleContext,
+                       project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _handler_is_broad(node) or _handler_handles(node):
+            continue
+        what = "bare except" if node.type is None else "except Exception"
+        findings.append(Finding(
+            ctx.rel, node.lineno, "broad-except",
+            f"{what} swallows the error silently (no re-raise, log, "
+            "counter, or use of the exception) — narrow it, count it, or "
+            "justify with a suppression"))
+    return findings
+
+
+# ---- schema-drift -------------------------------------------------------------------
+
+def event_schema_fingerprint(events_ctx: ModuleContext) -> str:
+    """sha256 over {record class: [field names in order]} for every
+    module-level dataclass in observability/events.py."""
+    classes: Dict[str, List[str]] = {}
+    for stmt in events_ctx.tree.body:
+        if not isinstance(stmt, ast.ClassDef):
+            continue
+        fields = [s.target.id for s in stmt.body
+                  if isinstance(s, ast.AnnAssign) and
+                  isinstance(s.target, ast.Name)]
+        classes[stmt.name] = fields
+    blob = json.dumps(classes, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def read_schema_version(event_log_ctx: ModuleContext) -> Optional[int]:
+    for stmt in event_log_ctx.module_level_stmts():
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == "SCHEMA_VERSION" and \
+                isinstance(stmt.value, ast.Constant):
+            return int(stmt.value.value)
+    return None
+
+
+def check_schema_drift(project: ProjectContext) -> List[Finding]:
+    events = project.by_rel.get(policy.EVENTS_MODULE)
+    event_log = project.by_rel.get(policy.EVENT_LOG_MODULE)
+    if events is None or event_log is None:
+        return []  # partial-path run: nothing to pin against
+    fp = event_schema_fingerprint(events)
+    version = read_schema_version(event_log)
+    pin = project.schema_pin
+    if pin is None:
+        return [Finding(
+            policy.EVENTS_MODULE, 1, "schema-drift",
+            "no schema_pin.json — run `python -m daft_tpu.tools.lint "
+            "--repin-schema` to pin the current event field set")]
+    if version != pin.get("schema_version"):
+        return [Finding(
+            policy.EVENT_LOG_MODULE, 1, "schema-drift",
+            f"SCHEMA_VERSION is v{version} but the pin records "
+            f"v{pin.get('schema_version')} — after a deliberate bump, "
+            "re-pin with `python -m daft_tpu.tools.lint --repin-schema`")]
+    if fp != pin.get("fingerprint"):
+        return [Finding(
+            policy.EVENTS_MODULE, 1, "schema-drift",
+            f"event record field set changed without bumping SCHEMA_VERSION "
+            f"(still v{version}) — consumers key on the version; bump it in "
+            "observability/event_log.py and re-pin")]
+    return []

@@ -1,0 +1,60 @@
+"""atomic-publish: shared-directory writes must stage + os.replace.
+
+The shuffle map-output directory is served concurrently by the fetch server
+(and duplicate speculative attempts write the same file names); the
+checkpoint store is read by resumed drivers. A partially-written file there
+is indistinguishable from a complete one, so every publish must write to a
+tmp/staging path and ``os.replace()`` into place — the discipline PR 8
+established for map outputs and PR 9 for checkpoint commits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import policy
+from .engine import Finding, ModuleContext, ProjectContext
+
+_WRITE_MODES = set("wxa")
+
+
+def _is_write_mode(call: ast.Call) -> bool:
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and bool(set(mode) & _WRITE_MODES)
+
+
+def _path_is_staged(ctx: ModuleContext, path_arg: ast.AST) -> bool:
+    seg = ast.get_source_segment(ctx.source, path_arg) or ""
+    return any(tok in seg.lower() for tok in policy.ATOMIC_PATH_TOKENS)
+
+
+def check_atomic_publish(ctx: ModuleContext,
+                         project: ProjectContext) -> List[Finding]:
+    if ctx.rel not in policy.SHARED_DIR_MODULES:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ModuleContext.dotted(node.func)
+        if dotted == "os.rename":
+            findings.append(Finding(
+                ctx.rel, node.lineno, "atomic-publish",
+                "`os.rename` can fail across filesystems and is not the "
+                "blessed publish idiom — use `os.replace`"))
+            continue
+        if dotted == "open" and node.args and _is_write_mode(node):
+            if not _path_is_staged(ctx, node.args[0]):
+                findings.append(Finding(
+                    ctx.rel, node.lineno, "atomic-publish",
+                    "write into a shared directory without a tmp/staging "
+                    "path — write to a `*.tmp-*` (or staging-dir) name and "
+                    "`os.replace()` into place so readers never observe a "
+                    "partial file"))
+    return findings

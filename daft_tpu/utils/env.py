@@ -5,6 +5,12 @@ One site for the parse-or-default idiom the distributed knobs repeat
 raises — production knobs must degrade to their defaults, not crash a worker
 or driver at import/spawn time. `lo` clamps the floor where a knob has one
 (slot counts >= 1, retry budgets >= 0).
+
+These four helpers are the engine's single blessed idiom for reading knobs:
+the lint rule ``env-discipline`` (daft_tpu/tools/lint/) rejects raw
+``int(os.environ...)`` / ``float(os.environ...)`` parses anywhere else, so a
+new knob can't reintroduce the crash-on-typo behavior this module exists to
+kill.
 """
 
 from __future__ import annotations
@@ -27,3 +33,23 @@ def env_float(name: str, default: float, lo: Optional[float] = None) -> float:
     except ValueError:
         v = default
     return v if lo is None else max(v, lo)
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String knob (mode selectors, file paths). Trivial today, but the one
+    spelling keeps every knob read greppable and lintable at a single call
+    shape."""
+    return os.environ.get(name, default)
+
+
+_FALSY = ("0", "off", "false", "no", "")
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Flag knob. Absent -> default; set -> anything but a falsy spelling
+    ("0"/"off"/"false"/"no"/empty, case-insensitive) counts as on — matching
+    the DAFT_TPU_SPECULATIVE=0 convention the distributed tier established."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSY

@@ -23,8 +23,8 @@ if _cache_dir:
         os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    except Exception:  # lint: ignore[broad-except] -- persistent compile cache
+        pass  # is an optimization; failing to set it up must not break jax init
 
 
 def get_jax():

@@ -7,13 +7,15 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from .env import env_int
+
 _POOL: Optional[ThreadPoolExecutor] = None
 
 
 def compute_pool() -> ThreadPoolExecutor:
     global _POOL
     if _POOL is None:
-        workers = int(os.environ.get("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4))
+        workers = env_int("DAFT_TPU_NUM_THREADS", os.cpu_count() or 4, lo=1)
         _POOL = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="daft-compute")
     return _POOL
 
