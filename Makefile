@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault bench bench-ai bench-mesh bench-serve bench-gate bench-compare
+.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-gate bench-compare
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -68,6 +68,24 @@ bench-mesh:
 # hbm_h2d flat across repeats (bench.py serve_bench).
 bench-serve:
 	env BENCH_SERVE=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Out-of-core suite: host memory manager ledger/pressure semantics,
+# streaming-scan split planning + backpressure, tiny-budget (~10% of input
+# bytes) join/sort/agg bit-identity, spill-dir lifecycle/GC. Budget bugs
+# tend to present as hangs (a stalled producer waiting on a ledger nobody
+# drains), so the whole run gets a hard timeout.
+test-oom:
+	$(TIMEOUT_CMD) env JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_host_memory.py tests/test_streaming_scan.py \
+		tests/test_oom_budget.py tests/test_out_of_core.py \
+		-q -p no:cacheprovider
+
+# Out-of-core capture: the TPC-H subset with lineitem through parquet
+# streaming scans under DAFT_TPU_MEMORY_LIMIT pinned to a fraction of the
+# dataset — bit-identical vs unbudgeted, spill counters + RSS high-water in
+# the JSON. SF100-capable: BENCH_SF=100 make bench-oom on a big box.
+bench-oom:
+	env BENCH_OOM=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 bench:
 	$(PY) bench.py

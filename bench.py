@@ -39,6 +39,16 @@ Environment knobs:
     BENCH_SERVE_CLIENTS=N   concurrent client threads (default 4)
     BENCH_SERVE_QUERIES=N   queries per client (default 12)
     BENCH_SERVE_ROWS=N      table rows (default 200_000)
+    BENCH_OOM=1           run the out-of-core capture instead: the TPC-H
+                          query subset with lineitem round-tripped through
+                          parquet (streaming scans) and DAFT_TPU_MEMORY_LIMIT
+                          pinned to BENCH_OOM_FRACTION of the dataset bytes —
+                          asserting bit-identical results vs the unbudgeted
+                          run and spill_bytes > 0, recording spill/scan/
+                          backpressure counters, rss_high_water_bytes and
+                          host_bytes_high_water. SF100-capable: pair with
+                          BENCH_SF=100 on a box whose disk fits the spill.
+    BENCH_OOM_FRACTION=f  budget as a fraction of dataset bytes (default 0.1)
     BENCH_PROFILE=1       after timing, save a per-query Chrome-trace timeline
                           (explain_analyze(profile=...)) — open in Perfetto
     BENCH_PROFILE_DIR=d   where the trace JSONs land (default ".")
@@ -486,6 +496,104 @@ def ai_bench() -> None:
     }))
 
 
+def _rss_high_water_bytes() -> int:
+    """Process RSS high-water via getrusage (ru_maxrss is KiB on Linux,
+    bytes on macOS); 0 where the platform doesn't report it."""
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        return 0  # platform without getrusage: the field is advisory
+    if sys.platform == "darwin":
+        return int(peak)
+    return int(peak) * 1024
+
+
+def oom_bench() -> None:
+    """BENCH_OOM=1: the out-of-core capture (see module docstring). The
+    dataset's fact table round-trips through parquet so the scans exercise
+    the StreamingScan split/backpressure path, the host budget pins to a
+    fraction of the measured dataset bytes, and the budgeted run must be
+    bit-identical to the unbudgeted one with spill counters > 0. JSON keeps
+    the capture-record shape bench.py --compare understands."""
+    import tempfile
+
+    import daft_tpu
+    from benchmarking.tpch.datagen import load_dataframes
+    from benchmarking.tpch.queries import ALL_QUERIES
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.execution import memory as _mem
+    from daft_tpu.observability.metrics import registry
+
+    frac = float(os.environ.get("BENCH_OOM_FRACTION", 0.1))
+    tables = {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
+    total_bytes = sum(p.size_bytes()
+                      for df in tables.values()
+                      for p in df.iter_partitions())
+    budget = max(int(total_bytes * frac), 1 << 20)
+
+    with tempfile.TemporaryDirectory(prefix="daft_tpu_bench_oom_") as d:
+        # the fact table comes back through parquet: streaming scans with
+        # row-group split planning feed every query's pipeline
+        tables["lineitem"].write_parquet(os.path.join(d, "lineitem"))
+        tables["lineitem"] = daft_tpu.read_parquet(
+            os.path.join(d, "lineitem", "*.parquet"))
+
+        with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+            expected = {q: ALL_QUERIES[q](tables).to_pydict() for q in QUERIES}
+
+        _mem.reset_counters()
+        _mem.manager().clear()
+        reg_before = registry().snapshot()
+        per_query = {q: float("inf") for q in QUERIES}
+        elapsed = float("inf")
+        with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+            mismatches = []
+            with _mem.manager().query_scope() as scope:
+                for _ in range(REPS):
+                    t0 = time.perf_counter()
+                    for q in QUERIES:
+                        tq = time.perf_counter()
+                        out = ALL_QUERIES[q](tables).to_pydict()
+                        per_query[q] = min(per_query[q], time.perf_counter() - tq)
+                        if out != expected[q]:
+                            mismatches.append(q)
+                    elapsed = min(elapsed, time.perf_counter() - t0)
+        diff = registry().diff(reg_before)
+        n_lineitem = tables["lineitem"].count_rows()
+
+    assert not mismatches, \
+        f"budgeted results diverged from unbudgeted: {sorted(set(mismatches))}"
+    assert diff.get("spill_bytes", 0) > 0, \
+        "budget never triggered a spill — BENCH_OOM capture is not an " \
+        "out-of-core capture (lower BENCH_OOM_FRACTION or raise BENCH_SF)"
+
+    metric_totals = {k: int(v) if float(v).is_integer() else v
+                     for k, v in diff.items()
+                     if k.startswith(("spill_", "scan_", "host_"))}
+    metric_totals["host_bytes_high_water"] = _mem.manager().high_water_bytes()
+    metric_totals["host_scope_peak_bytes"] = scope.peak_bytes()
+    metric_totals["rss_high_water_bytes"] = _rss_high_water_bytes()
+    rows_per_sec = n_lineitem * len(QUERIES) / elapsed
+    print(json.dumps({
+        "metric": f"tpch_sf{SF}_oom_{len(QUERIES)}q_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
+        "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
+        "bit_identical": True,
+        "memory_limit_bytes": budget,
+        "dataset_bytes": int(total_bytes),
+        "rss_high_water_bytes": metric_totals["rss_high_water_bytes"],
+        "host_bytes_high_water": metric_totals["host_bytes_high_water"],
+        "fact_rows": n_lineitem,
+        "sf": SF,
+        "reps": REPS,
+        "metrics": metric_totals,
+    }))
+
+
 REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
 
 
@@ -553,6 +661,9 @@ def _save_profiles(tables, ALL_QUERIES) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_OOM"):
+        oom_bench()
+        return
     if os.environ.get("BENCH_MESH"):
         mesh_microbench()
         return
@@ -631,6 +742,12 @@ def main() -> None:
     _res = _residency().stats()
     for k in ("hbm_bytes_resident", "hbm_bytes_high_water", "hbm_entries"):
         metric_totals[k] = _res[k]
+
+    # Host-memory attribution (the out-of-core tier): ledger high-water off
+    # the manager's own state + the process RSS high-water, so every capture
+    # (budgeted or not) records how much host memory the run actually took.
+    metric_totals["host_bytes_high_water"] = _mem.manager().high_water_bytes()
+    metric_totals["rss_high_water_bytes"] = _rss_high_water_bytes()
 
     # Distributed placement attribution: the sched_* counters accumulated in
     # the snapshot loop above already carry sched_bytes_avoided etc.; derive

@@ -125,9 +125,43 @@ class ExecutionConfig:
     broadcast_join_size_bytes: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_BROADCAST_JOIN_BYTES", 10 * 1024 * 1024)
     )
-    # memory budget for blocking sinks (0 = unbounded)
+    # Host memory budget (daft_tpu/memory/ HostMemoryManager): the single
+    # process-wide byte ledger every memory-hungry site (agg/sort/join-build/
+    # window buffering, streaming-scan pacing) admits against. Positive =
+    # bytes; 0 (default) = unbounded AND untracked (the zero-overhead path —
+    # operators run their plain in-memory strategies, nothing touches the
+    # ledger); negative = auto, DAFT_TPU_MEMORY_FRACTION of system RAM —
+    # the host mirror of the HBM auto budget.
     memory_limit_bytes: int = field(
         default_factory=lambda: _env_int("DAFT_TPU_MEMORY_LIMIT", 0)
+    )
+    # Auto host-budget fraction of system RAM (memory_limit_bytes < 0).
+    memory_fraction: float = field(
+        default_factory=lambda: _env_float("DAFT_TPU_MEMORY_FRACTION", 0.6)
+    )
+    # Backpressure threshold as a fraction of the host budget: streaming
+    # scans stall (boundedly) while tracked bytes sit at/over this line so a
+    # fast producer cannot outrun a spilling consumer into an OOM.
+    memory_pressure: float = field(
+        default_factory=lambda: _env_float("DAFT_TPU_MEMORY_PRESSURE", 0.8)
+    )
+    # Spill-file IPC body compression (daft_tpu/memory/spill.py): same codec
+    # set and wire format as the shuffle transport. "none" writes raw buffers.
+    spill_compression: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_SPILL_COMPRESSION", "lz4")
+    )
+    # Spill root directory ("" = <system tmp>/daft_tpu_spill). Artifacts are
+    # pid-tagged; stale ones from dead processes are swept at first spill.
+    spill_dir: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_SPILL_DIR", "")
+    )
+    # Streaming-scan split/merge target (io/parquet.py split planning +
+    # io/scan.py merge_small_tasks): files larger than this split into
+    # row-group-aligned tasks, runs of smaller files merge toward it — so
+    # one in-flight scan task never materializes more than ~this many bytes.
+    # 0 disables split/merge (one task per file, the pre-streaming planning).
+    scan_split_bytes: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_SCAN_SPLIT_BYTES", 128 * 1024 * 1024)
     )
     # pipeline executor knobs
     num_threads: int = field(
@@ -226,6 +260,22 @@ class ExecutionConfig:
                 f"tenant_budget_bytes must be >= 0 (0 disables the per-tenant "
                 f"cap), got {self.tenant_budget_bytes!r} "
                 f"(check DAFT_TPU_TENANT_BUDGET)")
+        if not 0.0 < self.memory_fraction <= 1.0:
+            raise ValueError(
+                f"memory_fraction must be in (0, 1], got "
+                f"{self.memory_fraction!r} (check DAFT_TPU_MEMORY_FRACTION)")
+        if not 0.0 < self.memory_pressure <= 1.0:
+            raise ValueError(
+                f"memory_pressure must be in (0, 1], got "
+                f"{self.memory_pressure!r} (check DAFT_TPU_MEMORY_PRESSURE)")
+        if self.spill_compression not in ("none", "lz4", "zstd"):
+            raise ValueError(
+                f"spill_compression must be one of 'none'/'lz4'/'zstd', got "
+                f"{self.spill_compression!r} (check DAFT_TPU_SPILL_COMPRESSION)")
+        if self.scan_split_bytes < 0:
+            raise ValueError(
+                f"scan_split_bytes must be >= 0 (0 disables split/merge), got "
+                f"{self.scan_split_bytes!r} (check DAFT_TPU_SCAN_SPLIT_BYTES)")
 
 
 _default: Optional[ExecutionConfig] = None

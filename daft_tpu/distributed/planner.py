@@ -289,8 +289,10 @@ def distribute(ctx: DistContext, node: pp.PhysicalPlan) -> Partitioned:
         if len(node.tasks) <= 1:
             return Partitioned([node])
         groups = [node.tasks[i::N] for i in range(min(N, len(node.tasks)))]
+        # preserve the concrete scan class: a StreamingScan fragment keeps
+        # streaming (host-ledger pacing, scan counters) on its worker
         return Partitioned([
-            pp.TaskScan(g, node.schema, node.post_filter, None) for g in groups if g
+            type(node)(g, node.schema, node.post_filter, None) for g in groups if g
         ])
 
     if isinstance(node, _MAP_NODES):

@@ -105,6 +105,10 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         yield from node.partitions
         return
 
+    if isinstance(node, pp.StreamingScan):
+        yield from _streaming_scan(node)
+        return
+
     if isinstance(node, pp.TaskScan):
         from ..utils.pool import compute_pool
 
@@ -269,12 +273,14 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         return
 
     if isinstance(node, pp.UngroupedAggregate):
-        out = _two_phase_agg(node.input, [], node.aggregations, ungrouped=True)
+        out = _two_phase_agg(node.input, [], node.aggregations, ungrouped=True,
+                             node=node)
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
     if isinstance(node, pp.HashAggregate):
-        out = _two_phase_agg(node.input, node.groupby, node.aggregations, ungrouped=False)
+        out = _two_phase_agg(node.input, node.groupby, node.aggregations,
+                             ungrouped=False, node=node)
         yield MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
         return
 
@@ -427,6 +433,83 @@ def _exec_impl(node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
     raise NotImplementedError(f"executor: unhandled node {type(node).__name__}")
 
 
+def _streaming_scan(node) -> Iterator[MicroPartition]:
+    """Execute a StreamingScan: morsels yielded incrementally, never a whole
+    source in host RAM.
+
+    Tasks are pre-split toward scan_split_bytes (io/parquet.py row-group
+    planning), so even the IO-parallel window holds at most
+    window x split-target bytes in flight. Backpressure is two-layered: the
+    bounded stage channel (pipeline.py — StreamingScan is a stage node)
+    limits morsels between scan and consumer, and the host memory ledger's
+    pressure signal (daft_tpu/memory) stalls the scan — boundedly, never as
+    a correctness gate — while a downstream blocking operator is at the
+    memory wall and about to spill. Attribution: scan_batches/rows/bytes,
+    scan_backpressure_stalls + scan_stall_ms counters, and a per-task
+    "scan.stream" span while the timeline profiler is active."""
+    from ..memory import manager as _host_manager
+    from ..observability.metrics import registry
+    from ..observability.runtime_stats import current_collector, span_iter
+    from ..utils.pool import compute_pool
+
+    mgr = _host_manager()
+    budgeted = mgr.limit_bytes() > 0
+    reg = registry()
+    c = current_collector()
+    if c is not None:
+        c.annotate(node, f"streaming: {len(node.tasks)} tasks")
+
+    def count(part: MicroPartition) -> MicroPartition:
+        reg.inc("scan_batches")
+        reg.inc("scan_rows", part.num_rows)
+        reg.inc("scan_bytes", part.size_bytes())
+        return part
+
+    def task_parts(task) -> Iterator[MicroPartition]:
+        inner = task.read()
+        for part in span_iter("scan.stream", "scan", inner,
+                              source=task.source_label):
+            if node.post_filter is not None and not task.filters_applied:
+                part = _filter_part(part, node.post_filter)
+            yield part
+
+    remaining = node.post_limit
+    if remaining is not None or len(node.tasks) <= 1 or not _pipeline_on():
+        # fully streaming: one morsel resident at a time per task
+        for task in node.tasks:
+            if budgeted:
+                mgr.wait_for_headroom()
+            for part in task_parts(task):
+                if remaining is not None:
+                    if remaining <= 0:
+                        return
+                    if part.num_rows > remaining:
+                        part = part.head(remaining)
+                    remaining -= part.num_rows
+                yield count(part)
+                if budgeted and mgr.under_pressure():
+                    mgr.wait_for_headroom()
+        return
+
+    # IO-parallel scan with a bounded in-flight window: each future
+    # materializes ONE (split) task, so in-flight memory is bounded by
+    # window x scan_split_bytes instead of the whole dataset
+    def read_task(task):
+        return list(task_parts(task))
+
+    window = compute_pool()._max_workers
+    futures = []
+    ti = 0
+    while ti < len(node.tasks) or futures:
+        while ti < len(node.tasks) and len(futures) < window:
+            if budgeted and mgr.under_pressure():
+                mgr.wait_for_headroom()
+            futures.append(compute_pool().submit(read_task, node.tasks[ti]))
+            ti += 1
+        for part in futures.pop(0).result():
+            yield count(part)
+
+
 def _agg_morsel_rows() -> int:
     """Morsel size for the partial-agg splitter in _two_phase_agg — the
     config's morsel_size_rows (the batching strategies also initialize from
@@ -500,7 +583,8 @@ def _exec_device_agg(node) -> MicroPartition:
         if node.predicate is not None:
             s = (_filter_part(p, node.predicate) for p in s)
         out = _two_phase_agg(node.input, node.groupby if grouped else [],
-                             node.aggregations, ungrouped=not grouped, stream=s)
+                             node.aggregations, ungrouped=not grouped,
+                             stream=s, node=node)
         return MicroPartition(node.schema, [out.cast_to_schema(node.schema)])
 
     if not use_device:
@@ -815,7 +899,7 @@ def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
         if node.predicate is not None:
             s = (_filter_part(p, node.predicate) for p in s)
         host = _two_phase_agg(node.input, [], node.aggregations,
-                              ungrouped=True, stream=s)
+                              ungrouped=True, stream=s, node=node)
         return MicroPartition(node.schema, [host.cast_to_schema(node.schema)])
     c = current_collector()
     if c is not None:
@@ -1620,18 +1704,60 @@ def _batch_iter(stream) -> Iterator[RecordBatch]:
                 yield b
 
 
+def _drain_prefix(budget, batches: List[RecordBatch], it) -> Iterator[RecordBatch]:
+    """Chain the buffered over-budget prefix onto the rest of the stream,
+    releasing each prefix batch's ledger bytes only AFTER the consumer has
+    processed it (written it to spill / folded it into a partial) — an early
+    wholesale release would let concurrent operators admit a second working
+    set while the prefix still sits in RAM, transiently doubling the
+    process's real footprint past the budget. The prefix list is consumed
+    DESTRUCTIVELY for the same reason: a released batch must actually be
+    droppable, not pinned alive by the caller's list until the operator
+    finishes."""
+    while batches:
+        b = batches.pop(0)
+        yield b
+        budget.release(b.size_bytes())
+        del b
+    yield from it
+
+
+def _annotate_spill(node, nbytes: int, what: str) -> None:
+    """EXPLAIN ANALYZE attribution for one operator's spill activity —
+    rendered beside the operator name ("memory: spilled 12.5 MB, 8 runs")."""
+    from ..observability.runtime_stats import current_collector
+
+    c = current_collector()
+    if c is not None and node is not None:
+        c.annotate(node, f"memory: spilled {nbytes / 1e6:.1f} MB, {what}")
+
+
 def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
-                   stream=None) -> RecordBatch:
+                   stream=None, node=None) -> RecordBatch:
     """Partial aggregation per morsel on the compute pool, then a final combine
     (reference: two-stage aggregation in translate.rs + partial-agg thresholds).
 
-    Out-of-core: input batches are admitted against the operator memory budget
-    (ExecutionConfig.memory_limit_bytes); once over budget the aggregation
+    Out-of-core: input batches are admitted against the process-wide host
+    memory ledger (daft_tpu/memory — DAFT_TPU_MEMORY_LIMIT shared by every
+    concurrent query); once the LEDGER is over budget the aggregation
     switches to its spilling strategy — streamed partials for ungrouped aggs,
     Grace hash-partitioned spill (of shrunken partials when the aggs split,
     of raw rows otherwise) for grouped aggs (reference: blocking_sink.rs +
-    resource_manager.rs memory gating).
+    resource_manager.rs memory gating). Tracked bytes release as buffers
+    flush to disk and unconditionally when the operator finishes.
     """
+    from . import memory as mem
+
+    budget = mem.operator_budget()
+    try:
+        return _two_phase_agg_impl(child, groupby, aggs, ungrouped, stream,
+                                   node, budget)
+    finally:
+        budget.close()
+
+
+def _two_phase_agg_impl(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
+                        stream, node, budget) -> RecordBatch:
     from . import memory as mem
     from ..plan.agg_split import split_aggs
     from ..utils.pool import pool_map
@@ -1639,7 +1765,6 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
     if stream is None:
         stream = _exec(child)
     it = _batch_iter(stream)
-    budget = mem.operator_budget()
     batches: List[RecordBatch] = []
     over = False
     for b in it:
@@ -1679,15 +1804,19 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
         return eval_projection(final, [_col(k) for k in key_names] + split.projection)
 
     # ---- over budget: out-of-core paths ------------------------------------------
-    rest = itertools.chain(batches, it)
+    # the buffered prefix flushes to disk/partials as `rest` is consumed;
+    # each prefix batch hands its ledger bytes back as it is processed
+    rest = _drain_prefix(budget, batches, it)
 
     if ungrouped:
         if split is None:
-            return _ungrouped_agg_spilled(child, aggs, rest)
+            return _ungrouped_agg_spilled(child, aggs, rest, node)
         # streamed partials: memory is one 1-row partial batch per morsel
         partials = [rel.ungrouped_agg(b, split.partial) for b in rest]
         final = rel.ungrouped_agg(RecordBatch.concat(partials), split.final)
         return eval_projection(final, split.projection)
+
+    from ..observability.runtime_stats import profile_span
 
     K = 32
     key_names = [e.name() for e in groupby]
@@ -1702,9 +1831,11 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
                                  for e in list(groupby) + list(split.partial)])
         sp = mem.SpillPartitions(partial_schema, K)
         try:
-            for b in rest:
-                pb = rel.grouped_agg(b, groupby, split.partial)
-                sp.append_partitioned(pb, key_cols)
+            with profile_span("spill.grace_agg", "spill", partitions=K):
+                for b in rest:
+                    pb = rel.grouped_agg(b, groupby, split.partial)
+                    sp.append_partitioned(pb, key_cols)
+            _annotate_spill(node, sp.bytes_written, f"{K} partitions")
             outs = []
             for f in sp.files:
                 bs = list(f.read())
@@ -1720,8 +1851,10 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
     # unsplittable grouped aggs: Grace over raw rows
     sp = mem.SpillPartitions(child.schema, K)
     try:
-        for b in rest:
-            sp.append_partitioned(b, groupby)
+        with profile_span("spill.grace_agg", "spill", partitions=K):
+            for b in rest:
+                sp.append_partitioned(b, groupby)
+        _annotate_spill(node, sp.bytes_written, f"{K} partitions")
         outs = []
         for f in sp.files:
             bs = list(f.read())
@@ -1735,7 +1868,8 @@ def _two_phase_agg(child: pp.PhysicalPlan, groupby, aggs, ungrouped: bool,
         sp.delete()
 
 
-def _ungrouped_agg_spilled(child: pp.PhysicalPlan, aggs, stream) -> RecordBatch:
+def _ungrouped_agg_spilled(child: pp.PhysicalPlan, aggs, stream,
+                           node=None) -> RecordBatch:
     """Over-budget ungrouped aggregation with unsplittable aggs: spill the raw
     stream once, then evaluate each aggregation with bounded memory —
     count_distinct Grace-partitions its OWN value column (distinct values land
@@ -1752,8 +1886,12 @@ def _ungrouped_agg_spilled(child: pp.PhysicalPlan, aggs, stream) -> RecordBatch:
 
     spill = mem.SpillFile(child.schema)
     try:
-        for b in stream:
-            spill.append(b)
+        from ..observability.runtime_stats import profile_span
+
+        with profile_span("spill.raw", "spill"):
+            for b in stream:
+                spill.append(b)
+        _annotate_spill(node, spill.bytes_written, "1 raw run")
 
         cols: List[Series] = []
         for e in aggs:
@@ -1812,144 +1950,225 @@ def _ungrouped_agg_spilled(child: pp.PhysicalPlan, aggs, stream) -> RecordBatch:
 
 
 def _sort_exec(node: pp.PhysSort) -> Iterator[MicroPartition]:
-    """Sort with out-of-core fallback: buffer within the memory budget; once
-    over, range-partition the stream into K spill buckets on the first sort
-    key (boundaries sampled from the buffered prefix) and sort each bucket
-    independently — buckets are emitted in key order, so the concatenation is
-    globally sorted (reference approach: sampled range partitioning + per-
-    partition sort, flotilla.py get_boundaries_remote)."""
+    """Sort with out-of-core fallback: buffer within the host memory budget;
+    once the ledger says over, switch to sorted-RUN generation — each
+    budget-sized buffer sorts in memory and spills as one compressed IPC run
+    — followed by a streaming k-way merge of the runs (reference:
+    sinks/sort.rs external sort; fan-in capped, over-wide merges cascade
+    through intermediate runs).
+
+    Bit-identical to the in-memory path including tie order: runs partition
+    the input stream in order, the per-run sort is stable (np.lexsort), and
+    the merge breaks cross-run ties by run index — exactly the order a
+    stable sort of the whole stream produces."""
     from . import memory as mem
+    from ..observability.metrics import registry
+    from ..observability.runtime_stats import profile_span
 
     budget = mem.operator_budget()
-    it = _batch_iter(_exec(node.input))
-    buffered: List[RecordBatch] = []
-    over = False
-    for b in it:
-        buffered.append(b)
-        if not budget.admit(b.size_bytes()):
-            over = True
-            break
-
-    if not over:
-        batch = RecordBatch.concat(buffered) if buffered else RecordBatch.empty(node.schema)
-        keys = [eval_expression(batch, e) for e in node.sort_by]
-        yield MicroPartition(node.schema, [batch.sort(keys, node.descending, node.nulls_first)])
-        return
-
-    # ---- external sort ------------------------------------------------------------
-    K = 32
-    e0 = node.sort_by[0]
-    desc0 = bool(node.descending[0]) if node.descending else False
-    nf = node.nulls_first[0] if node.nulls_first else desc0
-
-    def key0(b: RecordBatch):
-        s = eval_expression(b, e0)
-        return s.to_numpy(), s.validity_numpy()
-
-    # boundaries from the buffered prefix (a large sample by construction)
-    sample_vals = []
-    for b in buffered:
-        v, ok = key0(b)
-        sample_vals.append(v[ok])
-    sample = np.concatenate(sample_vals) if sample_vals else np.empty(0)
-    if sample.dtype.kind == "f":
-        sample = sample[~np.isnan(sample)]  # NaN handled by searchsorted (last bucket)
-    if len(sample):
-        # dtype-agnostic quantile boundaries (strings/dates sort too)
-        srt = np.sort(sample)
-        idx = (np.linspace(0, 1, K + 1)[1:-1] * (len(srt) - 1)).astype(np.int64)
-        boundaries = np.unique(srt[idx])
-    else:
-        boundaries = np.empty(0)
-    nb = len(boundaries) + 1  # value buckets; nulls get their own bucket
-
-    sp = [mem.SpillFile(node.schema) for _ in range(nb + 1)]  # [+1] = null bucket
     try:
-        for b in itertools.chain(buffered, it):
-            v, ok = key0(b)
-            if len(boundaries):
-                if not ok.all():
-                    # null slots hold None/garbage that would break comparisons;
-                    # park them on a real value, then route to the null bucket
-                    v = np.array(v, copy=True)
-                    v[~ok] = boundaries[0]
-                ids = np.searchsorted(boundaries, v, side="right").astype(np.int64)
-            else:
-                ids = np.zeros(len(v), dtype=np.int64)
-            ids[~ok] = nb  # null bucket
-            for j, piece in enumerate(b._split_by_partition_ids(ids, nb + 1)):
-                if piece.num_rows:
-                    sp[j].append(piece)
-        value_order = list(range(nb))
-        if desc0:
-            value_order.reverse()
-        order = ([nb] + value_order) if nf else (value_order + [nb])
-        for j in order:
-            yield from _sort_bucket(node, sp[j], budget.limit, depth=0,
-                                    allow_split=(j != nb))
+        it = _batch_iter(_exec(node.input))
+        buffered: List[RecordBatch] = []
+        over = False
+        for b in it:
+            buffered.append(b)
+            if not budget.admit(b.size_bytes()):
+                over = True
+                break
+
+        if not over:
+            batch = RecordBatch.concat(buffered) if buffered else RecordBatch.empty(node.schema)
+            keys = [eval_expression(batch, e) for e in node.sort_by]
+            yield MicroPartition(node.schema, [batch.sort(keys, node.descending, node.nulls_first)])
+            return
+
+        # ---- external sort: sorted runs + k-way merge --------------------------
+        runs: List = []
+
+        def flush_run(bufs: List[RecordBatch]) -> None:
+            big = RecordBatch.concat(bufs) if len(bufs) > 1 else bufs[0]
+            keys = [eval_expression(big, e) for e in node.sort_by]
+            srt = big.sort(keys, node.descending, node.nulls_first)
+            f = mem.SpillFile(node.schema)
+            step = _agg_morsel_rows()
+            with profile_span("spill.sort_run", "spill", rows=srt.num_rows):
+                # chunked append so read-back streams morsel-sized batches
+                for s in range(0, srt.num_rows, step):
+                    f.append(srt.slice(s, min(s + step, srt.num_rows)))
+                f.finish()
+            registry().inc("spill_runs")
+            runs.append(f)
+            budget.release_all()  # the buffer now lives on disk
+
+        try:
+            flush_run(buffered)
+            buffered = []
+            for b in it:
+                buffered.append(b)
+                if not budget.admit(b.size_bytes()):
+                    flush_run(buffered)
+                    buffered = []
+            if buffered:
+                flush_run(buffered)
+                buffered = []
+            _annotate_spill(node, sum(f.bytes_written for f in runs),
+                            f"{len(runs)} runs")
+            yield from _merge_sorted_runs(node, runs)
+        finally:
+            for f in runs:
+                f.delete()
     finally:
-        for f in sp:
+        budget.close()
+
+
+# merge fan-in cap: one k-way merge holds ~one batch per input run (plus the
+# carried overflow), so capping the width bounds merge memory; wider run sets
+# cascade through intermediate merged runs
+_MERGE_FANIN = 16
+
+
+def _merge_sorted_runs(node: pp.PhysSort, runs) -> Iterator[MicroPartition]:
+    """Merge sorted spill runs into one globally sorted stream, cascading
+    through intermediate runs while the fan-in exceeds _MERGE_FANIN."""
+    from . import memory as mem
+    from ..observability.metrics import registry
+
+    live = [f for f in runs if f.rows > 0]
+    intermediates: List = []
+    try:
+        step = _agg_morsel_rows()
+        while len(live) > _MERGE_FANIN:
+            merged = []
+            for i in range(0, len(live), _MERGE_FANIN):
+                chunk = live[i:i + _MERGE_FANIN]
+                if len(chunk) == 1:
+                    merged.append(chunk[0])
+                    continue
+                f = mem.SpillFile(node.schema)
+                for part in _kway_merge(node, chunk):
+                    for b in part.batches:
+                        # re-chunk like flush_run: a merge round can emit up
+                        # to fan-in concatenated batches, and without this
+                        # the batch size (= next level's per-run memory)
+                        # would multiply by the fan-in per cascade level
+                        for s in range(0, b.num_rows, step):
+                            f.append(b.slice(s, min(s + step, b.num_rows)))
+                f.finish()
+                registry().inc("spill_merge_passes")
+                intermediates.append(f)
+                merged.append(f)
+                for g in chunk:
+                    g.delete()  # idempotent with the caller's finally
+            live = merged
+        yield from _kway_merge(node, live)
+    finally:
+        for f in intermediates:
             f.delete()
 
 
-def _sort_bucket(node: pp.PhysSort, f, limit: int, depth: int,
-                 allow_split: bool) -> Iterator[MicroPartition]:
-    """Sort one spill bucket. A bucket bigger than the budget (boundary skew:
-    sorted/clustered input defeats prefix sampling) re-splits recursively with
-    boundaries sampled from its own full contents (streamed — the oversized
-    bucket is never materialized); identical-key buckets can't split, so
-    recursion is bounded and falls back to in-memory sort."""
-    from . import memory as mem
+def _kway_merge(node: pp.PhysSort, files) -> Iterator[MicroPartition]:
+    """Streaming k-way merge of sorted runs with bounded memory: one batch
+    per run in flight plus the carried (not-yet-emittable) overflow.
 
-    if f.rows == 0:
+    Per round, each run's current batch contributes its LAST row as a
+    boundary marker; everything that sorts before the first marker is safely
+    emittable (any unread row of run j is >= run j's boundary >= the first
+    marker in the total order). The total order is the user sort key
+    extended with a final int64 merge key = run_index*2 for data rows and
+    run_index*2+1 for markers — ties across runs resolve by run (= stream)
+    order, and a run's marker sorts after that run's real rows without
+    relying on sort stability.
+
+    Cost: the carried overflow is bounded by one batch per run (a run's
+    batch leaves carry the round its boundary becomes the horizon), and
+    each round re-keys and re-argsorts carry + pool — so total merge work
+    is O(total_rows x fan-in) key-eval/lexsort, a bounded constant factor
+    over the input, not quadratic. A carry-preserving two-way merge would
+    shave that factor; not worth the added state machine at current run
+    counts (_MERGE_FANIN caps the factor at 16)."""
+    from ..core.series import Series
+    from ..datatype import DataType
+
+    if not files:
         return
-    e0 = node.sort_by[0]
+    nkeys = len(node.sort_by)
+    desc = list(node.descending) if node.descending else [False] * nkeys
+    nf = list(node.nulls_first) if node.nulls_first else list(desc)
+    desc_m = desc + [False]
+    nf_m = nf + [False]
 
-    if limit > 0 and allow_split and depth < 3:
-        # pass 1 (streaming): total size + a bounded per-batch key sample
-        total = 0
-        sample_parts = []
-        for b in f.read():
-            total += b.size_bytes()
-            s = eval_expression(b, e0)
-            v, ok = s.to_numpy(), s.validity_numpy()
-            sample_parts.append(v[ok][:4096])
-        if total > limit:
-            sample = np.concatenate(sample_parts) if sample_parts else np.empty(0)
-            if sample.dtype.kind == "f":
-                sample = sample[~np.isnan(sample)]
-            srt = np.sort(sample) if len(sample) else sample
-            if len(srt) and srt[0] != srt[-1]:  # splittable: keys not all equal
-                idx = (np.linspace(0, 1, 9)[1:-1] * (len(srt) - 1)).astype(np.int64)
-                bounds = np.unique(srt[idx])
-                subs = [mem.SpillFile(node.schema) for _ in range(len(bounds) + 1)]
-                try:
-                    for b in f.read():  # pass 2 (streaming): re-partition
-                        s = eval_expression(b, e0)
-                        v, ok = s.to_numpy(), s.validity_numpy()
-                        if not ok.all():
-                            v = np.array(v, copy=True)
-                            v[~ok] = bounds[0]
-                        ids = np.searchsorted(bounds, v, side="right").astype(np.int64)
-                        ids[~ok] = 0  # nulls can't reach here (dedicated bucket upstream)
-                        for k, piece in enumerate(
-                                b._split_by_partition_ids(ids, len(bounds) + 1)):
-                            if piece.num_rows:
-                                subs[k].append(piece)
-                    desc0 = bool(node.descending[0]) if node.descending else False
-                    order = reversed(range(len(subs))) if desc0 else range(len(subs))
-                    for k in order:
-                        yield from _sort_bucket(node, subs[k], limit, depth + 1,
-                                                allow_split=True)
-                    return
-                finally:
-                    for sf in subs:
-                        sf.delete()
+    if len(files) == 1:
+        for b in files[0].read():
+            yield MicroPartition(node.schema, [b])
+        return
 
-    bucket = RecordBatch.concat(list(f.read()))
-    keys = [eval_expression(bucket, e) for e in node.sort_by]
-    yield MicroPartition(node.schema,
-                         [bucket.sort(keys, node.descending, node.nulls_first)])
+    def merge_key(batch, mrg):
+        keys = [eval_expression(batch, e) for e in node.sort_by]
+        keys.append(Series.from_numpy(mrg, "__mrg__", DataType.int64()))
+        return keys
+
+    its = [f.read() for f in files]
+    need = set(range(len(its)))
+    bounds: dict = {}                      # run idx -> 1-row boundary batch
+    carry: Optional[RecordBatch] = None    # rows held past the safe horizon
+    carry_mrg: Optional[np.ndarray] = None
+    pool: List[tuple] = []                 # (batch, run idx) taken this round
+
+    while True:
+        for i in sorted(need):
+            b = next(its[i], None)
+            while b is not None and b.num_rows == 0:
+                b = next(its[i], None)
+            if b is None:
+                bounds.pop(i, None)        # run exhausted: no boundary
+            else:
+                pool.append((b, i))
+                bounds[i] = b.slice(b.num_rows - 1, b.num_rows)
+        need.clear()
+
+        data_batches: List[RecordBatch] = []
+        mrg_parts: List[np.ndarray] = []
+        if carry is not None and carry.num_rows:
+            data_batches.append(carry)
+            mrg_parts.append(carry_mrg)
+        for b, i in pool:
+            data_batches.append(b)
+            mrg_parts.append(np.full(b.num_rows, 2 * i, dtype=np.int64))
+        pool = []
+
+        if not bounds:
+            # every run exhausted: the remainder is emittable wholesale
+            if data_batches:
+                big = RecordBatch.concat(data_batches) \
+                    if len(data_batches) > 1 else data_batches[0]
+                mrg = np.concatenate(mrg_parts)
+                idx = big.argsort(merge_key(big, mrg), desc_m, nf_m)
+                yield MicroPartition(node.schema, [big.take(idx)])
+            return
+
+        for i in sorted(bounds):
+            data_batches.append(bounds[i])
+            mrg_parts.append(np.array([2 * i + 1], dtype=np.int64))
+        big = RecordBatch.concat(data_batches)
+        mrg = np.concatenate(mrg_parts)
+        idx = big.argsort(merge_key(big, mrg), desc_m, nf_m)
+        sorted_mrg = mrg[idx]
+        markers = np.flatnonzero(sorted_mrg & 1)
+        first = int(markers[0])
+        if first:
+            yield MicroPartition(node.schema, [big.take(idx[:first])])
+        # refill the run whose boundary was the horizon; everything past it
+        # (minus the marker rows, which are copies) carries to the next round
+        r = int(sorted_mrg[first] >> 1)
+        need.add(r)
+        del bounds[r]
+        rest, rest_mrg = idx[first + 1:], sorted_mrg[first + 1:]
+        keep = (rest_mrg & 1) == 0
+        carry_idx = rest[keep]
+        if len(carry_idx):
+            carry, carry_mrg = big.take(carry_idx), rest_mrg[keep]
+        else:
+            carry = carry_mrg = None
 
 
 def _window_exec(node) -> Iterator[MicroPartition]:
@@ -1965,61 +2184,80 @@ def _window_exec(node) -> Iterator[MicroPartition]:
     Output row order: under budget, original input order (results scatter
     back); spilled, rows come out grouped by spill partition."""
     from . import memory as mem
+    from ..observability.runtime_stats import profile_span
     from .window import eval_window
 
     budget = mem.operator_budget()
-    it = _batch_iter(_exec(node.input))
-    buffered: List[RecordBatch] = []
-    over = False
-    for b in it:
-        buffered.append(b)
-        if not budget.admit(b.size_bytes()):
-            over = True
-            break
-
-    if not over or not node.spec.partition_by_exprs:
-        rest = list(it) if over else []
-        all_batches = buffered + rest
-        batch = RecordBatch.concat(all_batches) if all_batches \
-            else RecordBatch.empty(node.input.schema)
-        out = eval_window(batch, node.window_exprs, node.spec, node.schema)
-        yield MicroPartition(node.schema, [out])
-        return
-
-    K = 16
-    sp = mem.SpillPartitions(node.input.schema, K)
     try:
-        for b in itertools.chain(buffered, it):
-            sp.append_partitioned(b, node.spec.partition_by_exprs)
+        it = _batch_iter(_exec(node.input))
+        buffered: List[RecordBatch] = []
+        over = False
+        for b in it:
+            buffered.append(b)
+            if not budget.admit(b.size_bytes()):
+                over = True
+                break
 
-        def eval_file(f, _i):
-            bs = list(f.read())
-            if not bs:
-                return MicroPartition.empty(node.schema)
-            out = eval_window(RecordBatch.concat(bs), node.window_exprs,
-                              node.spec, node.schema)
-            return MicroPartition(node.schema, [out])
+        if not over or not node.spec.partition_by_exprs:
+            rest = list(it) if over else []
+            all_batches = buffered + rest
+            batch = RecordBatch.concat(all_batches) if all_batches \
+                else RecordBatch.empty(node.input.schema)
+            out = eval_window(batch, node.window_exprs, node.spec, node.schema)
+            yield MicroPartition(node.schema, [out])
+            return
 
-        if _pipeline_on():
-            from .pipeline import pmap_stream
+        K = 16
+        sp = mem.SpillPartitions(node.input.schema, K)
+        try:
+            with profile_span("spill.grace_window", "spill", partitions=K):
+                # prefix batches release (and drop) one by one as they land
+                # on disk; per-partition evaluation below runs with the
+                # prefix genuinely freed, not just un-ledgered
+                for b in _drain_prefix(budget, buffered, it):
+                    sp.append_partitioned(b, node.spec.partition_by_exprs)
+            _annotate_spill(node, sp.bytes_written, f"{K} partitions")
 
-            yield from pmap_stream(iter(sp.files), eval_file)
-        else:
-            for i, f in enumerate(sp.files):
-                yield eval_file(f, i)
+            def eval_file(f, _i):
+                bs = list(f.read())
+                if not bs:
+                    return MicroPartition.empty(node.schema)
+                out = eval_window(RecordBatch.concat(bs), node.window_exprs,
+                                  node.spec, node.schema)
+                return MicroPartition(node.schema, [out])
+
+            if _pipeline_on():
+                from .pipeline import pmap_stream
+
+                yield from pmap_stream(iter(sp.files), eval_file)
+            else:
+                for i, f in enumerate(sp.files):
+                    yield eval_file(f, i)
+        finally:
+            sp.delete()
     finally:
-        sp.delete()
+        budget.close()
 
 
 def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
     """Hash join with a spillable build side: the right (build) side is
-    admitted against the memory budget; if it exceeds the budget, both sides
-    Grace-partition into K co-partitioned spill files by join-key hash and the
-    join runs per partition (correct for every join type since equal keys
-    land in the same partition)."""
+    admitted against the process-wide host memory ledger; if the LEDGER goes
+    over budget, both sides Grace-partition into K co-partitioned spill files
+    by join-key hash and the join runs per partition (correct for every join
+    type since equal keys land in the same partition)."""
     from . import memory as mem
 
     budget = mem.operator_budget()
+    try:
+        yield from _join_exec_impl(node, budget)
+    finally:
+        budget.close()
+
+
+def _join_exec_impl(node: pp.HashJoin, budget) -> Iterator[MicroPartition]:
+    from . import memory as mem
+    from ..observability.runtime_stats import profile_span
+
     right_it = _batch_iter(_exec(node.right))
     right_parts: List[RecordBatch] = []
     over = False
@@ -2111,12 +2349,17 @@ def _join_exec(node: pp.HashJoin) -> Iterator[MicroPartition]:
     spr = mem.SpillPartitions(node.right.schema, K)
     spl = mem.SpillPartitions(node.left.schema, K)
     try:
-        for b in itertools.chain(right_parts, right_it):
-            spr.append_partitioned(b, node.right_on)
-        if left_it is None:
-            left_it = _batch_iter(_exec(node.left))
-        for b in itertools.chain(left_prefix, left_it):
-            spl.append_partitioned(b, node.left_on)
+        with profile_span("spill.grace_join", "spill", partitions=K):
+            # prefix batches (right build, and left for right/outer joins)
+            # release their ledger bytes one by one as they land on disk
+            for b in _drain_prefix(budget, right_parts, right_it):
+                spr.append_partitioned(b, node.right_on)
+            if left_it is None:
+                left_it = _batch_iter(_exec(node.left))
+            for b in _drain_prefix(budget, left_prefix, left_it):
+                spl.append_partitioned(b, node.left_on)
+        _annotate_spill(node, spr.bytes_written + spl.bytes_written,
+                        f"{K}x2 partitions")
         for fl, fr in zip(spl.files, spr.files):
             lbs = list(fl.read())
             rbs = list(fr.read())

@@ -1,41 +1,31 @@
-"""Memory manager + spill files for out-of-core execution.
+"""Backward-compatible view over the host memory subsystem.
 
-Reference parity: src/daft-local-execution/src/resource_manager.rs:44
-(MemoryManager gating memory-hungry sinks) and the disk-backed spill design of
-daft-shuffles. Blocking operators (grouped agg, sort, join build) admit bytes
-against the configured budget (ExecutionConfig.memory_limit_bytes /
-DAFT_TPU_MEMORY_LIMIT); when over budget they switch to their spilling
-strategy (Grace partitioning / sorted-run generation) instead of OOMing.
-
-Spill files are Arrow IPC on local disk, written incrementally and read back
-streaming; the `spill_batches` / `spill_bytes` counters live in the
-process-wide MetricsRegistry (observability/metrics.py) so spill activity
-reaches QueryEnd.metrics, EXPLAIN ANALYZE's engine counters, the dashboard's
-/metrics exposition, and the bench JSON. The historical module attributes
-(``memory.spills`` / ``memory.spill_bytes``) keep working as a PEP 562 view
-over the registry, the same pattern as ops/counters.py.
+The out-of-core machinery was re-homed into ``daft_tpu/memory/`` (PR 12):
+``manager.py`` holds the process-wide HostMemoryManager + LedgerBudget the
+blocking operators admit against, ``spill.py`` the compressed Arrow IPC
+spill files with crash-safe lifecycle. This module keeps the historical
+import surface working — ``operator_budget()`` now hands out LEDGER budgets
+drawn against the shared process byte ledger instead of per-operator
+``MemoryBudget`` instances that each believed they owned the whole
+``memory_limit_bytes``; the module counters (``memory.spills`` /
+``memory.spill_bytes``) remain a PEP 562 view over the registry.
 """
 
 from __future__ import annotations
 
-import os
-import tempfile
-import uuid
-from typing import Iterator, List, Optional
+from ..memory.manager import (HostMemoryManager, LedgerBudget,  # noqa: F401
+                              manager, operator_budget)
+from ..memory.spill import (SpillFile, SpillPartitions,  # noqa: F401
+                            gc_stale_spills, reset_counters, spill_root)
+from ..observability.metrics import SPILL_COUNTER_NAMES, registry  # noqa: F401
 
-import pyarrow as pa
-import pyarrow.ipc as ipc
+class MemoryBudget(LedgerBudget):
+    """Historical one-arg form — ``MemoryBudget(limit_bytes)`` — preserved
+    for external callers; it now draws on the process ledger like every
+    other budget instead of assuming sole ownership of the limit."""
 
-from ..core.recordbatch import RecordBatch
-from ..observability.metrics import registry
-from ..schema import Schema
-
-SPILL_COUNTER_NAMES = (
-    "spill_batches",   # batches written to spill files
-    "spill_bytes",     # logical bytes of those batches
-)
-
-registry().declare(*SPILL_COUNTER_NAMES)
+    def __init__(self, limit_bytes: int):
+        super().__init__(manager(), limit_bytes)
 
 _ATTR_TO_COUNTER = {"spills": "spill_batches", "spill_bytes": "spill_bytes"}
 
@@ -44,94 +34,3 @@ def __getattr__(name: str) -> int:
     if name in _ATTR_TO_COUNTER:
         return registry().get(_ATTR_TO_COUNTER[name])
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-
-
-def _bump(n_batches: int, n_bytes: int) -> None:
-    registry().inc("spill_batches", n_batches)
-    registry().inc("spill_bytes", n_bytes)
-
-
-def reset_counters() -> None:
-    registry().reset(SPILL_COUNTER_NAMES)
-
-
-class MemoryBudget:
-    """Byte-accounting for one blocking operator instance."""
-
-    def __init__(self, limit_bytes: int):
-        self.limit = limit_bytes  # 0 = unbounded
-        self.used = 0
-
-    def admit(self, nbytes: int) -> bool:
-        """Account nbytes; returns True while within budget."""
-        self.used += nbytes
-        return self.limit <= 0 or self.used <= self.limit
-
-def operator_budget() -> MemoryBudget:
-    from ..config import execution_config
-
-    return MemoryBudget(execution_config().memory_limit_bytes)
-
-
-class SpillFile:
-    """One append-only Arrow IPC spill file with streaming read-back."""
-
-    def __init__(self, schema: Schema, spill_dir: Optional[str] = None):
-        self.schema = schema
-        d = spill_dir or os.path.join(tempfile.gettempdir(), "daft_tpu_spill")
-        os.makedirs(d, exist_ok=True)
-        self.path = os.path.join(d, f"s{os.getpid()}_{uuid.uuid4().hex[:10]}.arrow")
-        self._writer: Optional[ipc.RecordBatchFileWriter] = None
-        self.rows = 0
-
-    def append(self, batch: RecordBatch) -> None:
-        if batch.num_rows == 0:
-            return
-        table = batch.to_arrow()
-        if self._writer is None:
-            self._writer = ipc.RecordBatchFileWriter(self.path, table.schema)
-        self._writer.write_table(table)
-        self.rows += batch.num_rows
-        _bump(1, batch.size_bytes())
-
-    def finish(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
-
-    def read(self) -> Iterator[RecordBatch]:
-        self.finish()
-        if self.rows == 0 or not os.path.exists(self.path):
-            return
-        with ipc.RecordBatchFileReader(self.path) as r:
-            for i in range(r.num_record_batches):
-                rb = r.get_batch(i)
-                yield RecordBatch.from_arrow(
-                    pa.Table.from_batches([rb])).cast_to_schema(self.schema)
-
-    def delete(self) -> None:
-        self.finish()
-        try:
-            os.unlink(self.path)
-        except FileNotFoundError:
-            pass
-
-
-class SpillPartitions:
-    """K hash-partitioned spill files (Grace partitioning for agg/join/dedup)."""
-
-    def __init__(self, schema: Schema, k: int, spill_dir: Optional[str] = None):
-        self.k = k
-        self.files: List[SpillFile] = [SpillFile(schema, spill_dir) for _ in range(k)]
-
-    def append_partitioned(self, batch: RecordBatch, key_exprs) -> None:
-        from ..expressions.eval import eval_expression
-
-        keys = [eval_expression(batch, e) for e in key_exprs]
-        for j, piece in enumerate(batch.partition_by_hash(keys, self.k)):
-            if piece.num_rows:
-                self.files[j].append(piece)
-
-    def delete(self) -> None:
-        for f in self.files:
-            f.delete()

@@ -14,7 +14,14 @@ primitives:
   pushing into a bounded queue. Backpressure = the bounded queue; cancellation
   (a downstream limit stops pulling, or the query errors) propagates upstream
   by closing the producer's generator, which unwinds its `finally` blocks
-  (spill-file cleanup etc.) on the producer thread.
+  (spill-file cleanup etc.) on the producer thread. Out-of-core interplay
+  (daft_tpu/memory): the bounded channel caps MORSELS between stages, while
+  the host memory ledger's pressure signal paces BYTES — a StreamingScan
+  producer additionally stalls (bounded) while downstream blocking operators
+  sit at the memory wall, so channel depth x morsel size can't outrun the
+  process budget; and because cancellation unwinds producer `finally`
+  blocks, an abandoned spilling query deletes its spill artifacts on the
+  way out.
 - pmap_stream: ordered morsel fan-out — submit fn(item, i) for a bounded
   window of in-flight items to the shared compute pool, yield results in input
   order (row order is part of the engine's semantics).

@@ -81,17 +81,39 @@ class ParquetScanOperator(ScanOperator):
         out_schema = Schema([schema[c] for c in columns]) if columns is not None else schema
         arrow_filter = _expr_to_arrow_filter(pushdowns.filters) if pushdowns.filters is not None else None
 
+        from ..config import execution_config
         from .object_store import is_remote
 
+        split_bytes = execution_config().scan_split_bytes
         tasks = []
         conjuncts = _zone_map_conjuncts(pushdowns.filters) if pushdowns.filters is not None else []
         for path in self._paths:
-            if conjuncts and _file_prunable(path, conjuncts):
-                continue  # zone map proved no row can match (metadata-only read)
+            remote = is_remote(path)
+            size = os.path.getsize(path) if os.path.exists(path) else None
+            want_split = (not remote and size is not None
+                          and (self._row_groups_per_task is not None
+                               or (split_bytes and size > split_bytes)))
+            # one footer parse per local file serves BOTH zone-map pruning
+            # and split planning (a filtered many-file scan used to pay two)
+            md = _local_metadata(path) if not remote and (conjuncts or want_split) \
+                else None
+            if conjuncts:
+                if md is not None:
+                    if _prunable_md(md, conjuncts):
+                        continue  # zone map proved no row can match
+                elif remote and _file_prunable(path, conjuncts):
+                    continue  # same proof via ranged footer reads
+            if want_split and md is not None:
+                split = _row_group_split_tasks(
+                    path, md, columns, out_schema, conjuncts,
+                    split_bytes or size, self._row_groups_per_task)
+                if split is not None:
+                    tasks.extend(split)
+                    continue
             tasks.append(ScanTask(
                 read=_make_reader(path, columns, arrow_filter, pushdowns.limit, out_schema),
                 schema=out_schema,
-                size_bytes=os.path.getsize(path) if os.path.exists(path) else None,
+                size_bytes=size,
                 # remote readers don't evaluate the predicate; the executor
                 # re-applies it post-scan
                 filters_applied=arrow_filter is not None and not is_remote(path),
@@ -130,43 +152,134 @@ def _zone_map_conjuncts(expr) -> List[tuple]:
     return out
 
 
+def _rg_excluded(rg, conjuncts: List[tuple]) -> bool:
+    """True iff row-group statistics PROVE no row in `rg` satisfies some
+    conjunct (shared by file-level pruning and split planning)."""
+    cols = {rg.column(i).path_in_schema: rg.column(i).statistics
+            for i in range(rg.num_columns)}
+    for name, op, value in conjuncts:
+        st = cols.get(name)
+        if st is None or not st.has_min_max:
+            continue
+        try:
+            if op == "lt" and not (st.min < value):
+                return True
+            if op == "le" and not (st.min <= value):
+                return True
+            if op == "gt" and not (st.max > value):
+                return True
+            if op == "ge" and not (st.max >= value):
+                return True
+            if op == "eq" and not (st.min <= value <= st.max):
+                return True
+        except TypeError:
+            continue  # incomparable stats (e.g. logical-type mismatch)
+    return False
+
+
+def _local_metadata(path: str):
+    """Parsed footer metadata of a LOCAL parquet file, or None when the
+    footer is unreadable (callers degrade to whole-file/no-prune planning)."""
+    try:
+        return pq.ParquetFile(path).metadata
+    except Exception:  # lint: ignore[broad-except] -- unreadable footer: plan without metadata
+        return None
+
+
+def _prunable_md(md, conjuncts: List[tuple]) -> bool:
+    """True iff the statistics in `md` PROVE no row satisfies the predicate
+    — every row group must be excluded by some conjunct."""
+    for rg_i in range(md.num_row_groups):
+        if not _rg_excluded(md.row_group(rg_i), conjuncts):
+            return False  # this row group might match
+    return md.num_row_groups > 0
+
+
 def _file_prunable(path: str, conjuncts: List[tuple]) -> bool:
-    """True iff parquet row-group statistics PROVE no row satisfies the
-    predicate — every row group must be excluded by some conjunct. Metadata
-    only: remote objects read just the footer via ranged gets."""
+    """Remote-object variant of _prunable_md: reads just the footer via
+    ranged gets; never prunes on metadata trouble."""
     from .object_store import open_input
 
     try:
-        md = pq.ParquetFile(open_input(path)).metadata
-        for rg_i in range(md.num_row_groups):
-            rg = md.row_group(rg_i)
-            cols = {rg.column(i).path_in_schema: rg.column(i).statistics
-                    for i in range(rg.num_columns)}
-            excluded = False
-            for name, op, value in conjuncts:
-                st = cols.get(name)
-                if st is None or not st.has_min_max:
-                    continue
-                try:
-                    if op in ("lt",) and not (st.min < value):
-                        excluded = True
-                    elif op == "le" and not (st.min <= value):
-                        excluded = True
-                    elif op == "gt" and not (st.max > value):
-                        excluded = True
-                    elif op == "ge" and not (st.max >= value):
-                        excluded = True
-                    elif op == "eq" and not (st.min <= value <= st.max):
-                        excluded = True
-                except TypeError:
-                    continue  # incomparable stats (e.g. logical-type mismatch)
-                if excluded:
-                    break
-            if not excluded:
-                return False  # this row group might match
-        return md.num_row_groups > 0
+        return _prunable_md(pq.ParquetFile(open_input(path)).metadata, conjuncts)
     except Exception:  # lint: ignore[broad-except] -- never prune on metadata trouble
         return False
+
+
+def _row_group_split_tasks(path: str, md, columns, out_schema: Schema,
+                           conjuncts: List[tuple], split_bytes: int,
+                           row_groups_per_task: Optional[int]) -> Optional[List[ScanTask]]:
+    """Split one large local parquet file into row-group-aligned ScanTasks
+    so no single scan task materializes more than ~split_bytes (reference:
+    daft-scan's ScanTask-per-row-group splitting). `md` is the caller's
+    already-parsed footer metadata. Row groups a zone-map conjunct excludes
+    are dropped at plan time. Returns None when the file can't split (one
+    row group, everything pruned into one task) — the caller falls back to
+    the whole-file task.
+
+    Split tasks read via ``ParquetFile.iter_batches(row_groups=...)`` with
+    column pruning but WITHOUT the arrow predicate (``filters_applied`` is
+    False, so the executor re-applies the pushed filter post-scan — exactly
+    the remote-reader contract)."""
+    if md.num_row_groups <= 1:
+        return None
+    groups: List[List[int]] = []
+    sizes: List[int] = []
+    rows: List[int] = []
+    cur: List[int] = []
+    cur_bytes = cur_rows = 0
+    for rg_i in range(md.num_row_groups):
+        rg = md.row_group(rg_i)
+        if conjuncts and _rg_excluded(rg, conjuncts):
+            continue  # zone map: no row in this group can match
+        # ON-DISK bytes (compressed), not rg.total_byte_size (uncompressed):
+        # whole-file tasks report file size, and planner byte estimates /
+        # task merging must see one unit, or the same table looks several
+        # times bigger once split (flipping broadcast-join eligibility)
+        nb = sum(rg.column(ci).total_compressed_size
+                 for ci in range(rg.num_columns))
+        if cur and (cur_bytes + nb > split_bytes
+                    or (row_groups_per_task is not None
+                        and len(cur) >= row_groups_per_task)):
+            groups.append(cur)
+            sizes.append(cur_bytes)
+            rows.append(cur_rows)
+            cur, cur_bytes, cur_rows = [], 0, 0
+        cur.append(rg_i)
+        cur_bytes += nb
+        cur_rows += rg.num_rows
+    if cur:
+        groups.append(cur)
+        sizes.append(cur_bytes)
+        rows.append(cur_rows)
+    if len(groups) <= 1:
+        return None
+
+    def make_read(rgs: List[int]):
+        def read():
+            pf = pq.ParquetFile(path)
+            for rb in pf.iter_batches(batch_size=_scan_batch_rows(),
+                                      row_groups=rgs, columns=columns):
+                t = pa.Table.from_batches([rb])
+                yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
+
+        return read
+
+    from ..observability.metrics import registry
+
+    registry().inc("scan_tasks_split", len(groups))
+    return [
+        ScanTask(
+            read=make_read(g),
+            schema=out_schema,
+            size_bytes=nb,
+            num_rows=nr,
+            filters_applied=False,
+            limit_applied=False,
+            source_label=f"{path}[rg{g[0]}..{g[-1]}]",
+        )
+        for g, nb, nr in zip(groups, sizes, rows)
+    ]
 
 
 def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):

@@ -57,6 +57,68 @@ class ScanTask:
     source_label: str = ""
 
 
+def merge_small_tasks(tasks: List[ScanTask], target_bytes: int) -> List[ScanTask]:
+    """Coalesce runs of small adjacent ScanTasks toward `target_bytes` so a
+    many-tiny-files source doesn't pay per-task scheduling/IO overhead (the
+    merge half of scan split planning; io/parquet.py owns the split half).
+
+    Only tasks with a KNOWN size merge, and only while every merged member
+    agrees on filters_applied (a merged task must be re-filterable as one
+    unit); limit-absorbing tasks never merge (the limit bookkeeping is
+    per-task). Order is preserved — a merged task reads its members
+    sequentially, so row order matches the unmerged plan exactly."""
+    if target_bytes <= 0 or len(tasks) <= 1:
+        return tasks
+
+    out: List[ScanTask] = []
+    group: List[ScanTask] = []
+    group_bytes = 0
+
+    def flush() -> None:
+        nonlocal group, group_bytes
+        if not group:
+            return
+        if len(group) == 1:
+            out.append(group[0])
+        else:
+            members = list(group)
+
+            def read_all(_members=members):
+                for t in _members:
+                    yield from t.read()
+
+            rows = [t.num_rows for t in members]
+            out.append(ScanTask(
+                read=read_all,
+                schema=members[0].schema,
+                size_bytes=sum(t.size_bytes for t in members),
+                num_rows=sum(rows) if all(r is not None for r in rows) else None,
+                filters_applied=members[0].filters_applied,
+                limit_applied=False,
+                source_label=f"{members[0].source_label} (+{len(members) - 1} merged)",
+            ))
+            from ..observability.metrics import registry
+
+            registry().inc("scan_tasks_merged", len(members) - 1)
+        group, group_bytes = [], 0
+
+    for t in tasks:
+        mergeable = (t.size_bytes is not None and not t.limit_applied
+                     and t.size_bytes < target_bytes)
+        if not mergeable:
+            flush()
+            out.append(t)
+            continue
+        if group and (group_bytes + t.size_bytes > target_bytes
+                      or group[0].filters_applied != t.filters_applied
+                      or group[0].schema is not t.schema):
+            flush()
+        group.append(t)
+        group_bytes += t.size_bytes
+    flush()
+    return out
+
+
 class ScanOperator:
     """Base class for external sources (parquet/csv/json readers, Python DataSources)."""
 

@@ -1,0 +1,244 @@
+"""Disk spill for out-of-core operators: compressed Arrow IPC files with a
+crash-safe lifecycle.
+
+Format: Arrow IPC *stream* files with per-message body compression — the
+same wire format the shuffle writer uses (distributed/shuffle.py), governed
+by ``DAFT_TPU_SPILL_COMPRESSION`` (none|lz4|zstd, default lz4). Readers
+stream batch-by-batch; the codec travels in the IPC message headers, so
+mixed-codec spill dirs decode fine.
+
+Lifecycle discipline:
+
+- every artifact name carries the OWNING PID (``s<pid>_…`` files,
+  ``g<pid>_…`` Grace directories) under one spill root
+  (``DAFT_TPU_SPILL_DIR`` or ``<tmp>/daft_tpu_spill``);
+- writers append to a ``.tmp`` name and ``os.replace`` into the final name
+  on finish (tmp + atomic publish), so a half-written file is never
+  mistaken for a complete one;
+- operators delete their files in ``finally`` blocks, which the pipeline's
+  cancellation propagation unwinds on the producer thread (pipeline.py
+  spawn_stage closes abandoned generators) — query failure and cancellation
+  both GC their spill state in-process;
+- artifacts orphaned by a KILLED process (no finally ran) are swept by
+  ``gc_stale_spills()``: any artifact whose embedded pid is dead is removed.
+  The sweep runs once per process, lazily, at the first spill — a crashed
+  run's droppings survive at most until the next spilling process starts.
+
+Attribution: spill_batches / spill_bytes (logical) / spill_wire_bytes
+(on-disk) / spill_files / spill_runs / spill_merge_passes / spill_dirs_gced
+counters in the process registry (observability/metrics.py), so spill
+activity reaches QueryEnd.metrics, EXPLAIN ANALYZE, /metrics, and bench JSON.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+import threading
+import uuid
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ..core.recordbatch import RecordBatch
+from ..observability.metrics import SPILL_COUNTER_NAMES, registry
+from ..schema import Schema
+
+_ATTR_TO_COUNTER = {"spills": "spill_batches", "spill_bytes": "spill_bytes"}
+
+
+def __getattr__(name: str) -> int:
+    # historical module attributes (memory.spills / memory.spill_bytes) as a
+    # PEP 562 view over the registry — same pattern as ops/counters.py
+    if name in _ATTR_TO_COUNTER:
+        return registry().get(_ATTR_TO_COUNTER[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def reset_counters() -> None:
+    from ..observability.metrics import MEMORY_COUNTER_NAMES
+
+    registry().reset(SPILL_COUNTER_NAMES + MEMORY_COUNTER_NAMES)
+
+
+def spill_root() -> str:
+    """Base directory spill artifacts land under."""
+    from ..config import execution_config
+
+    d = execution_config().spill_dir
+    return d or os.path.join(tempfile.gettempdir(), "daft_tpu_spill")
+
+
+# ---- stale-artifact GC ---------------------------------------------------------------
+
+_GC_LOCK = threading.Lock()
+_GC_DONE = False
+
+# s<pid>_<hex>.arrow files, g<pid>_<hex> Grace dirs (+ trailing .tmp variants)
+_ARTIFACT_RE = re.compile(r"^[a-z](\d+)_[0-9a-f]+")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # unknowable: never sweep what might be alive
+    return True
+
+
+def gc_stale_spills(root: Optional[str] = None) -> int:
+    """Remove spill artifacts left behind by DEAD processes (pid parsed from
+    the artifact name). Never touches a live process's files. Returns the
+    number of artifacts removed (also counted as spill_dirs_gced)."""
+    root = root or spill_root()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    removed = 0
+    for name in names:
+        m = _ARTIFACT_RE.match(name)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        path = os.path.join(root, name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.unlink(path)
+            removed += 1
+        except OSError:
+            continue  # raced with another sweeper / fs trouble: leave it
+    if removed:
+        registry().inc("spill_dirs_gced", removed)
+    return removed
+
+
+def _gc_stale_once() -> None:
+    global _GC_DONE
+    with _GC_LOCK:
+        if _GC_DONE:
+            return
+        _GC_DONE = True
+    gc_stale_spills()
+
+
+# ---- spill files ---------------------------------------------------------------------
+
+
+def _ipc_options(compression: Optional[str]) -> ipc.IpcWriteOptions:
+    if compression is None:
+        from ..config import execution_config
+
+        compression = execution_config().spill_compression
+    return ipc.IpcWriteOptions(
+        compression=None if compression == "none" else compression)
+
+
+class SpillFile:
+    """One append-only compressed Arrow IPC spill file with streaming
+    read-back and tmp + atomic-publish lifecycle."""
+
+    def __init__(self, schema: Schema, spill_dir: Optional[str] = None,
+                 compression: Optional[str] = None):
+        _gc_stale_once()
+        self.schema = schema
+        d = spill_dir or spill_root()
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, f"s{os.getpid()}_{uuid.uuid4().hex[:10]}.arrow")
+        self._tmp = self.path + ".tmp"
+        self._opts = _ipc_options(compression)
+        self._writer = None
+        self._published = False
+        self.rows = 0
+        self.bytes_written = 0  # logical Arrow bytes appended
+
+    def append(self, batch: RecordBatch) -> None:
+        if batch.num_rows == 0:
+            return
+        table = batch.to_arrow()
+        if self._writer is None:
+            registry().inc("spill_files")
+            self._writer = ipc.new_stream(self._tmp, table.schema,
+                                          options=self._opts)
+        self._writer.write_table(table)
+        self.rows += batch.num_rows
+        nb = batch.size_bytes()
+        self.bytes_written += nb
+        registry().inc("spill_batches")
+        registry().inc("spill_bytes", nb)
+
+    def finish(self) -> None:
+        """Close the writer and atomically publish the file."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if not self._published and os.path.exists(self._tmp):
+            os.replace(self._tmp, self.path)
+            self._published = True
+            try:
+                registry().inc("spill_wire_bytes", os.path.getsize(self.path))
+            except OSError:
+                pass  # the file vanished (concurrent delete): wire bytes stay advisory
+
+    def read(self) -> Iterator[RecordBatch]:
+        """Stream batches back in append order, one at a time."""
+        self.finish()
+        if self.rows == 0 or not os.path.exists(self.path):
+            return
+        with ipc.open_stream(self.path) as r:
+            for rb in r:
+                yield RecordBatch.from_arrow(
+                    pa.Table.from_batches([rb])).cast_to_schema(self.schema)
+
+    def delete(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        for p in (self._tmp, self.path):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+
+
+class SpillPartitions:
+    """K hash-partitioned spill files (Grace partitioning for agg/join/dedup/
+    window), grouped under one per-operator directory so failure cleanup and
+    the dead-pid sweep are a single rmtree."""
+
+    def __init__(self, schema: Schema, k: int, spill_dir: Optional[str] = None):
+        _gc_stale_once()
+        base = spill_dir or spill_root()
+        self.dir = os.path.join(base, f"g{os.getpid()}_{uuid.uuid4().hex[:10]}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.k = k
+        self.files: List[SpillFile] = [SpillFile(schema, self.dir)
+                                       for _ in range(k)]
+
+    @property
+    def bytes_written(self) -> int:
+        return sum(f.bytes_written for f in self.files)
+
+    def append_partitioned(self, batch: RecordBatch, key_exprs) -> None:
+        from ..expressions.eval import eval_expression
+
+        keys = [eval_expression(batch, e) for e in key_exprs]
+        for j, piece in enumerate(batch.partition_by_hash(keys, self.k)):
+            if piece.num_rows:
+                self.files[j].append(piece)
+
+    def delete(self) -> None:
+        for f in self.files:
+            f.delete()
+        shutil.rmtree(self.dir, ignore_errors=True)

@@ -211,18 +211,43 @@ FAULT_COUNTER_NAMES = (
 # so a broken subscriber can't fail a query — counted so it isn't invisible).
 OBS_COUNTER_NAMES = ("subscriber_errors",)
 
-# Host memory manager spill (execution/memory.py documents the semantics).
-SPILL_COUNTER_NAMES = ("spill_batches", "spill_bytes")
+# Host memory manager spill (daft_tpu/memory/ documents the semantics;
+# execution/memory.py is the compatibility view).
+SPILL_COUNTER_NAMES = (
+    "spill_batches",        # batches written to spill files
+    "spill_bytes",          # logical Arrow bytes of those batches
+    "spill_wire_bytes",     # bytes that actually hit disk (IPC body compression)
+    "spill_files",          # spill files opened (runs + Grace partitions)
+    "spill_runs",           # sorted runs generated by the external sort
+    "spill_merge_passes",   # intermediate k-way merge passes (fan-in capping)
+    "spill_dirs_gced",      # stale spill artifacts swept from dead processes
+)
+
+# Out-of-core streaming scans (execution/executor.py _streaming_scan over
+# io/parquet.py split planning) + the host memory ledger (daft_tpu/memory/).
+MEMORY_COUNTER_NAMES = (
+    "scan_batches",             # morsels yielded by streaming scans
+    "scan_rows",                # rows through streaming scans
+    "scan_bytes",               # logical bytes through streaming scans
+    "scan_tasks_split",         # scan tasks produced by row-group splitting
+    "scan_tasks_merged",        # small scan tasks absorbed by task merging
+    "scan_backpressure_stalls", # times a scan stalled on host memory pressure
+    "scan_stall_ms",            # cumulative milliseconds of those stalls
+    "host_over_budget_events",  # operators that crossed the host budget -> spill
+)
 
 DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
                      SHUFFLE_COUNTER_NAMES + FAULT_COUNTER_NAMES +
-                     SPILL_COUNTER_NAMES + OBS_COUNTER_NAMES)
+                     SPILL_COUNTER_NAMES + MEMORY_COUNTER_NAMES +
+                     OBS_COUNTER_NAMES)
 
 DECLARED_GAUGES = (
     "serve_queue_depth",       # admission queue depth (serving/session.py)
     "hbm_bytes_resident",      # device bytes the residency manager holds
     "hbm_bytes_high_water",
     "hbm_reserved_bytes",      # admission-controller reservations outstanding
+    "host_bytes_tracked",      # host bytes admitted against the memory ledger
+    "host_bytes_high_water",   # ledger high-water since process start / clear()
     "shuffle_fetch_inflight",  # high-water concurrent fetch requests
     "mesh_devices_used",       # devices of the last mesh dispatch
     "bucket_fill_ratio",       # coalescer padding efficiency (per run)

@@ -70,6 +70,20 @@ class TaskScan(PhysicalPlan):
         self.post_limit = post_limit
 
 
+class StreamingScan(TaskScan):
+    """Out-of-core scan: tasks arrive pre-split/merged toward
+    ``scan_split_bytes`` (row-group splits in io/parquet.py, small-file
+    merging in io/scan.py) and the executor streams morsels incrementally
+    under the host memory ledger (execution/executor.py _streaming_scan) —
+    a source is never materialized whole, and a fast scan paces itself
+    against memory pressure from downstream spilling operators. Subclasses
+    TaskScan so the distributed planner's task partitioning and every
+    isinstance gate keep working unchanged."""
+
+    def name(self) -> str:
+        return f"StreamingScan({len(self.tasks)} tasks)"
+
+
 class Project(_Unary):
     def __init__(self, input: PhysicalPlan, projection: List[Expression], schema: Schema):
         super().__init__(input, schema)
@@ -434,6 +448,14 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
 
     if isinstance(plan, lp.ScanSource):
         tasks = plan.scan_op.to_scan_tasks(plan.pushdowns)
+        from ..config import execution_config
+
+        cfg = config or execution_config()
+        target = getattr(cfg, "scan_split_bytes", 0)
+        if target and len(tasks) > 1:
+            from ..io.scan import merge_small_tasks
+
+            tasks = merge_small_tasks(tasks, target)
         post_filter = None
         post_limit = plan.pushdowns.limit
         if plan.pushdowns.filters is not None:
@@ -442,7 +464,7 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
         if post_limit is not None and all(t.limit_applied for t in tasks):
             # limit fully absorbed per-task; still cap globally
             pass
-        return TaskScan(tasks, plan.schema, post_filter, post_limit)
+        return StreamingScan(tasks, plan.schema, post_filter, post_limit)
 
     if isinstance(plan, lp.Project):
         return Project(translate(plan.input, config), plan.projection, plan.schema)

@@ -1,0 +1,212 @@
+"""Tiny-budget correctness: TPC-H-shaped join/sort/agg queries with
+DAFT_TPU_MEMORY_LIMIT at ~10% of the input bytes must stay bit-identical to
+the unbudgeted runs while actually spilling — plus the spill-artifact
+lifecycle (cancellation GC, dead-pid sweep, tmp + atomic publish)."""
+
+import os
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.config import execution_config_ctx
+from daft_tpu.execution import memory as mem
+from daft_tpu.observability.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    mem.reset_counters()
+    mem.manager().clear()
+    yield
+    mem.manager().clear()
+
+
+@pytest.fixture(scope="module")
+def tables():
+    from benchmarking.tpch.datagen import load_dataframes
+
+    return {k: v.collect() for k, v in load_dataframes(sf=0.05, seed=0).items()}
+
+
+def _input_bytes(dfs):
+    return sum(p.size_bytes() for df in dfs for p in df.iter_partitions())
+
+
+def _tiny_budget(tables) -> int:
+    return max(int(_input_bytes(tables.values()) * 0.1), 1 << 16)
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6, 10])
+def test_tpch_bit_identical_under_tiny_budget(tables, qnum):
+    """Bit-identity at ~10% of input bytes. Pushdowns can legitimately keep
+    an individual query's working set under the budget (q6's filter survives
+    ~2% of rows), so the spill assertions live in the suite-level test below
+    and the shape-controlled join/sort tests."""
+    from benchmarking.tpch.queries import ALL_QUERIES
+
+    budget = _tiny_budget(tables)
+    with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+        capped = ALL_QUERIES[qnum](tables).to_pydict()
+    with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+        unbudgeted = ALL_QUERIES[qnum](tables).to_pydict()
+    assert capped == unbudgeted, f"q{qnum} diverged under the budget"
+
+
+def test_tpch_suite_spills_at_ten_percent(tables):
+    """Across the TPC-H subset, a 10% budget must actually engage the
+    out-of-core tier: ledger crossings AND disk spill somewhere."""
+    from benchmarking.tpch.queries import ALL_QUERIES
+
+    budget = _tiny_budget(tables)
+    mem.reset_counters()
+    with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+        for qnum in (1, 3, 5, 6, 10):
+            ALL_QUERIES[qnum](tables).to_pydict()
+    assert registry().get("host_over_budget_events") > 0
+    assert registry().get("spill_bytes") > 0
+
+
+def test_join_grace_spills_under_tiny_budget(tables):
+    def q():
+        return (tables["orders"]
+                .join(tables["lineitem"], left_on="o_orderkey",
+                      right_on="l_orderkey")
+                .groupby("o_orderpriority")
+                .agg(col("l_extendedprice").sum().alias("rev"))
+                .sort("o_orderpriority"))
+
+    mem.reset_counters()
+    # small enough that even the column-pruned build side crosses it
+    with execution_config_ctx(memory_limit_bytes=256 * 1024, device_mode="off"):
+        capped = q().to_pydict()
+    assert registry().get("spill_bytes") > 0, "Grace join never spilled"
+    with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+        unbudgeted = q().to_pydict()
+    # Grace partitioning feeds the float sum in spill-partition order, so
+    # 'rev' is compared to fp tolerance (the existing out-of-core suite's
+    # convention); the group keys must match exactly
+    import numpy as np
+
+    assert capped["o_orderpriority"] == unbudgeted["o_orderpriority"]
+    np.testing.assert_allclose(capped["rev"], unbudgeted["rev"], rtol=1e-9)
+
+
+def test_sort_generates_runs_and_merges(tables):
+    li = tables["lineitem"].select(
+        col("l_orderkey"), col("l_linenumber"), col("l_extendedprice"))
+    budget = max(int(_input_bytes(tables.values()) * 0.01), 1 << 16)
+
+    def q():
+        return li.sort(["l_extendedprice", "l_orderkey", "l_linenumber"])
+
+    mem.reset_counters()
+    with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+        capped = q().to_pydict()
+    assert registry().get("spill_runs") >= 2, "external sort produced <2 runs"
+    assert registry().get("spill_bytes") > 0
+    with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+        unbudgeted = q().to_pydict()
+    assert capped == unbudgeted
+
+
+def test_merge_cascade_over_fanin(tables):
+    """Enough runs to exceed the merge fan-in: the cascade (intermediate
+    merged runs) must engage and stay exact."""
+    # fine-grained batches so run count tracks the budget, not the stored
+    # partition chunking (a run flushes at the first over-budget batch)
+    li = (tables["lineitem"].select(col("l_orderkey"), col("l_extendedprice"))
+          .into_batches(8192).collect())
+
+    def q():
+        return li.sort(["l_extendedprice", "l_orderkey"])
+
+    mem.reset_counters()
+    with execution_config_ctx(memory_limit_bytes=96 * 1024, device_mode="off"):
+        capped = q().to_pydict()
+    assert registry().get("spill_merge_passes") > 0, \
+        "run count never exceeded the merge fan-in"
+    with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+        unbudgeted = q().to_pydict()
+    assert capped == unbudgeted
+
+
+def test_cancelled_spilling_query_gcs_spill_artifacts(tables):
+    """Kill (abandon) a spilling query mid-stream: cancellation propagates
+    to the producer threads, their finally blocks run, and no spill artifact
+    of this pid survives."""
+    from daft_tpu.memory import spill_root
+    from daft_tpu.runners import get_or_create_runner
+
+    li = tables["lineitem"].select(col("l_orderkey"), col("l_extendedprice"))
+    with execution_config_ctx(memory_limit_bytes=256 * 1024, device_mode="off"):
+        q = li.sort(["l_extendedprice", "l_orderkey"])
+        it = get_or_create_runner().run_iter(q._builder)
+        first = next(it)
+        assert first.num_rows > 0
+        assert registry().get("spill_files") > 0, "query never spilled"
+        it.close()  # consumer abandons the stream mid-merge
+    root = spill_root()
+    mine_tag = f"{os.getpid()}_"
+    deadline = time.time() + 10
+    mine = ["?"]
+    while time.time() < deadline and mine:
+        mine = [n for n in os.listdir(root)
+                if mine_tag in n] if os.path.isdir(root) else []
+        if mine:
+            time.sleep(0.05)
+    assert not mine, f"orphaned spill artifacts after cancellation: {mine}"
+
+
+def _dead_pid() -> int:
+    for pid in range(300_000, 300_064):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return pid
+        except OSError:
+            continue
+    pytest.skip("could not find a dead pid on this platform")
+
+
+def test_stale_spill_artifacts_swept(tmp_path):
+    """Artifacts from a KILLED process (embedded pid dead) are swept; a live
+    process's artifacts are never touched."""
+    from daft_tpu.memory import gc_stale_spills
+
+    root = tmp_path / "spillroot"
+    root.mkdir()
+    dead = _dead_pid()
+    (root / f"s{dead}_deadbeef01.arrow").write_bytes(b"x")
+    grace = root / f"g{dead}_deadbeef02"
+    grace.mkdir()
+    (grace / "s1_aa.arrow").write_bytes(b"x")
+    live = f"s{os.getpid()}_cafecafe01.arrow"
+    (root / live).write_bytes(b"x")
+    removed = gc_stale_spills(str(root))
+    assert removed == 2
+    assert sorted(os.listdir(root)) == [live]
+    assert registry().get("spill_dirs_gced") >= 2
+
+
+def test_spill_file_tmp_publish_discipline(tmp_path):
+    """A spill file streams into <name>.tmp and publishes atomically on
+    finish; delete removes both names; round-trip preserves content."""
+    import numpy as np
+    import pyarrow as pa
+
+    from daft_tpu.core.recordbatch import RecordBatch
+    from daft_tpu.memory import SpillFile
+
+    batch = RecordBatch.from_arrow(pa.table({"a": np.arange(1000)}))
+    f = SpillFile(batch.schema, spill_dir=str(tmp_path))
+    f.append(batch)
+    assert os.path.exists(f._tmp) and not os.path.exists(f.path)
+    f.finish()
+    assert os.path.exists(f.path) and not os.path.exists(f._tmp)
+    got = list(f.read())
+    assert sum(b.num_rows for b in got) == 1000
+    assert got[0].get_column("a").to_pylist()[:5] == [0, 1, 2, 3, 4]
+    f.delete()
+    assert not os.path.exists(f.path)
