@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-gate bench-compare
+.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-gate bench-compare calibrate-report
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -99,3 +99,10 @@ bench-gate:
 # Ad-hoc: make bench-compare OLD=BENCH_r04.json NEW=BENCH_r05.json
 bench-compare:
 	$(PY) bench.py --compare $(OLD) $(NEW)
+
+# Cost-model calibration report (daft_tpu/tools/calibrate.py): run a forced
+# priced probe workload, replay the placement ledger's observed-vs-predicted
+# samples, and print suggested DAFT_TPU_COST_* overrides. On real silicon,
+# run WITHOUT JAX_PLATFORMS=cpu so the link terms are measured on the device.
+calibrate-report:
+	env JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m daft_tpu.tools.calibrate

@@ -97,6 +97,31 @@ QUERIES = [int(x) for x in os.environ.get(
 REPS = int(os.environ.get("BENCH_REPS", 5))
 
 
+def _calibration_dict() -> dict:
+    """The effective DAFT_TPU_COST_* calibration the capture ran under ({}
+    when the process never calibrated) — every bench JSON records it so two
+    captures are comparable knowing which terms priced their placements."""
+    from daft_tpu.ops.costmodel import calibration_dict
+
+    return calibration_dict()
+
+
+def _placement_brief(placements: list) -> list:
+    """Compact per-query placement verdicts for the bench JSON: one dict per
+    decision with the chosen tier, the reason/margin, and the model-error
+    ratio for dispatched stages (full per-term records stay in the process
+    ledger / event log — the capture records the verdicts)."""
+    out = []
+    for p in placements:
+        rec = {"site": p.get("site"), "chosen": p.get("chosen")}
+        for k in ("reason", "margin", "error_ratio", "cached", "forced"):
+            v = p.get(k)
+            if v:
+                rec[k] = v
+        out.append(rec)
+    return out
+
+
 def _derive_mesh_ratio(metric_totals: dict) -> None:
     """Attach mesh_dispatch_ratio — the mesh share of all device dispatches
     (mesh + single-chip) — wherever the raw counters landed, so a capture
@@ -170,6 +195,7 @@ def shuffle_microbench() -> None:
             "group_rows": rows,
             "fact_rows": n,
             "reps": REPS,
+            "calibration": _calibration_dict(),
             "metrics": metric_totals,
         }))
     finally:
@@ -257,6 +283,7 @@ def mesh_microbench() -> None:
         "bit_identical": True,
         "fact_rows": n,
         "reps": REPS,
+        "calibration": _calibration_dict(),
         "metrics": metric_totals,
     }))
 
@@ -377,6 +404,7 @@ def serve_bench() -> None:
         "serve_workers": workers,
         "bit_identical": True,
         "fact_rows": n,
+        "calibration": _calibration_dict(),
         "metrics": metric_totals,
     }))
 
@@ -492,6 +520,7 @@ def ai_bench() -> None:
         "labels": len(labels),
         "fact_rows": n,
         "reps": REPS,
+        "calibration": _calibration_dict(),
         "metrics": metric_totals,
     }))
 
@@ -590,6 +619,7 @@ def oom_bench() -> None:
         "fact_rows": n_lineitem,
         "sf": SF,
         "reps": REPS,
+        "calibration": _calibration_dict(),
         "metrics": metric_totals,
     }))
 
@@ -639,6 +669,18 @@ def compare(old_path: str, new_path: str) -> int:
             regressions.append("rows_per_sec")
         print(f"{'TOTAL':<8} {'':>10} {'':>10} {nv / ov:>7.2f}x{flag}  "
               f"({old.get('metric', '?')}: {ov:g} -> {nv:g} rows/sec)")
+    # cost-model drift: a WARNING, not a gate failure — prediction error
+    # moving >2x between captures means the calibration (or the model's
+    # terms) no longer matches the silicon, and placement verdicts near the
+    # boundary may have flipped for the wrong reason. Recalibrate via
+    # `make calibrate-report` and commit the suggested overrides.
+    oe = old.get("cost_model_error_ratio")
+    ne = new.get("cost_model_error_ratio")
+    if oe and ne and (ne > 2 * oe or ne < oe / 2):
+        print(f"WARNING: cost_model_error_ratio drifted {oe:g} -> {ne:g} "
+              f"(> 2x): placement predictions diverged from measured "
+              f"dispatches — run `make calibrate-report` and refresh the "
+              f"DAFT_TPU_COST_* overrides")
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) > "
               f"{REGRESSION_TOLERANCE:.0%}: {', '.join(regressions)}")
@@ -690,9 +732,19 @@ def main() -> None:
     tables = {k: v.collect() for k, v in load_dataframes(sf=SF, seed=0).items()}
     n_lineitem = tables[fact].count_rows()
 
-    # warmup (compile caches, device column residency, key dictionaries)
+    from daft_tpu.observability import placement as _placement
+
+    # warmup (compile caches, device column residency, key dictionaries).
+    # Placement verdicts are collected HERE, on the first execution of each
+    # query: the warmup run prices every decision fresh (full per-tier cost
+    # breakdowns + margins), while later reps are served from the verdict
+    # caches and would record margin-less cached records for exactly the
+    # host-rejected join queries the capture needs to explain.
+    q_placement = {}                       # per-query placement verdicts
     for q in QUERIES:
-        ALL_QUERIES[q](tables).to_pydict()
+        with _placement.query_scope() as pscope:
+            ALL_QUERIES[q](tables).to_pydict()
+        q_placement[q] = _placement_brief(pscope.to_dicts())
 
     from daft_tpu.execution import memory as _mem
 
@@ -726,9 +778,12 @@ def main() -> None:
                                   key=counters.rejections.get)
             if rep == REPS - 1:
                 # one full pass over the query set: per-query registry deltas
-                # (device counters + shuffle bytes) summed for attribution
+                # (device counters + shuffle bytes) summed for attribution.
+                # cost_*/placement_* series are process-cumulative (outside
+                # the counters.reset() scope) — summing them once per query
+                # would multiply them; they land below from live state
                 for k, v in counters.snapshot().items():
-                    if v:
+                    if v and not k.startswith(("cost_", "placement_")):
                         metric_totals[k] = metric_totals.get(k, 0) + v
         elapsed = min(elapsed, time.perf_counter() - t0)
 
@@ -787,8 +842,23 @@ def main() -> None:
     if os.environ.get("BENCH_PROFILE"):
         _save_profiles(tables, ALL_QUERIES)
 
+    # Placement attribution: per-query verdicts from the decision ledger
+    # (which tier each stage chose and why, margins, cached-vs-fresh), the
+    # aggregate prediction-error stats for dispatched stages, and the
+    # calibration terms the capture priced with — bench.py --compare warns
+    # when cost_model_error_ratio drifts >2x between captures. The
+    # placement_* counters report process-lifetime values (like the hbm
+    # gauges), not per-query sums.
+    from daft_tpu.observability.metrics import registry as _registry
+    from daft_tpu.observability.placement import ledger as _ledger
+
+    for k, v in _registry().snapshot().items():
+        if k.startswith("placement_") and v:
+            metric_totals[k] = v
+
+    err = _ledger().error_summary()
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
-    print(json.dumps({
+    out = {
         "metric": f"{SUITE}_sf{SF}_{len(QUERIES)}q_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
@@ -797,10 +867,16 @@ def main() -> None:
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
         "per_query_device": {f"q{q}": q_device[q] for q in QUERIES},
         "host_reasons": {f"q{q}": r for q, r in sorted(q_reject.items())},
+        "placement": {f"q{q}": v for q, v in sorted(q_placement.items()) if v},
+        "calibration": _calibration_dict(),
         "metrics": metric_totals,
         "sf": SF,
         "fact_rows": n_lineitem,
-    }))
+    }
+    if err.get("samples"):
+        out["cost_model_error_ratio"] = err["median"]
+        out["cost_model_error"] = err
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -153,6 +153,23 @@ class DataFrame:
                 json.dump(data, f)
         return report
 
+    def explain_placement(self) -> str:
+        """Execute the plan and report every device-placement decision the
+        cost model made: chosen tier, per-term cost tables for every priced
+        tier (rtt / h2d / compute / d2h / ici / factorize, residency
+        credit), the what-if margin (how close the losing tier was), cache-
+        hit vs fresh verdicts, and — for dispatched device stages — the
+        observed seconds and per-row model error next to the prediction.
+        The raw records also ride QueryEnd.placements (event log schema v9)
+        and the process ledger behind the dashboard's /api/placement."""
+        from ..observability import placement
+        from ..runners import get_or_create_runner
+
+        with placement.query_scope() as scope:
+            for _ in get_or_create_runner().run_iter(self._builder):
+                pass
+        return placement.render(scope.records())
+
     def _next(self, builder: LogicalPlanBuilder) -> "DataFrame":
         return DataFrame(builder)
 

@@ -134,10 +134,19 @@ class DistributedRunner(Runner):
                           ckpt=self._make_checkpointer(phys))
         collector = prev if prev is not None \
             else (StatsCollector() if observed else None)
+        # driver-side placement scope (worker-side decisions stay in each
+        # worker's own process ledger): the driver remainder's device stages
+        # still record, and explain_placement's ambient scope is inherited
+        from ..observability import placement as _placement
+
+        prev_scope = _placement.current_scope()
+        pscope = prev_scope if prev_scope is not None \
+            else (_placement.PlacementScope() if traced else None)
         rows = 0
         err = None
         try:
             set_collector(collector)
+            _placement.set_scope(pscope)
             try:
                 # localize EXECUTES distributed stages eagerly (shuffle + final
                 # task waves run on the pool here, recording into the trace)
@@ -145,14 +154,17 @@ class DistributedRunner(Runner):
                 stream = execute_plan(plan)
             finally:
                 set_collector(prev)
+                _placement.set_scope(prev_scope)
             while True:
                 set_collector(collector)
+                _placement.set_scope(pscope)
                 try:
                     part = next(stream)
                 except StopIteration:
                     break
                 finally:
                     set_collector(prev)
+                    _placement.set_scope(prev_scope)
                 rows += part.num_rows
                 yield part
         except Exception as e:
@@ -160,6 +172,7 @@ class DistributedRunner(Runner):
             raise
         finally:
             set_collector(prev)
+            _placement.set_scope(prev_scope)
             # drain even when untraced so beats from idle periods or untraced
             # queries never pile up and get misattributed to a later query
             beats = pool.drain_heartbeats()
@@ -201,7 +214,8 @@ class DistributedRunner(Runner):
                     notify("on_operator_stats", qid, s)
                 notify("on_query_end", QueryEnd(
                     qid, rows, time.perf_counter() - t_start, err, stats,
-                    metrics=registry().diff(reg_before)))
+                    metrics=registry().diff(reg_before),
+                    placements=pscope.to_dicts() if pscope is not None else []))
 
     def _make_checkpointer(self, phys):
         """Stage-boundary checkpoint/resume, armed ONLY by

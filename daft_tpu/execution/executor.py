@@ -27,7 +27,10 @@ from ..core.recordbatch import RecordBatch
 from ..device.residency import identity_token
 from ..expressions import ColumnRef, Expression
 from ..expressions.eval import eval_expression, eval_projection
+from ..observability import placement as _placement
+from ..ops import costmodel as _costmodel
 from ..plan import physical as pp
+from ..utils.env import env_bool as _env_bool
 
 
 def execute_plan(plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
@@ -559,6 +562,7 @@ def _exec_device_agg(node) -> MicroPartition:
     stream = _exec(node.input)
 
     use_device = cfg.device_mode == "on"
+    prec = None  # placement ledger record for the costed/forced decision
     if cfg.device_mode == "auto":
         first = next(stream, None)
         if first is not None:
@@ -574,8 +578,22 @@ def _exec_device_agg(node) -> MicroPartition:
                         # partition morsels widen the coalesce horizon in
                         # the cost decision (skipped when coalescing is off)
                         second = next(stream, None)
-                    use_device = _device_wins(node, first, grouped,
-                                              second=second)
+                    use_device, prec = _device_wins(node, first, grouped,
+                                                    second=second)
+                else:
+                    # the common dev/CI backend under the default auto mode:
+                    # recorded only into an active query scope, never the
+                    # process ledger (the zero-overhead contract)
+                    _placement.ledger().gate(
+                        "grouped agg" if grouped else "agg", "cpu backend",
+                        first.num_rows, only_scoped=True)
+            else:
+                # the common tiny-host-query bail: recorded only when an
+                # explain_placement()/query scope is actually listening
+                _placement.ledger().gate(
+                    "grouped agg" if grouped else "agg",
+                    "below device_min_rows", first.num_rows,
+                    only_scoped=True)
             stream = itertools.chain(
                 [first] if second is None else [first, second], stream)
 
@@ -596,10 +614,11 @@ def _exec_device_agg(node) -> MicroPartition:
             import jax
 
             if jax.default_backend() not in ("cpu",):
-                mesh_n, stream = _select_mesh_tier(node, stream, grouped, cfg)
+                mesh_n, stream, mrec = _select_mesh_tier(node, stream,
+                                                         grouped, cfg)
                 if mesh_n:
                     return _exec_mesh_stage(node, stream, grouped, mesh_n,
-                                            cfg, _host_agg)
+                                            cfg, _host_agg, prec=mrec)
         return _host_agg(stream)
 
     from ..core.series import Series
@@ -608,9 +627,22 @@ def _exec_device_agg(node) -> MicroPartition:
     in_schema = node.input.schema
     mesh_n = 0
     if cfg.mesh_devices != 1:
-        mesh_n, stream = _select_mesh_tier(node, stream, grouped, cfg)
+        mesh_n, stream, mrec = _select_mesh_tier(node, stream, grouped, cfg)
     if mesh_n:
-        return _exec_mesh_stage(node, stream, grouped, mesh_n, cfg, _host_agg)
+        return _exec_mesh_stage(node, stream, grouped, mesh_n, cfg, _host_agg,
+                                prec=mrec)
+    site = "grouped agg" if grouped else "agg"
+    if prec is None and cfg.device_mode == "on":
+        # forced run: recorded so the ledger attributes the dispatch; priced
+        # too under DAFT_TPU_PLACEMENT_PRICE_FORCED so forced captures yield
+        # predicted-vs-observed calibration samples (the calibrate tool)
+        if _env_bool("DAFT_TPU_PLACEMENT_PRICE_FORCED", False):
+            first = next(stream, None)
+            if first is not None:
+                stream = itertools.chain([first], stream)
+                _w, prec = _device_wins(node, first, grouped, forced=True)
+        if prec is None:
+            prec = _placement.ledger().record(site, "device", forced=True)
     if grouped:
         from ..ops.grouped_stage import DeviceFallback, try_build_grouped_agg_stage
 
@@ -621,16 +653,19 @@ def _exec_device_agg(node) -> MicroPartition:
         coal = _make_coalescer(run.feed_batch, cfg)
         feed = coal.add if coal is not None else run.feed_batch
         buffered: List[MicroPartition] = []
+        fed_rows = 0
         try:
             # pin the query's resident planes so a tight HBM budget cannot
             # evict buffers this run still reads; released at scope exit
-            with _residency().pin_scope():
+            with _placement.feedback(prec) as fb, _residency().pin_scope():
                 for part in stream:
                     buffered.append(part)
+                    fed_rows += part.num_rows
                     for b in part.batches:
                         feed(b)
                 if coal is not None:
                     coal.close()
+                fb.set_rows(fed_rows)
                 key_rows, results = run.finalize()
         except DeviceFallback:
             # runtime shape outside the device kernel envelope (e.g. group count
@@ -647,12 +682,15 @@ def _exec_device_agg(node) -> MicroPartition:
     run = stage.start_run()
     coal = _make_coalescer(run.feed_batch, cfg)
     feed = coal.add if coal is not None else run.feed_batch
-    with _residency().pin_scope():
+    fed_rows = 0
+    with _placement.feedback(prec) as fb, _residency().pin_scope():
         for part in stream:
+            fed_rows += part.num_rows
             for b in part.batches:
                 feed(b)
         if coal is not None:
             coal.close()
+        fb.set_rows(fed_rows)
         final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
@@ -692,12 +730,14 @@ def _exec_device_udf(node) -> Iterator[MicroPartition]:
     if call is None or cfg.device_mode == "off":
         yield from _host(stream)
         return
+    prec = None
     if cfg.device_mode == "auto":
         import jax
 
         if jax.default_backend() in ("cpu",):
             _counters.reject("cost", "device udf: cpu backend")
             _counters.bump("device_udf_fallbacks")
+            _placement.ledger().gate("udf", "cpu backend", only_scoped=True)
             yield from _host(stream)
             return
         first = next(stream, None)
@@ -712,24 +752,44 @@ def _exec_device_udf(node) -> Iterator[MicroPartition]:
               _batch_layout(first))
         wins = _DECISION_CACHE.get(dk)
         if wins is None:
-            wins = _udf_device_wins(call.func, first,
-                                    _coalesce_horizon([first]))
+            wins, prec = _udf_device_wins(call.func, first,
+                                          _coalesce_horizon([first]))
             _DECISION_CACHE.put(dk, wins)
+        else:
+            # accelerator-backend-only path: count the cached verdict
+            prec = _placement.ledger().record(
+                "udf", "device" if wins else "host", first.num_rows,
+                cached=True, detail=call.func.name)
         if not wins:
             _counters.reject("cost", "device udf: host wins cost model")
             _counters.bump("device_udf_fallbacks")
             yield from _host(stream)
             return
-    yield _run_device_udf_stage(node, call, stream, cfg)
+    elif cfg.device_mode == "on":
+        if _env_bool("DAFT_TPU_PLACEMENT_PRICE_FORCED", False):
+            first = next(stream, None)
+            if first is None:
+                yield MicroPartition.empty(node.schema)
+                return
+            stream = itertools.chain([first], stream)
+            _w, prec = _udf_device_wins(call.func, first,
+                                        _coalesce_horizon([first]),
+                                        forced=True)
+        if prec is None:
+            prec = _placement.ledger().record("udf", "device", forced=True,
+                                              detail=call.func.name)
+    yield _run_device_udf_stage(node, call, stream, cfg, prec)
 
 
-def _udf_device_wins(func, first: MicroPartition, coal: float) -> bool:
-    """Cost decision for one device-UDF stage. The flops estimate is coarse
-    (2 x weight scalars per row — a dense forward's order of magnitude); both
-    sides use the same estimate, so the verdict hangs on the measured rates,
-    the per-morsel input upload, and the coalesce-amortized RTT. Weight
-    upload is priced at zero: it is a residency-managed one-time investment
-    (flat across repeats), exactly like resident column planes."""
+def _udf_device_wins(func, first: MicroPartition, coal: float,
+                     forced: bool = False):
+    """Cost decision for one device-UDF stage; returns (wins,
+    placement_record). The flops estimate is coarse (2 x weight scalars per
+    row — a dense forward's order of magnitude); both sides use the same
+    estimate, so the verdict hangs on the measured rates, the per-morsel
+    input upload, and the coalesce-amortized RTT. Weight upload is priced at
+    zero: it is a residency-managed one-time investment (flat across
+    repeats), exactly like resident column planes."""
     from ..ops import costmodel
     from ..ops.udf_stage import func_weight_nbytes
 
@@ -743,10 +803,14 @@ def _udf_device_wins(func, first: MicroPartition, coal: float) -> bool:
     dev = costmodel.device_udf_cost(cal, rows, in_bytes, flops, fetch_bytes,
                                     coalesce=coal)
     host = costmodel.host_udf_cost(cal, flops)
-    return dev < host
+    wins = dev < host
+    rec = _placement.ledger().record(
+        "udf", "device" if (wins or forced) else "host", rows, forced=forced,
+        device=dev, host=host, detail=func.name)
+    return wins, rec
 
 
-def _run_device_udf_stage(node, call, stream, cfg) -> MicroPartition:
+def _run_device_udf_stage(node, call, stream, cfg, prec=None) -> MicroPartition:
     """Drive one DeviceUdfProject on the device tier: coalesced dispatch-only
     feeds under a residency pin scope, one finalize d2h, output assembled as
     passthrough columns + the decoded UDF column. A runtime DeviceFallback
@@ -764,18 +828,21 @@ def _run_device_udf_stage(node, call, stream, cfg) -> MicroPartition:
     out_name = node.udf_expr.name()
     stage = build_device_udf_stage(func, call.args, out_name)
     buffered: List[MicroPartition] = []
+    fed_rows = 0
     try:
-        with _residency().pin_scope():
+        with _placement.feedback(prec) as fb, _residency().pin_scope():
             run = stage.start_run()
             coal = _make_coalescer(run.feed_batch, cfg)
             feed = coal.add if coal is not None else run.feed_batch
             for part in stream:
                 buffered.append(part)
+                fed_rows += part.num_rows
                 for b in part.batches:
                     if b.num_rows:
                         feed(b)
             if coal is not None:
                 coal.close()
+            fb.set_rows(fed_rows)
             out, valid = run.finalize()
     except DeviceFallback as e:
         _counters.bump("device_udf_fallbacks")
@@ -867,8 +934,13 @@ def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
     agg_run = agg_stage.start_run()
     in_stream = _exec(udf_node.input)
     buffered: List[MicroPartition] = []
+    # fusion only engages under device_mode=on: a forced ledger record so the
+    # fused dispatch still lands in placement telemetry
+    prec = _placement.ledger().record("udf+agg fused", "device", forced=True,
+                                      detail=call.func.name)
+    fed_rows = 0
     try:
-        with _residency().pin_scope():
+        with _placement.feedback(prec) as fb, _residency().pin_scope():
             udf_run = udf_stage.start_run()
             feeder = FusedUdfAggFeeder(udf_run, agg_run, udf_plane_names,
                                        other, f32=not agg_stage._use_f64)
@@ -876,11 +948,13 @@ def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
             feed = coal.add if coal is not None else feeder.feed_batch
             for part in in_stream:
                 buffered.append(part)
+                fed_rows += part.num_rows
                 for b in part.batches:
                     if b.num_rows:
                         feed(b)
             if coal is not None:
                 coal.close()
+            fb.set_rows(fed_rows)
             final = agg_run.finalize()
     except DeviceFallback as e:
         _counters.bump("device_udf_fallbacks")
@@ -1019,6 +1093,7 @@ def _run_device_join(node, label: str, make_run, assemble,
 
         if jax.default_backend() in ("cpu",):
             _counters.reject("cost", f"{label}: cpu backend")
+            _placement.ledger().gate(label, "cpu backend", only_scoped=True)
             return _host()
 
     # config/spec-only check BEFORE any subtree executes (the fallback path
@@ -1036,6 +1111,8 @@ def _run_device_join(node, label: str, make_run, assemble,
         if cfg.device_mode == "auto" and first.num_rows < cfg.device_min_rows:
             _counters.reject("cost", f"{label}: below device_min_rows",
                              f"({first.num_rows} rows)")
+            _placement.ledger().gate(label, "below device_min_rows",
+                                     first.num_rows, only_scoped=True)
             raw_stream.close()
             return _host()
         # a previously-rejected query shape skips dim materialization + the
@@ -1050,6 +1127,10 @@ def _run_device_join(node, label: str, make_run, assemble,
                            _batch_layout(first))
         if cfg.device_mode == "auto" and _DECISION_CACHE.get(dk) is False:
             _counters.reject("cost", f"{label}: host wins (cached decision)")
+            # accelerator-backend-only path: safe to count the cached verdict
+            _placement.ledger().record(label, "host", first.num_rows,
+                                       cached=True,
+                                       reason="host wins (cached decision)")
             raw_stream.close()
             return _host()
         second = None
@@ -1071,22 +1152,29 @@ def _run_device_join(node, label: str, make_run, assemble,
         for name, plan in node.dim_plans:
             dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
         ctx = _JoinContext(node.spec, dim_batches)
+        prec = None
         if cfg.device_mode == "auto":
             batch0 = next((b for b in first.batches if b.num_rows > 0), None)
-            wins = batch0 is not None and _join_device_wins(
-                node, ctx, batch0, first.num_rows, grouped, stage,
-                topn=topn, label=label, coalesce=coal)
+            wins = False
+            if batch0 is not None:
+                wins, prec = _join_device_wins(
+                    node, ctx, batch0, first.num_rows, grouped, stage,
+                    topn=topn, label=label, coalesce=coal)
             _DECISION_CACHE.put(dk, wins)
             if not wins:
                 raw_stream.close()
                 return _host()
+        elif cfg.device_mode == "on":
+            prec = _placement.ledger().record(label, "device",
+                                              first.num_rows, forced=True)
         run = make_run(stage, grouped, ctx)
         from ..device.residency import manager as _residency
 
         # pin-scope the feed + finalize: entries this query touches (packed
         # planes, index planes, resident columns) cannot be evicted mid-run
         # by a tight HBM budget; the budget re-enforces at scope exit
-        with _residency().pin_scope():
+        fed_rows = 0
+        with _placement.feedback(prec) as fb, _residency().pin_scope():
             if topn:
                 # the fused TopN program needs ONE fact batch: bail on sighting a
                 # SECOND (before any device work, without draining the stream)
@@ -1097,10 +1185,12 @@ def _run_device_join(node, label: str, make_run, assemble,
                             continue
                         if first_b is not None:
                             _counters.reject("runtime", f"{label}: multi-batch fact")
+                            fb.cancel()  # no dispatch happened: nothing to observe
                             raw_stream.close()
                             return _host()
                         first_b = b
                 if first_b is not None:
+                    fed_rows = first_b.num_rows
                     run.feed_batch(first_b)
             else:
                 # coalesce fact morsels like the agg paths: one gather-join
@@ -1110,10 +1200,12 @@ def _run_device_join(node, label: str, make_run, assemble,
                 coalescer = _make_coalescer(run.feed_batch, cfg)
                 feed = coalescer.add if coalescer is not None else run.feed_batch
                 for part in fact_stream:
+                    fed_rows += part.num_rows
                     for b in part.batches:
                         feed(b)
                 if coalescer is not None:
                     coalescer.close()
+            fb.set_rows(fed_rows)
             return assemble(run, stage, grouped)
     except DeviceFallback as e:
         _counters.reject("runtime", f"{label}: device fallback", str(e))
@@ -1202,8 +1294,11 @@ def _decision_key(node, rows: int, cfg, topn: bool, layout: tuple) -> tuple:
 
 def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
                       topn: bool = False, label: str = "join agg",
-                      coalesce: float = 1.0) -> bool:
+                      coalesce: float = 1.0):
     """Cost-model decision for a DeviceJoinAgg node (see ops/costmodel.py).
+    Returns (wins, placement_record) — both sides' CostBreakdowns land in
+    the ledger so EXPLAIN PLACEMENT can show per-term why a star join
+    cost-rejected to host (the engine's headline loss).
 
     One-time investments (fact column uploads, index planes, joined-key
     factorize) amortize over device_amortize_runs when the fact source is a
@@ -1239,8 +1334,12 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
                  if spec.col_side.get(c) == "fact" and c not in spec.fact_synthetic]
     dim_cols = [c for c in stage._input_cols
                 if spec.col_side.get(c) not in ("fact", None)]
-    nonres = sum(batch.num_rows * 5 for c in fact_cols
-                 if not batch.get_column(c).is_device_resident(bucket, f32=True))
+    nonres = res = 0
+    for c in fact_cols:
+        if batch.get_column(c).is_device_resident(bucket, f32=True):
+            res += batch.num_rows * 5  # residency credit: priced at zero h2d
+        else:
+            nonres += batch.num_rows * 5
     # padded per-dim index planes: residency-aware — a repeat query whose
     # index planes are already in HBM is costed with zero transfer for them
     nonres += ctx.nonresident_index_bytes(batch, bucket)
@@ -1258,13 +1357,18 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         if cap_est > ceiling:
             _counters.reject("cost", f"{label}: est group count over ceiling",
                              f"({card} > {ceiling})")
-            return False
+            _placement.ledger().gate(label, "est group count over ceiling",
+                                     rows)
+            return False, None
         if cap_est > MAX_MATMUL_SEGMENTS and (stage._sct_specs
                                               or stage._use_f64):
             _counters.reject(
                 "cost", f"{label}: high-cardinality stage needs 64-bit "
                 "scatter/f64 (no local-dense program)")
-            return False
+            _placement.ledger().gate(
+                label, "high-cardinality stage needs 64-bit scatter/f64",
+                rows)
+            return False, None
         n_mm = len(stage._mm_specs)
         n_ext = len(stage._ext_specs)
         n_sct = len(stage._sct_specs)
@@ -1276,35 +1380,44 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         nonres += bucket * 4                   # codes plane (host-factorize case)
         dev_cost = costmodel.device_join_agg_cost(
             cal, rows, nonres // amort, n_gathers, n_mm, n_ext, n_sct,
-            cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal)
+            cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal,
+            resident_bytes=res)
         if topn:
             # device multi-key sort over the cap-length planes
             nkeys = len(node.topn.keys) + 2
-            dev_cost += (cap_est * max(math.log2(max(cap_est, 2)), 1.0)
+            dev_cost.add("compute",
+                         cap_est * max(math.log2(max(cap_est, 2)), 1.0)
                          * nkeys / cal.mm_plane_rows_per_s)
         host_cost = costmodel.host_join_agg_cost(
             cal, host_rows, len(spec.dims), len(stage.aggs), True, False)
         if spec.predicate is not None:
-            host_cost += rows / cal.host_agg_rate  # filter pass over the full stream
+            host_cost.add("compute", rows / cal.host_agg_rate)  # filter pass
         if topn:
             # host additionally sorts the aggregate's output rows
-            host_cost += (card * max(math.log2(max(card, 2)), 1.0)
+            host_cost.add("compute", card * max(math.log2(max(card, 2)), 1.0)
                           / cal.host_agg_rate)
+        detail = (f"{len(spec.dims)} dims, {len(stage.aggs)} aggs, "
+                  f"~{card} joined groups")
     else:
         fetch = 256 * max(len(stage.aggs), 1)
         dev_cost = costmodel.device_join_agg_cost(
             cal, rows, nonres // amort, n_gathers, max(len(stage.aggs), 1),
-            0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal)
+            0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal,
+            resident_bytes=res)
         host_cost = costmodel.host_join_agg_cost(
             cal, host_rows, len(spec.dims), len(stage.aggs), False, False)
         if spec.predicate is not None:
-            host_cost += rows / cal.host_agg_rate  # filter pass over the full stream
-    if dev_cost >= host_cost:
+            host_cost.add("compute", rows / cal.host_agg_rate)  # filter pass
+        detail = f"{len(spec.dims)} dims, {len(stage.aggs)} aggs"
+    wins = dev_cost < host_cost
+    if not wins:
         _counters.reject("cost", f"{label}: host wins cost model",
                          f"(host {host_cost*1e3:.0f}ms vs device "
                          f"{dev_cost*1e3:.0f}ms est)")
-        return False
-    return True
+    rec = _placement.ledger().record(
+        label, "device" if wins else "host", rows,
+        device=dev_cost, host=host_cost, detail=detail)
+    return wins, rec
 
 
 def _resident_source_rec(n) -> bool:
@@ -1336,6 +1449,18 @@ def _grouped_output(schema, groupby, aggregations, key_rows, results) -> MicroPa
 _MESH_TIER_CACHE = _BoundedDecisionCache()
 
 
+def _invalidate_costed_verdicts() -> None:
+    """costmodel.reset_calibration() hook: every cached placement verdict was
+    priced under the Calibration being discarded — a recalibrated process
+    (e.g. after exporting the calibrate tool's suggested cost overrides)
+    must re-decide placements, not replay stale ones."""
+    _DECISION_CACHE.clear()
+    _MESH_TIER_CACHE.clear()
+
+
+_costmodel.on_calibration_reset(_invalidate_costed_verdicts)
+
+
 def _select_mesh_tier(node, stream, grouped: bool, cfg):
     """Pick the mesh width for one device agg stage; 0 = single-chip.
 
@@ -1345,8 +1470,8 @@ def _select_mesh_tier(node, stream, grouped: bool, cfg):
     placement, never be config-forced — the first morsel's shape is costed
     (ops/costmodel.py mesh_*_cost) and the mesh tier is taken only when it
     beats BOTH the single-chip device and the host; verdicts are cached per
-    stage shape like the join decision cache. Returns (n_devices, stream)
-    with any peeked partition chained back."""
+    stage shape like the join decision cache. Returns (n_devices, stream,
+    placement_record) with any peeked partition chained back."""
     import jax
 
     from ..ops import counters as _counters
@@ -1354,19 +1479,23 @@ def _select_mesh_tier(node, stream, grouped: bool, cfg):
     ndev = len(jax.devices())
     if cfg.mesh_devices >= 2:
         if ndev >= cfg.mesh_devices:
-            return cfg.mesh_devices, stream
+            rec = _placement.ledger().record("mesh tier", "mesh", forced=True,
+                                             detail=f"{cfg.mesh_devices} devices")
+            return cfg.mesh_devices, stream, rec
         _counters.bump("mesh_unavailable_fallbacks")
         _counters.reject("runtime", "mesh: fewer local devices than mesh_devices",
                          f"({ndev} < {cfg.mesh_devices})")
-        return 0, stream
+        _placement.ledger().gate(
+            "mesh tier", "fewer local devices than mesh_devices")
+        return 0, stream, None
     if ndev < 2:
-        return 0, stream
+        return 0, stream, None
     first = next(stream, None)
     if first is None:
-        return 0, iter(())
+        return 0, iter(()), None
     stream = itertools.chain([first], stream)
     if first.num_rows < cfg.device_min_rows:
-        return 0, stream
+        return 0, stream, None
     from ..ops.stage import pad_bucket
 
     key = (grouped, ndev, pad_bucket(first.num_rows),
@@ -1375,24 +1504,36 @@ def _select_mesh_tier(node, stream, grouped: bool, cfg):
            tuple(repr(g) for g in getattr(node, "groupby", ())),
            tuple(repr(a) for a in node.aggregations))
     wins = _MESH_TIER_CACHE.get(key)
+    rec = None
     if wins is None:
-        wins = _mesh_wins(node, first, grouped, ndev)
+        wins, rec = _mesh_wins(node, first, grouped, ndev)
         _MESH_TIER_CACHE.put(key, wins)
-    return (ndev if wins else 0), stream
+    elif wins:
+        # cached-accept repeat: still a ledger entry so the dispatched run's
+        # observed seconds have a record to land in
+        rec = _placement.ledger().record(
+            "mesh tier", "mesh", first.num_rows, cached=True,
+            detail=f"{ndev} devices")
+    else:
+        _placement.ledger().gate("mesh tier", "no-mesh (cached verdict)",
+                                 first.num_rows, only_scoped=True)
+    return (ndev if wins else 0), stream, rec
 
 
-def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int) -> bool:
+def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int):
     """Cost-model tier decision: mesh vs single-chip vs host for one stage
     shape. Mesh compute divides by the mesh width but pays a multi-device
     dispatch premium and the ICI collective; uploads amortize exactly like
-    the single-chip decision when the source table is resident."""
+    the single-chip decision when the source table is resident. Returns
+    (wins, placement_record) — the record carries all THREE tiers'
+    CostBreakdowns (mesh / device / host)."""
     from ..config import execution_config
     from ..ops import costmodel, counters as _counters
     from ..ops.stage import _decompose_agg, pad_bucket
 
     batch = next((b for b in first.batches if b.num_rows > 0), None)
     if batch is None:
-        return False
+        return False, None
     rows = first.num_rows
     cal = costmodel.calibrate()
     coal = _coalesce_horizon([first])
@@ -1414,7 +1555,7 @@ def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int) -> bool:
         stage = try_build_grouped_agg_stage(
             node.input.schema, node.predicate, node.groupby, node.aggregations)
         if stage is None:
-            return False
+            return False, None
         key_series = resolve_key_series(batch, stage.groupby, batch.num_rows)
         card = max(estimate_key_cardinality(key_series), 1)
         cap_est = _pad_groups(min(card, 2 * MAX_MATMUL_SEGMENTS))
@@ -1463,7 +1604,7 @@ def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int) -> bool:
         stage = try_build_filter_agg_stage(
             node.input.schema, node.predicate, node.aggregations)
         if stage is None:
-            return False
+            return False, None
         n_partials = max(len(stage.aggs), 1)
         nonres_single = sum(
             batch.num_rows * 5 for c in stage._input_cols
@@ -1480,17 +1621,24 @@ def _mesh_wins(node, first: MicroPartition, grouped: bool, ndev: int) -> bool:
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=False,
             has_predicate=node.predicate is not None)
-    if mesh_cost >= single_cost or mesh_cost >= host_cost:
+    wins = mesh_cost < single_cost and mesh_cost < host_cost
+    if not wins:
         _counters.reject(
             "cost", "mesh: single-chip/host wins tier decision",
             f"(mesh {mesh_cost*1e3:.1f}ms vs chip {single_cost*1e3:.1f}ms "
             f"vs host {host_cost*1e3:.1f}ms est)")
-        return False
-    return True
+    # the 3-way record: which tier the cost model ranked first, all three
+    # breakdowns attached so explain_placement can show the full what-if
+    chosen = "mesh" if wins else \
+        ("device" if single_cost <= host_cost else "host")
+    rec = _placement.ledger().record(
+        "mesh tier", chosen, rows, device=single_cost, host=host_cost,
+        mesh=mesh_cost, detail=f"{ndev} devices")
+    return wins, rec
 
 
 def _exec_mesh_stage(node, stream, grouped: bool, n_devices: int, cfg,
-                     host_agg) -> MicroPartition:
+                     host_agg, prec=None) -> MicroPartition:
     """Run a DeviceFilterAgg/DeviceGroupedAgg node sharded across the local
     mesh (ops/mesh_stage.py) — the engine's scale-out execution tier.
 
@@ -1521,14 +1669,17 @@ def _exec_mesh_stage(node, stream, grouped: bool, n_devices: int, cfg,
         coal = _make_coalescer(run.feed_batch, cfg)
         feed = coal.add if coal is not None else run.feed_batch
         buffered: List[MicroPartition] = []
+        fed_rows = 0
         try:
-            with _residency().pin_scope():
+            with _placement.feedback(prec) as fb, _residency().pin_scope():
                 for part in stream:
                     buffered.append(part)
+                    fed_rows += part.num_rows
                     for b in part.batches:
                         feed(b)
                 if coal is not None:
                     coal.close()
+                fb.set_rows(fed_rows)
                 key_rows, results = run.finalize()
         except DeviceFallback:
             return host_agg(itertools.chain(buffered, stream))
@@ -1544,14 +1695,17 @@ def _exec_mesh_stage(node, stream, grouped: bool, n_devices: int, cfg,
     run = stage.start_run()
     coal = _make_coalescer(run.feed_batch, cfg)
     feed = coal.add if coal is not None else run.feed_batch
+    fed_rows = 0
     # no buffering: the ungrouped mesh run has no DeviceFallback site, so the
     # stream flows straight through like the single-chip path
-    with _residency().pin_scope():
+    with _placement.feedback(prec) as fb, _residency().pin_scope():
         for part in stream:
+            fed_rows += part.num_rows
             for b in part.batches:
                 feed(b)
         if coal is not None:
             coal.close()
+        fb.set_rows(fed_rows)
         final = run.finalize()
     cols = []
     for name, _agg in stage.aggs:
@@ -1562,20 +1716,29 @@ def _exec_mesh_stage(node, stream, grouped: bool, n_devices: int, cfg,
 
 
 def _device_wins(node, first: MicroPartition, grouped: bool,
-                 second: Optional[MicroPartition] = None) -> bool:
+                 second: Optional[MicroPartition] = None,
+                 forced: bool = False):
     """Cost-model decision for one device-agg stage based on the first morsel.
+    Returns (wins, placement_record) — the record carries both tiers'
+    CostBreakdowns into the ledger and receives the run's observed timings.
 
     One-time cacheable costs (column upload, key-dictionary builds) amortize
     over cfg.device_amortize_runs when the source is a resident in-memory table
     (they persist on the Series across queries); streaming scans pay in full.
+
+    `forced=True` (device_mode=on with DAFT_TPU_PLACEMENT_PRICE_FORCED) runs
+    the SAME pricing but only to populate the ledger — the verdict is ignored
+    by the caller and the record is marked forced, so the calibrate tool gets
+    predicted-vs-observed samples from forced captures too.
     """
     from ..config import execution_config
     from ..ops import costmodel
     from ..ops.stage import pad_bucket
 
+    site = "grouped agg" if grouped else "agg"
     batch = next((b for b in first.batches if b.num_rows > 0), None)
     if batch is None:
-        return False
+        return False, None
     rows = first.num_rows
     cal = costmodel.calibrate()
     coal = _coalesce_horizon([first] if second is None else [first, second])
@@ -1596,12 +1759,14 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
         stage = try_build_grouped_agg_stage(
             node.input.schema, node.predicate, node.groupby, node.aggregations)
         if stage is None:
-            return False
+            return False, None
         bucket = pad_bucket(batch.num_rows)
-        nonres = sum(
-            batch.num_rows * 5
-            for c in stage._input_cols
-            if not batch.get_column(c).is_device_resident(bucket, f32=True))
+        nonres = res = 0
+        for c in stage._input_cols:
+            if batch.get_column(c).is_device_resident(bucket, f32=True):
+                res += batch.num_rows * 5
+            else:
+                nonres += batch.num_rows * 5
         from ..ops.grouped_stage import (MAX_MATMUL_SEGMENTS, _pad_groups,
                                          estimate_key_cardinality,
                                          resolve_key_series)
@@ -1624,34 +1789,46 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
                         + len(stage._sct_specs))
             dev_cost = costmodel.device_grouped_sort_cost(
                 cal, rows, nonres // amort, n_planes=n_planes,
-                factorize_rows=factorize_cost_rows, coalesce=coal)
+                factorize_rows=factorize_cost_rows, coalesce=coal,
+                resident_bytes=res)
         else:
             dev_cost = costmodel.device_grouped_cost(
                 cal, rows, nonres // amort, n_mm=len(stage._mm_specs),
                 n_ext=len(stage._ext_specs), n_sct=len(stage._sct_specs),
-                cap=cap_est, factorize_rows=factorize_cost_rows, coalesce=coal)
+                cap=cap_est, factorize_rows=factorize_cost_rows, coalesce=coal,
+                resident_bytes=res)
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=True,
             has_predicate=node.predicate is not None)
-        return dev_cost < host_cost
+        detail = (f"{len(node.groupby)} keys, {len(node.aggregations)} aggs, "
+                  f"~{card} groups")
+    else:
+        from ..ops.stage import try_build_filter_agg_stage
 
-    from ..ops.stage import try_build_filter_agg_stage
-
-    stage = try_build_filter_agg_stage(node.input.schema, node.predicate, node.aggregations)
-    if stage is None:
-        return False
-    bucket = pad_bucket(batch.num_rows)
-    nonres = sum(
-        batch.num_rows * 5
-        for c in stage._input_cols
-        if not batch.get_column(c).is_device_resident(bucket, f32=True))
-    dev_cost = costmodel.device_ungrouped_cost(
-        cal, rows, nonres // amort, n_partials=max(len(stage.aggs), 1),
-        coalesce=coal)
-    host_cost = costmodel.host_agg_cost(
-        cal, rows, len(node.aggregations), grouped=False,
-        has_predicate=node.predicate is not None)
-    return dev_cost < host_cost
+        stage = try_build_filter_agg_stage(node.input.schema, node.predicate,
+                                           node.aggregations)
+        if stage is None:
+            return False, None
+        bucket = pad_bucket(batch.num_rows)
+        nonres = res = 0
+        for c in stage._input_cols:
+            if batch.get_column(c).is_device_resident(bucket, f32=True):
+                res += batch.num_rows * 5
+            else:
+                nonres += batch.num_rows * 5
+        dev_cost = costmodel.device_ungrouped_cost(
+            cal, rows, nonres // amort, n_partials=max(len(stage.aggs), 1),
+            coalesce=coal, resident_bytes=res)
+        host_cost = costmodel.host_agg_cost(
+            cal, rows, len(node.aggregations), grouped=False,
+            has_predicate=node.predicate is not None)
+        detail = (f"{len(node.aggregations)} aggs"
+                  + (", filtered" if node.predicate is not None else ""))
+    wins = dev_cost < host_cost
+    rec = _placement.ledger().record(
+        site, "device" if (wins or forced) else "host", rows, forced=forced,
+        device=dev_cost, host=host_cost, detail=detail)
+    return wins, rec
 
 
 def _coalesce_horizon(parts) -> float:

@@ -143,12 +143,18 @@ def spawn_stage(gen: Iterator, maxsize: int = 4, node=None) -> Iterator:
     producer threads — the channel's cancel flag is only ever set by the
     consumer iterator, which would otherwise never run."""
     from ..device.residency import current_pin_observation, set_pin_observation
+    from ..observability.placement import current_scope as _cur_pscope
+    from ..observability.placement import set_scope as _set_pscope
     from ..observability.runtime_stats import current_collector, set_collector
 
     collector = current_collector()
     # serving admission calibration: device pin scopes open on THIS stage
     # thread, so the observing query's handle rides along like the collector
     pin_obs = current_pin_observation()
+    # placement decisions fire on stage threads too: the query's placement
+    # scope (explain_placement / per-query QueryEnd records) rides along so
+    # concurrent queries' decisions never bleed into each other's scopes
+    pscope = _cur_pscope()
     profile = (collector, collector.node_id(node)) \
         if collector is not None and node is not None else None
     ch = Channel(maxsize, profile=profile)
@@ -156,6 +162,7 @@ def spawn_stage(gen: Iterator, maxsize: int = 4, node=None) -> Iterator:
     def run():
         set_collector(collector)
         set_pin_observation(pin_obs)
+        _set_pscope(pscope)
         err: Optional[BaseException] = None
         try:
             for item in gen:

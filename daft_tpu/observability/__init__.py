@@ -23,6 +23,8 @@ from .subscribers import (
     notify,
     subscribers_active,
 )
+from .placement import (PlacementLedger, PlacementRecord, PlacementScope,
+                        ledger as placement_ledger, query_scope)
 from .runtime_stats import (SpanRecorder, StatsCollector, current_collector,
                             current_spans, profile_span, set_spans)
 
@@ -50,6 +52,11 @@ __all__ = [
     "current_spans",
     "profile_span",
     "set_spans",
+    "PlacementLedger",
+    "PlacementRecord",
+    "PlacementScope",
+    "placement_ledger",
+    "query_scope",
 ]
 
 # OTLP trace export opt-in via environment (DAFT_TPU_OTLP_ENDPOINT)

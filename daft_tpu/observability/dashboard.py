@@ -384,6 +384,22 @@ class _Handler(BaseHTTPRequestHandler):
             # admission waits, p50/p99) — the hit-rate table's data source
             body = json.dumps(self.server.state.serving(), default=str).encode()
             ctype = "application/json"
+        elif self.path.startswith("/api/placement"):
+            # the cost-model decision ledger: recent placement records
+            # (chosen tier, per-term breakdowns, observed-vs-predicted),
+            # ledger stats, the aggregate model-error summary, and the
+            # effective calibration terms the process is pricing with
+            from ..ops.costmodel import calibration_dict
+            from .placement import ledger
+
+            led = ledger()
+            body = json.dumps({
+                "records": led.snapshot(limit=128),
+                "stats": led.stats(),
+                "error": led.error_summary(),
+                "calibration": calibration_dict(),
+            }, default=str).encode()
+            ctype = "application/json"
         elif self.path == "/" or self.path.startswith("/index"):
             body = _HTML.encode()
             ctype = "text/html"

@@ -36,7 +36,12 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # may now carry the recovery counters (worker_failures_total,
 # tasks_requeued_total, shuffle_maps_regenerated_total, worker_respawns_total,
 # fetch_retries_total, checkpoint_stages_committed/skipped).
-SCHEMA_VERSION = 8
+# v9: query_end gains placements — the query's placement-decision records
+# (site, chosen tier, per-term cost breakdowns for every priced tier,
+# cached/forced flags, margin, and observed-vs-predicted device seconds for
+# dispatched stages; observability/placement.py); query_end.metrics may carry
+# the placement_* counters and the cost_* calibration/error gauges.
+SCHEMA_VERSION = 9
 
 
 class EventLogSubscriber(Subscriber):

@@ -136,6 +136,12 @@ class QueryEnd:
     # per-query metrics-registry counter deltas (device batches, shuffle
     # bytes, rejections dropped, ...) — see observability/metrics.py
     metrics: Dict[str, float] = field(default_factory=dict)
+    # per-query placement-decision records (observability/placement.py
+    # PlacementRecord.to_dict(): site, chosen tier, cached/forced flags, both
+    # sides' cost-term breakdowns, margin, observed device seconds +
+    # error_ratio for dispatched stages) — empty when the query made no
+    # device placement decision
+    placements: List[dict] = field(default_factory=list)
 
 
 @dataclass(frozen=True)

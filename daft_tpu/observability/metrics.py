@@ -211,6 +211,22 @@ FAULT_COUNTER_NAMES = (
 # so a broken subscriber can't fail a query — counted so it isn't invisible).
 OBS_COUNTER_NAMES = ("subscriber_errors",)
 
+# Placement observability (observability/placement.py): the cost-model
+# decision ledger. Counters move ONLY on costed/forced placement decisions —
+# pre-cost gate rejections (cpu backend, below device_min_rows) are ledger
+# records without registry writes, preserving the unobserved-path
+# empty-registry-diff guarantee.
+PLACEMENT_COUNTER_NAMES = (
+    "placement_decisions_total",   # costed auto-tier placement decisions
+    "placement_device_wins",       # decisions that chose the single-chip device
+    "placement_host_wins",         # decisions that kept the stage on host
+    "placement_mesh_wins",         # decisions that took the mesh tier
+    "placement_cached_verdicts",   # verdicts served from the bounded caches
+    "placement_forced_runs",       # device_mode=on runs recorded uncosted
+    "placement_feedback_total",    # dispatched stages reporting actual seconds
+    "placement_records_dropped",   # ledger appends evicted at the bounded cap
+)
+
 # Host memory manager spill (daft_tpu/memory/ documents the semantics;
 # execution/memory.py is the compatibility view).
 SPILL_COUNTER_NAMES = (
@@ -239,7 +255,7 @@ MEMORY_COUNTER_NAMES = (
 DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
                      SHUFFLE_COUNTER_NAMES + FAULT_COUNTER_NAMES +
                      SPILL_COUNTER_NAMES + MEMORY_COUNTER_NAMES +
-                     OBS_COUNTER_NAMES)
+                     OBS_COUNTER_NAMES + PLACEMENT_COUNTER_NAMES)
 
 DECLARED_GAUGES = (
     "serve_queue_depth",       # admission queue depth (serving/session.py)
@@ -251,6 +267,16 @@ DECLARED_GAUGES = (
     "shuffle_fetch_inflight",  # high-water concurrent fetch requests
     "mesh_devices_used",       # devices of the last mesh dispatch
     "bucket_fill_ratio",       # coalescer padding efficiency (per run)
+    # cost-model observability (ops/costmodel.py + observability/placement.py)
+    "cost_model_error_ratio",  # last dispatched stage: observed/predicted s/row
+    # the effective Calibration terms, exported at calibrate() so every
+    # scrape and bench capture states the calibration the process ran under
+    "cost_rtt_s",
+    "cost_h2d_bytes_per_s",
+    "cost_d2h_bytes_per_s",
+    "cost_ici_bytes_per_s",
+    "cost_mesh_dispatch_s",
+    "cost_udf_flops_per_s",
 )
 
 

@@ -26,17 +26,120 @@ matmul segment-reduction streams ~5e9 plane-rows/s, scatter segment ops
 ~1e8 rows/s (TPU scatter serializes — why the grouped stage avoids it), host
 numpy aggregation ~1.5e8 value-ops/s, host key factorization ~8e6 rows/s.
 The decision only needs to be right within ~2x; both paths are correct.
+
+Every ``*_cost`` function returns a :class:`CostBreakdown` — the total plus
+its NAMED terms (rtt, h2d, compute, d2h, ici, factorize, probe, ...) — so the
+placement ledger (observability/placement.py), ``explain_placement()``, and
+the ``daft_tpu.tools.calibrate`` report can say WHICH term kept a stage on
+host and how wrong each term's prediction was versus the dispatch the stage
+actually timed. CostBreakdown compares and formats like the float total it
+wraps, so decision call sites (``dev_cost < host_cost``) are unchanged.
 """
 
 from __future__ import annotations
 
-import os
+import threading
 import time
-from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Dict, List, Optional
 
+from dataclasses import dataclass, fields as _dc_fields
 
 from ..utils.env import env_float as _env_f
+
+
+class CostBreakdown:
+    """One tier's predicted cost: total seconds plus the named terms it sums.
+
+    Behaves like the float total for comparison/ordering/formatting so the
+    executor's decision sites keep reading ``dev < host``; the terms ride
+    along for the placement ledger and the calibration report. ``notes``
+    carries informational values that are NOT part of the total (the coalesce
+    horizon used, the residency credit — bytes priced at zero because they
+    were already resident in HBM).
+    """
+
+    __slots__ = ("terms", "notes")
+
+    def __init__(self, _notes: Optional[Dict[str, float]] = None, **terms):
+        self.terms: Dict[str, float] = {k: float(v) for k, v in terms.items()
+                                        if v}
+        self.notes: Dict[str, float] = dict(_notes) if _notes else {}
+
+    @property
+    def total(self) -> float:
+        return sum(self.terms.values())
+
+    def add(self, term: str, seconds: float) -> "CostBreakdown":
+        """Fold extra seconds into a named term (in place); returns self so
+        call sites can chain."""
+        if seconds:
+            self.terms[term] = self.terms.get(term, 0.0) + float(seconds)
+        return self
+
+    def note(self, key: str, value: float) -> "CostBreakdown":
+        self.notes[key] = float(value)
+        return self
+
+    def as_dict(self) -> Dict[str, float]:
+        """{"total": s, <term>: s, ...} (+ "note_<k>" informational values) —
+        the picklable/JSON shape the placement ledger stores."""
+        out: Dict[str, float] = {"total": self.total}
+        out.update(self.terms)
+        for k, v in self.notes.items():
+            out[f"note_{k}"] = v
+        return out
+
+    # ---- float-compatible surface (decision call sites) ----------------------------
+    @staticmethod
+    def _tot(other) -> float:
+        return other.total if isinstance(other, CostBreakdown) else float(other)
+
+    def __float__(self) -> float:
+        return self.total
+
+    def __lt__(self, other) -> bool:
+        return self.total < self._tot(other)
+
+    def __le__(self, other) -> bool:
+        return self.total <= self._tot(other)
+
+    def __gt__(self, other) -> bool:
+        return self.total > self._tot(other)
+
+    def __ge__(self, other) -> bool:
+        return self.total >= self._tot(other)
+
+    def __eq__(self, other) -> bool:
+        return self.total == self._tot(other)
+
+    def __ne__(self, other) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self):  # totals are the identity, like the float they replace
+        return hash(self.total)
+
+    def __add__(self, other) -> "CostBreakdown":
+        out = CostBreakdown(_notes=self.notes, **self.terms)
+        if isinstance(other, CostBreakdown):
+            for k, v in other.terms.items():
+                out.add(k, v)
+            out.notes.update(other.notes)
+        else:
+            out.add("extra", float(other))
+        return out
+
+    __radd__ = __add__
+
+    def __mul__(self, k) -> float:
+        # display sites do `cost * 1e3` for milliseconds — a plain float
+        return self.total * float(k)
+
+    __rmul__ = __mul__
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v * 1e3:.3f}ms"
+                          for k, v in sorted(self.terms.items()))
+        return f"CostBreakdown(total={self.total * 1e3:.3f}ms, {inner})"
 
 
 @dataclass(frozen=True)
@@ -66,6 +169,51 @@ class Calibration:
 
 _CAL: Optional[Calibration] = None
 
+# Recalibration must invalidate every cached placement verdict priced under
+# the OLD calibration (the executor's decision/mesh-tier caches) — otherwise
+# a process that recalibrates keeps routing repeat shapes on stale terms.
+# The executor registers its cache-clearing hook here at import; the list is
+# module-level mutable state shared by serving threads, hence the lock.
+_RESET_HOOKS: List[Callable[[], None]] = []
+_HOOK_LOCK = threading.Lock()
+
+# The calibration terms exported as gauges (observability/metrics.py declares
+# them) so /metrics, QueryEnd.metrics, and every bench JSON state the
+# calibration the process actually ran under.
+_CAL_GAUGES = (
+    ("cost_rtt_s", "rtt_s"),
+    ("cost_h2d_bytes_per_s", "h2d_bytes_per_s"),
+    ("cost_d2h_bytes_per_s", "d2h_bytes_per_s"),
+    ("cost_ici_bytes_per_s", "ici_bytes_per_s"),
+    ("cost_mesh_dispatch_s", "mesh_dispatch_s"),
+    ("cost_udf_flops_per_s", "udf_device_flops_per_s"),
+)
+
+
+def on_calibration_reset(hook: Callable[[], None]) -> None:
+    """Register a hook fired by reset_calibration() — used by the executor to
+    invalidate its cached placement verdicts (decision + mesh-tier caches),
+    which were priced under the Calibration being discarded."""
+    with _HOOK_LOCK:
+        _RESET_HOOKS.append(hook)
+
+
+def current_calibration() -> Optional[Calibration]:
+    """The completed calibration, or None — NEVER triggers a live probe
+    (reporting surfaces must not pay two round trips on a scrape)."""
+    return _CAL
+
+
+def calibration_dict() -> Dict[str, float]:
+    """The effective calibration terms as a flat dict ({} when the process
+    never calibrated) — recorded into every bench JSON and served by the
+    dashboard's /api/placement so each capture states the terms it ran
+    under."""
+    cal = _CAL
+    if cal is None:
+        return {}
+    return {f.name: getattr(cal, f.name) for f in _dc_fields(cal)}
+
 
 def calibrate() -> Calibration:
     """Measure link costs once per process (lazily, on first auto decision).
@@ -86,7 +234,7 @@ def calibrate() -> Calibration:
 
         from ..utils import jax_setup  # noqa: F401
         import jax
-        import jax.numpy as jnp
+        import jax.numpy as jnp  # noqa: F401
 
         probe = jax.jit(lambda a: a.sum())
         x = jax.device_put(np.ones(64, np.float32))
@@ -113,10 +261,13 @@ def calibrate() -> Calibration:
             ident = jax.jit(lambda a: a * 1)
             big = jax.device_put(np.ones(256 * 1024, np.float32))  # 1 MB down
             jax.device_get(ident(big))  # compile
-            t0 = time.perf_counter()
-            jax.device_get(ident(big))
-            dt = max(time.perf_counter() - t0 - rtt, 1e-3)
-            d2h = big.nbytes / dt
+            best = 0.0
+            for _ in range(2):  # best-of-2: tunnel jitter biases single samples low
+                t0 = time.perf_counter()
+                jax.device_get(ident(big))
+                dt = max(time.perf_counter() - t0 - rtt, 1e-3)
+                best = max(best, big.nbytes / dt)
+            d2h = best
 
     _CAL = Calibration(
         rtt_s=rtt,
@@ -137,12 +288,37 @@ def calibrate() -> Calibration:
         udf_device_flops_per_s=_env_f("DAFT_TPU_COST_UDF_FLOPS", 2e11),
         udf_host_flops_per_s=_env_f("DAFT_TPU_COST_UDF_HOST_FLOPS", 5e9),
     )
+    _export_calibration_gauges(_CAL)
     return _CAL
 
 
+def _export_calibration_gauges(cal: Calibration) -> None:
+    """Publish the effective terms as gauges so every scrape/bench capture
+    states the calibration it ran under (satellite: cost_rtt_s & co)."""
+    from ..observability.metrics import registry
+
+    reg = registry()
+    for gauge, attr in _CAL_GAUGES:
+        reg.set_gauge(gauge, getattr(cal, attr))
+
+
 def reset_calibration() -> None:
+    """Drop the measured calibration AND invalidate every cached placement
+    verdict priced under it (executor decision/mesh-tier caches via the
+    registered hooks) — a recalibrated process must re-decide placements,
+    not replay stale ones. Calibration gauges zero until the next
+    calibrate()."""
     global _CAL
     _CAL = None
+    from ..observability.metrics import registry
+
+    reg = registry()
+    for gauge, _attr in _CAL_GAUGES:
+        reg.set_gauge(gauge, 0.0)
+    with _HOOK_LOCK:
+        hooks = list(_RESET_HOOKS)
+    for hook in hooks:
+        hook()
 
 
 # Default link rates for ADVISORY estimates that must never trigger a live
@@ -193,61 +369,82 @@ def expected_coalesce_factor(first_rows: int, target_rows: int) -> float:
     return float(min(max(target_rows / first_rows, 1.0), _COALESCE_CAP))
 
 
+def _base_terms(cal: Calibration, nonresident_bytes: int, coalesce: float,
+                resident_bytes: int = 0) -> CostBreakdown:
+    """The terms every device tier pays: the coalesce-amortized dispatch round
+    trip + non-resident uploads. `resident_bytes` records the residency
+    CREDIT as a note — bytes priced at zero because a prior run left them in
+    HBM — so the breakdown can show why a repeat query got cheaper."""
+    c = max(coalesce, 1.0)
+    out = CostBreakdown(rtt=cal.rtt_s / c,
+                        h2d=nonresident_bytes / cal.h2d_bytes_per_s)
+    if c > 1.0:
+        out.note("coalesce", c)
+    if resident_bytes:
+        out.note("residency_credit_s", resident_bytes / cal.h2d_bytes_per_s)
+    return out
+
+
 def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                         n_mm: int, n_ext: int, n_sct: int, cap: int,
-                        factorize_rows: int, coalesce: float = 1.0) -> float:
+                        factorize_rows: int, coalesce: float = 1.0,
+                        resident_bytes: int = 0) -> CostBreakdown:
     cap = max(cap, 8)
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + nonresident_bytes / cal.h2d_bytes_per_s
-            # one-hot matmul work scales with rows x segments x planes
-            + rows * cap * n_mm / cal.mm_cell_rate
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    # one-hot matmul work scales with rows x segments x planes
+    out.add("compute", rows * cap * n_mm / cal.mm_cell_rate
             + rows * cap * n_ext / cal.ext_cell_rate
-            + n_sct * rows / cal.scatter_rows_per_s
-            + factorize_rows / cal.host_factorize_rate)
+            + n_sct * rows / cal.scatter_rows_per_s)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    return out
 
 
 def device_grouped_sort_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                              n_planes: int, factorize_rows: int,
-                             coalesce: float = 1.0) -> float:
+                             coalesce: float = 1.0,
+                             resident_bytes: int = 0) -> CostBreakdown:
     """High-cardinality path (grouped_stage._build_sorted): argsort + one
     segmented scan per plane — O(n log n) sort plus O(n) per plane, no
     one-hot cells."""
     import math
 
     logn = max(math.log2(max(rows, 2)), 1.0)
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + nonresident_bytes / cal.h2d_bytes_per_s
-            + rows * logn / cal.mm_plane_rows_per_s      # bitonic sort passes
-            + rows * max(n_planes, 1) / cal.mm_plane_rows_per_s
-            + factorize_rows / cal.host_factorize_rate)
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("compute", rows * logn / cal.mm_plane_rows_per_s      # bitonic sort passes
+            + rows * max(n_planes, 1) / cal.mm_plane_rows_per_s)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    return out
 
 
 def device_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
-                          n_partials: int, coalesce: float = 1.0) -> float:
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + nonresident_bytes / cal.h2d_bytes_per_s
-            + rows * n_partials / cal.mm_plane_rows_per_s)
+                          n_partials: int, coalesce: float = 1.0,
+                          resident_bytes: int = 0) -> CostBreakdown:
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("compute", rows * n_partials / cal.mm_plane_rows_per_s)
+    return out
 
 
 def mesh_ungrouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                         n_partials: int, n_devices: int,
-                        coalesce: float = 1.0) -> float:
+                        coalesce: float = 1.0,
+                        resident_bytes: int = 0) -> CostBreakdown:
     """One mesh filter+ungrouped-agg dispatch: the per-shard reduce runs on
     rows/N, the combine is one psum of n_partials scalars over ICI, and the
     dispatch pays the multi-device launch premium on top of the (coalesce-
     amortized) round trip. Upload bytes are the same as single-chip — shards
     split the data, they don't duplicate it."""
     n = max(n_devices, 1)
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + cal.mesh_dispatch_s
-            + nonresident_bytes / cal.h2d_bytes_per_s
-            + rows * max(n_partials, 1) / (cal.mm_plane_rows_per_s * n)
-            + max(n_partials, 1) * 8 * n / cal.ici_bytes_per_s)
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("mesh_dispatch", cal.mesh_dispatch_s)
+    out.add("compute", rows * max(n_partials, 1) / (cal.mm_plane_rows_per_s * n))
+    out.add("ici", max(n_partials, 1) * 8 * n / cal.ici_bytes_per_s)
+    return out
 
 
 def mesh_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                       n_cols: int, cap: int, n_devices: int,
-                      factorize_rows: int, coalesce: float = 1.0) -> float:
+                      factorize_rows: int, coalesce: float = 1.0,
+                      resident_bytes: int = 0) -> CostBreakdown:
     """One mesh exact-groupby dispatch (parallel/distributed.py
     sharded_groupby_step): per shard an O(s log s) sort/unique over s = rows/N
     plus one segmented reduce per value plane, then an all_gather table merge
@@ -259,20 +456,21 @@ def mesh_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
     shard = max(rows // n, 1)
     logn = max(math.log2(max(shard, 2)), 1.0)
     cap = max(cap, 16)
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + cal.mesh_dispatch_s
-            + nonresident_bytes / cal.h2d_bytes_per_s
-            + shard * logn / cal.mm_plane_rows_per_s
-            + shard * max(n_cols, 1) / cal.mm_plane_rows_per_s
-            + cap * (max(n_cols, 1) + 1) * 8 * n / cal.ici_bytes_per_s
-            + factorize_rows / cal.host_factorize_rate)
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("mesh_dispatch", cal.mesh_dispatch_s)
+    out.add("compute", shard * logn / cal.mm_plane_rows_per_s
+            + shard * max(n_cols, 1) / cal.mm_plane_rows_per_s)
+    out.add("ici", cap * (max(n_cols, 1) + 1) * 8 * n / cal.ici_bytes_per_s)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    return out
 
 
 def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
                          n_gathers: int, n_mm: int, n_ext: int, n_sct: int,
                          cap_est: int, fetch_bytes: int,
                          factorize_rows: int, matmul_ceiling: int = 4096,
-                         coalesce: float = 1.0) -> float:
+                         coalesce: float = 1.0,
+                         resident_bytes: int = 0) -> CostBreakdown:
     """One gather-join + aggregate device run: fixed round trip (amortized
     over the expected coalesce horizon) + amortized uploads + per-dim gathers
     + the segment reduction (matmul cells below the ceiling, sort passes
@@ -280,55 +478,55 @@ def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
     indices / joined-key codes)."""
     import math
 
-    c = (cal.rtt_s / max(coalesce, 1.0)
-         + upload_bytes / cal.h2d_bytes_per_s
-         + n_gathers * rows / cal.mm_plane_rows_per_s
-         + factorize_rows / cal.host_factorize_rate
-         + fetch_bytes / cal.d2h_bytes_per_s)
+    out = _base_terms(cal, upload_bytes, coalesce, resident_bytes)
+    out.add("compute", n_gathers * rows / cal.mm_plane_rows_per_s)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
     cap_est = max(cap_est, 8)
     if cap_est <= matmul_ceiling:
-        c += (rows * cap_est * n_mm / cal.mm_cell_rate
-              + rows * cap_est * n_ext / cal.ext_cell_rate
-              + n_sct * rows / cal.scatter_rows_per_s)
+        out.add("compute", rows * cap_est * n_mm / cal.mm_cell_rate
+                + rows * cap_est * n_ext / cal.ext_cell_rate
+                + n_sct * rows / cal.scatter_rows_per_s)
     else:
         logn = max(math.log2(max(rows, 2)), 1.0)
-        c += (rows * logn / cal.mm_plane_rows_per_s
-              + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
-    return c
+        out.add("compute", rows * logn / cal.mm_plane_rows_per_s
+                + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
+    return out
 
 
 def device_udf_cost(cal: Calibration, rows: int, h2d_bytes: int, flops: float,
-                    fetch_bytes: int, coalesce: float = 1.0) -> float:
+                    fetch_bytes: int, coalesce: float = 1.0) -> CostBreakdown:
     """One device-UDF stage run: the (coalesce-amortized) dispatch round trip
     + per-morsel input uploads (token ids / masks — derived arrays, never
     resident) + the model forward at the device flop rate + the finalize
     fetch of the output rows. Weight uploads are absent on purpose: they are
     residency-managed one-time investments (flat across repeat queries), so
     pricing them per run would mis-reject every warm repeat."""
-    return (cal.rtt_s / max(coalesce, 1.0)
-            + h2d_bytes / cal.h2d_bytes_per_s
-            + flops / cal.udf_device_flops_per_s
-            + fetch_bytes / cal.d2h_bytes_per_s)
+    out = _base_terms(cal, h2d_bytes, coalesce)
+    out.add("compute", flops / cal.udf_device_flops_per_s)
+    out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
+    return out
 
 
-def host_udf_cost(cal: Calibration, flops: float) -> float:
+def host_udf_cost(cal: Calibration, flops: float) -> CostBreakdown:
     """The same model forward on the host path (today's plain batch UDF)."""
-    return flops / cal.udf_host_flops_per_s
+    return CostBreakdown(compute=flops / cal.udf_host_flops_per_s)
 
 
 def host_join_agg_cost(cal: Calibration, rows: int, n_dims: int, n_aggs: int,
-                       grouped: bool, has_predicate: bool) -> float:
+                       grouped: bool, has_predicate: bool) -> CostBreakdown:
     """Host execution of the same star query: probe-table passes over the fact
     stream (one per dim) + the aggregation."""
-    return (rows * max(n_dims, 1) / cal.host_probe_rate
-            + host_agg_cost(cal, rows, n_aggs, grouped, has_predicate))
+    out = host_agg_cost(cal, rows, n_aggs, grouped, has_predicate)
+    out.add("probe", rows * max(n_dims, 1) / cal.host_probe_rate)
+    return out
 
 
 def host_agg_cost(cal: Calibration, rows: int, n_aggs: int, grouped: bool,
-                  has_predicate: bool) -> float:
-    c = rows * max(n_aggs, 1) / cal.host_agg_rate
+                  has_predicate: bool) -> CostBreakdown:
+    out = CostBreakdown(compute=rows * max(n_aggs, 1) / cal.host_agg_rate)
     if has_predicate:
-        c += rows / cal.host_agg_rate
+        out.add("compute", rows / cal.host_agg_rate)
     if grouped:
-        c += rows / cal.host_factorize_rate
-    return c
+        out.add("factorize", rows / cal.host_factorize_rate)
+    return out

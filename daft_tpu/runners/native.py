@@ -48,6 +48,7 @@ class NativeRunner(Runner):
             notify("on_query_optimized", QueryOptimized(
                 qid, optimized.plan.display(), phys.display(),
                 time.perf_counter() - t0))
+        from ..observability import placement
         from ..observability.runtime_stats import current_collector
 
         # inherit any ambient collector (explain_analyze routes through the
@@ -57,22 +58,33 @@ class NativeRunner(Runner):
         prev = current_collector()
         collector = prev if prev is not None \
             else (StatsCollector() if observed else None)
+        # placement scope, same inheritance/save-restore discipline: an
+        # ambient scope (explain_placement) wins; otherwise an observed query
+        # gets its own so QueryEnd carries the decisions; unobserved queries
+        # run scope-less (the zero-overhead path)
+        prev_scope = placement.current_scope()
+        pscope = prev_scope if prev_scope is not None \
+            else (placement.PlacementScope() if observed else None)
         rows = 0
         err: str = None
         try:
             set_collector(collector)
+            placement.set_scope(pscope)
             try:
                 stream = execute_plan(phys)
             finally:
                 set_collector(prev)
+                placement.set_scope(prev_scope)
             while True:
                 set_collector(collector)
+                placement.set_scope(pscope)
                 try:
                     part = next(stream)
                 except StopIteration:
                     break
                 finally:
                     set_collector(prev)
+                    placement.set_scope(prev_scope)
                 rows += part.num_rows
                 yield part
         except Exception as e:
@@ -80,6 +92,7 @@ class NativeRunner(Runner):
             raise
         finally:
             set_collector(prev)
+            placement.set_scope(prev_scope)
             if observed:
                 from ..observability.metrics import registry
 
@@ -88,4 +101,5 @@ class NativeRunner(Runner):
                     notify("on_operator_stats", qid, s)
                 notify("on_query_end", QueryEnd(
                     qid, rows, time.perf_counter() - t_start, err, stats,
-                    metrics=registry().diff(reg_before)))
+                    metrics=registry().diff(reg_before),
+                    placements=pscope.to_dicts() if pscope is not None else []))

@@ -225,6 +225,7 @@ class ServingSession:
             self._execute(ticket)
 
     def _execute(self, ticket: _Ticket) -> None:
+        from ..observability.placement import query_scope as _placement_scope
         from ..observability.runtime_stats import span_scope
 
         fut = ticket.future
@@ -260,8 +261,11 @@ class ServingSession:
                 wait_s = time.perf_counter() - t_adm
                 t_exec = time.perf_counter()
                 # span isolation: this thread's device spans stay out of any
-                # globally-installed profiler recorder (cross-query bleed)
-                with span_scope(None):
+                # globally-installed profiler recorder (cross-query bleed);
+                # the placement scope isolates this query's decision records
+                # the same way — concurrent tenants' placements never mix
+                with span_scope(None), \
+                        _placement_scope(tag=fut.query_id):
                     if self._runner is None:
                         from ..execution.executor import execute_plan
 

@@ -16,6 +16,21 @@ def sql(query: str, **bindings):
         from .planner import plan_sql
     except ImportError as e:
         raise NotImplementedError("SQL planner not built yet (see SQL milestone)") from e
+    # EXPLAIN PLACEMENT <select>: run the inner query and return the
+    # placement-decision report (DataFrame.explain_placement) as a one-row
+    # frame — the SQL face of the cost-model decision ledger
+    stripped = query.lstrip()
+    head = stripped[:30].upper().split()
+    if head[:2] == ["EXPLAIN", "PLACEMENT"]:
+        import daft_tpu
+
+        parts = stripped.split(None, 2)
+        if len(parts) < 3:
+            raise ValueError(
+                "EXPLAIN PLACEMENT requires a query to explain: "
+                "EXPLAIN PLACEMENT SELECT ...")
+        report = plan_sql(parts[2], bindings).explain_placement()
+        return daft_tpu.from_pydict({"explain": report.split("\n")})
     return plan_sql(query, bindings)
 
 
