@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-gate bench-compare calibrate-report
+.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-tpcds bench-gate bench-compare calibrate-report
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -53,7 +53,8 @@ bench-ai:
 # residency, cost-tier flips.
 test-mesh:
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-		$(PY) -m pytest tests/test_mesh_stage.py tests/test_distributed.py \
+		$(PY) -m pytest tests/test_mesh_stage.py tests/test_mesh_join.py \
+		tests/test_distributed.py \
 		-q -p no:cacheprovider
 
 # CPU-CI mesh capture: a TPC-H-shaped groupby sharded across 8 simulated
@@ -86,6 +87,13 @@ test-oom:
 # the JSON. SF100-capable: BENCH_SF=100 make bench-oom on a big box.
 bench-oom:
 	env BENCH_OOM=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# TPC-DS store-sales capture (the star-join-heavy suite the mesh join tier
+# targets): same one-JSON-line contract; pair with BENCH_MESH-style env on
+# real silicon to record which join queries flip (bench.py --compare shows
+# the per-query placement-flip column against a prior capture).
+bench-tpcds:
+	env BENCH_SUITE=tpcds $(PY) bench.py
 
 bench:
 	$(PY) bench.py

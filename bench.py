@@ -118,6 +118,13 @@ def _placement_brief(placements: list) -> list:
             v = p.get(k)
             if v:
                 rec[k] = v
+        # which tiers were PRICED, with their totals — a join verdict must
+        # show the mesh arm present (ms), not silently absent
+        tiers = {t: round(p[t]["total"] * 1e3, 3)
+                 for t in ("device", "host", "mesh")
+                 if isinstance(p.get(t), dict) and "total" in p[t]}
+        if tiers:
+            rec["cost_ms"] = tiers
         out.append(rec)
     return out
 
@@ -129,9 +136,10 @@ def _derive_mesh_ratio(metric_totals: dict) -> None:
     mesh_disp = metric_totals.get("mesh_dispatches", 0)
     single_disp = (metric_totals.get("device_grouped_batches", 0)
                    + metric_totals.get("device_stage_batches", 0))
-    if mesh_disp or single_disp:
-        metric_totals["mesh_dispatch_ratio"] = round(
-            mesh_disp / max(mesh_disp + single_disp, 1), 4)
+    # recorded explicitly even at 0.0: a host-only capture states "the mesh
+    # tier did not engage" instead of omitting the field
+    metric_totals["mesh_dispatch_ratio"] = round(
+        mesh_disp / max(mesh_disp + single_disp, 1), 4)
 
 
 def _derive_shuffle_ratios(metric_totals: dict) -> None:
@@ -142,8 +150,9 @@ def _derive_shuffle_ratios(metric_totals: dict) -> None:
     seconds (> 0 means the pipelined fan-in actually overlapped transfers)."""
     wire = metric_totals.get("shuffle_wire_bytes", 0)
     logical = metric_totals.get("shuffle_logical_bytes", 0)
-    if logical:
-        metric_totals["shuffle_compression_ratio"] = round(wire / logical, 4)
+    # 0.0 = no shuffle crossed this capture (explicit, not omitted)
+    metric_totals["shuffle_compression_ratio"] = \
+        round(wire / logical, 4) if logical else 0.0
     cum = metric_totals.get("shuffle_fetch_seconds", 0.0)
     overlap = metric_totals.get("shuffle_overlap_seconds", 0.0)
     if cum:
@@ -187,7 +196,7 @@ def shuffle_microbench() -> None:
         metric_totals = {k: v for k, v in registry().diff(before).items()
                          if k.startswith("shuffle_")}
         _derive_shuffle_ratios(metric_totals)
-        print(json.dumps({
+        _emit({
             "metric": "shuffle_microbench_rows_per_sec",
             "value": round(n / elapsed, 1),
             "unit": "rows/sec",
@@ -197,18 +206,31 @@ def shuffle_microbench() -> None:
             "reps": REPS,
             "calibration": _calibration_dict(),
             "metrics": metric_totals,
-        }))
+        })
     finally:
         runner.shutdown()
 
 
 def mesh_microbench() -> None:
-    """BENCH_MESH=1: a TPC-H-shaped groupby executed with its device stage
-    sharded across 8 devices via shard_map, fed by the streaming
-    morsel/coalescer path, checked BIT-IDENTICAL against the single-chip and
-    host paths (quantity aggregates are integer-valued, so every f64 partial
-    is exact in any reduction order). CPU CI invocation (the MULTICHIP
-    harness environment):
+    """BENCH_MESH=1: the multi-chip capture — three sections, all checked
+    against the host path:
+
+    1. a TPC-H-shaped groupby executed with its device stage sharded across
+       8 devices via shard_map, fed by the streaming morsel/coalescer path,
+       BIT-IDENTICAL vs single-chip and host (quantity aggregates are
+       integer-valued, so every f64 partial is exact in any reduction order);
+    2. real TPC-H JOIN queries (q12 grouped join-agg, q14 ungrouped) through
+       the mesh join tier (ops/mesh_stage.MeshJoin*Run): mesh_dispatches > 0
+       with q12 bit-identical (integer 0/1 sums — exact in any order) and
+       q14 within float tolerance; the run is priced under
+       DAFT_TPU_PLACEMENT_PRICE_FORCED so every join verdict carries ALL
+       THREE tiers' CostBreakdowns (mesh arm priced, not absent);
+    3. an intra-host hash repartition routed over ICI (jax.lax.all_to_all)
+       instead of the host shuffle — bit-identical partitions with ZERO
+       shuffle wire bytes while the exchange moved real plane bytes
+       (asserted: wire < ici — the co-located-worker wire-byte drop).
+
+    CPU CI invocation (the MULTICHIP harness environment):
 
         BENCH_MESH=1 JAX_PLATFORMS=cpu \\
         XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py
@@ -274,18 +296,105 @@ def mesh_microbench() -> None:
         raise AssertionError(
             "mesh result differs from single-chip/host — parity broken")
 
-    print(json.dumps({
+    # ---- section 2: TPC-H join queries through the mesh join tier ----------
+    from benchmarking.tpch.queries import ALL_QUERIES
+    from daft_tpu.observability import placement as _placement
+
+    join_queries = [12, 14]  # grouped + ungrouped star shapes
+    os.environ["DAFT_TPU_PLACEMENT_PRICE_FORCED"] = "1"
+    try:
+        with execution_config_ctx(device_mode="off"):
+            join_host = {q: ALL_QUERIES[q](tables).to_pydict()
+                         for q in join_queries}
+        join_placement = {}
+        join_ms = {}
+        with execution_config_ctx(device_mode="on", mesh_devices=8,
+                                  device_min_rows=1):
+            # warmup pass first (main()'s discipline): the timed + scoped
+            # runs below must not embed jit-compile time — these forced
+            # records feed the calibrate tool, and compile seconds counted
+            # as dispatch would inflate the mesh term suggestions
+            for qi in join_queries:
+                ALL_QUERIES[qi](tables).to_pydict()
+            join_disp_before = counters.mesh_dispatches
+            join_mesh = {}
+            for qi in join_queries:
+                with _placement.query_scope() as pscope:
+                    t0 = time.perf_counter()
+                    join_mesh[qi] = ALL_QUERIES[qi](tables).to_pydict()
+                    join_ms[qi] = round((time.perf_counter() - t0) * 1000, 1)
+                join_placement[qi] = _placement_brief(pscope.to_dicts())
+    finally:
+        os.environ.pop("DAFT_TPU_PLACEMENT_PRICE_FORCED", None)
+    mesh_join_disp = counters.mesh_dispatches - join_disp_before
+    assert counters.mesh_join_runs > 0 and mesh_join_disp > 0, \
+        "mesh join tier never dispatched — the join wiring is not engaged"
+    assert join_mesh[12] == join_host[12], \
+        "q12 mesh join diverged from host (integer sums must be exact)"
+    _q14m = join_mesh[14]["promo_revenue"][0]
+    _q14h = join_host[14]["promo_revenue"][0]
+    assert abs(_q14m - _q14h) <= 1e-9 * max(abs(_q14h), 1.0), \
+        f"q14 mesh join outside float tolerance ({_q14m} vs {_q14h})"
+    # the join verdicts must carry the mesh arm: at least one record with
+    # a priced mesh breakdown (forced pricing populates all three tiers)
+    _rec = [r for r in _placement.ledger().snapshot()
+            if r.get("site") in ("join agg", "join topn") and r.get("mesh")
+            and r.get("device") and r.get("host")]
+    assert _rec, "join placement records missing the mesh CostBreakdown"
+    metric_totals.update({k: v for k, v in counters.snapshot().items() if v})
+    _derive_mesh_ratio(metric_totals)
+
+    # ---- section 3: intra-host repartition over ICI ------------------------
+    from daft_tpu.observability.metrics import registry as _registry
+
+    rep_rows = 200_000
+    rep_df = daft_tpu.from_pydict({
+        "k": [i % 997 for i in range(rep_rows)],
+        "v": [float(i % 8191) for i in range(rep_rows)],
+    })
+    with execution_config_ctx(device_mode="off"):
+        host_parts = rep_df.repartition(8, col("k")).collect()
+    wire_before = _registry().get("shuffle_wire_bytes")
+    ici_before = _registry().get("mesh_alltoall_ici_bytes")
+    with execution_config_ctx(device_mode="on", mesh_devices=8,
+                              device_min_rows=1):
+        mesh_parts = rep_df.repartition(8, col("k")).collect()
+    wire_delta = _registry().get("shuffle_wire_bytes") - wire_before
+    ici_delta = _registry().get("mesh_alltoall_ici_bytes") - ici_before
+    assert ici_delta > 0, "all_to_all repartition never engaged"
+    assert wire_delta < ici_delta, \
+        "co-located repartition still paid shuffle wire bytes"
+    from daft_tpu.core.recordbatch import RecordBatch as _RB
+
+    def _part_dict(p):
+        bs = [b for b in p.batches if b.num_rows]
+        if not bs:
+            return {}
+        b = bs[0] if len(bs) == 1 else _RB.concat(bs)
+        return {c: b.get_column(c).to_pylist() for c in ("k", "v")}
+
+    for hp, mp in zip(host_parts._result, mesh_parts._result):
+        assert _part_dict(hp) == _part_dict(mp), \
+            "ICI repartition partitions diverge from the host shuffle"
+    metric_totals["mesh_alltoall_ici_bytes"] = int(ici_delta)
+    metric_totals["shuffle_wire_bytes_colocated"] = int(wire_delta)
+
+    _emit({
         "metric": f"tpch_sf{SF}_mesh_groupby_rows_per_sec",
         "value": round(n / elapsed, 1),
         "unit": "rows/sec",
         "vs_baseline": round((n / elapsed) / BASELINE_ROWS_PER_SEC, 4),
         "mesh_devices": len(jax.devices()),
         "bit_identical": True,
+        "mesh_join_dispatches": int(mesh_join_disp),
+        "per_query_ms": {f"q{qi}": join_ms[qi] for qi in join_queries},
+        "placement": {f"q{qi}": v for qi, v in sorted(join_placement.items())
+                      if v},
         "fact_rows": n,
         "reps": REPS,
         "calibration": _calibration_dict(),
         "metrics": metric_totals,
-    }))
+    })
 
 
 def serve_bench() -> None:
@@ -389,7 +498,7 @@ def serve_bench() -> None:
                                       "device_", "dispatch_"))}
     metric_totals["serve_repeat_h2d_bytes"] = repeat_h2d
     rows_per_sec = n * total / elapsed
-    print(json.dumps({
+    _emit({
         "metric": "serve_queries_per_sec",
         "value": round(total / elapsed, 2),
         "unit": "queries/sec",
@@ -406,7 +515,7 @@ def serve_bench() -> None:
         "fact_rows": n,
         "calibration": _calibration_dict(),
         "metrics": metric_totals,
-    }))
+    })
 
 
 def ai_bench() -> None:
@@ -507,7 +616,7 @@ def ai_bench() -> None:
         metric_totals[k] = _res[k]
 
     rows_per_sec = n * len(shapes) / elapsed
-    print(json.dumps({
+    _emit({
         "metric": f"ai_{len(shapes)}q_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
@@ -522,7 +631,7 @@ def ai_bench() -> None:
         "reps": REPS,
         "calibration": _calibration_dict(),
         "metrics": metric_totals,
-    }))
+    })
 
 
 def _rss_high_water_bytes() -> int:
@@ -605,7 +714,7 @@ def oom_bench() -> None:
     metric_totals["host_scope_peak_bytes"] = scope.peak_bytes()
     metric_totals["rss_high_water_bytes"] = _rss_high_water_bytes()
     rows_per_sec = n_lineitem * len(QUERIES) / elapsed
-    print(json.dumps({
+    _emit({
         "metric": f"tpch_sf{SF}_oom_{len(QUERIES)}q_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
@@ -621,20 +730,48 @@ def oom_bench() -> None:
         "reps": REPS,
         "calibration": _calibration_dict(),
         "metrics": metric_totals,
-    }))
+    })
 
 
 REGRESSION_TOLERANCE = 0.05   # >5% slower than OLD fails the gate
 
 
+def _validate_capture(data: dict) -> None:
+    """The capture-record contract `--compare` relies on: a dict carrying at
+    least the headline metric/value pair (per_query_ms rides along for
+    suite captures). Raises with the offending shape — bench.py refuses to
+    EMIT a capture its own loader could not read back (the BENCH_r05
+    lesson: a committed artifact that the gate silently half-parses is a
+    regression hiding place)."""
+    if not isinstance(data, dict):
+        raise SystemExit(f"bench capture must be a JSON object, got "
+                         f"{type(data).__name__}")
+    missing = [k for k in ("metric", "value") if k not in data]
+    if missing:
+        raise SystemExit(
+            f"bench capture is missing {missing} — not a capture record "
+            f"(keys: {sorted(data)[:8]})")
+
+
+def _emit(out: dict) -> None:
+    """Print the one-JSON-line capture, refusing to emit anything the
+    --compare loader cannot round-trip."""
+    line = json.dumps(out)
+    _validate_capture(json.loads(line))
+    print(line)
+
+
 def _load_capture(path: str) -> dict:
     """A bench JSON — either the raw one-line output of this script or a
-    driver capture record wrapping it under "parsed" (the committed
-    BENCH_r*.json shape)."""
+    driver capture record wrapping it under "parsed". Fails LOUDLY on any
+    other shape instead of returning a dict the comparison loops would
+    silently treat as an empty query set."""
     with open(path) as f:
         data = json.load(f)
-    if "per_query_ms" not in data and isinstance(data.get("parsed"), dict):
+    if isinstance(data, dict) and "metric" not in data \
+            and isinstance(data.get("parsed"), dict):
         data = data["parsed"]
+    _validate_capture(data)
     return data
 
 
@@ -645,13 +782,31 @@ def compare(old_path: str, new_path: str) -> int:
     new = _load_capture(new_path)
     old_q = old.get("per_query_ms", {})
     new_q = new.get("per_query_ms", {})
+    # per-query placement FLIP column: which queries moved between host and
+    # device capture between the two runs (per_query_device counts device
+    # dispatches per query) — a re-capture then shows exactly which join
+    # queries the mesh tier flipped, next to their speedups
+    old_d = old.get("per_query_device", {})
+    new_d = new.get("per_query_device", {})
+
+    def _flip(q: str) -> str:
+        if q not in old_d or q not in new_d:
+            return ""
+        o, n = old_d.get(q, 0), new_d.get(q, 0)
+        if o == 0 and n > 0:
+            return "host->device"
+        if o > 0 and n == 0:
+            return "device->host"
+        return ""
+
     regressions = []
     # a query that vanished from NEW is lost coverage, not a pass: a
     # regression hiding in a dropped query must fail the gate loudly
     for q in sorted(set(old_q) - set(new_q)):
         print(f"{q:<8} missing from NEW capture  <-- REGRESSION")
         regressions.append(q)
-    print(f"{'query':<8} {'old ms':>10} {'new ms':>10} {'speedup':>8}")
+    print(f"{'query':<8} {'old ms':>10} {'new ms':>10} {'speedup':>8} "
+          f"{'placement':>13}")
     for q in sorted(set(old_q) & set(new_q),
                     key=lambda s: int(s[1:]) if s[1:].isdigit() else 0):
         o, n = old_q[q], new_q[q]
@@ -660,7 +815,8 @@ def compare(old_path: str, new_path: str) -> int:
         if n > o * (1 + REGRESSION_TOLERANCE):
             flag = "  <-- REGRESSION"
             regressions.append(q)
-        print(f"{q:<8} {o:>10.1f} {n:>10.1f} {speedup:>7.2f}x{flag}")
+        print(f"{q:<8} {o:>10.1f} {n:>10.1f} {speedup:>7.2f}x "
+              f"{_flip(q):>13}{flag}")
     ov, nv = old.get("value", 0), new.get("value", 0)
     if ov and nv:
         flag = ""
@@ -810,9 +966,8 @@ def main() -> None:
     # the HBM gauges without post-processing.
     hits = metric_totals.get("sched_affinity_hits", 0)
     misses = metric_totals.get("sched_affinity_misses", 0)
-    if hits or misses:
-        metric_totals["sched_affinity_hit_rate"] = round(
-            hits / (hits + misses), 4)
+    metric_totals["sched_affinity_hit_rate"] = round(
+        hits / (hits + misses), 4) if (hits or misses) else 0.0
 
     # Dispatch-coalescing attribution: whether the RTT amortization actually
     # paid on this capture. bucket_fill_ratio = real rows / padded bucket rows
@@ -876,7 +1031,7 @@ def main() -> None:
     if err.get("samples"):
         out["cost_model_error_ratio"] = err["median"]
         out["cost_model_error"] = err
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":

@@ -218,6 +218,15 @@ class Series:
         from ..utils import jax_setup  # noqa: F401  (enables x64 before device use)
         import jax.numpy as jnp
 
+        values, validity = self._padded_planes(pad_to, f32)
+        return jnp.asarray(values), jnp.asarray(validity)
+
+    def _padded_planes(self, pad_to: Optional[int], f32: bool):
+        """Host-side (values, validity) numpy planes padded to `pad_to` rows
+        (padding invalid), with the h2d byte attribution every device
+        placement shares — the single body behind to_device /
+        to_device_sharded / to_device_replicated, so padding and accounting
+        can never drift between layouts."""
         values = self.to_numpy()
         if f32 and values.dtype == np.float64:
             values = values.astype(np.float32)
@@ -231,7 +240,7 @@ class Series:
 
         # h2d attribution: a fully-resident repeat query shows a zero delta
         registry().inc("hbm_h2d_bytes", int(values.nbytes) + int(validity.nbytes))
-        return jnp.asarray(values), jnp.asarray(validity)
+        return values, validity
 
     def to_device_sharded(self, mesh, pad_to: int, f32: bool = False,
                           axis: str = "dp"):
@@ -249,24 +258,31 @@ class Series:
             raise ValueError(
                 f"to_device_sharded: pad_to={pad_to} not divisible by the "
                 f"{n_dev}-device mesh")
-        values = self.to_numpy()
-        if f32 and values.dtype == np.float64:
-            values = values.astype(np.float32)
-        validity = self.validity_numpy()
-        if pad_to > len(self):
-            pad = pad_to - len(self)
-            pad_shape = (pad,) + values.shape[1:]
-            values = np.concatenate([values, np.zeros(pad_shape, dtype=values.dtype)])
-            validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
-        from ..observability.metrics import registry
-
-        registry().inc("hbm_h2d_bytes", int(values.nbytes) + int(validity.nbytes))
+        values, validity = self._padded_planes(pad_to, f32)
         sharding = NamedSharding(mesh, PartitionSpec(axis))
         return (jax.device_put(values, sharding),
                 jax.device_put(validity, sharding))
 
+    def to_device_replicated(self, mesh, pad_to: Optional[int] = None,
+                             f32: bool = False):
+        """(values, validity) broadcast to EVERY device of the mesh
+        (replicated NamedSharding) — the dim-plane layout of the mesh join
+        feed: the probe is then a purely local gather on each shard, no
+        collective until the reduce. h2d attribution counts the host bytes
+        once (the broadcast fan-out is the link's business, not the
+        ledger's); residency accounting still sees N per-device copies via
+        device_nbytes."""
+        from ..utils import jax_setup  # noqa: F401
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        values, validity = self._padded_planes(pad_to, f32)
+        sharding = NamedSharding(mesh, PartitionSpec())
+        return (jax.device_put(values, sharding),
+                jax.device_put(validity, sharding))
+
     def to_device_cached(self, pad_to: Optional[int] = None, f32: bool = False,
-                         mesh=None, axis: str = "dp"):
+                         mesh=None, axis: str = "dp", replicated: bool = False):
         """to_device through the process-wide HBM residency manager.
 
         Collected tables queried repeatedly keep their columns resident in HBM
@@ -286,6 +302,12 @@ class Series:
             return manager().get_or_build(
                 self, ("col", pad_to, bool(f32)), (),
                 lambda: self.to_device(pad_to, f32=f32))
+        if replicated:
+            key = ("col", pad_to, bool(f32), "meshR", int(mesh.shape[axis]),
+                   axis)
+            return manager().get_or_build(
+                self, key, (),
+                lambda: self.to_device_replicated(mesh, pad_to, f32=f32))
         key = ("col", pad_to, bool(f32), "mesh", int(mesh.shape[axis]), axis)
         return manager().get_or_build(
             self, key, (),
@@ -304,15 +326,18 @@ class Series:
         object.__setattr__(self, "_pyobjs", pyobjs)
 
     def is_device_resident(self, pad_to: Optional[int] = None, f32: bool = False,
-                           mesh_devices: int = 0, axis: str = "dp") -> bool:
+                           mesh_devices: int = 0, axis: str = "dp",
+                           replicated: bool = False) -> bool:
         """True if this column is already in HBM for the given layout (cost-model
         hook — resident inputs are costed with zero transfer bytes).
-        mesh_devices > 0 probes the row-sharded mesh layout instead."""
+        mesh_devices > 0 probes the row-sharded mesh layout instead
+        (replicated=True: the broadcast dim-plane layout of the join feed)."""
         from ..device.residency import manager
 
         if mesh_devices > 0:
+            fam = "meshR" if replicated else "mesh"
             return manager().is_resident(
-                self, ("col", pad_to, bool(f32), "mesh", int(mesh_devices), axis))
+                self, ("col", pad_to, bool(f32), fam, int(mesh_devices), axis))
         return manager().is_resident(self, ("col", pad_to, bool(f32)))
 
     def content_fingerprint(self) -> Optional[int]:

@@ -1009,7 +1009,13 @@ def _exec_device_join_agg(node) -> MicroPartition:
     """
     from ..ops.device_join import DeviceJoinGroupedRun, DeviceJoinUngroupedRun
 
-    def make_run(stage, grouped, ctx):
+    def make_run(stage, grouped, ctx, mesh_stage):
+        if mesh_stage is not None:
+            from ..ops.mesh_stage import (MeshJoinGroupedRun,
+                                          MeshJoinUngroupedRun)
+
+            return (MeshJoinGroupedRun(mesh_stage, ctx) if grouped
+                    else MeshJoinUngroupedRun(mesh_stage, ctx))
         return (DeviceJoinGroupedRun(stage, ctx) if grouped
                 else DeviceJoinUngroupedRun(stage, ctx))
 
@@ -1038,7 +1044,11 @@ def _exec_device_join_topn(node) -> MicroPartition:
     DeviceFallback)."""
     from ..ops.device_join import DeviceJoinTopNRun
 
-    def make_run(stage, grouped, ctx):
+    def make_run(stage, grouped, ctx, mesh_stage):
+        if mesh_stage is not None:
+            from ..ops.mesh_stage import MeshJoinTopNRun
+
+            return MeshJoinTopNRun(mesh_stage, ctx, node.topn)
         return DeviceJoinTopNRun(stage, ctx, node.topn)
 
     def assemble(run, stage, grouped):
@@ -1152,22 +1162,78 @@ def _run_device_join(node, label: str, make_run, assemble,
         for name, plan in node.dim_plans:
             dim_batches[name] = _concat_parts(list(_exec(plan)), plan.schema)
         ctx = _JoinContext(node.spec, dim_batches)
+
+        # Mesh CANDIDATE resolution happens BEFORE pricing: the mesh arm is
+        # only priced when the mesh stage actually BUILDS for this spec, so
+        # a "mesh" verdict is always executable (an unbuildable mesh must
+        # lose the decision to chip/host at cost time, never silently run a
+        # tier the model rejected) and forced-priced records name the tier
+        # that will really execute — the calibrate tool keys samples on
+        # `chosen`, so a mismatch there poisons its suggestions.
+        mesh_width = _join_mesh_width(cfg)
+        if cfg.device_mode == "on" and cfg.mesh_devices < 2:
+            # "on" forces the SINGLE-CHIP device path: the mesh engages only
+            # via an explicit mesh_devices width (or by winning the auto-mode
+            # cost decision) — a default-config 4-chip host must not silently
+            # route every forced join onto the mesh
+            mesh_width = 0
+        if cfg.mesh_devices >= 2 and mesh_width == 0:
+            # forced mesh, local devices short: LOUD single-chip fallback
+            # (same semantics as the agg stages)
+            import jax
+
+            _counters.bump("mesh_unavailable_fallbacks")
+            _counters.reject(
+                "runtime", f"{label}: fewer local devices than mesh_devices",
+                f"({len(jax.devices())} < {cfg.mesh_devices})")
+        mesh_stage = None
+        if mesh_width >= 2:
+            from ..ops.mesh_stage import try_build_mesh_join_stage
+
+            mesh_stage = try_build_mesh_join_stage(node.spec, mesh_width)
+            if mesh_stage is None:
+                _counters.reject(
+                    "runtime", f"{label}: mesh join stage unbuildable")
+                mesh_width = 0
+
         prec = None
+        tier = False
         if cfg.device_mode == "auto":
             batch0 = next((b for b in first.batches if b.num_rows > 0), None)
-            wins = False
             if batch0 is not None:
-                wins, prec = _join_device_wins(
+                tier, prec = _join_device_wins(
                     node, ctx, batch0, first.num_rows, grouped, stage,
-                    topn=topn, label=label, coalesce=coal)
-            _DECISION_CACHE.put(dk, wins)
-            if not wins:
+                    topn=topn, label=label, coalesce=coal,
+                    mesh_ndev=mesh_width,
+                    mesh_forced=cfg.mesh_devices >= 2 and mesh_width >= 2)
+            _DECISION_CACHE.put(dk, tier)
+            if not tier:
                 raw_stream.close()
                 return _host()
         elif cfg.device_mode == "on":
-            prec = _placement.ledger().record(label, "device",
-                                              first.num_rows, forced=True)
-        run = make_run(stage, grouped, ctx)
+            tier = "mesh" if mesh_width >= 2 else "chip"
+            if _env_bool("DAFT_TPU_PLACEMENT_PRICE_FORCED", False):
+                batch0 = next((b for b in first.batches if b.num_rows > 0),
+                              None)
+                if batch0 is not None:
+                    # forced run, priced anyway: the ledger record carries
+                    # every tier's CostBreakdown (mesh arm included) so
+                    # forced captures yield calibration samples + the
+                    # three-way what-if in EXPLAIN PLACEMENT; `chosen` is
+                    # pinned to the tier that executes below
+                    _t, prec = _join_device_wins(
+                        node, ctx, batch0, first.num_rows, grouped, stage,
+                        topn=topn, label=label, coalesce=coal,
+                        mesh_ndev=mesh_width, forced=True,
+                        forced_tier=tier)
+            if prec is None:
+                prec = _placement.ledger().record(
+                    label, "mesh" if tier == "mesh" else "device",
+                    first.num_rows, forced=True)
+
+        if tier != "mesh":
+            mesh_stage = None  # costed verdict picked the single chip / host
+        run = make_run(stage, grouped, ctx, mesh_stage)
         from ..device.residency import manager as _residency
 
         # pin-scope the feed + finalize: entries this query touches (packed
@@ -1279,6 +1345,8 @@ def _decision_key(node, rows: int, cfg, topn: bool, layout: tuple) -> tuple:
         # the coalescer knobs OR a different fact batch layout must re-decide,
         # not hit a stale cached verdict
         cfg.batch_fill_target, cfg.morsel_size_rows, layout,
+        # the mesh arm reads the mesh knob: flipping it re-decides the tier
+        cfg.mesh_devices,
         repr(spec.predicate),
         tuple(repr(g) for g in spec.groupby),
         tuple(repr(a) for a in spec.aggregations),
@@ -1292,18 +1360,50 @@ def _decision_key(node, rows: int, cfg, topn: bool, layout: tuple) -> tuple:
     )
 
 
+def _join_mesh_width(cfg) -> int:
+    """Mesh width the join cost decision should PRICE: 0 when the mesh tier
+    is disabled (mesh_devices == 1) or fewer than 2 local devices exist,
+    else the full local mesh (or the forced width). Pricing-only — forcing
+    semantics live in _run_device_join."""
+    if cfg.mesh_devices == 1:
+        return 0
+    import jax
+
+    ndev = len(jax.devices())
+    if cfg.mesh_devices >= 2:
+        return cfg.mesh_devices if ndev >= cfg.mesh_devices else 0
+    return ndev if ndev >= 2 else 0
+
+
 def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
                       topn: bool = False, label: str = "join agg",
-                      coalesce: float = 1.0):
+                      coalesce: float = 1.0, mesh_ndev: int = 0,
+                      forced: bool = False, forced_tier=None,
+                      mesh_forced: bool = False):
     """Cost-model decision for a DeviceJoinAgg node (see ops/costmodel.py).
-    Returns (wins, placement_record) — both sides' CostBreakdowns land in
-    the ledger so EXPLAIN PLACEMENT can show per-term why a star join
-    cost-rejected to host (the engine's headline loss).
+    Returns (tier, placement_record) with tier in {"mesh", "chip", False} —
+    ALL priced tiers' CostBreakdowns land in the ledger so EXPLAIN PLACEMENT
+    can show per-term why a star join cost-rejected to host (the engine's
+    headline loss) and what the mesh arm would have cost.
+
+    The mesh arm (mesh_ndev >= 2) prices the fused sharded program
+    (ops/mesh_stage.MeshJoin*Run): per-shard compute ÷ mesh width, the ICI
+    table-merge collective, the multi-device dispatch premium, and its OWN
+    residency picture (native-dtype sharded fact planes + replicated dim
+    planes under mesh slot keys). Mesh must beat BOTH the single chip and
+    the host — same discipline as _mesh_wins.
 
     One-time investments (fact column uploads, index planes, joined-key
     factorize) amortize over device_amortize_runs when the fact source is a
     resident in-memory table — they are all series_keyed-cached, so reps pay
-    only dispatches + one fetch."""
+    only dispatches + one fetch.
+
+    `forced=True` (device_mode=on under DAFT_TPU_PLACEMENT_PRICE_FORCED)
+    runs the same pricing purely to populate the ledger — the caller ignores
+    the verdict, the record is marked forced, and its `chosen` is pinned to
+    `forced_tier` (the tier the caller will actually execute — the calibrate
+    tool attributes observed seconds to the CHOSEN tier's prediction, so
+    recording the priced winner instead would poison its samples)."""
     from ..config import execution_config
     from ..ops import costmodel, counters as _counters
     from ..ops.device_join import DeviceJoinGroupedRun, estimate_joined_cardinality
@@ -1345,6 +1445,35 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
     nonres += ctx.nonresident_index_bytes(batch, bucket)
     n_gathers = len(dim_cols) + len(spec.dims)  # value planes + visibility
 
+    # mesh arm inputs: native-dtype (~9B/row incl. validity) sharded fact
+    # planes + int64 index/code planes + replicated dim planes, each probed
+    # against its OWN mesh residency slots so a warm mesh repeat prices at
+    # zero transfer like the single-chip arm does
+    mesh_nonres = mesh_res = 0
+    if mesh_ndev >= 2:
+        per = pad_bucket(max((batch.num_rows + mesh_ndev - 1) // mesh_ndev, 1))
+        mesh_pad = per * mesh_ndev
+        for c in fact_cols:
+            if batch.get_column(c).is_device_resident(
+                    mesh_pad, f32=False, mesh_devices=mesh_ndev):
+                mesh_res += batch.num_rows * 9
+            else:
+                mesh_nonres += batch.num_rows * 9
+        mesh_nonres += mesh_pad * 8 * len(spec.dims)   # int64 index planes
+        for c in dim_cols:
+            side = spec.col_side[c]
+            dim_rows = ctx.batches[side].num_rows
+            src = ctx._dim_source(side, c)
+            if not src.is_device_resident(
+                    pad_bucket(max(dim_rows, 1)), f32=False,
+                    mesh_devices=mesh_ndev, replicated=True):
+                mesh_nonres += dim_rows * 9
+
+    from ..ops.stage import _decompose_agg
+
+    n_slots = sum(len(_decompose_agg(agg.op)) for _n, agg in stage.aggs)
+    chip_ok = True
+    mesh_cost = None
     if grouped:
         import math
 
@@ -1354,7 +1483,11 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             else DeviceJoinGroupedRun.max_segments
         card = estimate_joined_cardinality(ctx, batch, stage.groupby)
         cap_est = _pad_groups(min(max(card, 1), 2 * ceiling))
-        if cap_est > ceiling:
+        if cap_est > ceiling and not forced:
+            # both device tiers pay the same finalize-fetch/table budget.
+            # A FORCED run executes regardless, so gating here would write a
+            # host-gate record + cost rejects that contradict the forced
+            # device record for the same query — forced pricing proceeds.
             _counters.reject("cost", f"{label}: est group count over ceiling",
                              f"({card} > {ceiling})")
             _placement.ledger().gate(label, "est group count over ceiling",
@@ -1362,21 +1495,28 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             return False, None
         if cap_est > MAX_MATMUL_SEGMENTS and (stage._sct_specs
                                               or stage._use_f64):
-            _counters.reject(
-                "cost", f"{label}: high-cardinality stage needs 64-bit "
-                "scatter/f64 (no local-dense program)")
-            _placement.ledger().gate(
-                label, "high-cardinality stage needs 64-bit scatter/f64",
-                rows)
-            return False, None
+            # single-chip-only limitation: the local-dense program cannot
+            # serve 64-bit scatter/f64 stages. The MESH programs reduce in
+            # native dtypes (exact int64), so the mesh arm stays eligible.
+            chip_ok = False
+            if mesh_ndev < 2 and not forced:
+                _counters.reject(
+                    "cost", f"{label}: high-cardinality stage needs 64-bit "
+                    "scatter/f64 (no local-dense program)")
+                _placement.ledger().gate(
+                    label, "high-cardinality stage needs 64-bit scatter/f64",
+                    rows)
+                return False, None
         n_mm = len(stage._mm_specs)
         n_ext = len(stage._ext_specs)
         n_sct = len(stage._sct_specs)
         if topn:
             k_total = node.topn.offset + node.topn.limit
             fetch = k_total * (n_mm + n_ext + n_sct + 1) * 8
+            mesh_fetch = k_total * (n_slots + 1) * 8
         else:
             fetch = cap_est * (n_mm + n_ext + n_sct) * 8
+            mesh_fetch = cap_est * (n_slots * 2 + 1) * 8
         nonres += bucket * 4                   # codes plane (host-factorize case)
         dev_cost = costmodel.device_join_agg_cost(
             cal, rows, nonres // amort, n_gathers, n_mm, n_ext, n_sct,
@@ -1388,6 +1528,8 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             dev_cost.add("compute",
                          cap_est * max(math.log2(max(cap_est, 2)), 1.0)
                          * nkeys / cal.mm_plane_rows_per_s)
+        if mesh_ndev >= 2:
+            mesh_nonres += mesh_pad * 8        # joined-key codes plane (int64)
         host_cost = costmodel.host_join_agg_cost(
             cal, host_rows, len(spec.dims), len(stage.aggs), True, False)
         if spec.predicate is not None:
@@ -1396,6 +1538,16 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             # host additionally sorts the aggregate's output rows
             host_cost.add("compute", card * max(math.log2(max(card, 2)), 1.0)
                           / cal.host_agg_rate)
+        if mesh_ndev >= 2:
+            mesh_cost = costmodel.mesh_join_agg_cost(
+                cal, rows, mesh_nonres // amort, n_gathers, n_slots, cap_est,
+                mesh_ndev, mesh_fetch, rows // amort, coalesce=coal,
+                resident_bytes=mesh_res, grouped=True)
+            if topn:
+                nkeys = len(node.topn.keys) + 2
+                mesh_cost.add("compute",
+                              cap_est * max(math.log2(max(cap_est, 2)), 1.0)
+                              * nkeys / cal.mm_plane_rows_per_s)
         detail = (f"{len(spec.dims)} dims, {len(stage.aggs)} aggs, "
                   f"~{card} joined groups")
     else:
@@ -1408,16 +1560,42 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             cal, host_rows, len(spec.dims), len(stage.aggs), False, False)
         if spec.predicate is not None:
             host_cost.add("compute", rows / cal.host_agg_rate)  # filter pass
+        if mesh_ndev >= 2:
+            mesh_cost = costmodel.mesh_join_agg_cost(
+                cal, rows, mesh_nonres // amort, n_gathers, n_slots, 1,
+                mesh_ndev, fetch, rows // amort, coalesce=coal,
+                resident_bytes=mesh_res, grouped=False)
         detail = f"{len(spec.dims)} dims, {len(stage.aggs)} aggs"
-    wins = dev_cost < host_cost
-    if not wins:
-        _counters.reject("cost", f"{label}: host wins cost model",
-                         f"(host {host_cost*1e3:.0f}ms vs device "
-                         f"{dev_cost*1e3:.0f}ms est)")
+
+    wins_chip = chip_ok and dev_cost < host_cost
+    if mesh_forced:
+        # explicit mesh_devices width under auto: the device side IS the
+        # mesh (the chip is not an option), so the decision — and the
+        # record's chosen, which calibration samples key on — is mesh vs
+        # host only
+        tier = "mesh" if (mesh_cost is not None
+                          and mesh_cost < host_cost) else False
+    else:
+        wins_mesh = (mesh_cost is not None
+                     and (not chip_ok or mesh_cost < dev_cost)
+                     and mesh_cost < host_cost)
+        tier = "mesh" if wins_mesh else ("chip" if wins_chip else False)
+    if not tier and not forced:
+        msg = (f"(host {host_cost*1e3:.0f}ms vs device "
+               f"{dev_cost*1e3:.0f}ms est")
+        if mesh_cost is not None:
+            msg += f" vs mesh {mesh_cost*1e3:.0f}ms"
+        _counters.reject("cost", f"{label}: host wins cost model", msg + ")")
+    if forced:
+        # the record must name the tier that EXECUTES, not the priced winner
+        chosen = {"mesh": "mesh", "chip": "device"}.get(forced_tier, "device")
+    else:
+        chosen = {"mesh": "mesh", "chip": "device", False: "host"}[tier]
     rec = _placement.ledger().record(
-        label, "device" if wins else "host", rows,
-        device=dev_cost, host=host_cost, detail=detail)
-    return wins, rec
+        label, chosen, rows,
+        forced=forced, device=dev_cost, host=host_cost, mesh=mesh_cost,
+        detail=detail + (f", mesh x{mesh_ndev}" if mesh_ndev >= 2 else ""))
+    return tier, rec
 
 
 def _resident_source_rec(n) -> bool:
@@ -2663,6 +2841,140 @@ def _hash_buckets(stream, by: List[Expression], n: int):
                     yield j, piece
 
 
+def _mesh_repart_eligible(node, n: int) -> bool:
+    """Static gate for the intra-host ICI repartition: explicit mesh opt-in
+    (mesh_devices >= 2), one partition per mesh worker, every column
+    device-representable, and enough local devices. Decided WITHOUT touching
+    the input stream, so the host path starts clean on a reject — and the
+    default config never imports a device module here (zero-overhead)."""
+    from ..config import execution_config
+
+    cfg = execution_config()
+    if cfg.device_mode == "off" or cfg.mesh_devices < 2 \
+            or n != cfg.mesh_devices or not node.by:
+        return False
+    for f in node.schema:
+        if not (f.dtype.is_numeric() or f.dtype.is_boolean()):
+            return False
+    import jax
+
+    if len(jax.devices()) < n:
+        from ..ops import counters as _counters
+
+        _counters.bump("mesh_unavailable_fallbacks")
+        _counters.reject("runtime",
+                         "repartition: fewer local devices than mesh_devices")
+        return False
+    return True
+
+
+def _mesh_repartition(node, n: int) -> Iterator[MicroPartition]:
+    """Hash repartition routed over ICI (SURVEY §7's two-tier shuffle: the
+    exchange between co-located mesh workers is ONE jax.lax.all_to_all
+    program instead of the host shuffle's write-files/fetch round trip —
+    zero shuffle wire bytes move). Destination buckets are computed on host
+    with the exact partition_by_hash function, each shard stable-sorts its
+    rows by destination on device, and the exchanged planes come back in
+    (source shard, stream order) — bit-identical partition contents and row
+    order versus the host path, asserted in tests and the BENCH_MESH
+    capture. Any runtime failure falls back to host bucketing of the
+    already-collected batches (results identical, rejection counted)."""
+    from ..config import execution_config
+    from ..ops import counters as _counters
+
+    cfg = execution_config()
+    parts = list(_exec(node.input))
+    batches = [b for p in parts for b in p.batches if b.num_rows > 0]
+
+    def _host_buckets() -> List[MicroPartition]:
+        buckets: List[List[RecordBatch]] = [[] for _ in range(n)]
+        for b in batches:
+            keys = [eval_expression(b, e) for e in node.by]
+            for j, piece in enumerate(b.partition_by_hash(keys, n)):
+                if piece.num_rows:
+                    buckets[j].append(piece)
+        return [MicroPartition(node.schema, bs) if bs
+                else MicroPartition.empty(node.schema) for bs in buckets]
+
+    rows = sum(b.num_rows for b in batches)
+    if not batches or rows < cfg.device_min_rows:
+        yield from _host_buckets()
+        return
+    try:
+        # materialize BEFORE yielding: a failure after partial emission would
+        # otherwise fall back to the full host bucket set and hand the
+        # consumer duplicated rows
+        parts = list(_mesh_repartition_exchange(node, batches, rows, n))
+    except Exception as e:  # device-path failure must never fail the query
+        _counters.reject("runtime", "repartition: mesh all_to_all fallback",
+                         str(e))
+        parts = _host_buckets()
+    yield from parts
+
+
+def _mesh_repartition_exchange(node, batches: List[RecordBatch], rows: int,
+                               n: int) -> Iterator[MicroPartition]:
+    import jax
+
+    from ..core.kernels.hashing import combine_hashes
+    from ..core.series import Series
+    from ..ops import counters as _counters
+    from ..ops.mesh_stage import _shard_np, mesh_row_mask, mesh_total
+    from ..parallel.distributed import (default_mesh,
+                                        sharded_alltoall_repartition_step)
+
+    big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
+    keys = [eval_expression(big, e) for e in node.by]
+    hashes = combine_hashes([s.hash().to_numpy().astype(np.uint64)
+                             for s in keys])
+    dest = (hashes % np.uint64(n)).astype(np.int64)
+    mesh = default_mesh(n)
+    total = mesh_total(rows, n)
+    S = total // n
+    cols = []
+    dtypes: List = []
+    for col in big.columns:
+        vals = col.to_numpy()
+        if vals.dtype == object:
+            raise ValueError(f"column {col.name!r} has no device layout")
+        valid = col.validity_numpy()
+        cols.append((vals, valid))
+        dtypes += [vals.dtype, np.bool_]
+    step = sharded_alltoall_repartition_step(mesh, dtypes)
+    flat = []
+    ici_bytes = 0
+    for vals, valid in cols:
+        flat += [_shard_np(mesh, vals, total), _shard_np(mesh, valid, total)]
+        # the exchanged scratch is [n, S] per shard per plane: every plane
+        # crosses the interconnect once at its padded size
+        ici_bytes += n * total * vals.dtype.itemsize + n * total
+    counts, planes = step(_shard_np(mesh, dest, total),
+                          mesh_row_mask(mesh, rows, total), *flat)
+    counts_np = np.asarray(jax.device_get(counts))
+    planes_np = [np.asarray(p) for p in jax.device_get(list(planes))]
+    _counters.bump("mesh_alltoall_dispatches")
+    _counters.bump("mesh_alltoall_rows", rows)
+    _counters.bump("mesh_alltoall_ici_bytes", ici_bytes)
+
+    import pyarrow as pa
+
+    for d in range(n):
+        per_src = [(j, int(counts_np[d * n + j])) for j in range(n)
+                   if counts_np[d * n + j] > 0]
+        out_cols = []
+        for i, f in enumerate(node.schema):
+            v = [planes_np[2 * i][d * n + j][:c] for j, c in per_src]
+            m = [planes_np[2 * i + 1][d * n + j][:c] for j, c in per_src]
+            vv = np.concatenate(v) if v else np.empty(0, dtypes[2 * i])
+            mm = np.concatenate(m) if m else np.empty(0, bool)
+            arr = pa.array(vv, mask=~mm) if not mm.all() else pa.array(vv)
+            out_cols.append(Series.from_arrow(arr, f.name, dtype=f.dtype))
+        total_d = sum(c for _j, c in per_src)
+        out = RecordBatch(node.schema, out_cols, total_d)
+        yield MicroPartition(node.schema,
+                             [out.cast_to_schema(node.schema)])
+
+
 def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
     n = node.num_partitions or 1
     if node.scheme == "into":
@@ -2677,6 +2989,9 @@ def _repartition(node: pp.PhysRepartition) -> Iterator[MicroPartition]:
 
     buckets: List[List[RecordBatch]] = [[] for _ in range(n)]
     if node.scheme == "hash":
+        if _mesh_repart_eligible(node, n):
+            yield from _mesh_repartition(node, n)
+            return
         for j, piece in _hash_buckets(_exec(node.input), node.by, n):
             buckets[j].append(piece)
     elif node.scheme == "random":

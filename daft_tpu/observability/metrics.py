@@ -133,6 +133,12 @@ DEVICE_COUNTER_NAMES = (
     "mesh_capacity_growths",   # mesh group-table capacity grown mid-run (recompile)
     "device_join_batches",     # batches through the gather-join device stages
     "device_topn_runs",        # join+agg+TopN fused device programs completed
+    "mesh_join_runs",          # device joins executed via the mesh-sharded tier
+    # intra-host ICI repartition (jax.lax.all_to_all over the local mesh —
+    # the in-mesh replacement for the host shuffle between co-located workers)
+    "mesh_alltoall_dispatches",    # all_to_all exchange programs dispatched
+    "mesh_alltoall_rows",          # rows routed over ICI instead of the host shuffle
+    "mesh_alltoall_ici_bytes",     # plane bytes the exchange moved over ICI
     # device-UDF tier (ops/udf_stage.py): jax-traceable model UDFs as stages
     "device_udf_dispatches",   # compiled UDF program dispatches (super-batches)
     "device_udf_rows",         # real rows through device UDF dispatches

@@ -269,6 +269,18 @@ def calibrate() -> Calibration:
                 best = max(best, big.nbytes / dt)
             d2h = best
 
+    # Mesh terms: probed LIVE like rtt/h2d when more than one local device
+    # exists and the env doesn't pin them — the auto ICI tier then prices
+    # collectives with the silicon's numbers instead of v5e constants.
+    ici = _env_f("DAFT_TPU_COST_ICI", -1.0)
+    meshd = _env_f("DAFT_TPU_COST_MESH_DISPATCH", -1.0)
+    if ici < 0 or meshd < 0:
+        p_ici, p_meshd = _probe_mesh_terms(rtt)
+        if ici < 0:
+            ici = p_ici
+        if meshd < 0:
+            meshd = p_meshd
+
     _CAL = Calibration(
         rtt_s=rtt,
         h2d_bytes_per_s=h2d,
@@ -280,16 +292,85 @@ def calibrate() -> Calibration:
         host_agg_rate=_env_f("DAFT_TPU_COST_HOST_AGG", 1.5e8),
         host_factorize_rate=_env_f("DAFT_TPU_COST_HOST_FACT", 8e6),
         host_probe_rate=_env_f("DAFT_TPU_COST_HOST_PROBE", 3e7),
-        # v5e ICI is ~45GB/s per direction per link; the conservative default
-        # (and the multi-device dispatch overhead) keep the auto tier honest —
-        # mesh must WIN real compute before paying its launch premium
-        ici_bytes_per_s=_env_f("DAFT_TPU_COST_ICI", 4.5e10),
-        mesh_dispatch_s=_env_f("DAFT_TPU_COST_MESH_DISPATCH", 2e-3),
+        ici_bytes_per_s=ici,
+        mesh_dispatch_s=meshd,
         udf_device_flops_per_s=_env_f("DAFT_TPU_COST_UDF_FLOPS", 2e11),
         udf_host_flops_per_s=_env_f("DAFT_TPU_COST_UDF_HOST_FLOPS", 5e9),
     )
     _export_calibration_gauges(_CAL)
     return _CAL
+
+
+# v5e constants for the mesh terms when no live probe is possible (a single
+# local device — the mesh tier can never engage there anyway). ~45GB/s per
+# direction per ICI link; 2ms multi-device launch premium. Conservative on
+# purpose: mesh must WIN real compute before paying its premium.
+_STATIC_ICI_BPS = 4.5e10
+_STATIC_MESH_DISPATCH_S = 2e-3
+
+
+def _probe_mesh_terms(rtt: float):
+    """(ici_bytes_per_s, mesh_dispatch_s) measured on the local mesh:
+    best-of-2 timings of a tiny psum (the multi-device launch premium over
+    the single-chip rtt) and a ~4MB all_gather (collective bandwidth — each
+    device receives the full array, so bytes-moved = nbytes x mesh width).
+    Static v5e constants when fewer than 2 local devices exist or the probe
+    fails (the tier gate rejects meshes there regardless)."""
+    try:
+        import numpy as np
+
+        from ..utils import jax_setup  # noqa: F401
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        devs = jax.devices()
+        if len(devs) < 2 or jax.default_backend() in ("cpu",):
+            # a forced-multi-device CPU host has no interconnect to measure —
+            # its 'ICI' probe would time memcpy and flip auto-tier verdicts
+            # toward a mesh that buys nothing; real silicon probes live
+            return _STATIC_ICI_BPS, _STATIC_MESH_DISPATCH_S
+        from ..parallel.distributed import _shard_map, default_mesh
+
+        n = len(devs)
+        mesh = default_mesh(n)
+        P = PartitionSpec
+
+        def small(x):
+            return jax.lax.psum(jnp.sum(x), "dp")
+
+        sprobe = jax.jit(_shard_map(small, mesh, (P("dp"),), P()))
+        xs = jax.device_put(np.ones(8 * n, np.float32),
+                            NamedSharding(mesh, P("dp")))
+        jax.device_get(sprobe(xs))  # compile outside the timed region
+        t_small = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_get(sprobe(xs))
+            t_small = min(t_small, time.perf_counter() - t0)
+        meshd = max(t_small - rtt, 1e-5)
+
+        def gather(x):
+            return jnp.sum(jax.lax.all_gather(x, "dp"))
+
+        gprobe = jax.jit(_shard_map(gather, mesh, (P("dp"),), P()))
+        per = (1 << 20) // 4  # 1MB per shard -> n MB gathered per device
+        xb = jax.device_put(np.ones(per * n, np.float32),
+                            NamedSharding(mesh, P("dp")))
+        jax.device_get(gprobe(xb))  # compile
+        best = 0.0
+        # each device RECEIVES the other n-1 shards (its own is local), so
+        # interconnect bytes = shard * (n-1) per device, summed over devices
+        moved = per * 4 * (n - 1) * n
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_get(gprobe(xb))
+            dt = max(time.perf_counter() - t0 - t_small, 1e-4)
+            best = max(best, moved / dt)
+        return (best or _STATIC_ICI_BPS), meshd
+    except Exception:  # lint: ignore[broad-except] -- probe is an optimization;
+        # a backend without collective support falls back to the static terms
+        return _STATIC_ICI_BPS, _STATIC_MESH_DISPATCH_S
 
 
 def _export_calibration_gauges(cal: Calibration) -> None:
@@ -491,6 +572,36 @@ def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
         logn = max(math.log2(max(rows, 2)), 1.0)
         out.add("compute", rows * logn / cal.mm_plane_rows_per_s
                 + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
+    return out
+
+
+def mesh_join_agg_cost(cal: Calibration, rows: int, nonresident_bytes: int,
+                       n_gathers: int, n_slots: int, cap_est: int,
+                       n_devices: int, fetch_bytes: int, factorize_rows: int,
+                       coalesce: float = 1.0, resident_bytes: int = 0,
+                       grouped: bool = True) -> CostBreakdown:
+    """One mesh-sharded gather-join + aggregate dispatch (ops/mesh_stage.py
+    MeshJoin*Run over the fused parallel/distributed.py program): per-shard
+    gathers + the segment/masked reduce run on rows/N, the cross-shard merge
+    is one psum/pmin/pmax per partial table moving cap x slots x 8 bytes over
+    ICI (ungrouped: scalars), and the dispatch pays the multi-device launch
+    premium on top of the coalesce-amortized round trip. Host factorize work
+    (join indices, joined-key codes) is unchanged by sharding — full rows,
+    amortized by the caller exactly like the single-chip arm."""
+    n = max(n_devices, 1)
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("mesh_dispatch", cal.mesh_dispatch_s)
+    out.add("compute",
+            rows * (max(n_gathers, 1) + max(n_slots, 1))
+            / (cal.mm_plane_rows_per_s * n))
+    if grouped:
+        cap = max(cap_est, 16)
+        out.add("ici", cap * (max(n_slots, 1) + 1) * 8 * n
+                / cal.ici_bytes_per_s)
+    else:
+        out.add("ici", max(n_slots, 1) * 8 * n / cal.ici_bytes_per_s)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
     return out
 
 

@@ -164,6 +164,46 @@ def suggest(records: List[dict],
             if base:
                 report["suggestions"][knob] = f"{base / r:.4g}"
 
+    # mesh terms (the ICI tier): calibrated from samples whose chosen tier
+    # was the mesh — the observed dispatch window carries the multi-device
+    # launch premium AND the collective, so the premium comes from the
+    # per-dispatch floor (minus the single-chip rtt) and the ICI bandwidth
+    # from the residual after premium + predicted compute are subtracted.
+    cal_rtt = cal.get("rtt_s") or 0.0
+    mesh_samples = [s for s in samples
+                    if s["pred"].get("mesh_dispatch") is not None]
+    floors = []
+    for s in mesh_samples:
+        d, n = s["obs"].get("dispatch", 0.0), s["obs"].get("dispatches", 0)
+        if d > _MIN_TERM_S and n:
+            floors.append(max(d / n - cal_rtt, 0.0))
+    if floors:
+        floor = min(floors)
+        report["terms"]["mesh_dispatch"] = {
+            "samples": len(floors), "observed_floor_s": round(floor, 6)}
+        cur = cal.get("mesh_dispatch_s")
+        if cur and floor > _MIN_TERM_S \
+                and (floor > 2 * cur or floor < cur / 2):
+            report["suggestions"]["DAFT_TPU_COST_MESH_DISPATCH"] = \
+                f"{floor:.6g}"
+    ici_ratios = []
+    cal_meshd = cal.get("mesh_dispatch_s") or 0.0
+    for s in mesh_samples:
+        pred_ici = s["pred"].get("ici", 0.0)
+        n_disp = s["obs"].get("dispatches", 0)
+        residual = (s["obs"].get("dispatch", 0.0)
+                    - n_disp * (cal_rtt + cal_meshd)
+                    - s["pred"].get("compute", 0.0))
+        if pred_ici > _MIN_TERM_S and residual > _MIN_TERM_S:
+            ici_ratios.append(residual / pred_ici)
+    if ici_ratios:
+        r = _median(ici_ratios)
+        report["terms"]["ici"] = {"samples": len(ici_ratios),
+                                  "observed_over_predicted": round(r, 4)}
+        cur = cal.get("ici_bytes_per_s")
+        if cur and (r > 2 or r < 0.5):
+            report["suggestions"]["DAFT_TPU_COST_ICI"] = f"{cur / r:.4g}"
+
     errs = [s["error_ratio"] for s in samples
             if s.get("error_ratio") is not None]
     if errs:

@@ -201,6 +201,10 @@ def test_calibration_terms_exported_as_gauges(monkeypatch):
     monkeypatch.setenv("DAFT_TPU_COST_RTT", "0.042")
     monkeypatch.setenv("DAFT_TPU_COST_H2D", "2e9")
     monkeypatch.setenv("DAFT_TPU_COST_D2H", "3e6")
+    # the mesh terms are live-probed like rtt/h2d when unset (r15) — pin
+    # them so the gauge assertion is deterministic on any device count
+    monkeypatch.setenv("DAFT_TPU_COST_ICI", "4.5e10")
+    monkeypatch.setenv("DAFT_TPU_COST_MESH_DISPATCH", "2e-3")
     costmodel.reset_calibration()
     try:
         cal = costmodel.calibrate()
