@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-tpcds bench-gate bench-compare calibrate-report
+.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -107,6 +107,20 @@ bench-gate:
 # Ad-hoc: make bench-compare OLD=BENCH_r04.json NEW=BENCH_r05.json
 bench-compare:
 	$(PY) bench.py --compare $(OLD) $(NEW)
+
+# Regression-attribution triage (daft_tpu/tools/doctor.py): rank what got
+# slower between two bench captures (per-operator/counter deltas when the
+# captures carry per_query_profile, capture-level movement otherwise), or
+# triage flight-recorder anomaly dumps: make doctor DUMPS="dump1.json ...".
+# Defaults to the committed SF10 pair that bracketed the out-of-core tier.
+DOCTOR_OLD ?= BENCH_SF10_r04.json
+DOCTOR_NEW ?= BENCH_SF10_r05.json
+doctor:
+ifdef DUMPS
+	$(PY) -m daft_tpu.tools.doctor $(DUMPS)
+else
+	$(PY) -m daft_tpu.tools.doctor --compare $(DOCTOR_OLD) $(DOCTOR_NEW)
+endif
 
 # Cost-model calibration report (daft_tpu/tools/calibrate.py): run a forced
 # priced probe workload, replay the placement ledger's observed-vs-predicted

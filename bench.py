@@ -590,6 +590,8 @@ def ai_bench() -> None:
             elapsed = min(elapsed, time.perf_counter() - t0)
         repeat_weight_h2d = counters.device_udf_weight_h2d_bytes - w_warm
         metric_totals = {k: v for k, v in counters.snapshot().items() if v}
+        per_query_profile = _profile_pass(
+            {name: (lambda q=q: q().to_pydict()) for name, q in shapes.items()})
     assert counters.device_udf_dispatches > 0, \
         "device-UDF tier never dispatched — BENCH_SUITE=ai is not an ai capture"
     assert repeat_weight_h2d == 0, \
@@ -624,6 +626,7 @@ def ai_bench() -> None:
         "device_batches": int(metric_totals.get("device_udf_dispatches", 0)),
         "per_query_ms": {name: round(per_query[name] * 1000, 1)
                          for name in shapes},
+        "per_query_profile": per_query_profile,
         "bit_identical": True,
         "embed_bit_identical": bool(embed_ok),
         "labels": len(labels),
@@ -700,6 +703,13 @@ def oom_bench() -> None:
                     elapsed = min(elapsed, time.perf_counter() - t0)
         diff = registry().diff(reg_before)
         n_lineitem = tables["lineitem"].count_rows()
+        # per-operator attribution pass under the same budget, AFTER the
+        # registry diff so the profile run's own spill/scan deltas cannot
+        # inflate the capture-level totals above
+        with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+            per_query_profile = _profile_pass(
+                {f"q{q}": (lambda q=q: ALL_QUERIES[q](tables).to_pydict())
+                 for q in QUERIES})
 
     assert not mismatches, \
         f"budgeted results diverged from unbudgeted: {sorted(set(mismatches))}"
@@ -720,6 +730,7 @@ def oom_bench() -> None:
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
+        "per_query_profile": per_query_profile,
         "bit_identical": True,
         "memory_limit_bytes": budget,
         "dataset_bytes": int(total_bytes),
@@ -838,12 +849,77 @@ def compare(old_path: str, new_path: str) -> int:
               f"dispatches — run `make calibrate-report` and refresh the "
               f"DAFT_TPU_COST_* overrides")
     if regressions:
+        # regression attribution (doctor's lens, inline): name the top
+        # regressed queries with their operator/counter deltas so the FAIL
+        # line says WHAT got slower, not just that something did. Old
+        # captures without per_query_profile degrade to capture-level
+        # counter movement — the loader and attribution are shape-tolerant.
+        from daft_tpu.tools.doctor import attribution_lines
+
+        q_regressed = [r for r in regressions if r in old_q]
+        for line in attribution_lines(old, new, q_regressed):
+            print(line)
         print(f"FAIL: {len(regressions)} regression(s) > "
               f"{REGRESSION_TOLERANCE:.0%}: {', '.join(regressions)}")
+        top = sorted(q_regressed,
+                     key=lambda q: (new_q.get(q, 0) / old_q[q]) if old_q.get(q)
+                     else float("inf"), reverse=True)[:3]
+        if top:
+            print("worst offenders: "
+                  + "; ".join(f"{q} {new_q[q] / old_q[q]:.2f}x slower"
+                              for q in top if old_q.get(q) and q in new_q)
+                  + " — see attribution above for operator/counter deltas")
     else:
         print(f"OK: no regressions > {REGRESSION_TOLERANCE:.0%} "
               f"across {len(set(old_q) & set(new_q))} queries")
     return len(regressions)
+
+
+# counter families worth carrying per query in per_query_profile: the
+# engine-tax attribution set (scans/spills/ledger/shuffle/h2d + dispatch
+# shape). Everything else stays in the capture-level metrics dict.
+_PROFILE_COUNTER_PREFIXES = ("scan_", "spill_", "host_", "shuffle_", "hbm_",
+                             "device_", "mesh_", "dispatch_", "coalesce_")
+
+
+def _profile_pass(thunks: dict) -> dict:
+    """Per-operator profiles for the capture (schema v10): one extra
+    instrumented run per query AFTER the timed reps — the StatsCollector
+    compute/starve/blocked self-time split per physical operator plus the
+    per-query registry counter deltas for the engine-tax families
+    (scan/spill/ledger/shuffle/h2d). Runs after timing for the same reason
+    _save_profiles does: collector overhead never contaminates the headline
+    number. The result lands in the capture as per_query_profile — the raw
+    material doctor's regression attribution ranks when --compare fails."""
+    from daft_tpu.observability.metrics import registry
+    from daft_tpu.observability.runtime_stats import (StatsCollector,
+                                                      set_collector)
+
+    profile = {}
+    for label, run in thunks.items():
+        before = registry().snapshot()
+        collector = StatsCollector()
+        set_collector(collector)
+        try:
+            run()
+        finally:
+            set_collector(None)
+        deltas = {k: (int(v) if float(v).is_integer() else round(v, 6))
+                  for k, v in registry().diff(before).items()
+                  if k.startswith(_PROFILE_COUNTER_PREFIXES)}
+        ops = sorted(collector.finish(), key=lambda s: s.seconds, reverse=True)
+        profile[label] = {
+            "operators": [{
+                "name": s.name,
+                "rows": s.rows_out,
+                "seconds": round(s.seconds, 6),
+                "compute": round(s.compute_seconds, 6),
+                "starve": round(s.starve_seconds, 6),
+                "blocked": round(s.blocked_seconds, 6),
+            } for s in ops],
+            "counters": deltas,
+        }
+    return profile
 
 
 def _save_profiles(tables, ALL_QUERIES) -> None:
@@ -935,11 +1011,13 @@ def main() -> None:
             if rep == REPS - 1:
                 # one full pass over the query set: per-query registry deltas
                 # (device counters + shuffle bytes) summed for attribution.
-                # cost_*/placement_* series are process-cumulative (outside
-                # the counters.reset() scope) — summing them once per query
-                # would multiply them; they land below from live state
+                # cost_*/placement_*/flight_* series are process-cumulative
+                # (outside the counters.reset() scope) — summing them once per
+                # query would multiply them; cost/placement land below from
+                # live state, flight_* only moves on anomalies
                 for k, v in counters.snapshot().items():
-                    if v and not k.startswith(("cost_", "placement_")):
+                    if v and not k.startswith(("cost_", "placement_",
+                                               "flight_")):
                         metric_totals[k] = metric_totals.get(k, 0) + v
         elapsed = min(elapsed, time.perf_counter() - t0)
 
@@ -994,6 +1072,10 @@ def main() -> None:
     # (only present when the capture crossed a distributed shuffle).
     _derive_shuffle_ratios(metric_totals)
 
+    per_query_profile = _profile_pass(
+        {f"q{q}": (lambda q=q: ALL_QUERIES[q](tables).to_pydict())
+         for q in QUERIES})
+
     if os.environ.get("BENCH_PROFILE"):
         _save_profiles(tables, ALL_QUERIES)
 
@@ -1020,6 +1102,7 @@ def main() -> None:
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
         "device_batches": sum(q_device.values()),
         "per_query_ms": {f"q{q}": round(per_query[q] * 1000, 1) for q in QUERIES},
+        "per_query_profile": per_query_profile,
         "per_query_device": {f"q{q}": q_device[q] for q in QUERIES},
         "host_reasons": {f"q{q}": r for q, r in sorted(q_reject.items())},
         "placement": {f"q{q}": v for q, v in sorted(q_placement.items()) if v},

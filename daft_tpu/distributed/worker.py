@@ -708,6 +708,15 @@ class WorkerPool:
         # next dispatch pass): drained into the next traced run so EXPLAIN
         # ANALYZE still renders the failure its recovery responded to
         self._unattributed_recovery: List[tuple] = []
+        # idle-pool liveness: the dispatcher's idle loop runs a low-rate
+        # liveness check (see _idle_liveness_tick), so a worker that dies
+        # while NO stage is dispatching is still detected within one
+        # heartbeat timeout instead of on the next dispatch pass. Start the
+        # dispatcher at construction — lazily-on-first-run_tasks would leave
+        # an idle pool blind until its first query.
+        self._idle_check_t = 0.0
+        with self._pool_lock:
+            self._ensure_dispatcher()
 
     def scale_up(self, n: int = 1,
                  env: Optional[Dict[str, str]] = None) -> List[str]:
@@ -820,6 +829,7 @@ class WorkerPool:
                 if not has_work:
                     self._wake.wait(0.05)
                     self._wake.clear()
+                    self._idle_liveness_tick()
                     continue
                 self._dispatch_pass()
         except Exception as e:  # noqa: BLE001 — a dispatcher crash must fail callers loudly
@@ -1021,6 +1031,39 @@ class WorkerPool:
                         run, f"{sched.pending_count()} tasks unschedulable "
                              f"(no eligible workers)")
 
+    def _idle_liveness_tick(self) -> None:
+        """Low-rate liveness check for an IDLE pool (dispatcher thread, no
+        dispatch pass running). The _dispatch_pass liveness monitor only runs
+        while stages are in flight, so without this an idle pool never
+        noticed a kill -9'd worker — the dashboard's dead-worker marking and
+        the respawn path both waited for the next query. Same detection as
+        the dispatch-pass block: pump() first so a stale last_beat is real
+        silence, then connection-EOF / process-exit / heartbeat-timeout."""
+        if self._hb_timeout > 0:
+            interval = max(min(self._hb_timeout / 3.0, 2.0), 0.1)
+        else:
+            interval = 1.0  # EOF/exit detection still applies with beats off
+        now = time.time()
+        if now - self._idle_check_t < interval:
+            return
+        self._idle_check_t = now
+        for w in list(self.workers.values()):
+            if not (w.alive and w.failed_reason is None):
+                self._note_worker_death(w)
+                continue
+            w.pump()
+            if w.conn_dead:
+                w.mark_failed("connection closed")
+            elif (self._hb_timeout > 0
+                    and time.time() - w.last_beat > self._hb_timeout):
+                w.mark_failed(
+                    f"no heartbeat for {self._hb_timeout:.1f}s "
+                    f"(interval {self._hb_interval:.1f}s)")
+            if not w.alive or w.failed_reason is not None:
+                self._note_worker_death(w)
+        if self._pending_respawns > 0:
+            self._maybe_respawn()
+
     def _note_worker_death(self, w: WorkerProcess) -> bool:
         """Handle one dead worker: counters + death ledger, requeue its
         in-flight tasks (excluding it), drop it from scheduler and pool, and
@@ -1030,6 +1073,11 @@ class WorkerPool:
         rc = w._proc.poll()
         reason = w.failed_reason or f"process exited (code {rc})"
         registry().inc("worker_failures_total")
+        from ..observability import flight as _flight
+
+        frec = _flight.recorder()
+        if frec is not None:
+            frec.note_worker_death(w.worker_id, reason)
         self.dead_workers[w.worker_id] = {"ts": now, "reason": reason}
         self._death_events.append(
             {"worker_id": w.worker_id, "ts": now, "reason": reason})

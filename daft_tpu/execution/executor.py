@@ -462,11 +462,27 @@ def _streaming_scan(node) -> Iterator[MicroPartition]:
     if c is not None:
         c.annotate(node, f"streaming: {len(node.tasks)} tasks")
 
+    # per-morsel accounting is LOCAL (one list, no registry lock) and
+    # flushed per scan task: the unbudgeted fast path pays neither three
+    # locked increments nor the arrow-buffer walk of size_bytes() per
+    # morsel — scan_bytes is only meaningful (and only counted) when a
+    # budget makes morsel sizing load-bearing
+    acc = [0, 0, 0]  # batches, rows, bytes
+
     def count(part: MicroPartition) -> MicroPartition:
-        reg.inc("scan_batches")
-        reg.inc("scan_rows", part.num_rows)
-        reg.inc("scan_bytes", part.size_bytes())
+        acc[0] += 1
+        acc[1] += part.num_rows
+        if budgeted:
+            acc[2] += part.size_bytes()
         return part
+
+    def flush() -> None:
+        if acc[0]:
+            reg.inc("scan_batches", acc[0])
+            reg.inc("scan_rows", acc[1])
+            if acc[2]:
+                reg.inc("scan_bytes", acc[2])
+            acc[0] = acc[1] = acc[2] = 0
 
     def task_parts(task) -> Iterator[MicroPartition]:
         inner = task.read()
@@ -476,41 +492,48 @@ def _streaming_scan(node) -> Iterator[MicroPartition]:
                 part = _filter_part(part, node.post_filter)
             yield part
 
-    remaining = node.post_limit
-    if remaining is not None or len(node.tasks) <= 1 or not _pipeline_on():
-        # fully streaming: one morsel resident at a time per task
-        for task in node.tasks:
-            if budgeted:
-                mgr.wait_for_headroom()
-            for part in task_parts(task):
-                if remaining is not None:
-                    if remaining <= 0:
-                        return
-                    if part.num_rows > remaining:
-                        part = part.head(remaining)
-                    remaining -= part.num_rows
-                yield count(part)
+    try:
+        remaining = node.post_limit
+        if remaining is not None or len(node.tasks) <= 1 or not _pipeline_on():
+            # fully streaming: one morsel resident at a time per task
+            for task in node.tasks:
+                if budgeted:
+                    mgr.wait_for_headroom()
+                for part in task_parts(task):
+                    if remaining is not None:
+                        if remaining <= 0:
+                            return
+                        if part.num_rows > remaining:
+                            part = part.head(remaining)
+                        remaining -= part.num_rows
+                    yield count(part)
+                    if budgeted and mgr.under_pressure():
+                        mgr.wait_for_headroom()
+                flush()
+            return
+
+        # IO-parallel scan with a bounded in-flight window: each future
+        # materializes ONE (split) task, so in-flight memory is bounded by
+        # window x scan_split_bytes instead of the whole dataset
+        def read_task(task):
+            return list(task_parts(task))
+
+        window = compute_pool()._max_workers
+        futures = []
+        ti = 0
+        while ti < len(node.tasks) or futures:
+            while ti < len(node.tasks) and len(futures) < window:
                 if budgeted and mgr.under_pressure():
                     mgr.wait_for_headroom()
-        return
-
-    # IO-parallel scan with a bounded in-flight window: each future
-    # materializes ONE (split) task, so in-flight memory is bounded by
-    # window x scan_split_bytes instead of the whole dataset
-    def read_task(task):
-        return list(task_parts(task))
-
-    window = compute_pool()._max_workers
-    futures = []
-    ti = 0
-    while ti < len(node.tasks) or futures:
-        while ti < len(node.tasks) and len(futures) < window:
-            if budgeted and mgr.under_pressure():
-                mgr.wait_for_headroom()
-            futures.append(compute_pool().submit(read_task, node.tasks[ti]))
-            ti += 1
-        for part in futures.pop(0).result():
-            yield count(part)
+                futures.append(compute_pool().submit(read_task, node.tasks[ti]))
+                ti += 1
+            for part in futures.pop(0).result():
+                yield count(part)
+            flush()
+    finally:
+        # early close (limit hit, failed consumer) still lands the partial
+        # task's counts — scan_rows stays exact for what was yielded
+        flush()
 
 
 def _agg_morsel_rows() -> int:

@@ -91,6 +91,7 @@ class HostMemoryManager:
         if nbytes <= 0:
             return
         fire = None
+        crossed = False
         with self._cond:
             self._tracked += nbytes
             if self._tracked > self._high_water:
@@ -100,12 +101,22 @@ class HostMemoryManager:
                     s._peak = self._tracked
             registry().set_gauge("host_bytes_tracked", float(self._tracked))
             registry().set_gauge("host_bytes_high_water", float(self._high_water))
-            if not self._in_pressure and self._pressure_cbs \
-                    and self._under_pressure_locked():
+            # crossing detection is independent of callback registration:
+            # the flight recorder must see pressure crossings even with no
+            # on_pressure subscribers attached
+            if not self._in_pressure and self._under_pressure_locked():
                 self._in_pressure = True
-                fire = list(self._pressure_cbs)
+                crossed = True
+                if self._pressure_cbs:
+                    fire = list(self._pressure_cbs)
             elif self._in_pressure and not self._under_pressure_locked():
                 self._in_pressure = False
+        if crossed:
+            from ..observability import flight as _flight
+
+            frec = _flight.recorder()
+            if frec is not None:
+                frec.note_pressure(self._tracked, self.limit_bytes())
         if fire:
             tracked, limit = self._tracked, self.limit_bytes()
             for cb in fire:

@@ -6,6 +6,7 @@ vocabulary), daft-local-execution/src/runtime_stats (rows/time per node).
 """
 
 from .events import (
+    FlightAnomaly,
     OperatorStats,
     QueryEnd,
     QueryOptimized,
@@ -29,6 +30,7 @@ from .runtime_stats import (SpanRecorder, StatsCollector, current_collector,
                             current_spans, profile_span, set_spans)
 
 __all__ = [
+    "FlightAnomaly",
     "OperatorStats",
     "QueryEnd",
     "QueryOptimized",

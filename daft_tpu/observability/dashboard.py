@@ -384,6 +384,24 @@ class _Handler(BaseHTTPRequestHandler):
             # admission waits, p50/p99) — the hit-rate table's data source
             body = json.dumps(self.server.state.serving(), default=str).encode()
             ctype = "application/json"
+        elif self.path.startswith("/api/flight"):
+            # the flight recorder's live ring + anomaly dump inventory
+            # (observability/flight.py) — what `doctor` reads from disk,
+            # served hot for a dashboard triage view
+            from . import flight
+
+            frec = flight.recorder()
+            if frec is None:
+                body = json.dumps({"enabled": False}).encode()
+            else:
+                body = json.dumps({
+                    "enabled": True,
+                    "ring": frec.snapshot(limit=128),
+                    "ring_dropped": frec.dropped,
+                    "dump_dir": frec.dump_dir,
+                    "dumps": list(frec.dumps),
+                }, default=str).encode()
+            ctype = "application/json"
         elif self.path.startswith("/api/placement"):
             # the cost-model decision ledger: recent placement records
             # (chosen tier, per-term breakdowns, observed-vs-predicted),

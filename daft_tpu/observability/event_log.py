@@ -41,7 +41,11 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # cached/forced flags, margin, and observed-vs-predicted device seconds for
 # dispatched stages; observability/placement.py); query_end.metrics may carry
 # the placement_* counters and the cost_* calibration/error gauges.
-SCHEMA_VERSION = 9
+# v10: adds the flight_anomaly record kind (observability/flight.py — kind,
+# detail, query_id, tenant, dump_path); query_end.metrics may carry the
+# flight_* counters; bench captures gain per_query_profile (per-query
+# operator compute/starve/blocked splits + counter deltas).
+SCHEMA_VERSION = 10
 
 
 class EventLogSubscriber(Subscriber):
@@ -87,6 +91,9 @@ class EventLogSubscriber(Subscriber):
 
     def on_serve_query(self, rec) -> None:
         self._emit("serve_query", dataclasses.asdict(rec))
+
+    def on_flight_anomaly(self, e) -> None:
+        self._emit("flight_anomaly", dataclasses.asdict(e))
 
     def on_query_end(self, e) -> None:
         d = dataclasses.asdict(e)

@@ -127,6 +127,25 @@ class WorkerHeartbeat:
 
 
 @dataclass(frozen=True)
+class FlightAnomaly:
+    """One flight-recorder anomaly trigger (observability/flight.py): the
+    recorder noticed a slow query (wall clock > k x its plan-fingerprint
+    EMA), a query error, a host-ledger pressure crossing, a DeviceFallback,
+    or a worker death, and (cooldown permitting) snapshotted its ring to
+    `dump_path`. `tenant` is set for serving-tier anomalies; dumps for a
+    tenant-tagged anomaly carry only that tenant's ring events plus
+    engine-global ones (no cross-tenant bleed)."""
+
+    kind: str                  # slow_query | query_error | ledger_pressure |
+                               # device_fallback | worker_death
+    detail: str = ""
+    query_id: str = ""
+    tenant: str = ""
+    dump_path: str = ""        # empty when suppressed by cooldown or failed
+    ts: float = 0.0
+
+
+@dataclass(frozen=True)
 class QueryEnd:
     query_id: str
     rows: int

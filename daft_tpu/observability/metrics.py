@@ -217,6 +217,15 @@ FAULT_COUNTER_NAMES = (
 # so a broken subscriber can't fail a query — counted so it isn't invisible).
 OBS_COUNTER_NAMES = ("subscriber_errors",)
 
+# Flight recorder (observability/flight.py): ONLY anomalies touch the
+# registry — ring appends and cap eviction are registry-silent so the
+# always-on recorder preserves the per-query empty-diff guarantee.
+FLIGHT_COUNTER_NAMES = (
+    "flight_anomalies_total",  # anomaly triggers fired (incl. cooldown-suppressed)
+    "flight_dumps_total",      # ring snapshots written to the dump dir
+    "flight_dump_failures",    # dump writes that failed (unwritable dir)
+)
+
 # Placement observability (observability/placement.py): the cost-model
 # decision ledger. Counters move ONLY on costed/forced placement decisions —
 # pre-cost gate rejections (cpu backend, below device_min_rows) are ledger
@@ -250,7 +259,9 @@ SPILL_COUNTER_NAMES = (
 MEMORY_COUNTER_NAMES = (
     "scan_batches",             # morsels yielded by streaming scans
     "scan_rows",                # rows through streaming scans
-    "scan_bytes",               # logical bytes through streaming scans
+    "scan_bytes",               # logical bytes through BUDGETED streaming scans
+                                # (sizing morsels walks arrow buffers — skipped
+                                # on the unbudgeted zero-overhead path)
     "scan_tasks_split",         # scan tasks produced by row-group splitting
     "scan_tasks_merged",        # small scan tasks absorbed by task merging
     "scan_backpressure_stalls", # times a scan stalled on host memory pressure
@@ -261,7 +272,8 @@ MEMORY_COUNTER_NAMES = (
 DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
                      SHUFFLE_COUNTER_NAMES + FAULT_COUNTER_NAMES +
                      SPILL_COUNTER_NAMES + MEMORY_COUNTER_NAMES +
-                     OBS_COUNTER_NAMES + PLACEMENT_COUNTER_NAMES)
+                     OBS_COUNTER_NAMES + PLACEMENT_COUNTER_NAMES +
+                     FLIGHT_COUNTER_NAMES)
 
 DECLARED_GAUGES = (
     "serve_queue_depth",       # admission queue depth (serving/session.py)

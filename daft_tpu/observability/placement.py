@@ -416,6 +416,15 @@ class feedback:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
+        # flight-recorder hook BEFORE the rec-is-None early return: a
+        # DeviceFallback is an anomaly whether or not this decision is being
+        # ledger-recorded (matched by name — the import discipline below)
+        if exc is not None and type(exc).__name__ == "DeviceFallback":
+            from . import flight as _flight
+
+            frec = _flight.recorder()
+            if frec is not None:
+                frec.note_fallback(f"{type(exc).__name__}: {exc}")
         if self._scope is None:
             return False
         wall = time.perf_counter() - self._t0

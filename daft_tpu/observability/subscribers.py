@@ -12,8 +12,8 @@ import threading
 from typing import List
 
 from .metrics import registry
-from .events import (OperatorStats, QueryEnd, QueryOptimized, QueryStart,
-                     ServeQueryRecord, ShuffleStats, TaskStats,
+from .events import (FlightAnomaly, OperatorStats, QueryEnd, QueryOptimized,
+                     QueryStart, ServeQueryRecord, ShuffleStats, TaskStats,
                      WorkerHeartbeat)
 
 
@@ -47,6 +47,13 @@ class Subscriber:
     def on_serve_query(self, rec: ServeQueryRecord) -> None:  # pragma: no cover
         """One query served through a ServingSession (per-tenant latency,
         prepared-cache hit, admission wait) — see daft_tpu/serving/."""
+        pass
+
+    def on_flight_anomaly(self, event: FlightAnomaly) -> None:  # pragma: no cover
+        """The flight recorder fired an anomaly trigger (slow query, query
+        error, ledger pressure, device fallback, worker death) — see
+        daft_tpu/observability/flight.py. event.dump_path names the ring
+        snapshot when one was written."""
         pass
 
     def on_query_end(self, event: QueryEnd) -> None:  # pragma: no cover

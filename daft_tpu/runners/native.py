@@ -26,24 +26,30 @@ class NativeRunner(Runner):
 
         from ..execution.executor import execute_plan
         from ..observability import (QueryEnd, QueryOptimized, QueryStart,
-                                     notify, subscribers_active)
+                                     flight, notify, subscribers_active)
         from ..observability.runtime_stats import StatsCollector, set_collector
         from ..plan.physical import translate
 
         observed = subscribers_active()
-        qid = uuid.uuid4().hex[:12] if observed else ""
+        # the flight recorder records EVERY query (bounded ring, anomaly
+        # triggers), not just subscriber-observed ones; None when disabled
+        frec = flight.recorder()
+        qid = uuid.uuid4().hex[:12] if (observed or frec is not None) else ""
         t_start = time.perf_counter()
         reg_before = {}
-        if observed:
+        if observed or frec is not None:
             from ..observability.metrics import registry
 
             # per-query engine-path attribution (device batches, shuffle
-            # bytes): counter deltas land in QueryEnd.metrics
+            # bytes): counter deltas land in QueryEnd.metrics and the
+            # flight ring's query record
             reg_before = registry().snapshot()
+        if observed:
             notify("on_query_start", QueryStart(qid, builder.plan.display()))
         t0 = time.perf_counter()
         optimized = builder.optimize()
         phys = translate(optimized.plan)
+        fkey = flight.plan_key(phys.display()) if frec is not None else ""
         if observed:
             notify("on_query_optimized", QueryOptimized(
                 qid, optimized.plan.display(), phys.display(),
@@ -93,13 +99,23 @@ class NativeRunner(Runner):
         finally:
             set_collector(prev)
             placement.set_scope(prev_scope)
-            if observed:
+            seconds = time.perf_counter() - t_start
+            deltas = {}
+            if observed or frec is not None:
                 from ..observability.metrics import registry
 
+                deltas = registry().diff(reg_before)
+            placements = pscope.to_dicts() if pscope is not None else []
+            if observed:
                 stats = collector.finish() if collector else []
                 for s in stats:
                     notify("on_operator_stats", qid, s)
                 notify("on_query_end", QueryEnd(
-                    qid, rows, time.perf_counter() - t_start, err, stats,
-                    metrics=registry().diff(reg_before),
-                    placements=pscope.to_dicts() if pscope is not None else []))
+                    qid, rows, seconds, err, stats,
+                    metrics=deltas, placements=placements))
+            if frec is not None:
+                # always-on black box: the query record + the slow-query /
+                # query-error anomaly checks (observability/flight.py)
+                frec.note_query(fkey, seconds, query_id=qid, rows=rows,
+                                error=err, metrics=deltas,
+                                placements=placements or None)

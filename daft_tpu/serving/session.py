@@ -231,6 +231,7 @@ class ServingSession:
         fut = ticket.future
         t0 = time.perf_counter()
         cfg = execution_config()
+        entry = None
         err: Optional[str] = None
         rows = 0
         hit = False
@@ -327,3 +328,25 @@ class ServingSession:
                 admission_wait_s=wait_s, est_pin_bytes=est, error=err,
                 admission_waited=waited,
                 in_process=self._runner is None))
+        from ..observability import flight as _flight
+
+        frec = _flight.recorder()
+        if frec is not None:
+            # tenant-tagged flight record: metrics stay OFF the record —
+            # concurrent tenants share one process registry, so a per-query
+            # delta here would bleed other tenants' counters into this
+            # tenant's ring events (and their anomaly dumps)
+            if waited:
+                frec.record("admission", tenant=ticket.tenant,
+                            query_id=fut.query_id,
+                            wait_s=round(wait_s, 6), est_pin_bytes=est)
+            if isinstance(exc, QueryCancelled):
+                # a client-initiated cancel is not an engine anomaly: ring
+                # record only, no query_error trigger
+                frec.record("cancelled", tenant=ticket.tenant,
+                            query_id=fut.query_id, seconds=round(seconds, 6))
+            else:
+                fp = str(getattr(entry, "fingerprint", "") or "")
+                frec.note_query(_flight.plan_key(fp) if fp else "", seconds,
+                                query_id=fut.query_id, tenant=ticket.tenant,
+                                rows=rows, error=err)

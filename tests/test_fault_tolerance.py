@@ -214,6 +214,43 @@ def test_heartbeat_timeout_detects_sigstopped_worker(monkeypatch):
 
 
 @requires_fault_injection
+def test_idle_pool_liveness_detects_kill9_without_work(monkeypatch, tmp_path):
+    """A kill -9'd worker in an IDLE pool (no run_tasks in flight) is
+    declared dead within about one heartbeat timeout by the dispatcher's
+    idle liveness tick — death detection must not wait for the next query.
+    The flight recorder's worker_death anomaly dump rides along."""
+    from daft_tpu.distributed.worker import WorkerPool
+    from daft_tpu.observability import flight
+
+    monkeypatch.setenv("DAFT_TPU_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("DAFT_TPU_HEARTBEAT_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("DAFT_TPU_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("DAFT_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("DAFT_TPU_ANOMALY_COOLDOWN_S", "0")
+    flight._reset_for_tests()
+    fail0 = registry().get("worker_failures_total")
+    pool = WorkerPool(2)
+    try:
+        # warm both workers, then go fully idle
+        assert len(pool.run_tasks(_scan_tasks(2))) == 2
+        kill9(pool, "worker-0")
+        # no run_tasks from here on: only the idle tick can notice. The
+        # process exit is caught via poll() (faster than the heartbeat
+        # timeout); allow a couple of tick intervals of slack.
+        wait_until(lambda: "worker-0" in pool.dead_workers, timeout_s=5.0,
+                   what="idle liveness tick declaring the killed worker dead")
+        assert "worker-0" not in pool.workers  # dropped, not zombie-polled
+        # the survivor keeps serving
+        assert len(pool.run_tasks(_scan_tasks(2))) == 2
+    finally:
+        pool.shutdown()
+        flight._reset_for_tests()
+    assert registry().get("worker_failures_total") - fail0 == 1
+    dumps = list(tmp_path.glob("flight_worker_death_*.json"))
+    assert dumps, "worker death never reached the flight recorder"
+
+
+@requires_fault_injection
 def test_respawn_cap_honored(monkeypatch):
     """DAFT_TPU_WORKER_RESPAWN=1: the first death spawns one replacement;
     the second death does not (cap), and the pool keeps serving on the

@@ -139,6 +139,59 @@ def test_scan_backpressure_stalls_bounded(big_file):
     assert registry().get("scan_stall_ms") > 0
 
 
+def test_unbudgeted_scan_skips_sizing_and_ledger_reads(big_file, monkeypatch):
+    """Zero-overhead guard for the unbudgeted fast path: with the ledger
+    unbounded the scan must not size morsels (the arrow-buffer walk behind
+    size_bytes), must never consult the ledger's admit/stall surface, and
+    must flush its batch/row counts per TASK, not per morsel (no per-morsel
+    registry lock traffic). scan_bytes stays zero — it is only meaningful
+    when a budget makes morsel sizing load-bearing."""
+    from daft_tpu.core.micropartition import MicroPartition
+
+    path, t = big_file
+    size = os.path.getsize(path)
+    m = manager()
+    calls = {"size_bytes": 0, "under_pressure": 0, "wait_for_headroom": 0}
+    orig_size = MicroPartition.size_bytes
+
+    def counting_size(self):
+        calls["size_bytes"] += 1
+        return orig_size(self)
+
+    monkeypatch.setattr(MicroPartition, "size_bytes", counting_size)
+    monkeypatch.setattr(m, "under_pressure", lambda: (
+        calls.__setitem__("under_pressure", calls["under_pressure"] + 1)
+        or False))
+    monkeypatch.setattr(m, "wait_for_headroom", lambda *a, **k: (
+        calls.__setitem__("wait_for_headroom",
+                          calls["wait_for_headroom"] + 1)))
+    inc_names = []
+    reg = registry()
+    orig_inc = reg.inc
+
+    def counting_inc(name, n=1):
+        inc_names.append(name)
+        orig_inc(name, n)
+
+    monkeypatch.setattr(reg, "inc", counting_inc)
+    with execution_config_ctx(memory_limit_bytes=0,
+                              scan_split_bytes=max(size // 5, 1),
+                              device_mode="off"):
+        df = dt.read_parquet(path)
+        n_tasks = len(_streaming_scans(_physical(df))[0].tasks)
+        out = df.to_pydict()
+    assert out["a"] == t.column("a").to_pylist()
+    assert calls["size_bytes"] == 0, "unbudgeted scan walked arrow buffers"
+    assert calls["under_pressure"] == 0 and calls["wait_for_headroom"] == 0, \
+        "unbudgeted scan consulted the ledger per morsel"
+    assert registry().get("scan_bytes") == 0
+    # 10 row groups split across n_tasks: flush granularity is per task
+    scan_incs = inc_names.count("scan_rows")
+    assert 0 < scan_incs <= n_tasks + 1, \
+        f"{scan_incs} scan_rows incs for {n_tasks} tasks — per-morsel flush?"
+    assert registry().get("scan_rows") == N_ROWS
+
+
 def test_streaming_scan_feeds_spilling_sort_exactly(big_file):
     """End-to-end out-of-core pipeline: streaming scan -> external sort under
     a budget far below the file size, bit-identical to unbudgeted."""
