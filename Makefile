@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom bench bench-ai bench-mesh bench-serve bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor
+.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom test-gateway bench bench-ai bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -69,6 +69,25 @@ bench-mesh:
 # hbm_h2d flat across repeats (bench.py serve_bench).
 bench-serve:
 	env BENCH_SERVE=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Gateway capture: the same mixed stream replayed over the WIRE — an
+# in-process gateway serving a multi-process client swarm (bench.py
+# serve_bench_net): p50/p99/QPS, result-cache hit rate, warm-vs-uncached
+# repeat latency, bit-identical vs in-process serial.
+bench-serve-net:
+	env BENCH_SERVE=1 BENCH_SERVE_NET=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Wire-layer gateway suite: auth, framing, reconnect-resume, concurrent
+# tenants, result-cache invalidation/eviction, QoS caps, kill -9 resume.
+test-gateway:
+	$(TIMEOUT_CMD) env JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_gateway.py -q -p no:cacheprovider
+
+# Run the serving gateway standalone (the network front door). Override:
+# make serve SERVE_ARGS="--port 8642 --demo-rows 200000".
+SERVE_ARGS ?= --port 8642 --demo-rows 200000
+serve:
+	env JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m daft_tpu.gateway $(SERVE_ARGS)
 
 # Out-of-core suite: host memory manager ledger/pressure semantics,
 # streaming-scan split planning + backpressure, tiny-budget (~10% of input

@@ -7,6 +7,7 @@ vocabulary), daft-local-execution/src/runtime_stats (rows/time per node).
 
 from .events import (
     FlightAnomaly,
+    GatewayQueryRecord,
     OperatorStats,
     QueryEnd,
     QueryOptimized,
@@ -31,6 +32,7 @@ from .runtime_stats import (SpanRecorder, StatsCollector, current_collector,
 
 __all__ = [
     "FlightAnomaly",
+    "GatewayQueryRecord",
     "OperatorStats",
     "QueryEnd",
     "QueryOptimized",

@@ -110,6 +110,8 @@ class DashboardState(Subscriber):
         # totals for the hit-rate table (/api/serving)
         self.tenant_latency: dict = {}
         self._serving: dict = {}
+        # gateway tier: per-tenant wire rollup (/api/gateway)
+        self._gateway: dict = {}
 
     def on_query_start(self, event: QueryStart) -> None:
         rec = {"query_id": event.query_id, "started": time.time(),
@@ -248,6 +250,42 @@ class DashboardState(Subscriber):
             }
         return out
 
+    def on_gateway_query(self, rec) -> None:
+        """One gateway query (execute->fetch over the wire): accumulate the
+        per-tenant wire rollup, split by result tier. Engine-side latency
+        already lands via on_serve_query (the gateway executes through a
+        ServingSession), so only wire-level totals accrue here."""
+        with self._lock:
+            st = self._gateway.setdefault(rec.tenant, {
+                "queries": 0, "errors": 0, "bytes_streamed": 0, "rows": 0,
+                "seconds": 0.0, "executed": 0, "result_cache": 0,
+                "checkpoint": 0})
+            st["queries"] += 1
+            st["seconds"] += rec.seconds
+            st["rows"] += rec.rows
+            st["bytes_streamed"] += rec.bytes_streamed
+            if rec.source in st:
+                st[rec.source] += 1
+            if rec.error:
+                st["errors"] += 1
+
+    def gateway(self) -> dict:
+        """Per-tenant gateway rollup: wire queries by result tier (executed /
+        result_cache / checkpoint), cache-hit RATE, bytes streamed, mean wire
+        latency — /api/gateway's data source."""
+        with self._lock:
+            tenants = {k: dict(v) for k, v in self._gateway.items()}
+        out = {}
+        for tenant, st in tenants.items():
+            n = max(st["queries"], 1)
+            out[tenant] = {
+                **st,
+                "cache_hit_rate":
+                    round((st["result_cache"] + st["checkpoint"]) / n, 4),
+                "mean_s": st["seconds"] / n,
+            }
+        return out
+
     def on_query_end(self, event: QueryEnd) -> None:
         self.query_latency.observe(event.seconds)
         with self._lock:
@@ -383,6 +421,18 @@ class _Handler(BaseHTTPRequestHandler):
             # per-tenant serving rollup (queries, prepared hit rate,
             # admission waits, p50/p99) — the hit-rate table's data source
             body = json.dumps(self.server.state.serving(), default=str).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/gateway"):
+            # per-tenant gateway rollup (wire queries by result tier, cache
+            # hit rate, bytes streamed) + the process result-cache counters
+            from .metrics import registry as _registry
+
+            snap = _registry().snapshot()
+            body = json.dumps({
+                "tenants": self.server.state.gateway(),
+                "counters": {k: v for k, v in snap.items()
+                             if k.startswith(("gateway_", "result_cache_"))},
+            }, default=str).encode()
             ctype = "application/json"
         elif self.path.startswith("/api/flight"):
             # the flight recorder's live ring + anomaly dump inventory

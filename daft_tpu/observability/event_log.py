@@ -45,7 +45,11 @@ from .subscribers import Subscriber, attach_subscriber, detach_subscriber
 # detail, query_id, tenant, dump_path); query_end.metrics may carry the
 # flight_* counters; bench captures gain per_query_profile (per-query
 # operator compute/starve/blocked splits + counter deltas).
-SCHEMA_VERSION = 10
+# v11: adds the gateway_query record kind (daft_tpu/gateway/ — tenant,
+# seconds, rows, source executed|result_cache|checkpoint, bytes_streamed,
+# prepared_handle; see events.GatewayQueryRecord); query_end.metrics and
+# serve captures may carry the gateway_*/result_cache_* counters.
+SCHEMA_VERSION = 11
 
 
 class EventLogSubscriber(Subscriber):
@@ -91,6 +95,9 @@ class EventLogSubscriber(Subscriber):
 
     def on_serve_query(self, rec) -> None:
         self._emit("serve_query", dataclasses.asdict(rec))
+
+    def on_gateway_query(self, rec) -> None:
+        self._emit("gateway_query", dataclasses.asdict(rec))
 
     def on_flight_anomaly(self, e) -> None:
         self._emit("flight_anomaly", dataclasses.asdict(e))

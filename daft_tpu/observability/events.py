@@ -189,3 +189,25 @@ class ServeQueryRecord:
     # fired); False when a runner executed it (QueryEnd fired too — consumers
     # aggregating both event kinds must not double-count such queries)
     in_process: bool = True
+
+
+@dataclass(frozen=True)
+class GatewayQueryRecord:
+    """One query answered over the gateway wire protocol (daft_tpu/gateway/).
+
+    Emitted when the fetch stream completes (or fails) — it records the
+    NETWORK view of the query: where the bytes came from (``source``) and how
+    many hit the wire. Queries that actually executed ALSO emit a
+    ServeQueryRecord from the underlying ServingSession; result-cache and
+    checkpoint-restored answers never reach the session, so this record is
+    the only telemetry they produce."""
+
+    query_id: str
+    tenant: str
+    seconds: float             # execute accepted -> fetch stream finished
+    rows: int
+    # executed | result_cache | checkpoint — which tier answered
+    source: str
+    bytes_streamed: int        # compressed Arrow IPC payload bytes sent
+    prepared_handle: str = ""  # non-empty when executed via a prepared handle
+    error: Optional[str] = None

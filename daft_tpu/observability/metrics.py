@@ -183,7 +183,28 @@ DEVICE_COUNTER_NAMES = (
 # Serving-tier counters OUTSIDE the ops/counters.py reset scope (cancellation
 # is resolved on the session thread; a bench/test device-counter reset must
 # not wipe it mid-session).
-SERVING_COUNTER_NAMES = ("serve_cancelled_total",)
+SERVING_COUNTER_NAMES = (
+    "serve_cancelled_total",
+    "serve_over_cap_rejections",  # submits refused at a tenant queue-depth cap
+)
+
+# Gateway tier (daft_tpu/gateway/): the wire-protocol serving front door and
+# its cross-tenant result cache. Connection/auth/protocol failures count here
+# (they never reach a ServeQueryRecord); result-cache hits make repeat
+# traffic skip execution entirely, so the hit/miss split is the headline
+# serving-economics number.
+GATEWAY_COUNTER_NAMES = (
+    "gateway_connections_total",   # TCP connections accepted
+    "gateway_disconnects_total",   # connections closed (any reason)
+    "gateway_requests_total",      # wire requests served (all verbs)
+    "gateway_queries_total",       # execute verbs admitted (any source)
+    "gateway_auth_failures",       # hello rejected (bad token / unknown tenant)
+    "gateway_errors_total",        # protocol/IO errors answered or logged
+    "gateway_bytes_streamed",      # Arrow IPC payload bytes sent to clients
+    "result_cache_hits",           # queries served from the result cache
+    "result_cache_misses",         # result-cache lookups that executed
+    "result_cache_evictions",      # entries evicted under the byte budget
+)
 
 # Shuffle/transport volume (distributed/shuffle.py ShuffleRecorder rollups,
 # distributed/fetch_server.py).
@@ -270,6 +291,7 @@ MEMORY_COUNTER_NAMES = (
 )
 
 DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
+                     GATEWAY_COUNTER_NAMES +
                      SHUFFLE_COUNTER_NAMES + FAULT_COUNTER_NAMES +
                      SPILL_COUNTER_NAMES + MEMORY_COUNTER_NAMES +
                      OBS_COUNTER_NAMES + PLACEMENT_COUNTER_NAMES +
@@ -277,6 +299,8 @@ DECLARED_COUNTERS = (DEVICE_COUNTER_NAMES + SERVING_COUNTER_NAMES +
 
 DECLARED_GAUGES = (
     "serve_queue_depth",       # admission queue depth (serving/session.py)
+    "result_cache_bytes",      # gateway result-cache resident payload bytes
+    "gateway_active_connections",  # live gateway client connections
     "hbm_bytes_resident",      # device bytes the residency manager holds
     "hbm_bytes_high_water",
     "hbm_reserved_bytes",      # admission-controller reservations outstanding

@@ -12,9 +12,9 @@ import threading
 from typing import List
 
 from .metrics import registry
-from .events import (FlightAnomaly, OperatorStats, QueryEnd, QueryOptimized,
-                     QueryStart, ServeQueryRecord, ShuffleStats, TaskStats,
-                     WorkerHeartbeat)
+from .events import (FlightAnomaly, GatewayQueryRecord, OperatorStats,
+                     QueryEnd, QueryOptimized, QueryStart, ServeQueryRecord,
+                     ShuffleStats, TaskStats, WorkerHeartbeat)
 
 
 class Subscriber:
@@ -47,6 +47,12 @@ class Subscriber:
     def on_serve_query(self, rec: ServeQueryRecord) -> None:  # pragma: no cover
         """One query served through a ServingSession (per-tenant latency,
         prepared-cache hit, admission wait) — see daft_tpu/serving/."""
+        pass
+
+    def on_gateway_query(self, rec: GatewayQueryRecord) -> None:  # pragma: no cover
+        """One query answered over the gateway wire protocol (per-tenant
+        bytes streamed + which tier answered: executed, result cache, or
+        checkpoint restore) — see daft_tpu/gateway/."""
         pass
 
     def on_flight_anomaly(self, event: FlightAnomaly) -> None:  # pragma: no cover

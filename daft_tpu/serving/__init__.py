@@ -24,7 +24,8 @@ subscribers (dashboard per-tenant hit-rate table, event log schema v7).
 """
 
 from ..cancellation import QueryCancelled
-from .admission import FairAdmissionQueue
+from .admission import (FairAdmissionQueue, TenantQueueFull, tenant_queue_cap,
+                        tenant_weight)
 from .prepared import PreparedQueryCache, estimate_pin_bytes, plan_structure
 from .session import ServeFuture, ServingSession
 
@@ -34,6 +35,9 @@ __all__ = [
     "QueryCancelled",
     "ServeFuture",
     "ServingSession",
+    "TenantQueueFull",
     "estimate_pin_bytes",
     "plan_structure",
+    "tenant_queue_cap",
+    "tenant_weight",
 ]

@@ -226,6 +226,30 @@ def triage_dump(dump: dict, path: str = "") -> List[str]:
         findings.append((100, f"{len(errors)} errored quer"
                          f"{'ies' if len(errors) != 1 else 'y'} in the ring; "
                          f"last: {last.get('query_id', '?')}: {last['error']}"))
+    # gateway-tier findings: anomaly dumps fired by the serving gateway
+    # (daft_tpu/gateway) carry their cause in the dump header and/or the
+    # gateway counters in `metrics` — triage-able with no server access
+    if dump.get("kind") == "gateway_error":
+        findings.append((98, f"gateway error: {dump.get('detail', '?')} — "
+                         f"auth_failures="
+                         f"{int(metrics.get('gateway_auth_failures', 0))}, "
+                         f"wire errors="
+                         f"{int(metrics.get('gateway_errors_total', 0))} over "
+                         f"{int(metrics.get('gateway_connections_total', 0))} "
+                         f"connection(s)"))
+    if dump.get("kind") == "cache_thrash":
+        findings.append((85, f"result-cache thrash: {dump.get('detail', '?')}"))
+    rc_hits = metrics.get("result_cache_hits", 0)
+    rc_miss = metrics.get("result_cache_misses", 0)
+    if rc_hits or rc_miss:
+        rate = rc_hits / max(rc_hits + rc_miss, 1)
+        sev = 58 if (rate < 0.5 and dump.get("kind") != "cache_thrash") else 15
+        findings.append((sev, f"result cache: {int(rc_hits)} hit(s) / "
+                         f"{int(rc_miss)} miss(es) ({rate:.0%} hit rate), "
+                         f"{int(metrics.get('result_cache_evictions', 0))} "
+                         f"eviction(s), "
+                         f"{_fmt_bytes(metrics.get('result_cache_bytes', 0))} "
+                         f"resident"))
     deaths = _ring_events(dump, "worker_death")
     if deaths:
         who = ", ".join(f"{d.get('worker_id', '?')} ({d.get('detail', '')})"

@@ -69,7 +69,7 @@ def test_distributed_event_log_has_tasks_shuffles_heartbeats(dist_runner, tmp_pa
         disable_event_log(sub)
 
     events = [json.loads(l) for l in open(p)]
-    assert all(e["schema_version"] == 10 for e in events)
+    assert all(e["schema_version"] == 11 for e in events)
     by_kind = {}
     for e in events:
         by_kind.setdefault(e["event"], []).append(e)

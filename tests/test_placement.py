@@ -411,7 +411,7 @@ def test_event_log_query_end_carries_placements(tmp_path, monkeypatch):
         disable_event_log(sub)
     events = [json.loads(line) for line in open(p)]
     ends = [e for e in events if e["event"] == "query_end"]
-    assert ends and all(e["schema_version"] == 10 for e in events)
+    assert ends and all(e["schema_version"] == 11 for e in events)
     placements = [p for e in ends for p in e.get("placements", [])]
     assert placements and placements[0]["site"] in ("agg", "grouped agg")
 

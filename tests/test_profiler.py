@@ -455,7 +455,7 @@ def test_event_log_round_trip(tmp_path):
                                                   disable_event_log,
                                                   enable_event_log)
 
-    assert SCHEMA_VERSION == 10
+    assert SCHEMA_VERSION == 11
     p = str(tmp_path / "ev.jsonl")
     sub = enable_event_log(p)
     try:
@@ -464,7 +464,7 @@ def test_event_log_round_trip(tmp_path):
     finally:
         disable_event_log(sub)
     events = [json.loads(l) for l in open(p)]
-    assert events and all(e["schema_version"] == 10 for e in events)
+    assert events and all(e["schema_version"] == 11 for e in events)
     ops = [e for e in events if e["event"] == "operator_stats"]
     assert ops
     for o in ops:
