@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-mesh test-fault test-oom test-gateway bench bench-ai bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
+.PHONY: test lint lint-json test-ai test-fusion test-mesh test-fault test-oom test-gateway bench bench-ai bench-fusion bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -41,6 +41,19 @@ test-fault:
 test-ai:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_udf.py \
 		tests/test_jax_provider.py -q -p no:cacheprovider
+
+# Whole-stage fusion suite (tier-1; also runs under `make test`): fused
+# region 3-way bit-identity, mid-region fallback, Pallas interpret parity,
+# zero-overhead guard.
+test-fusion:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fused_region.py \
+		-q -p no:cacheprovider
+
+# Whole-stage fusion capture (bench.py fusion_microbench): an 8-morsel
+# filter→project→UDF→agg chain, fused vs unfused dispatch counts,
+# bit-identical results, derived fused_dispatch_ratio.
+bench-fusion:
+	env BENCH_FUSION=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 # AI pipeline capture on the device-UDF tier (bench.py ai_bench): seeded
 # encoder, embed + zero-shot classify + groupby count, bit-identical vs the

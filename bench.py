@@ -27,6 +27,12 @@ Environment knobs:
                           carries the wire/logical byte counters and the
                           derived compression/overlap ratios
     BENCH_SHUFFLE_ROWS=N  microbench fact rows (default 200_000)
+    BENCH_FUSION=1        run the whole-stage fusion microbench instead: an
+                          8-morsel filter→project→UDF→agg chain captured
+                          fused (region_mode=on) vs unfused, asserting the
+                          fused region cuts device dispatches with
+                          bit-identical results
+    BENCH_FUSION_ROWS=N   fusion microbench fact rows (default 64_000)
     BENCH_SERVE=1         run the serving-tier bench instead: a 2-worker
                           ServingSession replaying a mixed repeat-heavy query
                           stream from >= 4 concurrent clients (CPU backend,
@@ -151,6 +157,16 @@ def _derive_mesh_ratio(metric_totals: dict) -> None:
         mesh_disp / max(mesh_disp + single_disp, 1), 4)
 
 
+def _derive_fusion_ratio(metric_totals: dict) -> None:
+    """Attach fused_dispatch_ratio — the mean operators amortized per device
+    dispatch across the fused regions (device_region_ops_fused /
+    device_region_dispatches) — so every capture records how much of each
+    operator chain one RTT carried. 0.0 = no fused region dispatched."""
+    disp = metric_totals.get("device_region_dispatches", 0)
+    ops = metric_totals.get("device_region_ops_fused", 0)
+    metric_totals["fused_dispatch_ratio"] = round(ops / max(disp, 1), 4)
+
+
 def _derive_shuffle_ratios(metric_totals: dict) -> None:
     """Attach the derived shuffle transport ratios wherever the raw counters
     landed, so a capture round can attribute wire savings without
@@ -218,6 +234,78 @@ def shuffle_microbench() -> None:
         })
     finally:
         runner.shutdown()
+
+
+def fusion_microbench() -> None:
+    """BENCH_FUSION=1: whole-stage fusion capture — an 8-morsel
+    filter→project→UDF→agg chain on the device tier, run fused
+    (region_mode=on: the UDF output plane feeds the agg program in ONE
+    device dispatch per morsel) and unfused (region_mode=off: the UDF stage
+    and the agg stage each dispatch per morsel). Asserts the fused capture
+    cuts device dispatches with bit-identical results and emits both
+    counts plus the derived fused_dispatch_ratio."""
+    import numpy as np
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.datatype import DataType
+    from daft_tpu.ops import counters
+
+    n = int(os.environ.get("BENCH_FUSION_ROWS", 64_000))
+    rng = np.random.default_rng(0)
+    data = {"v": rng.integers(1, 1000, n).tolist()}
+    w = rng.standard_normal(8).astype(np.float32)
+    score = daft_tpu.func(
+        lambda params, x: x * params["w"].sum(),
+        on_device=True, return_dtype=DataType.float32(),
+        device_params=lambda: {"w": w}, device_key="bench_fusion:score")
+
+    def q(d):
+        return (d.where(col("v") > 3)
+                .select((col("v") * 2).alias("x"))
+                .select(score(col("x")).alias("y"))
+                .agg(col("y").sum().alias("s")))
+
+    def run(region_mode):
+        counters.reset()
+        best = float("inf")
+        with execution_config_ctx(device_mode="on", device_min_rows=1,
+                                  mesh_devices=1, region_mode=region_mode):
+            d = daft_tpu.from_pydict(data).into_partitions(8)
+            out = None
+            for _ in range(REPS):
+                counters.reset()
+                t0 = time.perf_counter()
+                out = q(d).to_pydict()
+                best = min(best, time.perf_counter() - t0)
+        # completed device executions = one finalize d2h round trip each:
+        # the fused region runs the whole chain behind ONE, the unfused
+        # chain pays one per operator stage (UDF run + agg run)
+        disp = counters.device_stage_runs + counters.device_udf_runs
+        totals = {k: v for k, v in counters.snapshot().items() if v}
+        _derive_fusion_ratio(totals)
+        return out, disp, best, totals
+
+    fused_out, fused_disp, fused_s, fused_totals = run("on")
+    unfused_out, unfused_disp, unfused_s, _ = run("off")
+    assert fused_out == unfused_out, \
+        "fused region result diverged from the unfused chain"
+    assert 0 < fused_disp < unfused_disp, \
+        f"fusion did not cut dispatches ({fused_disp} vs {unfused_disp})"
+    _emit({
+        "metric": "fusion_microbench_rows_per_sec",
+        "value": round(n / fused_s, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round((n / fused_s) / BASELINE_ROWS_PER_SEC, 4),
+        "fused_dispatches": fused_disp,
+        "unfused_dispatches": unfused_disp,
+        "unfused_rows_per_sec": round(n / unfused_s, 1),
+        "fact_rows": n,
+        "reps": REPS,
+        "calibration": _calibration_dict(),
+        "metrics": fused_totals,
+    })
 
 
 def mesh_microbench() -> None:
@@ -291,6 +379,7 @@ def mesh_microbench() -> None:
         "mesh path never executed — BENCH_MESH capture is not a mesh capture"
     metric_totals = {k: v for k, v in counters.snapshot().items() if v}
     _derive_mesh_ratio(metric_totals)
+    _derive_fusion_ratio(metric_totals)
     # repeat-query residency: sharded planes resident => h2d flat after warmup
     metric_totals["mesh_repeat_h2d_bytes"] = int(h2d_after - h2d_warm)
     assert metric_totals["mesh_repeat_h2d_bytes"] == 0, \
@@ -352,6 +441,7 @@ def mesh_microbench() -> None:
     assert _rec, "join placement records missing the mesh CostBreakdown"
     metric_totals.update({k: v for k, v in counters.snapshot().items() if v})
     _derive_mesh_ratio(metric_totals)
+    _derive_fusion_ratio(metric_totals)
 
     # ---- section 3: intra-host repartition over ICI ------------------------
     from daft_tpu.observability.metrics import registry as _registry
@@ -1123,6 +1213,9 @@ def main() -> None:
     if os.environ.get("BENCH_SHUFFLE"):
         shuffle_microbench()
         return
+    if os.environ.get("BENCH_FUSION"):
+        fusion_microbench()
+        return
     if os.environ.get("BENCH_SERVE"):
         if os.environ.get("BENCH_SERVE_NET"):
             serve_bench_net()
@@ -1248,6 +1341,10 @@ def main() -> None:
     # SF10/TPC-DS re-capture records mesh engagement alongside the HBM and
     # coalescing numbers.
     _derive_mesh_ratio(metric_totals)
+
+    # Fused-region attribution: mean operators amortized per device dispatch
+    # (the tentpole's "N ops, 1 RTT" claim at capture granularity).
+    _derive_fusion_ratio(metric_totals)
 
     # Shuffle transport attribution: compression + overlap ratios derived
     # from the wire/logical byte and cumulative/overlap second counters

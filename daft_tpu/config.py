@@ -29,6 +29,26 @@ class ExecutionConfig:
     device_mode: str = field(
         default_factory=lambda: os.environ.get("DAFT_TPU_DEVICE", "auto")
     )
+    # Whole-stage fused-region capture (ops/region.py): "on" (default) lets
+    # the planner collapse a Filter/Project chain under an Aggregate into ONE
+    # fused device region (one h2d/d2h + one coalesced dispatch stream for
+    # the whole chain); "off" restores the legacy capture (peel at most the
+    # one directly-adjacent Filter) — an A/B switch for the fusion microbench
+    # and a containment valve, not a perf knob.
+    region_mode: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_REGION", "on")
+    )
+    # Pallas kernel tier (ops/pallas_kernels.py) inside device grouped-agg
+    # regions: "auto" (default) selects the blocked segment-reduce kernel
+    # only when the stage is exactness-eligible AND the cost model prefers it
+    # over the sorted-segment path (high group cardinality past the one-hot
+    # matmul ceiling, real accelerator backend); "on" forces it for every
+    # eligible stage (CPU runs use the Pallas interpreter — correctness
+    # work); "off" never builds it. Lowering/runtime failures fall back to
+    # the jax.ops.segment_* path loudly (counters.pallas_fallbacks).
+    pallas_mode: str = field(
+        default_factory=lambda: os.environ.get("DAFT_TPU_PALLAS", "auto")
+    )
     # Floor below which "auto" never considers the device (skips cost-model
     # calibration for trivially small inputs). The real host-vs-device decision
     # above this floor is the measured cost model in ops/costmodel.py.
@@ -215,6 +235,14 @@ class ExecutionConfig:
             raise ValueError(
                 f"device_mode must be one of 'on'/'off'/'auto', got "
                 f"{self.device_mode!r} (check DAFT_TPU_DEVICE)")
+        if self.region_mode not in ("on", "off"):
+            raise ValueError(
+                f"region_mode must be one of 'on'/'off', got "
+                f"{self.region_mode!r} (check DAFT_TPU_REGION)")
+        if self.pallas_mode not in ("on", "off", "auto"):
+            raise ValueError(
+                f"pallas_mode must be one of 'on'/'off'/'auto', got "
+                f"{self.pallas_mode!r} (check DAFT_TPU_PALLAS)")
         if self.pipeline_mode not in ("on", "off", "force"):
             raise ValueError(
                 f"pipeline_mode must be one of 'on'/'off'/'force', got "

@@ -558,6 +558,21 @@ _STAGE_NODES = (pp.TaskScan, pp.Project, pp.PhysFilter, pp.PhysExplode,
                 pp.PhysRepartition)
 
 
+def _region_keep_columns(node, grouped) -> Optional[List[str]]:
+    """Referenced-column subset of a Device*Agg node's input, or None when
+    the node already reads (essentially) its whole input width. Input order
+    preserved so narrowing is a pure column slice."""
+    from ..ops.region import referenced_columns
+
+    need = referenced_columns(node.predicate,
+                              node.groupby if grouped else [],
+                              node.aggregations)
+    have = node.input.schema.column_names()
+    if not need or need >= set(have):
+        return None
+    return [c for c in have if c in need]
+
+
 def _exec_device_agg(node) -> MicroPartition:
     """Run a DeviceFilterAgg/DeviceGroupedAgg node: device stage or host fallback.
 
@@ -573,6 +588,7 @@ def _exec_device_agg(node) -> MicroPartition:
     cfg = execution_config()
     grouped = isinstance(node, pp.DeviceGroupedAgg)
     if (not grouped and cfg.device_mode == "on"
+            and getattr(cfg, "region_mode", "on") != "off"
             and _unwrap_udf_agg_input(node.input)[0] is not None):
         # device-UDF -> device-agg fusion: the UDF's output plane feeds the
         # agg program on device with no intermediate d2h (the split rule's
@@ -620,6 +636,16 @@ def _exec_device_agg(node) -> MicroPartition:
             stream = itertools.chain(
                 [first] if second is None else [first, second], stream)
 
+    keep = _region_keep_columns(node, grouped)
+    if keep is not None:
+        # A captured region that absorbed a pruning Project sits on the FULL
+        # base width; narrow to the referenced columns before anything
+        # filters, buffers or coalesces the stream (the device stage only
+        # uploads referenced columns, but the host fallback and the
+        # whole-region rerun buffer would otherwise carry every base column
+        # — wide string payloads included — through filter/concat).
+        stream = (p.select_columns(keep) for p in stream)
+
     def _host_agg(s):
         if node.predicate is not None:
             s = (_filter_part(p, node.predicate) for p in s)
@@ -666,6 +692,10 @@ def _exec_device_agg(node) -> MicroPartition:
                 _w, prec = _device_wins(node, first, grouped, forced=True)
         if prec is None:
             prec = _placement.ledger().record(site, "device", forced=True)
+    from ..ops import counters as _counters
+    from ..ops.region import node_region_ops
+
+    region_ops = node_region_ops(node)
     if grouped:
         from ..ops.grouped_stage import DeviceFallback, try_build_grouped_agg_stage
 
@@ -677,6 +707,7 @@ def _exec_device_agg(node) -> MicroPartition:
         feed = coal.add if coal is not None else run.feed_batch
         buffered: List[MicroPartition] = []
         fed_rows = 0
+        d0 = _counters.device_grouped_batches
         try:
             # pin the query's resident planes so a tight HBM budget cannot
             # evict buffers this run still reads; released at scope exit
@@ -693,8 +724,11 @@ def _exec_device_agg(node) -> MicroPartition:
         except DeviceFallback:
             # runtime shape outside the device kernel envelope (e.g. group count
             # beyond the matmul segment ceiling, raised before any dispatch for
-            # the offending batch): rerun the whole stage on host
+            # the offending batch): rerun the WHOLE buffered region on host —
+            # the composed region expressions evaluate compositionally, so
+            # the host result is bit-identical to the fused device program's
             return _host_agg(itertools.chain(buffered, stream))
+        _note_region(node, region_ops, _counters.device_grouped_batches - d0)
         return _grouped_output(node.schema, node.groupby, node.aggregations,
                                key_rows, results)
 
@@ -706,6 +740,7 @@ def _exec_device_agg(node) -> MicroPartition:
     coal = _make_coalescer(run.feed_batch, cfg)
     feed = coal.add if coal is not None else run.feed_batch
     fed_rows = 0
+    d0 = _counters.device_stage_batches
     with _placement.feedback(prec) as fb, _residency().pin_scope():
         for part in stream:
             fed_rows += part.num_rows
@@ -715,6 +750,7 @@ def _exec_device_agg(node) -> MicroPartition:
             coal.close()
         fb.set_rows(fed_rows)
         final = run.finalize()
+    _note_region(node, region_ops, _counters.device_stage_batches - d0)
     cols = []
     for name, _agg in stage.aggs:
         f = node.schema[name]
@@ -890,25 +926,32 @@ def _run_device_udf_stage(node, call, stream, cfg, prec=None) -> MicroPartition:
 
 
 def _unwrap_udf_agg_input(agg_input):
-    """(udf_node, rename) when `agg_input` is a DeviceUdfProject — possibly
-    under a pure rename/selection Project (the split-UDF rule always leaves
-    one: Project([col(__udf__x).alias(x), ...]) over the UDFProject).
-    `rename` maps each agg-visible column name to its source name in the UDF
-    node's OUTPUT schema. (None, None) when the shape doesn't match."""
-    from ..expressions.expressions import Alias
+    """The region builder's UDF→agg peephole (ops/region.py) — only ever
+    called on the device_mode=on path, so the device-tier import is safe."""
+    from ..ops.region import unwrap_udf_agg_input
 
-    if isinstance(agg_input, pp.DeviceUdfProject):
-        return agg_input, {c: c for c in agg_input.schema.column_names()}
-    if isinstance(agg_input, pp.Project) \
-            and isinstance(agg_input.input, pp.DeviceUdfProject):
-        rename = {}
-        for e in agg_input.projection:
-            ref = e.child if isinstance(e, Alias) else e
-            if not isinstance(ref, ColumnRef):
-                return None, None
-            rename[e.name()] = ref.name()
-        return agg_input.input, rename
-    return None, None
+    return unwrap_udf_agg_input(agg_input)
+
+
+def _note_region(node, region_ops, dispatches: int) -> None:
+    """Attribution for one completed fused-region run: every device dispatch
+    the region issued covered len(region_ops) operators in one RTT. Counted
+    only for genuine regions (>= 2 fused ops) so the bench-derived
+    fused_dispatch_ratio measures fusion, not bare aggs; the EXPLAIN ANALYZE
+    line makes the amortization visible per node."""
+    if dispatches <= 0 or len(region_ops) < 2:
+        return
+    from ..observability.runtime_stats import current_collector
+    from ..ops import counters as _counters
+    from ..ops.region import region_label
+
+    _counters.bump("device_region_dispatches", dispatches)
+    _counters.bump("device_region_ops_fused", dispatches * len(region_ops))
+    c = current_collector()
+    if c is not None:
+        d = "1 dispatch" if dispatches == 1 else f"{dispatches} dispatches"
+        c.annotate(node, f"fused region: {len(region_ops)} ops "
+                         f"({region_label(region_ops)}), {d}")
 
 
 def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
@@ -953,10 +996,16 @@ def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
         return None
     from ..ops.udf_stage import FusedUdfAggFeeder, build_device_udf_stage
 
+    from ..ops.region import node_region_ops
+
     udf_stage = build_device_udf_stage(call.func, call.args, internal)
     agg_run = agg_stage.start_run()
     in_stream = _exec(udf_node.input)
     buffered: List[MicroPartition] = []
+    # the UDF plane feeds the agg program in the SAME dispatch, so the
+    # region spans the UDF op plus whatever chain the planner fused
+    region_ops = ("udf",) + node_region_ops(node)
+    d0 = _counters.device_stage_batches
     # fusion only engages under device_mode=on: a forced ledger record so the
     # fused dispatch still lands in placement telemetry
     prec = _placement.ledger().record("udf+agg fused", "device", forced=True,
@@ -998,6 +1047,7 @@ def _try_fused_udf_agg(node, cfg) -> Optional[MicroPartition]:
         host = _two_phase_agg(node.input, [], node.aggregations,
                               ungrouped=True, stream=s, node=node)
         return MicroPartition(node.schema, [host.cast_to_schema(node.schema)])
+    _note_region(node, region_ops, _counters.device_stage_batches - d0)
     c = current_collector()
     if c is not None:
         c.annotate(node, f"fused device udf: {call.func.name}")
@@ -1179,7 +1229,12 @@ def _run_device_join(node, label: str, make_run, assemble,
                 second = next(raw_stream, None)
         fact_stream = itertools.chain(
             [first] if second is None else [first, second], raw_stream)
-        coal = 1.0 if topn else _coalesce_horizon(
+        from ..ops.region import single_batch_horizon
+
+        # the fused TopN program is a one-batch region by construction; its
+        # RTT pricing comes from the shared region builder, not a local
+        # constant (ops/region.py single_batch_horizon)
+        coal = single_batch_horizon() if topn else _coalesce_horizon(
             [first] if second is None else [first, second])
         dim_batches = {}
         for name, plan in node.dim_plans:
@@ -1263,6 +1318,8 @@ def _run_device_join(node, label: str, make_run, assemble,
         # planes, index planes, resident columns) cannot be evicted mid-run
         # by a tight HBM budget; the budget re-enforces at scope exit
         fed_rows = 0
+        region_ops = ("join", "agg", "topn") if topn else ("join", "agg")
+        d0 = _counters.device_join_batches
         with _placement.feedback(prec) as fb, _residency().pin_scope():
             if topn:
                 # the fused TopN program needs ONE fact batch: bail on sighting a
@@ -1295,7 +1352,9 @@ def _run_device_join(node, label: str, make_run, assemble,
                 if coalescer is not None:
                     coalescer.close()
             fb.set_rows(fed_rows)
-            return assemble(run, stage, grouped)
+            out = assemble(run, stage, grouped)
+        _note_region(node, region_ops, _counters.device_join_batches - d0)
+        return out
     except DeviceFallback as e:
         _counters.reject("runtime", f"{label}: device fallback", str(e))
         raw_stream.close()
@@ -1954,6 +2013,13 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
     amort = max(execution_config().device_amortize_runs, 1) \
         if _resident_source(node.input) else 1
 
+    # region ops the host fallback evaluates BEYOND the filter+agg that
+    # host_agg_cost's base terms already price (absorbed projects/filters)
+    from ..ops.region import node_region_ops
+
+    extra_ops = max(len(node_region_ops(node))
+                    - (2 if node.predicate is not None else 1), 0)
+
     if grouped:
         from ..ops.grouped_stage import try_build_grouped_agg_stage
 
@@ -2000,7 +2066,8 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
                 resident_bytes=res)
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=True,
-            has_predicate=node.predicate is not None)
+            has_predicate=node.predicate is not None,
+            n_region_ops=extra_ops)
         detail = (f"{len(node.groupby)} keys, {len(node.aggregations)} aggs, "
                   f"~{card} groups")
     else:
@@ -2022,7 +2089,8 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
             coalesce=coal, resident_bytes=res)
         host_cost = costmodel.host_agg_cost(
             cal, rows, len(node.aggregations), grouped=False,
-            has_predicate=node.predicate is not None)
+            has_predicate=node.predicate is not None,
+            n_region_ops=extra_ops)
         detail = (f"{len(node.aggregations)} aggs"
                   + (", filtered" if node.predicate is not None else ""))
     wins = dev_cost < host_cost

@@ -178,6 +178,16 @@ DEVICE_COUNTER_NAMES = (
     "serve_pin_calibrations",  # reservations shrunk toward observed pin high-water
     # checkpoint store GC (checkpoint/stages.py sweep_expired)
     "checkpoint_stages_gced",  # committed stages removed by the TTL sweep
+    # whole-stage fused regions (ops/region.py capture + executor wiring):
+    # a dispatch of a node whose fused chain spans >= 2 operators counts
+    # once here and len(chain) times in ops_fused, so
+    # ops_fused / dispatches = mean operators amortized per RTT (the
+    # fused_dispatch_ratio bench derivation).
+    "device_region_dispatches",   # device dispatches issued by fused regions
+    "device_region_ops_fused",    # operators covered by those dispatches
+    # Pallas kernel tier (ops/pallas_kernels.py segment-reduce groupby)
+    "pallas_dispatches",       # grouped-agg batches through the Pallas kernel
+    "pallas_fallbacks",        # Pallas lowering/run failures -> segment_* path
 )
 
 # Serving-tier counters OUTSIDE the ops/counters.py reset scope (cancellation

@@ -165,6 +165,12 @@ class Calibration:
     # needs to be right within ~2x); defaulted so old call sites construct.
     udf_device_flops_per_s: float = 2e11
     udf_host_flops_per_s: float = 5e9
+    # Pallas blocked segment-reduce (ops/pallas_kernels.py): one-hot tiles
+    # built in VMEM, so cells stream compute-bound instead of HBM-bound.
+    # Conservative v5e default (~20x the XLA one-hot cell rate); measured
+    # captures should override via DAFT_TPU_COST_PALLAS_RATE. Defaulted so
+    # old call sites construct.
+    pallas_cell_rate: float = 1e12
 
 
 _CAL: Optional[Calibration] = None
@@ -289,6 +295,7 @@ def calibrate() -> Calibration:
         mm_cell_rate=_env_f("DAFT_TPU_COST_MM_CELL_RATE", 5e10),
         scatter_rows_per_s=_env_f("DAFT_TPU_COST_SCATTER_RATE", 1e8),
         ext_cell_rate=_env_f("DAFT_TPU_COST_EXT_RATE", 5e9),
+        pallas_cell_rate=_env_f("DAFT_TPU_COST_PALLAS_RATE", 1e12),
         host_agg_rate=_env_f("DAFT_TPU_COST_HOST_AGG", 1.5e8),
         host_factorize_rate=_env_f("DAFT_TPU_COST_HOST_FACT", 8e6),
         host_probe_rate=_env_f("DAFT_TPU_COST_HOST_PROBE", 3e7),
@@ -466,16 +473,55 @@ def _base_terms(cal: Calibration, nonresident_bytes: int, coalesce: float,
     return out
 
 
+def _segment_reduce_terms(out: CostBreakdown, cal: Calibration, rows: int,
+                          n_mm: int, n_ext: int, n_sct: int, cap: int,
+                          matmul_ceiling: Optional[int] = None) -> CostBreakdown:
+    """THE segment-reduction compute pricing for every device region that
+    aggregates by key: one-hot matmul cells (rows x segments x planes) below
+    the matmul ceiling, sort passes + per-plane scans above it. The grouped
+    agg and the join-agg regions used to carry private copies of this
+    arithmetic (they drifted once already); both now price through here.
+    ``matmul_ceiling=None`` = the caller already chose the cell path
+    (device_grouped_cost's caller prices the sorted tier separately)."""
+    import math
+
+    cap = max(cap, 8)
+    if matmul_ceiling is None or cap <= matmul_ceiling:
+        out.add("compute", rows * cap * n_mm / cal.mm_cell_rate
+                + rows * cap * n_ext / cal.ext_cell_rate
+                + n_sct * rows / cal.scatter_rows_per_s)
+    else:
+        logn = max(math.log2(max(rows, 2)), 1.0)
+        out.add("compute", rows * logn / cal.mm_plane_rows_per_s
+                + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
+    return out
+
+
 def device_grouped_cost(cal: Calibration, rows: int, nonresident_bytes: int,
                         n_mm: int, n_ext: int, n_sct: int, cap: int,
                         factorize_rows: int, coalesce: float = 1.0,
                         resident_bytes: int = 0) -> CostBreakdown:
-    cap = max(cap, 8)
     out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
-    # one-hot matmul work scales with rows x segments x planes
-    out.add("compute", rows * cap * n_mm / cal.mm_cell_rate
-            + rows * cap * n_ext / cal.ext_cell_rate
-            + n_sct * rows / cal.scatter_rows_per_s)
+    _segment_reduce_terms(out, cal, rows, n_mm, n_ext, n_sct, cap)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    return out
+
+
+def device_grouped_pallas_cost(cal: Calibration, rows: int,
+                               nonresident_bytes: int, n_mm: int, n_ext: int,
+                               cap: int, factorize_rows: int,
+                               coalesce: float = 1.0,
+                               resident_bytes: int = 0) -> CostBreakdown:
+    """The Pallas blocked segment-reduce kernel (ops/pallas_kernels.py): the
+    same rows x segments x planes cell count as the one-hot matmul, but the
+    one-hot tiles are built in VMEM inside the kernel grid — never
+    materialized through HBM — so the cells stream at the compute-bound
+    ``pallas_cell_rate`` instead of the HBM-bound ``mm_cell_rate``. This is
+    the pricing arm the pallas_mode=auto gate weighs against
+    device_grouped_sort_cost past the one-hot ceiling."""
+    out = _base_terms(cal, nonresident_bytes, coalesce, resident_bytes)
+    out.add("compute", rows * max(cap, 8) * (n_mm + n_ext)
+            / cal.pallas_cell_rate)
     out.add("factorize", factorize_rows / cal.host_factorize_rate)
     return out
 
@@ -554,24 +600,15 @@ def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
                          resident_bytes: int = 0) -> CostBreakdown:
     """One gather-join + aggregate device run: fixed round trip (amortized
     over the expected coalesce horizon) + amortized uploads + per-dim gathers
-    + the segment reduction (matmul cells below the ceiling, sort passes
-    above) + the finalize fetch + amortized host factorize work (join
-    indices / joined-key codes)."""
-    import math
-
+    + the shared segment-reduction terms (matmul cells below the ceiling,
+    sort passes above) + the finalize fetch + amortized host factorize work
+    (join indices / joined-key codes)."""
     out = _base_terms(cal, upload_bytes, coalesce, resident_bytes)
     out.add("compute", n_gathers * rows / cal.mm_plane_rows_per_s)
     out.add("factorize", factorize_rows / cal.host_factorize_rate)
     out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
-    cap_est = max(cap_est, 8)
-    if cap_est <= matmul_ceiling:
-        out.add("compute", rows * cap_est * n_mm / cal.mm_cell_rate
-                + rows * cap_est * n_ext / cal.ext_cell_rate
-                + n_sct * rows / cal.scatter_rows_per_s)
-    else:
-        logn = max(math.log2(max(rows, 2)), 1.0)
-        out.add("compute", rows * logn / cal.mm_plane_rows_per_s
-                + rows * (n_mm + n_ext + n_sct) / cal.mm_plane_rows_per_s)
+    _segment_reduce_terms(out, cal, rows, n_mm, n_ext, n_sct, cap_est,
+                          matmul_ceiling=matmul_ceiling)
     return out
 
 
@@ -634,10 +671,16 @@ def host_join_agg_cost(cal: Calibration, rows: int, n_dims: int, n_aggs: int,
 
 
 def host_agg_cost(cal: Calibration, rows: int, n_aggs: int, grouped: bool,
-                  has_predicate: bool) -> CostBreakdown:
+                  has_predicate: bool, n_region_ops: int = 0) -> CostBreakdown:
+    """Host execution of the same (possibly fused-region) aggregate.
+    ``n_region_ops``: operators the region capture absorbed BEYOND the
+    filter+agg the other terms already price (extra projects/filters the
+    host fallback evaluates per batch) — one vectorized pass each."""
     out = CostBreakdown(compute=rows * max(n_aggs, 1) / cal.host_agg_rate)
     if has_predicate:
         out.add("compute", rows / cal.host_agg_rate)
+    if n_region_ops > 0:
+        out.add("compute", rows * n_region_ops / cal.host_agg_rate)
     if grouped:
         out.add("factorize", rows / cal.host_factorize_rate)
     return out

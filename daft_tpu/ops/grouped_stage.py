@@ -231,6 +231,9 @@ class GroupedAggStage:
         self.groupby = list(groupby)
         self.aggs = list(aggs)
         self._jitted: Dict[Tuple[int, int], Callable] = {}
+        # latched by feed_batch when a Pallas lowering/dispatch fails; the
+        # stage then serves every later cap from the XLA tiers
+        self._pallas_broken = False
         self._input_cols = self._referenced_columns()
         # group keys qualify for the device dictionary path iff they are bare columns
         self.dict_keys = all(isinstance(g, ColumnRef) or
@@ -541,11 +544,165 @@ class GroupedAggStage:
 
         return jax.jit(stage)
 
-    def _jit_for(self, cap: int) -> Callable:
+    def _jit_for(self, cap: int, rows: int = 0) -> Callable:
+        interp = self._pallas_gate(cap, rows)
+        if interp is not None:
+            key = ("pallas", cap)
+            if key not in self._jitted:
+                self._jitted[key] = self._build_pallas(cap, interpret=interp)
+            return self._jitted[key]
         if cap not in self._jitted:
             self._jitted[cap] = (self._build(cap) if cap <= MAX_MATMUL_SEGMENTS
                                  else self._build_sorted(cap))
         return self._jitted[cap]
+
+    def _pallas_eligible(self) -> bool:
+        """Exactness contract for the Pallas tier (ops/pallas_kernels.py):
+        the kernel accumulates f32 planes — exact only for small-integer
+        planes (rows/count/digit sums) and f32 float extremes. f64-exact
+        mode, raw float/bool sum planes, int extremes (f64 ext planes) and
+        int64 scatters keep the XLA tiers."""
+        if self._use_f64 or self._sct_specs:
+            return False
+        for _idx, kind in self._mm_specs:
+            if not (kind in ("rows", "count") or kind.startswith("isum")):
+                return False
+        for _idx, _op, use_f64 in self._ext_specs[1:]:
+            if use_f64:
+                return False
+        return True
+
+    def _pallas_gate(self, cap: int, rows: int = 0) -> Optional[bool]:
+        """Decide whether `cap` dispatches on the Pallas tier. Returns the
+        kernel's `interpret` flag when it should (True = CPU interpreter,
+        for off-silicon parity tests under DAFT_TPU_PALLAS=on), None when
+        the XLA tiers serve this cap."""
+        from ..config import execution_config
+
+        mode = getattr(execution_config(), "pallas_mode", "auto")
+        if mode == "off" or self._pallas_broken or not self._pallas_eligible():
+            return None
+        from .pallas_kernels import PALLAS_MAX_SEGMENTS, pallas_available
+
+        if not pallas_available() or cap > PALLAS_MAX_SEGMENTS:
+            return None
+        on_tpu = jax.default_backend() == "tpu"
+        if mode == "on":
+            return not on_tpu
+        # auto: real silicon only, past the one-hot matmul ceiling, and only
+        # when the calibrated kernel rate beats the sort tier for this shape
+        if not on_tpu or cap <= MAX_MATMUL_SEGMENTS:
+            return None
+        from . import costmodel as cm
+
+        cal = cm.calibrate()
+        r = max(rows, 1)
+        n_mm, n_ext = len(self._mm_specs), len(self._ext_specs)
+        pallas = cm.device_grouped_pallas_cost(cal, r, 0, n_mm, n_ext, cap, 0)
+        sort = cm.device_grouped_sort_cost(cal, r, 0, n_mm + n_ext, 0)
+        return False if pallas.total() < sort.total() else None
+
+    def _build_pallas(self, cap: int, interpret: bool) -> Callable:
+        """Pallas blocked segment-reduce tier: same output contract as
+        _build/_build_sorted ({"mm","ext","sct"}), compute routed through
+        ops/pallas_kernels.py. Only built for stages passing
+        _pallas_eligible(), so every plane is f32-exact: digit/count sums
+        combine in f64 across kernel windows, float extremes are
+        order-independent, and the first-row index rides an f32 plane
+        (exact while bucket < 2^24 — enforced at trace time; the feed's
+        runtime fallback catches the refusal and rebuilds on XLA)."""
+        from . import pallas_kernels as pk
+
+        schema = self.schema
+        fdt = jnp.float32
+        pred_fn = (dev.build_device_expr(self.predicate, schema, float_dtype=fdt)
+                   if self.predicate is not None else None)
+        child_fns = []
+        for name, agg in self.aggs:
+            count_all = agg.op == "count" and agg.params.get("mode", "valid") == "all"
+            child_fns.append((dev.build_device_expr(agg.child, schema, float_dtype=fdt),
+                              count_all))
+
+        mm_specs, ext_specs = self._mm_specs, self._ext_specs
+
+        def stage(cols: Dict[str, dev.DCol], codes: jnp.ndarray,
+                  row_mask: jnp.ndarray, row_offset: jnp.ndarray):
+            bucket = codes.shape[0]
+            if bucket >= pk.MAX_PALLAS_BUCKET:
+                raise ValueError(
+                    f"pallas tier: bucket {bucket} exceeds f32-exact "
+                    f"first-row-index range {pk.MAX_PALLAS_BUCKET}")
+            if pred_fn is not None:
+                pv, pm = pred_fn(cols)
+                keep = pv.astype(bool) & pm & row_mask
+            else:
+                keep = row_mask
+            seg = jnp.where(keep, codes, cap).astype(jnp.int32)
+
+            evaluated = []
+            for fn, count_all in child_fns:
+                v, m = fn(cols)
+                v = v + jnp.zeros(jnp.shape(seg), dtype=v.dtype) \
+                    if jnp.shape(v) != jnp.shape(seg) else v
+                mask = keep if count_all else dev._broadcast_valid(v, m) & keep
+                evaluated.append((v, mask))
+
+            planes = []
+            for agg_idx, kind in mm_specs:
+                if kind == "rows":
+                    planes.append(keep.astype(jnp.float32))
+                elif kind == "count":
+                    planes.append(evaluated[agg_idx][1].astype(jnp.float32))
+                else:  # isum digit — _pallas_eligible admits nothing else
+                    v, mask = evaluated[agg_idx]
+                    planes.append(jnp.where(mask, _isum_digit(v, kind), 0.0)
+                                  .astype(jnp.float32))
+
+            # extreme planes grouped by op for the two kernel launches; the
+            # first-row index (slot 0) rides the min family as a LOCAL f32
+            # arange — row_offset folds back in f64 after the kernel
+            min_slots, max_slots = [], []
+            min_planes, max_planes = [], []
+            for slot, (agg_idx, op, _use_f64) in enumerate(ext_specs):
+                if agg_idx < 0:
+                    v = jnp.arange(bucket, dtype=jnp.float32)
+                    mask = keep
+                else:
+                    v, mask = evaluated[agg_idx]
+                    v = v.astype(jnp.float32)
+                big = jnp.float32(jnp.inf if op == "min" else -jnp.inf)
+                plane = jnp.where(mask, v, big)
+                if op == "min":
+                    min_slots.append(slot)
+                    min_planes.append(plane)
+                else:
+                    max_slots.append(slot)
+                    max_planes.append(plane)
+
+            acc_mm = pk.segment_sum_planes_windowed(
+                jnp.stack(planes, axis=-1), seg, cap, interpret=interpret)
+            ext_out: List = [None] * len(ext_specs)
+            if min_planes:
+                mins = pk.segment_extreme_planes(
+                    jnp.stack(min_planes, axis=-1), seg, cap, "min",
+                    interpret=interpret)
+                for j, slot in enumerate(min_slots):
+                    ext_out[slot] = mins[:, j]
+            if max_planes:
+                maxs = pk.segment_extreme_planes(
+                    jnp.stack(max_planes, axis=-1), seg, cap, "max",
+                    interpret=interpret)
+                for j, slot in enumerate(max_slots):
+                    ext_out[slot] = maxs[:, j]
+            # slot 0 back to the global f64 index contract (+inf = empty group)
+            r0 = ext_out[0]
+            ext_out[0] = jnp.where(jnp.isfinite(r0),
+                                   r0.astype(jnp.float64) + row_offset,
+                                   jnp.inf)
+
+            return {"mm": acc_mm, "ext": tuple(ext_out), "sct": ()}
+
+        return jax.jit(stage)
 
     def _jit_local(self, cap: int) -> Callable:
         key = ("local", cap)
@@ -692,15 +849,33 @@ class GroupedAggRun:
             return
         bucket = pad_bucket(n)
         decode = self._codes_for(batch, n, bucket)
-        prog = stage._jit_for(decode.cap)
+        use_pallas = stage._pallas_gate(decode.cap, n) is not None
+        prog = stage._jit_for(decode.cap, rows=n)
         with profile_span("device.h2d", "device", rows=n, bucket=bucket):
             dcols = {name: batch.get_column(name).to_device_cached(
                          bucket, f32=not stage._use_f64)
                      for name in stage._input_cols}
         with profile_span("device.dispatch", "device", op="grouped_agg",
                           rows=n, bucket=bucket, groups_cap=decode.cap):
-            out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
-                       jnp.asarray(float(self._row_offset)))
+            try:
+                out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
+                           jnp.asarray(float(self._row_offset)))
+            except Exception as exc:
+                if not use_pallas:
+                    raise
+                # Pallas lowering/dispatch failed (e.g. no Mosaic support on
+                # this runtime): latch the stage onto the XLA tiers and rerun
+                # this batch — nothing was accumulated, so the retry is exact.
+                stage._pallas_broken = True
+                counters.bump("pallas_fallbacks")
+                counters.reject(
+                    "pallas", "pallas segment-reduce failed to lower; "
+                    "stage rebuilt on the XLA tier", detail=str(exc))
+                prog = stage._jit_for(decode.cap, rows=n)
+                out = prog(dcols, decode.dcodes, device_row_mask(n, bucket),
+                           jnp.asarray(float(self._row_offset)))
+        if use_pallas and not stage._pallas_broken:
+            counters.bump("pallas_dispatches")
         self._row_offset += n
         self._pending.append((out, decode))
         counters.bump("device_grouped_batches")

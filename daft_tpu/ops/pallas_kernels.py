@@ -1,19 +1,33 @@
 """Pallas TPU kernels (SURVEY.md §7: custom kernels for the hot relational ops).
 
-segment_sum_planes: the grouped-aggregation inner loop — accumulate P value
-planes into a (segments x P) table keyed by per-row segment codes — as ONE
-Pallas kernel. Instead of materializing a one-hot matrix in HBM (the lax.scan
-formulation in grouped_stage.py materializes chunk-sized one-hots per step),
-the kernel builds each block's one-hot in VMEM and accumulates the block's
-(cap x P) partial into the output block across sequential grid steps, so HBM
-traffic is exactly: read planes once, read codes once, write the table once.
+The grouped-aggregation inner loop — accumulate value planes into a
+(segments x planes) table keyed by per-row segment codes — as Pallas kernels.
+Instead of materializing one-hot matrices in HBM (the lax.scan formulation in
+grouped_stage.py materializes chunk-sized one-hots per step), each kernel
+builds its block's one-hot in VMEM and accumulates the block's partial into
+the output across sequential grid steps, so HBM traffic per segment-column
+block is: read planes once, read codes once, write the table once.
 
-Used by the grouped device stage when DAFT_TPU_PALLAS=1 (the lax.scan path
-remains the default — on small segment counts XLA's fusion is already at
-bandwidth). Correctness is pinned by interpret-mode tests; NOTE: this build
+Three entry points:
+
+- segment_sum_planes: the original single-window kernel (small caps, f32
+  accumulation end to end). Kept for microbenches and as the parity anchor.
+- segment_sum_planes_windowed: the tier the grouped stage dispatches —
+  f32 accumulation inside windows of _WINDOW_ROWS rows (small-integer planes
+  stay exact: 255 * 32768 < 2^24), f64 cross-window combine OUTSIDE the
+  kernel but inside the same jit (Mosaic has no f64), segment columns tiled
+  so the one-hot block never exceeds VMEM at six-figure caps.
+- segment_extreme_planes: min/max families over identity-filled planes,
+  same row/segment tiling.
+
+Selected by grouped_stage._jit_for when DAFT_TPU_PALLAS allows it (auto gates
+on the costmodel's pallas_cell_rate vs the sort tier past the one-hot matmul
+ceiling). Correctness is pinned by interpret-mode tests; NOTE: this build
 environment's tunneled device rejects Mosaic compilation (its remote-compile
 service returns HTTP 500 for Pallas lowerings), so on-chip dispatch could not
-be exercised here — co-located TPU runtimes compile it normally.
+be exercised here — co-located TPU runtimes compile it normally, and the
+runtime fallback in GroupedAggRun.feed_batch rebuilds on the XLA tier when
+lowering fails.
 """
 
 from __future__ import annotations
@@ -25,6 +39,34 @@ import jax
 import jax.numpy as jnp
 
 _BLOCK_ROWS = 1024
+# f32 accumulation window: digit planes carry values <= 255, so a window
+# partial tops out at 255 * 32768 = 8.3e6 < 2^24 and every window sum is
+# f32-exact; the f64 cross-window combine then matches the XLA tiers bit
+# for bit on the grouped stage's integer/count planes.
+_WINDOW_ROWS = 32 * _BLOCK_ROWS
+# segment-column tile: bounds the in-VMEM one-hot at BLOCK_ROWS x CAP_TILE
+# f32 (= 8 MB at 2048) regardless of the total segment count.
+_CAP_TILE = 2048
+# ceiling for the Pallas tier: past this the table write-back dominates and
+# the sort path wins outright; also bounds compile time for the tiled grid.
+PALLAS_MAX_SEGMENTS = 1 << 17
+# first-row indices ride an f32 plane inside the kernel; past 2^24 rows per
+# bucket f32 cannot hold the index exactly, so the stage refuses at trace time
+MAX_PALLAS_BUCKET = 1 << 24
+
+
+def _row_block(n: int) -> int:
+    """Row block size: buckets are power-of-two padded (>= 512), so
+    min(_BLOCK_ROWS, n) always divides n."""
+    b = min(_BLOCK_ROWS, n)
+    assert n % b == 0, (n, b)
+    return b
+
+
+def _cap_tile(cap: int) -> int:
+    t = min(_CAP_TILE, cap)
+    assert cap % t == 0, (cap, t)
+    return t
 
 
 @functools.partial(jax.jit, static_argnames=("cap", "interpret"))
@@ -34,19 +76,20 @@ def segment_sum_planes(planes: jnp.ndarray, codes: jnp.ndarray, cap: int,
 
     N must be a multiple of the block size (the callers' quantized padding
     guarantees this); rows whose code is outside [0, cap) are dropped (the
-    trash segment for filtered/padding rows).
+    trash segment for filtered/padding rows). Single-window f32 accumulation —
+    use segment_sum_planes_windowed when exactness past 2^24 matters.
     """
     from jax.experimental import pallas as pl
 
     n, p = planes.shape
-    assert n % _BLOCK_ROWS == 0, n
-    grid = n // _BLOCK_ROWS
+    block = _row_block(n)
+    grid = n // block
 
     def kernel(planes_ref, codes_ref, out_ref):
         step = pl.program_id(0)
         blk = planes_ref[...]                      # (BLOCK, P) in VMEM
         cds = codes_ref[...].astype(jnp.int32)     # (BLOCK, 1) — 2D for mosaic
-        seg_ids = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_ROWS, cap), 1)
+        seg_ids = jax.lax.broadcasted_iota(jnp.int32, (block, cap), 1)
         oh = (cds == seg_ids).astype(jnp.float32)  # (BLOCK, cap)
         part = jax.lax.dot_general(                # (cap, P) on the MXU
             oh, blk, (((0,), (0,)), ((), ())),
@@ -64,11 +107,126 @@ def segment_sum_planes(planes: jnp.ndarray, codes: jnp.ndarray, cap: int,
         kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, p), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, p), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((cap, p), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((cap, p), jnp.float32),
+        interpret=interpret,
+    )(planes, codes.reshape(-1, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def segment_sum_planes_windowed(planes: jnp.ndarray, codes: jnp.ndarray,
+                                cap: int, interpret: bool = False) -> jnp.ndarray:
+    """sum planes (N x P, f32) into segments (cap x P, f64) by codes (N, i32).
+
+    The production tier behind grouped_stage._build_pallas: the grid tiles
+    (window, segment-column, row-block); each (window, column) cell
+    accumulates its row blocks in f32 VMEM — exact for the grouped stage's
+    digit/count planes — and the per-window partials combine in f64 outside
+    the kernel, inside this jit. Rows with codes outside [0, cap) are dropped.
+    """
+    from jax.experimental import pallas as pl
+
+    n, p = planes.shape
+    block = _row_block(n)
+    blocks = n // block
+    wnd = min(max(_WINDOW_ROWS // block, 1), blocks)  # row blocks per window
+    n_windows = blocks // wnd
+    tile = _cap_tile(cap)
+    cap_tiles = cap // tile
+
+    def kernel(planes_ref, codes_ref, out_ref):
+        step = pl.program_id(2)
+        ctile = pl.program_id(1)
+        blk = planes_ref[...]                      # (BLOCK, P)
+        cds = codes_ref[...].astype(jnp.int32)     # (BLOCK, 1)
+        seg_ids = jax.lax.broadcasted_iota(jnp.int32, (block, tile), 1) \
+            + ctile * tile
+        oh = (cds == seg_ids).astype(jnp.float32)  # (BLOCK, tile)
+        part = jax.lax.dot_general(                # (tile, P) on the MXU
+            oh, blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[...] = part[None]
+
+        @pl.when(step != 0)
+        def _acc():
+            out_ref[...] += part[None]
+
+    parts = pl.pallas_call(
+        kernel,
+        grid=(n_windows, cap_tiles, wnd),
+        in_specs=[
+            pl.BlockSpec((block, p), lambda w, c, i: (w * wnd + i, 0)),
+            pl.BlockSpec((block, 1), lambda w, c, i: (w * wnd + i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile, p), lambda w, c, i: (w, c, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_windows, cap, p), jnp.float32),
+        interpret=interpret,
+    )(planes, codes.reshape(-1, 1))
+    return parts.astype(jnp.float64).sum(axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "op", "interpret"))
+def segment_extreme_planes(planes: jnp.ndarray, codes: jnp.ndarray, cap: int,
+                           op: str, interpret: bool = False) -> jnp.ndarray:
+    """min/max planes (N x Q, f32, identity-filled) into (cap x Q, f32).
+
+    Masked-out rows must already carry the identity (+inf for min, -inf for
+    max) — the kernel only routes by segment code; codes outside [0, cap)
+    are dropped. Plane columns loop inside the kernel (Q is a handful), so
+    the in-VMEM select buffer stays one (BLOCK x tile) slab.
+    """
+    from jax.experimental import pallas as pl
+
+    assert op in ("min", "max"), op
+    n, q = planes.shape
+    block = _row_block(n)
+    blocks = n // block
+    tile = _cap_tile(cap)
+    cap_tiles = cap // tile
+    big = float("inf") if op == "min" else float("-inf")  # python scalar:
+    # jnp constants captured from outside a pallas kernel are rejected
+
+    def kernel(planes_ref, codes_ref, out_ref):
+        step = pl.program_id(1)
+        ctile = pl.program_id(0)
+        blk = planes_ref[...]                      # (BLOCK, Q)
+        cds = codes_ref[...].astype(jnp.int32)     # (BLOCK, 1)
+        seg_ids = jax.lax.broadcasted_iota(jnp.int32, (block, tile), 1) \
+            + ctile * tile
+        oh = cds == seg_ids                        # (BLOCK, tile) bool
+        cols = []
+        for j in range(q):
+            w = jnp.where(oh, blk[:, j][:, None], big)   # (BLOCK, tile)
+            red = (jnp.min(w, axis=0, keepdims=True) if op == "min"
+                   else jnp.max(w, axis=0, keepdims=True))  # (1, tile)
+            cols.append(red)
+        part = jnp.concatenate(cols, axis=0).T     # (tile, Q)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[...] = part
+
+        @pl.when(step != 0)
+        def _acc():
+            cur = out_ref[...]
+            out_ref[...] = (jnp.minimum(cur, part) if op == "min"
+                            else jnp.maximum(cur, part))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(cap_tiles, blocks),
+        in_specs=[
+            pl.BlockSpec((block, q), lambda c, i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda c, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, q), lambda c, i: (c, 0)),
+        out_shape=jax.ShapeDtypeStruct((cap, q), jnp.float32),
         interpret=interpret,
     )(planes, codes.reshape(-1, 1))
 

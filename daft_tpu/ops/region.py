@@ -1,0 +1,279 @@
+"""Whole-stage device fusion: generic fused-region capture for the planner.
+
+SURVEY §7's core mapping — "operator fusion = tracing a chain of
+Project/Filter/Agg into ONE jit program per pipeline stage" — implemented as a
+*plan-time expression rewrite* rather than a new runtime: a chain of
+device-eligible operators under an Aggregate (any interleaving of Filter and
+Project, and transitively the rename Project the split-UDF rule leaves over a
+DeviceUdfProject) is collapsed by substituting each operator's expressions
+into its consumers until the aggregate's predicate / group keys / agg children
+are expressions over the chain's BASE input schema. The existing device stage
+builders (ops/stage.py, ops/grouped_stage.py) then trace those composed
+expressions into their single jit program, so the whole chain runs as ONE
+fused device region: one h2d of the base columns, one dispatch per coalesced
+super-batch, one d2h at finalize — no operator boundary ever round-trips.
+
+Why substitution instead of a new region node: the composed expressions ARE
+the fused program. Everything downstream — the DispatchCoalescer contract,
+the cost model's joint pricing (the stage's referenced columns after
+substitution are the base columns, so `_base_terms` prices one upload and one
+coalesce-amortized RTT for the whole chain), DeviceFallback's
+rerun-the-buffered-region-on-host semantics, mesh sharding, EXPLAIN ANALYZE —
+works unchanged, and host fallback is bit-identical by construction because
+host expression evaluation is compositional: evaluating `sum((a*b)[p])` over
+the base stream computes exactly what Project(a*b)→Filter(p)→Agg(sum) would,
+batch by batch, with the same numpy kernels.
+
+Correctness invariants the capture enforces per candidate:
+- absorbed expressions are UDF-free, aggregate-free and window-free (a UDF in
+  the chain terminates the region at the UDFProject boundary — the UDF stage
+  itself fuses with the agg at run time via ops/udf_stage.FusedUdfAggFeeder);
+- successive Filters AND-compose (Kleene: NULL `and` TRUE is NULL, which
+  drops the row — identical to sequential filtering, where the row is
+  dropped at whichever filter first evaluates non-TRUE);
+- every composed aggregate / group key is re-aliased to its original output
+  name and must type to the original dtype against the base schema, so the
+  node's output schema is untouched;
+- a candidate that fails any check degrades to a shorter chain — down to the
+  pre-region shape (peel at most the one directly-adjacent Filter) — never
+  to a planning error.
+
+Substitution duplicates a projected expression that is referenced by several
+consumers (XLA CSEs the copies inside the jit program; the host fallback
+re-evaluates them — accepted, it is the rare shape and stays semantically
+exact).
+
+This module is import-disciplined as a device-tier member (tools/lint
+policy): host-only queries must never import it, so the planner only reaches
+for it inside the device_mode != "off" branch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..expressions.expressions import (AggExpr, Alias, BinaryOp, ColumnRef,
+                                       Expression, WindowExpr)
+
+# Absorption ceiling: a region longer than this gains nothing (the RTT is
+# already amortized once) and risks pathological expression blow-up from
+# repeated substitution.
+REGION_MAX_OPS = 8
+
+
+class RegionCapture:
+    """One fused-region candidate: the aggregate re-expressed over `source`.
+
+    `ops` labels the fused chain source-first (e.g. ("filter", "project",
+    "agg")) — the executor's attribution counters and the EXPLAIN ANALYZE
+    "fused region" line render from it.
+    """
+
+    __slots__ = ("source", "predicate", "groupby", "aggregations", "ops")
+
+    def __init__(self, source, predicate: Optional[Expression],
+                 groupby: List[Expression], aggregations: List[Expression],
+                 ops: Tuple[str, ...]):
+        self.source = source
+        self.predicate = predicate
+        self.groupby = groupby
+        self.aggregations = aggregations
+        self.ops = ops
+
+
+def region_label(ops: Sequence[str]) -> str:
+    """Human form of a region's op chain for ledger/EXPLAIN rendering."""
+    return "→".join(ops)
+
+
+def _strip_alias(e: Expression) -> Expression:
+    while isinstance(e, Alias):
+        e = e.child
+    return e
+
+
+def _substitute(e: Expression, mapping: Dict[str, Expression]) -> Expression:
+    """Inline `mapping` (output name -> expression over the base schema) into
+    `e`, bottom-up. A reference to a name the mapping lost (column pruned by
+    a Filter's `keep` set) raises KeyError — the candidate is then invalid."""
+
+    def rewrite(node):
+        if isinstance(node, ColumnRef):
+            rep = mapping[node._name]
+            if isinstance(rep, ColumnRef) and rep._name == node._name:
+                return None  # identity: keep the original node
+            return rep
+        return None
+
+    return e.transform(rewrite)
+
+
+def _expr_absorbable(e: Expression) -> bool:
+    from ..udf.expr import UdfCall
+
+    return not any(isinstance(n, (AggExpr, UdfCall, WindowExpr))
+                   for n in e.walk())
+
+
+def _chain_below(agg_input) -> List:
+    """The maximal absorbable Filter/Project chain under the aggregate,
+    closest-to-agg first. Stops at the first operator whose expressions
+    cannot move into a single traced program."""
+    from ..plan import logical as lp
+
+    chain = []
+    node = agg_input
+    while len(chain) < REGION_MAX_OPS:
+        if isinstance(node, lp.Filter):
+            if not _expr_absorbable(node.predicate):
+                break
+        elif isinstance(node, lp.Project):
+            if not all(_expr_absorbable(e) for e in node.projection):
+                break
+        else:
+            break
+        chain.append(node)
+        node = node.input
+    return chain
+
+
+def _compose(plan, chain: List, k: int) -> Optional["RegionCapture"]:
+    """Candidate absorbing the k operators nearest the aggregate. Returns
+    None when substitution loses a name or drifts an output dtype."""
+    from ..plan import logical as lp
+
+    base = chain[k - 1].input if k else plan.input
+    mapping: Dict[str, Expression] = {
+        f.name: ColumnRef(f.name) for f in base.schema}
+    predicate: Optional[Expression] = None
+    labels: List[str] = []
+    try:
+        for node in reversed(chain[:k]):
+            if isinstance(node, lp.Filter):
+                p = _substitute(node.predicate, mapping)
+                predicate = p if predicate is None \
+                    else BinaryOp("and", predicate, p)
+                if node.keep is not None:
+                    mapping = {c: mapping[c] for c in node.keep}
+                labels.append("filter")
+            else:
+                mapping = {e.name(): _substitute(_strip_alias(e), mapping)
+                           for e in node.projection}
+                labels.append("project")
+
+        in_schema = plan.input.schema
+        groupby: List[Expression] = []
+        for g in plan.groupby:
+            composed = _substitute(_strip_alias(g), mapping)
+            if composed.to_field(base.schema).dtype \
+                    != g.to_field(in_schema).dtype:
+                return None
+            if not isinstance(composed, ColumnRef) \
+                    or composed._name != g.name():
+                composed = Alias(composed, g.name())
+            groupby.append(composed)
+
+        aggregations: List[Expression] = []
+        for e in plan.aggregations:
+            inner = _strip_alias(e)
+            if not isinstance(inner, AggExpr):
+                return None
+            child = _substitute(inner.child, mapping)
+            if child.to_field(base.schema).dtype \
+                    != inner.child.to_field(in_schema).dtype:
+                return None
+            aggregations.append(
+                Alias(AggExpr(inner.op, child, inner.params), e.name()))
+
+        if predicate is not None \
+                and not predicate.to_field(base.schema).dtype.is_boolean():
+            return None
+    except Exception:  # lint: ignore[broad-except] -- untypeable composition =
+        return None    # not capturable at this k; the shorter chain tries next
+    return RegionCapture(base, predicate, groupby, aggregations,
+                         tuple(labels) + ("agg",))
+
+
+def agg_region_candidates(plan) -> List["RegionCapture"]:
+    """Fused-region candidates for one lp.Aggregate, most-absorbed first.
+
+    The last candidate (k=0, or k=1 when a Filter sits directly under the
+    aggregate) reproduces the pre-region capture shape, so a plan that fused
+    before still fuses identically when every longer chain fails the device
+    stage builders' qualification.
+    """
+    chain = _chain_below(plan.input)
+    out: List[RegionCapture] = []
+    for k in range(len(chain), -1, -1):
+        cand = _compose(plan, chain, k)
+        if cand is not None:
+            out.append(cand)
+    return out
+
+
+# ---- shared run-time surfaces of the region builder --------------------------------
+
+
+def referenced_columns(predicate: Optional[Expression], groupby, aggregations):
+    """Base-schema column names a captured region actually reads.
+
+    Absorbing a pruning Project moves the region's input below it, so the
+    raw stream is the FULL base width; the device stage only uploads
+    referenced columns, but the host fallback (and the fallback rerun
+    buffer) must narrow explicitly or a wide base — 16-column lineitem with
+    its comment strings — gets filtered, buffered and concatenated whole."""
+    names = set()
+    exprs = list(groupby) + list(aggregations)
+    if predicate is not None:
+        exprs.append(predicate)
+    for e in exprs:
+        for sub in e.walk():
+            if isinstance(sub, ColumnRef):
+                names.add(sub._name)
+    return names
+
+
+def node_region_ops(node) -> Tuple[str, ...]:
+    """The fused-op chain of a planner-emitted device node. Nodes planned
+    before the region capture existed (or rebuilt by the distributed planner)
+    carry no region_ops; their chain is derivable from their shape."""
+    ops = getattr(node, "region_ops", None)
+    if ops:
+        return tuple(ops)
+    if getattr(node, "predicate", None) is not None:
+        return ("filter", "agg")
+    return ("agg",)
+
+
+def single_batch_horizon() -> float:
+    """Coalesce horizon for a region that by construction dispatches exactly
+    once (the fused TopN join buffers its whole fact side into one batch):
+    the dispatch RTT amortizes over nothing, so the cost path must price it
+    in full. THE shared pricing entry for single-dispatch regions — the
+    executor must not hand-write `coalesce=1` at fusion sites."""
+    return 1.0
+
+
+def unwrap_udf_agg_input(agg_input):
+    """(udf_node, rename) when `agg_input` is a DeviceUdfProject — possibly
+    under a pure rename/selection Project (the split-UDF rule always leaves
+    one: Project([col(__udf__x).alias(x), ...]) over the UDFProject). The
+    region capture normally absorbs that rename at plan time (the agg then
+    sits DIRECTLY on the DeviceUdfProject and `rename` is the identity); the
+    Project arm below keeps pre-region plans and region_mode=off working.
+    `rename` maps each agg-visible column name to its source name in the UDF
+    node's OUTPUT schema. (None, None) when the shape doesn't match."""
+    from ..plan import physical as pp
+
+    if isinstance(agg_input, pp.DeviceUdfProject):
+        return agg_input, {c: c for c in agg_input.schema.column_names()}
+    if isinstance(agg_input, pp.Project) \
+            and isinstance(agg_input.input, pp.DeviceUdfProject):
+        rename = {}
+        for e in agg_input.projection:
+            ref = e.child if isinstance(e, Alias) else e
+            if not isinstance(ref, ColumnRef):
+                return None, None
+            rename[e.name()] = ref.name()
+        return agg_input.input, rename
+    return None, None

@@ -231,10 +231,20 @@ class DeviceFilterAgg(_Unary):
     """
 
     def __init__(self, input: PhysicalPlan, predicate: Optional[Expression],
-                 aggregations: List[Expression], schema: Schema):
+                 aggregations: List[Expression], schema: Schema,
+                 region_ops=None):
         super().__init__(input, schema)
         self.predicate = predicate
         self.aggregations = aggregations
+        # source-first fused-op chain from the region capture, e.g.
+        # ("filter", "project", "agg") — attribution + EXPLAIN only; the
+        # fused semantics live in predicate/aggregations themselves.
+        self.region_ops = tuple(region_ops) if region_ops else None
+
+    def name(self) -> str:
+        if self.region_ops and len(self.region_ops) > 2:
+            return f"DeviceFilterAgg[{'+'.join(self.region_ops)}]"
+        return "DeviceFilterAgg"
 
 
 class DeviceJoinAgg(PhysicalPlan):
@@ -293,11 +303,18 @@ class DeviceGroupedAgg(_Unary):
     """
 
     def __init__(self, input: PhysicalPlan, predicate: Optional[Expression],
-                 groupby: List[Expression], aggregations: List[Expression], schema: Schema):
+                 groupby: List[Expression], aggregations: List[Expression], schema: Schema,
+                 region_ops=None):
         super().__init__(input, schema)
         self.predicate = predicate
         self.groupby = groupby
         self.aggregations = aggregations
+        self.region_ops = tuple(region_ops) if region_ops else None
+
+    def name(self) -> str:
+        if self.region_ops and len(self.region_ops) > 2:
+            return f"DeviceGroupedAgg[{'+'.join(self.region_ops)}]"
+        return "DeviceGroupedAgg"
 
 
 class Dedup(_Unary):
@@ -561,27 +578,54 @@ def translate(plan: lp.LogicalPlan, config: Any = None) -> PhysicalPlan:
                     translate(jspec.fact, config),
                     [(d.name, translate(d.base, config)) for d in jspec.dims],
                     jspec, host, plan.schema)
-            src = plan.input
-            predicate = None
-            if isinstance(src, lp.Filter):
-                predicate = src.predicate
-                src = src.input
-            if plan.groupby:
-                from ..ops.grouped_stage import try_build_grouped_agg_stage
+            # Whole-stage fused-region capture: collapse the maximal
+            # Filter/Project chain under the aggregate into composed
+            # expressions over the chain's base, then qualify candidates
+            # most-fused-first against the device stage builders. The last
+            # candidate reproduces the legacy one-Filter peel, so nothing
+            # that fused before stops fusing.
+            if getattr(cfg, "region_mode", "on") != "off":
+                from ..ops.region import agg_region_candidates
 
-                if try_build_grouped_agg_stage(
-                    src.schema, predicate, plan.groupby, plan.aggregations
-                ) is not None:
-                    return DeviceGroupedAgg(translate(src, config), predicate,
-                                            plan.groupby, plan.aggregations, plan.schema)
+                try:
+                    cands = agg_region_candidates(plan)
+                except Exception:
+                    counters.reject("capture", "fused region capture raised")
+                    cands = []
             else:
-                from ..ops.stage import try_build_filter_agg_stage
+                from ..ops.region import RegionCapture
 
-                if try_build_filter_agg_stage(
-                    src.schema, predicate, plan.aggregations
-                ) is not None:
-                    return DeviceFilterAgg(translate(src, config), predicate,
-                                           plan.aggregations, plan.schema)
+                src = plan.input
+                predicate = None
+                ops = ("agg",)
+                if isinstance(src, lp.Filter):
+                    predicate = src.predicate
+                    src = src.input
+                    ops = ("filter", "agg")
+                cands = [RegionCapture(src, predicate, plan.groupby,
+                                       plan.aggregations, ops)]
+            for cand in cands:
+                if plan.groupby:
+                    from ..ops.grouped_stage import try_build_grouped_agg_stage
+
+                    if try_build_grouped_agg_stage(
+                        cand.source.schema, cand.predicate, cand.groupby,
+                        cand.aggregations
+                    ) is not None:
+                        return DeviceGroupedAgg(
+                            translate(cand.source, config), cand.predicate,
+                            cand.groupby, cand.aggregations, plan.schema,
+                            region_ops=cand.ops)
+                else:
+                    from ..ops.stage import try_build_filter_agg_stage
+
+                    if try_build_filter_agg_stage(
+                        cand.source.schema, cand.predicate, cand.aggregations
+                    ) is not None:
+                        return DeviceFilterAgg(
+                            translate(cand.source, config), cand.predicate,
+                            cand.aggregations, plan.schema,
+                            region_ops=cand.ops)
         child = translate(plan.input, config)
         if plan.groupby:
             return HashAggregate(child, plan.groupby, plan.aggregations, plan.schema)

@@ -64,6 +64,7 @@ TIER_FORBIDDEN = (
     "daft_tpu.ops.device_join",
     "daft_tpu.ops.device_eval",
     "daft_tpu.ops.pallas_kernels",
+    "daft_tpu.ops.region",
 )
 
 # Modules allowed to import the above at top level: the tier itself.
@@ -79,6 +80,7 @@ TIER_MEMBERS = (
     "daft_tpu.ops.device_join",
     "daft_tpu.ops.device_eval",
     "daft_tpu.ops.pallas_kernels",
+    "daft_tpu.ops.region",
 )
 
 # ---- counter-discipline / schema-drift (obs_rules.py) ------------------------------

@@ -192,6 +192,49 @@ def test_unbudgeted_scan_skips_sizing_and_ledger_reads(big_file, monkeypatch):
     assert registry().get("scan_rows") == N_ROWS
 
 
+def test_unbudgeted_scan_distributed_path_skips_sizing(big_file, tmp_path):
+    """The fast-path guard extended to the distributed engine: worker-side
+    scans with an unbounded ledger never size morsels, so the per-task
+    engine-counter deltas propagated to the driver land scan_rows with
+    scan_bytes == 0 (sizing only happens when a budget makes it
+    load-bearing — monkeypatching cannot cross the spawn boundary, so the
+    propagated counters ARE the assertion surface)."""
+    import json
+
+    import daft_tpu.runners as runners
+    from daft_tpu.distributed import DistributedRunner
+    from daft_tpu.observability.event_log import (disable_event_log,
+                                                  enable_event_log)
+
+    path, t = big_file
+    p = str(tmp_path / "scan_events.jsonl")
+    r = DistributedRunner(num_workers=1, n_partitions=2)
+    native = runners.NativeRunner()
+    sub = enable_event_log(p)
+    runners.set_runner(r)
+    try:
+        # a groupby ships the scan inside the shuffle-map tasks — a bare
+        # scan+select short-circuits on the driver and tests nothing
+        out = (dt.read_parquet(path).groupby("s")
+               .agg(col("a").count().alias("c")).to_pydict())
+    finally:
+        runners.set_runner(native)
+        disable_event_log(sub)
+        r.shutdown()
+    assert sum(out["c"]) == N_ROWS
+    events = [json.loads(l) for l in open(p)]
+    task_counters = [dict(e["engine_counters"]) for e in events
+                     if e["event"] == "task_stats"]
+    assert task_counters, "no task stats propagated from the workers"
+    scanned = sum(c.get("scan_rows", 0) for c in task_counters)
+    assert scanned == N_ROWS, \
+        f"worker-side scans reported {scanned} rows via engine counters"
+    assert all(c.get("scan_bytes", 0) == 0 for c in task_counters), \
+        "unbudgeted distributed scan sized morsels (scan_bytes != 0)"
+    ends = [e for e in events if e["event"] == "query_end"]
+    assert all(e["metrics"].get("scan_bytes", 0) == 0 for e in ends)
+
+
 def test_streaming_scan_feeds_spilling_sort_exactly(big_file):
     """End-to-end out-of-core pipeline: streaming scan -> external sort under
     a budget far below the file size, bit-identical to unbudgeted."""
