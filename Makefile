@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-fusion test-mesh test-fault test-oom test-gateway bench bench-ai bench-fusion bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
+.PHONY: test lint lint-json test-ai test-fusion test-pallas test-mesh test-fault test-oom test-gateway bench bench-ai bench-fusion bench-pallas bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -48,6 +48,24 @@ test-ai:
 test-fusion:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fused_region.py \
 		-q -p no:cacheprovider
+
+# Pallas kernel-tier suite (tier-1; also runs under `make test`): interpret-
+# mode parity for the segment-reduce, hash-probe join, and ICI ring-permute
+# kernels — int64 exactness past 2^53, null keys, lowering-failure fallback
+# counters, fused-repartition zero-all_to_all assert, no-import guard.
+# 8 forced host devices so the mesh/ring sections run off-silicon.
+test-pallas:
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytest tests/test_pallas_join.py tests/test_fused_region.py \
+		-q -p no:cacheprovider
+
+# Pallas kernel-tier capture (bench.py pallas_microbench): grouped aggs
+# through the segment-reduce kernel, a star join-agg through the hash-probe
+# kernel, a repartition through the in-kernel ICI ring permute (zero
+# standalone all_to_all) — bit-checked vs the XLA tiers, derived
+# pallas_dispatch_ratio in the JSON.
+bench-pallas:
+	env BENCH_PALLAS=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Whole-stage fusion capture (bench.py fusion_microbench): an 8-morsel
 # filter→project→UDF→agg chain, fused vs unfused dispatch counts,

@@ -33,6 +33,17 @@ Environment knobs:
                           fused region cuts device dispatches with
                           bit-identical results
     BENCH_FUSION_ROWS=N   fusion microbench fact rows (default 64_000)
+    BENCH_PALLAS=1        run the Pallas kernel-tier microbench instead:
+                          grouped aggs through the blocked segment-reduce
+                          kernel (int64 extremes past 2^53 included), a star
+                          join-agg through the hash-probe join kernel, and
+                          (with >= 8 devices — the XLA flag is forced like
+                          BENCH_MESH) a hash repartition through the
+                          in-kernel ICI ring permute with ZERO standalone
+                          all_to_all dispatches — every section bit-checked
+                          against the XLA tiers, with the derived
+                          pallas_dispatch_ratio in the JSON
+    BENCH_PALLAS_ROWS=N   pallas microbench fact rows (default 50_000)
     BENCH_SERVE=1         run the serving-tier bench instead: a 2-worker
                           ServingSession replaying a mixed repeat-heavy query
                           stream from >= 4 concurrent clients (CPU backend,
@@ -95,7 +106,9 @@ BASELINE_ROWS_PER_SEC = 50e6
 # BENCH_MESH=1 on CPU CI simulates an 8-chip host; the XLA flag must be in the
 # environment before the first jax backend init (imports below are lazy, so
 # mutating it here still works — same trick as tests/conftest.py).
-if os.environ.get("BENCH_MESH"):
+# BENCH_PALLAS gets the same 8 virtual devices so its ring-permute section
+# can run the fused repartition off-silicon.
+if os.environ.get("BENCH_MESH") or os.environ.get("BENCH_PALLAS"):
     _xla = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _xla:
         os.environ["XLA_FLAGS"] = (
@@ -165,6 +178,21 @@ def _derive_fusion_ratio(metric_totals: dict) -> None:
     disp = metric_totals.get("device_region_dispatches", 0)
     ops = metric_totals.get("device_region_ops_fused", 0)
     metric_totals["fused_dispatch_ratio"] = round(ops / max(disp, 1), 4)
+
+
+def _derive_pallas_ratio(metric_totals: dict) -> None:
+    """Attach pallas_dispatch_ratio — Pallas kernel launches (segment-reduce
+    + hash-probe + fused ring-permute) per device stage dispatch (single-chip
+    + mesh) — recorded explicitly even at 0.0 so every capture states whether
+    the in-kernel tier engaged instead of omitting the field. Can exceed 1.0:
+    one join stage launches one probe kernel per adjacent dim."""
+    pal = (metric_totals.get("pallas_dispatches", 0)
+           + metric_totals.get("pallas_probe_dispatches", 0)
+           + metric_totals.get("mesh_fused_permute_dispatches", 0))
+    disp = (metric_totals.get("device_grouped_batches", 0)
+            + metric_totals.get("device_stage_batches", 0)
+            + metric_totals.get("mesh_dispatches", 0))
+    metric_totals["pallas_dispatch_ratio"] = round(pal / max(disp, 1), 4)
 
 
 def _derive_shuffle_ratios(metric_totals: dict) -> None:
@@ -285,6 +313,7 @@ def fusion_microbench() -> None:
         disp = counters.device_stage_runs + counters.device_udf_runs
         totals = {k: v for k, v in counters.snapshot().items() if v}
         _derive_fusion_ratio(totals)
+        _derive_pallas_ratio(totals)
         return out, disp, best, totals
 
     fused_out, fused_disp, fused_s, fused_totals = run("on")
@@ -305,6 +334,159 @@ def fusion_microbench() -> None:
         "reps": REPS,
         "calibration": _calibration_dict(),
         "metrics": fused_totals,
+    })
+
+
+def pallas_microbench() -> None:
+    """BENCH_PALLAS=1: the Pallas kernel-tier capture — three sections, all
+    bit-checked against the XLA tiers (off silicon the kernels run in
+    interpret mode; pallas_mode=on is the parity switch):
+
+    1. grouped aggs through the blocked segment-reduce kernel — integer
+       sums, count, and int64 min/max past 2^53 (the widened eligibility:
+       refined hi/lo digit planes, exact over the full int64 range):
+       pallas_dispatches > 0, bit-identical to pallas_mode=off;
+    2. a star join-agg through the hash-probe join kernel (null fact keys,
+       misses): pallas_probe_dispatches > 0, bit-identical to off;
+    3. (>= 8 devices) a hash repartition through the in-kernel ICI ring
+       permute: mesh_fused_permute_dispatches > 0 with ZERO standalone
+       all_to_all dispatches, partitions identical to the classic exchange.
+
+    CPU CI invocation (make bench-pallas):
+
+        BENCH_PALLAS=1 JAX_PLATFORMS=cpu python bench.py
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+
+    import numpy as np
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.ops import counters
+
+    n = int(os.environ.get("BENCH_PALLAS_ROWS", 50_000))
+    rng = np.random.default_rng(7)
+    big = 1 << 53
+    fact = daft_tpu.from_pydict({
+        "fk": [int(x) if x % 37 else None for x in rng.integers(0, 500, n)],
+        "q": rng.integers(0, 50, n).tolist(),
+        "big": (big + rng.integers(0, 1000, n)).tolist(),
+    }).collect()
+    dim = daft_tpu.from_pydict({
+        "dk": list(range(500)),
+        "grp": [f"g{i % 7}" for i in range(500)],
+        "w": [float(i % 13) for i in range(500)],
+    }).collect()
+
+    def q_grouped():
+        return (fact.groupby("fk")
+                .agg(col("q").sum().alias("sq"),
+                     col("q").count().alias("cq"),
+                     col("big").min().alias("lo"),
+                     col("big").max().alias("hi"))
+                .sort("fk").collect())
+
+    def q_join():
+        return (fact.join(dim, left_on="fk", right_on="dk")
+                .groupby("grp")
+                .agg(col("q").sum().alias("sq"),
+                     col("w").sum().alias("sw"))
+                .sort("grp").collect())
+
+    shapes = {"grouped_kernel": q_grouped, "probe_join": q_join}
+    ref = {}
+    with execution_config_ctx(device_mode="on", device_min_rows=1,
+                              mesh_devices=1, pallas_mode="off"):
+        for name, qf in shapes.items():
+            ref[name] = qf().to_pydict()
+    counters.reset()
+    per_query = {name: float("inf") for name in shapes}
+    out = {}
+    with execution_config_ctx(device_mode="on", device_min_rows=1,
+                              mesh_devices=1, pallas_mode="on"):
+        for qf in shapes.values():
+            qf().to_pydict()  # warmup: kernel compiles + plane residency
+        elapsed = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            for name, qf in shapes.items():
+                tq = time.perf_counter()
+                out[name] = qf().to_pydict()
+                per_query[name] = min(per_query[name],
+                                      time.perf_counter() - tq)
+            elapsed = min(elapsed, time.perf_counter() - t0)
+    snap = counters.snapshot()
+    assert snap.get("pallas_dispatches", 0) > 0, \
+        "segment-reduce kernel never dispatched — not a pallas capture"
+    assert snap.get("pallas_probe_dispatches", 0) > 0, \
+        "hash-probe join kernel never dispatched — not a pallas capture"
+    assert snap.get("pallas_fallbacks", 0) == 0, \
+        f"kernel tier latched a fallback: {counters.rejections}"
+    for name in shapes:
+        assert out[name] == ref[name], \
+            f"{name} diverged from the XLA tier under pallas_mode=on"
+
+    fused_metrics: dict = {}
+    if len(jax.devices()) >= 8:
+        rep_rows = min(n, 40_000)
+        rep_df = daft_tpu.from_pydict({
+            "k": rng.integers(0, 997, rep_rows).tolist(),
+            "v": (rng.random(rep_rows) * 100).tolist(),
+        })
+        with execution_config_ctx(device_mode="on", mesh_devices=8,
+                                  device_min_rows=1, pallas_mode="off"):
+            classic = rep_df.repartition(8, col("k")).collect()
+        counters.reset()
+        with execution_config_ctx(device_mode="on", mesh_devices=8,
+                                  device_min_rows=1, pallas_mode="on"):
+            fused = rep_df.repartition(8, col("k")).collect()
+        assert counters.mesh_alltoall_dispatches == 0, \
+            "fused repartition still issued standalone all_to_all dispatches"
+        assert counters.mesh_fused_permute_dispatches > 0, \
+            "in-kernel ring permute never dispatched"
+        from daft_tpu.core.recordbatch import RecordBatch as _RB
+
+        def _pd(p):
+            bs = [b for b in p.batches if b.num_rows]
+            if not bs:
+                return {}
+            b = bs[0] if len(bs) == 1 else _RB.concat(bs)
+            return {c: b.get_column(c).to_pylist() for c in ("k", "v")}
+
+        for cp, fp in zip(classic._result, fused._result):
+            assert _pd(cp) == _pd(fp), \
+                "ring-permute partitions diverge from the classic exchange"
+        fused_metrics = {
+            "mesh_fused_permute_dispatches":
+                int(counters.mesh_fused_permute_dispatches),
+            "fused_repartition_alltoall_dispatches": 0,
+        }
+
+    metric_totals = {k: v for k, v in snap.items() if v}
+    _derive_pallas_ratio(metric_totals)
+    metric_totals.update(fused_metrics)
+    rows_per_sec = n * len(shapes) / elapsed
+    _emit({
+        "metric": "pallas_microbench_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 4),
+        "per_query_ms": {name: round(per_query[name] * 1000, 1)
+                         for name in shapes},
+        "pallas_dispatch_ratio": metric_totals["pallas_dispatch_ratio"],
+        "bit_identical": True,
+        "ring_permute_checked": bool(fused_metrics),
+        "fact_rows": n,
+        "reps": REPS,
+        "calibration": _calibration_dict(),
+        "metrics": metric_totals,
     })
 
 
@@ -380,6 +562,7 @@ def mesh_microbench() -> None:
     metric_totals = {k: v for k, v in counters.snapshot().items() if v}
     _derive_mesh_ratio(metric_totals)
     _derive_fusion_ratio(metric_totals)
+    _derive_pallas_ratio(metric_totals)
     # repeat-query residency: sharded planes resident => h2d flat after warmup
     metric_totals["mesh_repeat_h2d_bytes"] = int(h2d_after - h2d_warm)
     assert metric_totals["mesh_repeat_h2d_bytes"] == 0, \
@@ -442,6 +625,7 @@ def mesh_microbench() -> None:
     metric_totals.update({k: v for k, v in counters.snapshot().items() if v})
     _derive_mesh_ratio(metric_totals)
     _derive_fusion_ratio(metric_totals)
+    _derive_pallas_ratio(metric_totals)
 
     # ---- section 3: intra-host repartition over ICI ------------------------
     from daft_tpu.observability.metrics import registry as _registry
@@ -1216,6 +1400,9 @@ def main() -> None:
     if os.environ.get("BENCH_FUSION"):
         fusion_microbench()
         return
+    if os.environ.get("BENCH_PALLAS"):
+        pallas_microbench()
+        return
     if os.environ.get("BENCH_SERVE"):
         if os.environ.get("BENCH_SERVE_NET"):
             serve_bench_net()
@@ -1345,6 +1532,7 @@ def main() -> None:
     # Fused-region attribution: mean operators amortized per device dispatch
     # (the tentpole's "N ops, 1 RTT" claim at capture granularity).
     _derive_fusion_ratio(metric_totals)
+    _derive_pallas_ratio(metric_totals)
 
     # Shuffle transport attribution: compression + overlap ratios derived
     # from the wire/logical byte and cumulative/overlap second counters

@@ -1554,6 +1554,19 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
     from ..ops.stage import _decompose_agg
 
     n_slots = sum(len(_decompose_agg(agg.op)) for _n, agg in stage.aggs)
+    # Pallas hash-probe what-if arm: total padded table slots over the
+    # fact-adjacent dims (the kernel's brute-force probe is rows x slots
+    # cells; chained dims keep the host probe, so they contribute none).
+    # Priced for EVERY decision — the breakdown rides the record even when
+    # the stage is Pallas-ineligible, and the verdict feeds the ctx's auto
+    # gate preference.
+    probe_slots = 0
+    for d in spec.dims:
+        if d.parent[0] == "fact":
+            t = 128
+            while t < max(ctx.batches[d.name].num_rows, 1):
+                t *= 2
+            probe_slots += t
     chip_ok = True
     mesh_cost = None
     if grouped:
@@ -1604,6 +1617,9 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             cal, rows, nonres // amort, n_gathers, n_mm, n_ext, n_sct,
             cap_est, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal,
             resident_bytes=res)
+        pallas_cost = costmodel.device_join_pallas_cost(
+            cal, rows, nonres // amort, probe_slots, n_mm, n_ext, n_sct,
+            cap_est, fetch, rows // amort, coalesce=coal, resident_bytes=res)
         if topn:
             # device multi-key sort over the cap-length planes
             nkeys = len(node.topn.keys) + 2
@@ -1638,6 +1654,10 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
             cal, rows, nonres // amort, n_gathers, max(len(stage.aggs), 1),
             0, 0, 1, fetch, rows // amort, MAX_MATMUL_SEGMENTS, coalesce=coal,
             resident_bytes=res)
+        pallas_cost = costmodel.device_join_pallas_cost(
+            cal, rows, nonres // amort, probe_slots,
+            max(len(stage.aggs), 1), 0, 0, 1, fetch, rows // amort,
+            coalesce=coal, resident_bytes=res)
         host_cost = costmodel.host_join_agg_cost(
             cal, host_rows, len(spec.dims), len(stage.aggs), False, False)
         if spec.predicate is not None:
@@ -1673,9 +1693,14 @@ def _join_device_wins(node, ctx, batch, rows: int, grouped: bool, stage,
         chosen = {"mesh": "mesh", "chip": "device"}.get(forced_tier, "device")
     else:
         chosen = {"mesh": "mesh", "chip": "device", False: "host"}[tier]
+    # the auto Pallas-probe gate reads this preference on silicon: the kernel
+    # arm must beat the XLA gather arm for THIS join's shape, and only joins
+    # with fact-adjacent dims are probe-eligible at all
+    ctx.pallas_probe_preferred = bool(probe_slots) and pallas_cost < dev_cost
     rec = _placement.ledger().record(
         label, chosen, rows,
         forced=forced, device=dev_cost, host=host_cost, mesh=mesh_cost,
+        pallas=pallas_cost,
         detail=detail + (f", mesh x{mesh_ndev}" if mesh_ndev >= 2 else ""))
     return tier, rec
 
@@ -2068,6 +2093,15 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
             cal, rows, len(node.aggregations), grouped=True,
             has_predicate=node.predicate is not None,
             n_region_ops=extra_ops)
+        # what-if arm for the Pallas segment-reduce kernel: recorded on every
+        # grouped decision (even Pallas-ineligible stages) so ledger dumps
+        # carry the breakdown calibrate's DAFT_TPU_COST_PALLAS_RATE
+        # suggestion reads
+        pallas_cost = costmodel.device_grouped_pallas_cost(
+            cal, rows, nonres // amort, n_mm=len(stage._mm_specs),
+            n_ext=len(stage._ext_specs), cap=cap_est,
+            factorize_rows=factorize_cost_rows, coalesce=coal,
+            resident_bytes=res)
         detail = (f"{len(node.groupby)} keys, {len(node.aggregations)} aggs, "
                   f"~{card} groups")
     else:
@@ -2093,10 +2127,11 @@ def _device_wins(node, first: MicroPartition, grouped: bool,
             n_region_ops=extra_ops)
         detail = (f"{len(node.aggregations)} aggs"
                   + (", filtered" if node.predicate is not None else ""))
+        pallas_cost = None
     wins = dev_cost < host_cost
     rec = _placement.ledger().record(
         site, "device" if (wins or forced) else "host", rows, forced=forced,
-        device=dev_cost, host=host_cost, detail=detail)
+        device=dev_cost, host=host_cost, pallas=pallas_cost, detail=detail)
     return wins, rec
 
 
@@ -3003,6 +3038,37 @@ def _mesh_repartition(node, n: int) -> Iterator[MicroPartition]:
     yield from parts
 
 
+def _ring_permute_gate(n: int) -> Optional[bool]:
+    """Pallas gate for the fused ring-permute repartition exchange: returns
+    the kernel's `interpret` flag when it should engage (True = CPU
+    interpreter, for off-silicon parity under DAFT_TPU_PALLAS=on), None
+    when the standalone all_to_all tier serves the exchange. Mirrors
+    grouped_stage._pallas_gate: mode off / a latched lowering failure /
+    missing pallas keep the XLA tier; auto engages on real silicon only."""
+    from ..config import execution_config
+
+    mode = getattr(execution_config(), "pallas_mode", "auto")
+    if mode == "off" or _RING_PERMUTE_BROKEN[0]:
+        return None
+    from ..ops.pallas_kernels import pallas_available
+
+    if not pallas_available():
+        return None
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "on":
+        return not on_tpu
+    return False if on_tpu else None
+
+
+# process-wide latch: one runtime lowering failure routes every later
+# repartition exchange back onto the all_to_all tier (same discipline as
+# GroupedAggStage._pallas_broken, but the exchange has no stage object)
+_RING_PERMUTE_BROKEN = [False]
+_RING_PERMUTE_LOCK = threading.Lock()
+
+
 def _mesh_repartition_exchange(node, batches: List[RecordBatch], rows: int,
                                n: int) -> Iterator[MicroPartition]:
     import jax
@@ -3012,7 +3078,8 @@ def _mesh_repartition_exchange(node, batches: List[RecordBatch], rows: int,
     from ..ops import counters as _counters
     from ..ops.mesh_stage import _shard_np, mesh_row_mask, mesh_total
     from ..parallel.distributed import (default_mesh,
-                                        sharded_alltoall_repartition_step)
+                                        sharded_alltoall_repartition_step,
+                                        sharded_ring_repartition_step)
 
     big = batches[0] if len(batches) == 1 else RecordBatch.concat(batches)
     keys = [eval_expression(big, e) for e in node.by]
@@ -3031,7 +3098,6 @@ def _mesh_repartition_exchange(node, batches: List[RecordBatch], rows: int,
         valid = col.validity_numpy()
         cols.append((vals, valid))
         dtypes += [vals.dtype, np.bool_]
-    step = sharded_alltoall_repartition_step(mesh, dtypes)
     flat = []
     ici_bytes = 0
     for vals, valid in cols:
@@ -3039,11 +3105,32 @@ def _mesh_repartition_exchange(node, batches: List[RecordBatch], rows: int,
         # the exchanged scratch is [n, S] per shard per plane: every plane
         # crosses the interconnect once at its padded size
         ici_bytes += n * total * vals.dtype.itemsize + n * total
-    counts, planes = step(_shard_np(mesh, dest, total),
-                          mesh_row_mask(mesh, rows, total), *flat)
+    args = (_shard_np(mesh, dest, total), mesh_row_mask(mesh, rows, total))
+    ring = _ring_permute_gate(n)
+    counts = None
+    if ring is not None:
+        try:
+            step = sharded_ring_repartition_step(mesh, dtypes, interpret=ring)
+            counts, planes = step(*args, *flat)
+            jax.block_until_ready(counts)
+        except Exception as exc:
+            # runtime lowering failure: latch onto the all_to_all tier and
+            # replay the batch — nothing was consumed, the retry is exact
+            with _RING_PERMUTE_LOCK:
+                _RING_PERMUTE_BROKEN[0] = True
+            counts = None
+            _counters.bump("pallas_fallbacks")
+            _counters.reject(
+                "pallas", "in-kernel ring permute failed to lower; "
+                "repartition replayed on the all_to_all tier", str(exc))
+    if counts is None:
+        step = sharded_alltoall_repartition_step(mesh, dtypes)
+        counts, planes = step(*args, *flat)
+        _counters.bump("mesh_alltoall_dispatches")
+    else:
+        _counters.bump("mesh_fused_permute_dispatches")
     counts_np = np.asarray(jax.device_get(counts))
     planes_np = [np.asarray(p) for p in jax.device_get(list(planes))]
-    _counters.bump("mesh_alltoall_dispatches")
     _counters.bump("mesh_alltoall_rows", rows)
     _counters.bump("mesh_alltoall_ici_bytes", ici_bytes)
 

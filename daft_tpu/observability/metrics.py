@@ -185,9 +185,14 @@ DEVICE_COUNTER_NAMES = (
     # fused_dispatch_ratio bench derivation).
     "device_region_dispatches",   # device dispatches issued by fused regions
     "device_region_ops_fused",    # operators covered by those dispatches
-    # Pallas kernel tier (ops/pallas_kernels.py segment-reduce groupby)
+    # Pallas kernel tier (ops/pallas_kernels.py: segment-reduce groupby,
+    # hash-probe join, in-kernel ICI ring permute)
     "pallas_dispatches",       # grouped-agg batches through the Pallas kernel
-    "pallas_fallbacks",        # Pallas lowering/run failures -> segment_* path
+    "pallas_fallbacks",        # Pallas lowering/run failures -> XLA tier
+    "pallas_probe_dispatches",  # join index planes probed in-kernel
+    # intra-host repartition exchanged by the in-kernel ring permute instead
+    # of a standalone all_to_all dispatch (mesh_alltoall_dispatches stays 0)
+    "mesh_fused_permute_dispatches",
 )
 
 # Serving-tier counters OUTSIDE the ops/counters.py reset scope (cancellation

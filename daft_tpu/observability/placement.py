@@ -62,12 +62,13 @@ class PlacementRecord:
     through the owning ledger's lock."""
 
     __slots__ = ("seq", "site", "chosen", "rows", "cached", "forced", "reason",
-                 "detail", "ts", "device", "host", "mesh", "observed",
-                 "error_ratio", "query_tag")
+                 "detail", "ts", "device", "host", "mesh", "pallas",
+                 "observed", "error_ratio", "query_tag")
 
     def __init__(self, seq: int, site: str, chosen: str, rows: int,
                  cached: bool, forced: bool, reason: str, detail: str,
-                 device=None, host=None, mesh=None, query_tag: str = ""):
+                 device=None, host=None, mesh=None, pallas=None,
+                 query_tag: str = ""):
         self.seq = seq
         self.site = site
         self.chosen = chosen
@@ -80,6 +81,12 @@ class PlacementRecord:
         self.device = _terms(device)
         self.host = _terms(host)
         self.mesh = _terms(mesh)
+        # what-if breakdown of the Pallas kernel arm (device_join_pallas_cost
+        # / device_grouped_pallas_cost): never a `chosen` value of its own —
+        # the kernel rides the device/mesh tiers — but recorded on EVERY
+        # decision (including Pallas-ineligible stages) so EXPLAIN PLACEMENT
+        # and the calibrate tool can see what the kernel would have cost.
+        self.pallas = _terms(pallas)
         # filled by feedback(): {"total": s, "h2d": s, "dispatch": s,
         # "d2h": s, "rows": n, "dispatches": k, "fallback": 0/1}
         self.observed: Optional[Dict[str, float]] = None
@@ -110,7 +117,7 @@ class PlacementRecord:
             v = getattr(self, k)
             if v:
                 out[k] = v
-        for k in ("device", "host", "mesh", "observed"):
+        for k in ("device", "host", "mesh", "pallas", "observed"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = dict(v)
@@ -207,18 +214,19 @@ class PlacementLedger:
 
     def _next_rec(self, site: str, chosen: str, rows: int, cached: bool,
                   forced: bool, reason: str, detail: str, scope,
-                  device=None, host=None, mesh=None) -> PlacementRecord:
+                  device=None, host=None, mesh=None,
+                  pallas=None) -> PlacementRecord:
         with self._lock:
             self._seq += 1
             return PlacementRecord(self._seq, site, chosen, rows, cached,
                                    forced, reason, detail, device=device,
-                                   host=host, mesh=mesh,
+                                   host=host, mesh=mesh, pallas=pallas,
                                    query_tag=scope.tag if scope else "")
 
     def record(self, site: str, chosen: str, rows: int = 0, *,
                cached: bool = False, forced: bool = False, reason: str = "",
                detail: str = "", device=None, host=None,
-               mesh=None) -> Optional[PlacementRecord]:
+               mesh=None, pallas=None) -> Optional[PlacementRecord]:
         """Record one COSTED (or forced) placement decision; returns the
         record so the executor can feed observed timings back, or None when
         the ledger is disabled. Registry counters move here — and only here —
@@ -228,7 +236,7 @@ class PlacementLedger:
         scope = current_scope()
         rec = self._next_rec(site, chosen, rows, cached, forced, reason,
                              detail, scope, device=device, host=host,
-                             mesh=mesh)
+                             mesh=mesh, pallas=pallas)
         self._append(rec, count_drop=True)
         reg = registry()
         if forced:
@@ -531,7 +539,8 @@ def render(records: List[PlacementRecord]) -> str:
                 f"({loser} {_fmt_ms(tiers[loser])} vs "
                 f"{winner} {_fmt_ms(tiers[winner])}, {m:.2f}x)")
         sides = [(n, d) for n, d in (("device", r.device), ("host", r.host),
-                                     ("mesh", r.mesh)) if d is not None]
+                                     ("mesh", r.mesh), ("pallas", r.pallas))
+                 if d is not None]
         if sides:
             names = [n for n, _ in sides]
             lines.append("    " + f"{'term':<14}"

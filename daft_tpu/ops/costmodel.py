@@ -171,6 +171,12 @@ class Calibration:
     # captures should override via DAFT_TPU_COST_PALLAS_RATE. Defaulted so
     # old call sites construct.
     pallas_cell_rate: float = 1e12
+    # Pallas hash-probe join (ops/pallas_kernels.py hash_probe_index): fact
+    # rows brute-force compare every dim table slot in VMEM — pure VPU
+    # equality cells, cheaper than the reduce's one-hot cells. Override via
+    # DAFT_TPU_COST_PALLAS_PROBE_RATE (tools/calibrate.py suggests both
+    # Pallas rates from placement-ledger samples).
+    pallas_probe_cell_rate: float = 2e12
 
 
 _CAL: Optional[Calibration] = None
@@ -296,6 +302,7 @@ def calibrate() -> Calibration:
         scatter_rows_per_s=_env_f("DAFT_TPU_COST_SCATTER_RATE", 1e8),
         ext_cell_rate=_env_f("DAFT_TPU_COST_EXT_RATE", 5e9),
         pallas_cell_rate=_env_f("DAFT_TPU_COST_PALLAS_RATE", 1e12),
+        pallas_probe_cell_rate=_env_f("DAFT_TPU_COST_PALLAS_PROBE_RATE", 2e12),
         host_agg_rate=_env_f("DAFT_TPU_COST_HOST_AGG", 1.5e8),
         host_factorize_rate=_env_f("DAFT_TPU_COST_HOST_FACT", 8e6),
         host_probe_rate=_env_f("DAFT_TPU_COST_HOST_PROBE", 3e7),
@@ -609,6 +616,31 @@ def device_join_agg_cost(cal: Calibration, rows: int, upload_bytes: int,
     out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
     _segment_reduce_terms(out, cal, rows, n_mm, n_ext, n_sct, cap_est,
                           matmul_ceiling=matmul_ceiling)
+    return out
+
+
+def device_join_pallas_cost(cal: Calibration, rows: int, upload_bytes: int,
+                            probe_slots: int, n_mm: int, n_ext: int,
+                            n_sct: int, cap_est: int, fetch_bytes: int,
+                            factorize_rows: int, coalesce: float = 1.0,
+                            resident_bytes: int = 0) -> CostBreakdown:
+    """The Pallas hash-probe join arm (ops/pallas_kernels.py
+    hash_probe_index / hash_probe_segment_sum): the per-dim dynamic gathers
+    and index-plane uploads are replaced by a brute-force VMEM probe — fact
+    rows compare against every padded dim table slot (rows x probe_slots VPU
+    equality cells at ``pallas_probe_cell_rate``, gather-free) — and the
+    segment reduce rides the compute-bound ``pallas_cell_rate`` like the
+    grouped Pallas tier. Priced for EVERY device_join decision so the ledger
+    carries the what-if breakdown even for Pallas-ineligible stages (the
+    PR 14 host-reject-keeps-mesh-what-if discipline) and calibrate can
+    suggest both rates the moment samples exist."""
+    out = _base_terms(cal, upload_bytes, coalesce, resident_bytes)
+    out.add("probe",
+            rows * max(probe_slots, 128) / cal.pallas_probe_cell_rate)
+    out.add("compute", rows * max(cap_est, 8) * max(n_mm + n_ext + n_sct, 1)
+            / cal.pallas_cell_rate)
+    out.add("factorize", factorize_rows / cal.host_factorize_rate)
+    out.add("d2h", fetch_bytes / cal.d2h_bytes_per_s)
     return out
 
 

@@ -515,6 +515,13 @@ class _JoinContext:
         self.spec = spec
         self.dims = spec.dims
         self.batches = dim_batches              # dim name -> RecordBatch (base rows)
+        # Pallas hash-probe tier state: the broken latch is per-context (one
+        # lowering failure reverts every later batch of this join to the host
+        # probe); the preference flag is set by the executor's
+        # device_join_pallas_cost arm and read by _pallas_probe_gate's auto
+        # branch.
+        self._pallas_probe_broken = False
+        self.pallas_probe_preferred = False
         self.syn_series: Dict[str, Dict[str, object]] = {}
         self._dev_filters: Dict[str, List[Expression]] = {}
         self._host_filters: Dict[str, List[Expression]] = {}
@@ -734,19 +741,126 @@ class _JoinContext:
         pvalid = (pidx >= 0) & valid[safe]
         return pv, pvalid
 
+    # ---- Pallas hash-probe tier ----------------------------------------------------
+    def _pallas_probe_gate(self, batch, d: DimSpec):
+        """Whether dim `d`'s device index plane builds on the Pallas
+        hash-probe kernel (ops/pallas_kernels.py hash_probe_index) instead of
+        the host probe + upload. Returns the kernel's `interpret` flag when
+        it should (True = CPU interpreter, for off-silicon parity under
+        DAFT_TPU_PALLAS=on), None for the host tier. Same mode vocabulary as
+        grouped_stage._pallas_gate; the auto branch additionally requires the
+        executor's device_join_pallas_cost arm to have preferred the kernel
+        for this join's shape. Chained dims keep the host path — their probe
+        values flow through the parent's HOST index, so an in-kernel probe
+        would not remove the host work it exists to skip."""
+        if d.parent[0] != "fact":
+            return None
+        from ..config import execution_config
+
+        mode = getattr(execution_config(), "pallas_mode", "auto")
+        if mode == "off" or self._pallas_probe_broken:
+            return None
+        from .pallas_kernels import MAX_PALLAS_BUCKET, pallas_available
+
+        if not pallas_available():
+            return None
+        if self.batches[d.name].num_rows >= MAX_PALLAS_BUCKET:
+            return None
+        on_tpu = jax.default_backend() == "tpu"
+        if mode == "on":
+            return not on_tpu
+        return False if (on_tpu and self.pallas_probe_preferred) else None
+
+    def _pallas_probe_table_host(self, d: DimSpec, kdt):
+        """Host (tbl_hi, tbl_lo, tbl_row) probe-table planes for dim `d`'s
+        key column — built ONCE per resident dim key Series and cached in the
+        ResidencyManager alongside the index planes, shared by the single-chip
+        and mesh probe paths (each uploads into its own slot). Non-unique /
+        non-integer / sentinel-valued keys raise DeviceFallback with the same
+        semantics as unique_key_index, so both tiers reject identical dims."""
+        from . import pallas_kernels as pk
+
+        key_series = self.batches[d.name].get_column(d.key_col)
+
+        def build():
+            s = key_series
+            if s.dtype != kdt:
+                s = s.cast(kdt)
+            kind, vals, valid = canonical_key_values(s)
+            if kind != "num":
+                raise DeviceFallback(
+                    f"dim key {key_series.name!r} is not an integer-like key")
+            try:
+                return pk.build_probe_table(
+                    vals.astype(np.int64, copy=False), valid)
+            except ValueError as exc:
+                raise DeviceFallback(
+                    f"dim key {key_series.name!r}: {exc}") from exc
+
+        return series_keyed(key_series, ("ptable", d.key_col, repr(kdt)),
+                            (), build)
+
+    def _pallas_dev_idx(self, batch, d: DimSpec, bucket: int, interp: bool):
+        """Padded device index plane for one ADJACENT dim, probed IN-KERNEL:
+        fact key digits matched against the VMEM-resident dim hash table —
+        no host hash probe, no index-plane upload (the h2d is two int32 digit
+        planes that the kernel consumes in place). Bit-identical to the host
+        unique_key_index path (pinned in tests/test_pallas_join.py) and
+        cached under its own slot key, so repeat queries re-probe nothing."""
+        from . import pallas_kernels as pk
+
+        dim_b = self.batches[d.name]
+        kdt = _common_key_dtype(
+            self._probe_dtype(batch, d), dim_b.schema[d.key_col].dtype)
+        tbl = self._pallas_probe_table_host(d, kdt)
+        anchor = self._probe_anchor(batch, d)
+        key_series = dim_b.get_column(d.key_col)
+        n = batch.num_rows
+
+        def build():
+            vals, valid = self._probe_values(batch, d, {}, kdt)
+            pv = np.full(bucket, pk.PROBE_SENTINEL, dtype=np.int64)
+            pm = np.zeros(bucket, dtype=bool)
+            pv[:n] = vals
+            pm[:n] = valid
+            fh, fl = pk.probe_key_digits(jnp.asarray(pv), jnp.asarray(pm))
+            idx = pk.hash_probe_index(
+                fh, fl, jnp.asarray(tbl[0]), jnp.asarray(tbl[1]),
+                jnp.asarray(tbl[2]), interpret=interp)
+            counters.bump("pallas_probe_dispatches")
+            return idx
+
+        return series_keyed(anchor, ("pdidx", d.key_col, d.parent, bucket),
+                            (key_series, tbl), build, rebuild_rows=n)
+
     def dev_idx(self, batch, dname: str, bucket: int, perm=None):
         """Padded device index plane for one dim, cached on the probe Series
         (identity: the host idx array — itself cached — plus the dim key).
         With `perm` (host group-sorted layout) the permutation is FOLDED INTO
         the indices, so the packed row-gather emits rows pre-sorted at zero
-        extra cost."""
+        extra cost. Under the Pallas gate the plain (un-permuted) plane is
+        probed in-kernel instead — a kernel failure latches the tier off and
+        falls through to the host probe below IN THE SAME CALL, so the batch
+        replays without the caller noticing."""
         d = next(dd for dd in self.dims if dd.name == dname)
-        idxs = self.indices_for(batch)
         anchor = self._probe_anchor(batch, d)
-        idx_np = idxs[dname]
         n = batch.num_rows
 
         if perm is None:
+            interp = self._pallas_probe_gate(batch, d)
+            if interp is not None:
+                try:
+                    return self._pallas_dev_idx(batch, d, bucket, interp)
+                except DeviceFallback:
+                    raise
+                except Exception as exc:  # noqa: BLE001 - latch + host replay
+                    self._pallas_probe_broken = True
+                    counters.bump("pallas_fallbacks")
+                    counters.reject(
+                        "pallas", "hash-probe join kernel failed; index "
+                        "plane replayed on the host probe tier", str(exc))
+            idx_np = self.indices_for(batch)[dname]
+
             def build():
                 padded = np.full(bucket, -1, dtype=np.int32)
                 padded[:n] = idx_np
@@ -755,6 +869,7 @@ class _JoinContext:
             return series_keyed(anchor, ("didx", d.key_col, d.parent, bucket),
                                 (idx_np,), build, rebuild_rows=n)
 
+        idx_np = self.indices_for(batch)[dname]
         pperm_np, _pdev = perm
 
         def build_p():
@@ -777,7 +892,7 @@ class _JoinContext:
             anchor = self._probe_anchor(batch, d)
             if not any(manager().is_resident(
                     anchor, (fam, d.key_col, d.parent, bucket))
-                    for fam in ("didx", "didxp")):
+                    for fam in ("didx", "didxp", "pdidx")):
                 total += bucket * 4
         return total
 

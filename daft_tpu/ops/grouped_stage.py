@@ -558,17 +558,20 @@ class GroupedAggStage:
 
     def _pallas_eligible(self) -> bool:
         """Exactness contract for the Pallas tier (ops/pallas_kernels.py):
-        the kernel accumulates f32 planes — exact only for small-integer
-        planes (rows/count/digit sums) and f32 float extremes. f64-exact
-        mode, raw float/bool sum planes, int extremes (f64 ext planes) and
-        int64 scatters keep the XLA tiers."""
-        if self._use_f64 or self._sct_specs:
+        sum planes accumulate in f32 — exact only for small-integer planes
+        (rows/count/digit sums) — so raw float/bool sums and f64-exact mode
+        (float min/max stages) keep the XLA tiers. Integer extremes — the
+        f64 ext planes AND the int64 scatter slots — are now served exactly
+        by segment_extreme_int64's refined hi/lo digit planes (exact over
+        the FULL int64 range, parity-pinned past 2^53 in tests), so they no
+        longer disqualify a stage."""
+        if self._use_f64:
             return False
         for _idx, kind in self._mm_specs:
             if not (kind in ("rows", "count") or kind.startswith("isum")):
                 return False
-        for _idx, _op, use_f64 in self._ext_specs[1:]:
-            if use_f64:
+        for _idx, kind in self._sct_specs:
+            if kind not in ("min", "max"):
                 return False
         return True
 
@@ -600,7 +603,7 @@ class GroupedAggStage:
         n_mm, n_ext = len(self._mm_specs), len(self._ext_specs)
         pallas = cm.device_grouped_pallas_cost(cal, r, 0, n_mm, n_ext, cap, 0)
         sort = cm.device_grouped_sort_cost(cal, r, 0, n_mm + n_ext, 0)
-        return False if pallas.total() < sort.total() else None
+        return False if pallas.total < sort.total else None
 
     def _build_pallas(self, cap: int, interpret: bool) -> Callable:
         """Pallas blocked segment-reduce tier: same output contract as
@@ -624,6 +627,7 @@ class GroupedAggStage:
                               count_all))
 
         mm_specs, ext_specs = self._mm_specs, self._ext_specs
+        sct_specs = self._sct_specs
 
         def stage(cols: Dict[str, dev.DCol], codes: jnp.ndarray,
                   row_mask: jnp.ndarray, row_offset: jnp.ndarray):
@@ -663,10 +667,17 @@ class GroupedAggStage:
             # arange — row_offset folds back in f64 after the kernel
             min_slots, max_slots = [], []
             min_planes, max_planes = [], []
-            for slot, (agg_idx, op, _use_f64) in enumerate(ext_specs):
+            int_ext = []    # (slot, agg_idx, op): exact-int64 extreme family
+            for slot, (agg_idx, op, use_f64) in enumerate(ext_specs):
                 if agg_idx < 0:
                     v = jnp.arange(bucket, dtype=jnp.float32)
                     mask = keep
+                elif use_f64:
+                    # integer extreme (f64 plane on the XLA tier): served by
+                    # the refined hi/lo digit-plane kernel below — a single
+                    # f32 plane would quantize values past 2^24
+                    int_ext.append((slot, agg_idx, op))
+                    continue
                 else:
                     v, mask = evaluated[agg_idx]
                     v = v.astype(jnp.float32)
@@ -699,8 +710,27 @@ class GroupedAggStage:
             ext_out[0] = jnp.where(jnp.isfinite(r0),
                                    r0.astype(jnp.float64) + row_offset,
                                    jnp.inf)
+            # exact-int64 families: integer ext planes decode back to the f64
+            # plane contract (±inf = empty group), int64 scatter slots keep
+            # their native int64 identity-fill contract — both bit-match the
+            # XLA tier's segment_min/max outputs including values past 2^53
+            for slot, agg_idx, op in int_ext:
+                v, mask = evaluated[agg_idx]
+                vals, nonempty = pk.segment_extreme_int64(
+                    v.astype(jnp.int64), mask, seg, cap, op,
+                    interpret=interpret)
+                big = jnp.float64(jnp.inf if op == "min" else -jnp.inf)
+                ext_out[slot] = jnp.where(nonempty, vals.astype(jnp.float64),
+                                          big)
+            scts = []
+            for agg_idx, kind in sct_specs:
+                v, mask = evaluated[agg_idx]
+                vals, _nonempty = pk.segment_extreme_int64(
+                    v.astype(jnp.int64), mask, seg, cap, kind,
+                    interpret=interpret)
+                scts.append(vals)
 
-            return {"mm": acc_mm, "ext": tuple(ext_out), "sct": ()}
+            return {"mm": acc_mm, "ext": tuple(ext_out), "sct": tuple(scts)}
 
         return jax.jit(stage)
 

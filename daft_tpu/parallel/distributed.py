@@ -547,27 +547,115 @@ def sharded_alltoall_repartition_step(mesh: Mesh, dtypes: Sequence,
 
     def local(dest, row_mask, *planes):
         S = dest.shape[0]
-        d = jnp.where(row_mask, dest.astype(jnp.int64), n_dev)
-        order = jnp.argsort(d)  # jax argsort lowers to a stable lax.sort
-        d_sorted = d[order]
-        valid_sorted = d_sorted < n_dev
-        counts = _segment_reduce("count", d, d < n_dev,
-                                 jnp.minimum(d, n_dev), n_dev + 1)[:n_dev]
-        offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64),
-                                   jnp.cumsum(counts)[:-1]])
-        safe_bin = jnp.minimum(d_sorted, n_dev - 1)
-        pos = jnp.arange(S, dtype=jnp.int64) - offsets[safe_bin]
-        flat_idx = jnp.where(valid_sorted, safe_bin * S + pos, n_dev * S)
-        outs = []
-        for p in planes:
-            sp = p[order]
-            mat = jnp.zeros((n_dev * S,), dtype=p.dtype)
-            mat = mat.at[flat_idx].set(sp, mode="drop")
-            outs.append(jax.lax.all_to_all(
-                mat.reshape(n_dev, S), axis, split_axis=0, concat_axis=0,
-                tiled=True))
+        counts, mats = _repart_sort_pack(dest, row_mask, planes, n_dev, S)
+        outs = [jax.lax.all_to_all(m, axis, split_axis=0, concat_axis=0,
+                                   tiled=True) for m in mats]
         cnt_x = jax.lax.all_to_all(counts.reshape(n_dev, 1), axis,
                                    split_axis=0, concat_axis=0, tiled=True)
+        return cnt_x.reshape(n_dev), tuple(outs)
+
+    in_specs = tuple([P(axis), P(axis)] + [P(axis)] * len(dtypes))
+    out_specs = (P(axis), tuple(P(axis) for _ in dtypes))
+    return jax.jit(_shard_map(local, mesh, in_specs, out_specs))
+
+
+def _repart_sort_pack(dest, row_mask, planes, n_dev: int, S: int):
+    """Shared local half of both repartition exchanges: stable-sort this
+    shard's rows by destination and scatter them into per-destination bins.
+    Returns (counts[n_dev] int64, one [n_dev, S] bin matrix per plane)."""
+    d = jnp.where(row_mask, dest.astype(jnp.int64), n_dev)
+    order = jnp.argsort(d)  # jax argsort lowers to a stable lax.sort
+    d_sorted = d[order]
+    valid_sorted = d_sorted < n_dev
+    counts = _segment_reduce("count", d, d < n_dev,
+                             jnp.minimum(d, n_dev), n_dev + 1)[:n_dev]
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                               jnp.cumsum(counts)[:-1]])
+    safe_bin = jnp.minimum(d_sorted, n_dev - 1)
+    pos = jnp.arange(S, dtype=jnp.int64) - offsets[safe_bin]
+    flat_idx = jnp.where(valid_sorted, safe_bin * S + pos, n_dev * S)
+    mats = []
+    for p in planes:
+        sp = p[order]
+        mat = jnp.zeros((n_dev * S,), dtype=p.dtype)
+        mat = mat.at[flat_idx].set(sp, mode="drop")
+        mats.append(mat.reshape(n_dev, S))
+    return counts, mats
+
+
+def _pack_words(mat: jnp.ndarray) -> jnp.ndarray:
+    """[n_dev, S] plane of any device dtype -> [n_dev, W] uint32 words,
+    bit-exact and invertible by _unpack_words: 64-bit types split into two
+    words, <=32-bit types widen losslessly to one."""
+    dt = mat.dtype
+    if dt.itemsize == 8:
+        return jax.lax.bitcast_convert_type(mat, jnp.uint32) \
+            .reshape(mat.shape[0], -1)
+    if dt == jnp.bool_:
+        return mat.astype(jnp.uint32)
+    if jnp.issubdtype(dt, jnp.floating):
+        return jax.lax.bitcast_convert_type(mat.astype(jnp.float32),
+                                            jnp.uint32)
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return mat.astype(jnp.uint32)
+    return jax.lax.bitcast_convert_type(mat.astype(jnp.int32), jnp.uint32)
+
+
+def _unpack_words(words: jnp.ndarray, dt, S: int) -> jnp.ndarray:
+    """Inverse of _pack_words: [n_dev, W] uint32 back to an [n_dev, S] dt
+    plane."""
+    dt = jnp.dtype(dt)
+    if dt.itemsize == 8:
+        pair = words.reshape(words.shape[0], S, 2)
+        if jnp.issubdtype(dt, jnp.floating):
+            return jax.lax.bitcast_convert_type(pair, jnp.float64)
+        return jax.lax.bitcast_convert_type(pair, jnp.uint64).astype(dt)
+    if dt == jnp.bool_:
+        return words != 0
+    if jnp.issubdtype(dt, jnp.floating):
+        return jax.lax.bitcast_convert_type(words, jnp.float32).astype(dt)
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return words.astype(dt)
+    return jax.lax.bitcast_convert_type(words, jnp.int32).astype(dt)
+
+
+def sharded_ring_repartition_step(mesh: Mesh, dtypes: Sequence,
+                                  axis: str = "dp",
+                                  interpret: bool = False) -> Callable:
+    """The Pallas tier of the intra-host repartition: same contract and
+    bit-identical results as sharded_alltoall_repartition_step, but the
+    exchange is an IN-KERNEL ICI ring permute (ops/pallas_kernels.py
+    ring_permute_bits — a pallas_call issuing per-step remote DMAs with
+    send/recv semaphores) instead of a standalone jax.lax.all_to_all. The
+    sort, the per-destination pack, the permute and the unpack all lower
+    into ONE compiled program with ZERO separate mesh collective dispatches
+    — every plane (and the counts) bitcast into a single [n_dev, W] uint32
+    word buffer so the ring crosses the interconnect exactly once.
+
+    Selected by the executor's repartition exchange under DAFT_TPU_PALLAS
+    (on = engage, interpret off-silicon; auto = silicon only); a runtime
+    lowering failure there latches back onto the all_to_all tier and
+    replays the batch.
+    """
+    n_dev = int(mesh.shape[axis])
+    dtypes = tuple(dtypes)
+
+    def local(dest, row_mask, *planes):
+        from ..ops.pallas_kernels import ring_permute_bits
+
+        S = dest.shape[0]
+        counts, mats = _repart_sort_pack(dest, row_mask, planes, n_dev, S)
+        words = [_pack_words(m) for m in mats]
+        widths = [w.shape[1] for w in words]
+        words.append(_pack_words(counts.reshape(n_dev, 1)))
+        buf = jnp.concatenate(words, axis=1)
+        out = ring_permute_bits(buf, axis, interpret=interpret)
+        outs = []
+        off = 0
+        for dt, w in zip(dtypes, widths):
+            outs.append(_unpack_words(out[:, off:off + w], dt, S))
+            off += w
+        cnt_x = _unpack_words(out[:, off:off + 2], np.int64, 1)
         return cnt_x.reshape(n_dev), tuple(outs)
 
     in_specs = tuple([P(axis), P(axis)] + [P(axis)] * len(dtypes))

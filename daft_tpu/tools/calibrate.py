@@ -80,6 +80,7 @@ def _samples(records: List[dict]) -> List[dict]:
         if not pred or not pred.get("total"):
             continue
         out.append({"site": r.get("site", "?"), "pred": pred, "obs": obs,
+                    "pallas": r.get("pallas"),
                     "rows_pred": r.get("rows", 0),
                     "error_ratio": r.get("error_ratio")})
     return out
@@ -203,6 +204,48 @@ def suggest(records: List[dict],
         cur = cal.get("ici_bytes_per_s")
         if cur and (r > 2 or r < 0.5):
             report["suggestions"]["DAFT_TPU_COST_ICI"] = f"{cur / r:.4g}"
+
+    # pallas terms (the kernel tier): every device decision carries the
+    # Pallas arm as a what-if side, but the breakdown only describes the
+    # dispatched work when the arm actually won its gate — approximated
+    # here as "pallas total under the chosen tier's total", the same
+    # preference the auto gates apply. Two rates, two sample shapes: the
+    # segment-reduce rate (DAFT_TPU_COST_PALLAS_RATE) calibrates from
+    # grouped-shaped samples (compute term, no probe) via the plain
+    # dispatch residual; the join-probe rate
+    # (DAFT_TPU_COST_PALLAS_PROBE_RATE) from join-shaped samples (probe
+    # term present) via the residual left after the predicted reduce is
+    # subtracted — the ici mechanics, one level down.
+    comp_ratios: List[float] = []
+    probe_ratios: List[float] = []
+    for s in samples:
+        pw = s.get("pallas")
+        if not pw or not pw.get("total") \
+                or pw["total"] > s["pred"].get("total", 0.0):
+            continue
+        n_disp = s["obs"].get("dispatches", 0)
+        residual = s["obs"].get("dispatch", 0.0) - n_disp * cal_rtt
+        pred_c = pw.get("compute", 0.0)
+        pred_p = pw.get("probe", 0.0)
+        if pred_p > _MIN_TERM_S:
+            rp = residual - pred_c
+            if rp > _MIN_TERM_S:
+                probe_ratios.append(rp / pred_p)
+        elif pred_c > _MIN_TERM_S and residual > _MIN_TERM_S:
+            comp_ratios.append(residual / pred_c)
+    for ratios, term, knob, cal_key in (
+            (comp_ratios, "pallas_compute", "DAFT_TPU_COST_PALLAS_RATE",
+             "pallas_cell_rate"),
+            (probe_ratios, "pallas_probe", "DAFT_TPU_COST_PALLAS_PROBE_RATE",
+             "pallas_probe_cell_rate")):
+        if not ratios:
+            continue
+        r = _median(ratios)
+        report["terms"][term] = {"samples": len(ratios),
+                                 "observed_over_predicted": round(r, 4)}
+        cur = cal.get(cal_key)
+        if cur and (r > 2 or r < 0.5):
+            report["suggestions"][knob] = f"{cur / r:.4g}"
 
     errs = [s["error_ratio"] for s in samples
             if s.get("error_ratio") is not None]
