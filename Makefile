@@ -6,7 +6,7 @@ PY ?= python
 LATEST_BENCH := $(shell ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
 NEW_BENCH ?= /tmp/daft_tpu_bench_new.json
 
-.PHONY: test lint lint-json test-ai test-fusion test-pallas test-mesh test-fault test-oom test-gateway bench bench-ai bench-fusion bench-pallas bench-mesh bench-serve bench-serve-net bench-oom bench-tpcds bench-gate bench-compare calibrate-report doctor serve
+.PHONY: test lint lint-json test-ai test-fusion test-pallas test-mesh test-fault test-oom test-gateway bench bench-ai bench-fusion bench-pallas bench-mesh bench-serve bench-serve-net bench-oom bench-oom-quick bench-tpcds bench-gate bench-compare calibrate-report doctor serve
 
 # `make test` includes the lint gate via tests/test_lint.py (tier-1).
 test:
@@ -137,6 +137,16 @@ test-oom:
 # the JSON. SF100-capable: BENCH_SF=100 make bench-oom on a big box.
 bench-oom:
 	env BENCH_OOM=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Quick mode: the synthetic carry-preserving-merge microbench (no TPC-H
+# datagen) — BENCH_OOM_ROWS rows forced through a multi-run external sort
+# under a tiny budget, asserting bit-identity, the merge's O(rows)/level
+# sort bound, and the prefetch high-water. The same body runs in tier-1
+# via tests/test_spill_async.py.
+BENCH_OOM_ROWS ?= 200000
+bench-oom-quick:
+	env BENCH_OOM=1 BENCH_OOM_ROWS=$(BENCH_OOM_ROWS) JAX_PLATFORMS=cpu \
+		$(PY) bench.py
 
 # TPC-DS store-sales capture (the star-join-heavy suite the mesh join tier
 # targets): same one-JSON-line contract; pair with BENCH_MESH-style env on

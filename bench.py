@@ -212,6 +212,28 @@ def _derive_shuffle_ratios(metric_totals: dict) -> None:
         metric_totals["shuffle_overlap_ratio"] = round(overlap / cum, 4)
 
 
+def _derive_spill_ratios(metric_totals: dict) -> None:
+    """Attach the derived spill-IO overlap wherever the raw counters landed.
+    The counter discipline mirrors the shuffle transport's: the cumulative
+    pair (spill_write_seconds / spill_read_seconds) sums per-batch IO time
+    wherever it ran, the wall pair (spill_write_wall_seconds /
+    spill_read_wall_seconds) sums only the time a CONSUMER actually stalled
+    on that IO, so cumulative - wall = time the pool hid behind compute.
+    overlap_ratio > 0 means the async path actually overlapped; 0 with
+    nonzero cumulative time means everything ran on the caller (the
+    DAFT_TPU_SPILL_IO_THREADS=0 compat path, or a pool that never got
+    ahead)."""
+    w_cum = metric_totals.get("spill_write_seconds", 0.0)
+    w_wall = metric_totals.get("spill_write_wall_seconds", 0.0)
+    r_cum = metric_totals.get("spill_read_seconds", 0.0)
+    r_wall = metric_totals.get("spill_read_wall_seconds", 0.0)
+    overlap = max(w_cum - w_wall, 0.0) + max(r_cum - r_wall, 0.0)
+    cum = w_cum + r_cum
+    if cum:
+        metric_totals["spill_io_overlap_seconds"] = round(overlap, 6)
+        metric_totals["spill_io_overlap_ratio"] = round(overlap / cum, 4)
+
+
 def shuffle_microbench() -> None:
     """2-worker socket-transport shuffle microbench (BENCH_SHUFFLE=1): a
     distributed groupby that crosses the pipelined compressed shuffle, traced
@@ -1164,6 +1186,10 @@ def oom_bench() -> None:
                 {f"q{q}": (lambda q=q: ALL_QUERIES[q](tables).to_pydict())
                  for q in QUERIES})
 
+        # sync-vs-async spill A/B on the same dataset (still inside the
+        # tempdir: the leg's scan goes through the parquet round-trip too)
+        spill_ab = _spill_ab(tables, total_bytes)
+
     assert not mismatches, \
         f"budgeted results diverged from unbudgeted: {sorted(set(mismatches))}"
     assert diff.get("spill_bytes", 0) > 0, \
@@ -1173,6 +1199,7 @@ def oom_bench() -> None:
     metric_totals = {k: int(v) if float(v).is_integer() else v
                      for k, v in diff.items()
                      if k.startswith(("spill_", "scan_", "host_"))}
+    _derive_spill_ratios(metric_totals)
     metric_totals["host_bytes_high_water"] = _mem.manager().high_water_bytes()
     metric_totals["host_scope_peak_bytes"] = scope.peak_bytes()
     metric_totals["rss_high_water_bytes"] = _rss_high_water_bytes()
@@ -1194,6 +1221,160 @@ def oom_bench() -> None:
         "reps": REPS,
         "calibration": _calibration_dict(),
         "metrics": metric_totals,
+        "spill_ab": spill_ab,
+    })
+
+
+def _spill_ab(tables: dict, total_bytes: float) -> dict:
+    """The sync-vs-async spill A/B that rides inside the BENCH_OOM capture:
+    the same 3-column external sort under the same 1% budget, once with
+    DAFT_TPU_SPILL_IO_THREADS=0 (compat path — every compression+write and
+    every decode on the caller's thread) and once with the async default.
+    Both legs must be bit-identical; each leg records its spill counter
+    deltas with the derived overlap attached, so the capture shows WHERE
+    the wall moved (write stalls shrinking, overlap seconds appearing), not
+    just a speedup number."""
+    from daft_tpu.config import execution_config_ctx
+    from daft_tpu.execution import memory as _mem
+    from daft_tpu.observability.metrics import registry
+
+    budget = max(int(total_bytes * 0.01), 1 << 20)
+    df = tables["lineitem"]
+    keys = ["l_extendedprice", "l_orderkey", "l_linenumber"]
+
+    def leg(**overrides):
+        _mem.reset_counters()
+        _mem.manager().clear()
+        before = registry().snapshot()
+        with execution_config_ctx(memory_limit_bytes=budget,
+                                  device_mode="off", **overrides):
+            t0 = time.perf_counter()
+            out = df.sort(keys).to_pydict()
+            wall = time.perf_counter() - t0
+        metrics = {k: int(v) if float(v).is_integer() else round(v, 6)
+                   for k, v in registry().diff(before).items()
+                   if k.startswith("spill_")}
+        _derive_spill_ratios(metrics)
+        return out, wall, metrics
+
+    sync_out, sync_wall, sync_metrics = leg(spill_io_threads=0,
+                                            spill_prefetch_batches=0)
+    async_out, async_wall, async_metrics = leg()
+    assert async_out == sync_out, \
+        "spill A/B legs diverged — overlapped IO must never change results"
+    assert sync_metrics.get("spill_bytes", 0) > 0, \
+        "spill A/B budget never spilled — not an out-of-core comparison"
+    return {
+        "budget_bytes": budget,
+        "sort_keys": keys,
+        "sync_wall_seconds": round(sync_wall, 4),
+        "async_wall_seconds": round(async_wall, 4),
+        "speedup": round(sync_wall / async_wall, 4) if async_wall else 0.0,
+        "bit_identical": True,
+        "sync_metrics": sync_metrics,
+        "async_metrics": async_metrics,
+    }
+
+
+def merge_microbench(rows: int = 200_000) -> dict:
+    """Quick out-of-core merge microbench — the BENCH_OOM_ROWS quick mode
+    and the tier-1 regression test in tests/test_spill_async.py share this
+    body. A synthetic sort is forced through a multi-run external merge
+    under a tiny fixed budget, then three contracts are asserted:
+
+      1. bit-identical to the unbudgeted in-memory sort;
+      2. spill_merge_sort_rows stays O(rows) per merge level — far below
+         the old per-round full re-argsort, whose cost grew with the
+         in-flight window every round (~rows x fan-in on a deep cascade);
+      3. the spill_prefetch_inflight high-water never exceeds the
+         configured DAFT_TPU_SPILL_PREFETCH_BATCHES depth.
+
+    Returns the measurements so the JSON emitter / test can inspect them."""
+    import numpy as np
+
+    import daft_tpu
+    from daft_tpu.config import execution_config, execution_config_ctx
+    from daft_tpu.execution import memory as _mem
+    from daft_tpu.observability.metrics import registry
+
+    rng = np.random.default_rng(7)
+    df = daft_tpu.from_pydict({
+        "k": rng.integers(0, max(rows, 1), size=rows),
+        "g": rng.integers(0, 997, size=rows),
+        "v": rng.standard_normal(rows),
+    }).into_batches(max(rows // 64, 256)).collect()
+    input_bytes = sum(p.size_bytes() for p in df.iter_partitions())
+
+    with execution_config_ctx(memory_limit_bytes=0, device_mode="off"):
+        expected = df.sort(["k", "g"]).to_pydict()
+
+    # ~48 runs: deep enough that the fan-in cascade (intermediate merges)
+    # engages, so the sort-rows bound below exercises multi-level merging
+    budget = max(input_bytes // 48, 48 << 10)
+    _mem.reset_counters()
+    _mem.manager().clear()
+    before = registry().snapshot()
+    with execution_config_ctx(memory_limit_bytes=budget, device_mode="off"):
+        t0 = time.perf_counter()
+        out = df.sort(["k", "g"]).to_pydict()
+        wall = time.perf_counter() - t0
+    diff = registry().diff(before)
+
+    assert out == expected, "budgeted merge diverged from in-memory sort"
+    runs = int(diff.get("spill_runs", 0))
+    assert runs >= 2, f"budget produced only {runs} run(s) — not external"
+    merge_rows = int(diff.get("spill_merge_sort_rows", 0))
+    # each row is keyed/argsorted at most once per merge level (cascade +
+    # final), and single-source stretches skip the argsort entirely; the
+    # old merge's bound was ~rows x fan-in across the morsel rounds
+    levels = 1 + (1 if diff.get("spill_merge_passes", 0) else 0)
+    old_bound = rows * max(runs // 2, 4)
+    assert 0 < merge_rows <= rows * (levels + 1), (
+        f"spill_merge_sort_rows={merge_rows} outside the carry-preserving "
+        f"bound for {rows} rows x {levels} merge level(s)")
+    depth = execution_config().spill_prefetch_batches
+    high_water = registry().snapshot().get("spill_prefetch_inflight", 0)
+    assert high_water <= depth, (
+        f"prefetch high-water {high_water} above the configured depth "
+        f"{depth}")
+    metrics = {k: int(v) if float(v).is_integer() else round(v, 6)
+               for k, v in diff.items() if k.startswith("spill_")}
+    _derive_spill_ratios(metrics)
+    return {
+        "rows": rows,
+        "runs": runs,
+        "wall_seconds": round(wall, 4),
+        "merge_sort_rows": merge_rows,
+        "old_merge_bound_rows": int(old_bound),
+        "prefetch_high_water": int(high_water),
+        "prefetch_depth": depth,
+        "budget_bytes": budget,
+        "input_bytes": int(input_bytes),
+        "metrics": metrics,
+    }
+
+
+def oom_merge_microbench() -> None:
+    """BENCH_OOM=1 BENCH_OOM_ROWS=N: the quick mode `make bench-oom-quick`
+    drives — merge_microbench scaled to N synthetic rows, emitted in the
+    capture-record shape so --compare can gate on it like any other run."""
+    rows = int(os.environ.get("BENCH_OOM_ROWS", 200_000))
+    r = merge_microbench(rows)
+    rows_per_sec = r["rows"] / r["wall_seconds"] if r["wall_seconds"] else 0.0
+    _emit({
+        "metric": f"oom_merge_{r['rows']}rows_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "runs": r["runs"],
+        "merge_sort_rows": r["merge_sort_rows"],
+        "old_merge_bound_rows": r["old_merge_bound_rows"],
+        "prefetch_high_water": r["prefetch_high_water"],
+        "prefetch_depth": r["prefetch_depth"],
+        "memory_limit_bytes": r["budget_bytes"],
+        "dataset_bytes": r["input_bytes"],
+        "bit_identical": True,
+        "calibration": _calibration_dict(),
+        "metrics": r["metrics"],
     })
 
 
@@ -1289,6 +1470,19 @@ def compare(old_path: str, new_path: str) -> int:
             regressions.append("rows_per_sec")
         print(f"{'TOTAL':<8} {'':>10} {'':>10} {nv / ov:>7.2f}x{flag}  "
               f"({old.get('metric', '?')}: {ov:g} -> {nv:g} rows/sec)")
+    # spill-IO overlap movement: derived here too, so captures recorded
+    # before the ratio landed in `metrics` still compare (the raw counter
+    # pairs are enough to reconstruct it)
+    om = dict(old.get("metrics", {}) or {})
+    nm = dict(new.get("metrics", {}) or {})
+    _derive_spill_ratios(om)
+    _derive_spill_ratios(nm)
+    if "spill_io_overlap_ratio" in om or "spill_io_overlap_ratio" in nm:
+        print(f"spill IO overlap ratio: "
+              f"{om.get('spill_io_overlap_ratio', 0.0):.0%} -> "
+              f"{nm.get('spill_io_overlap_ratio', 0.0):.0%} "
+              f"(overlapped {om.get('spill_io_overlap_seconds', 0.0):g}s -> "
+              f"{nm.get('spill_io_overlap_seconds', 0.0):g}s)")
     # cost-model drift: a WARNING, not a gate failure — prediction error
     # moving >2x between captures means the calibration (or the model's
     # terms) no longer matches the silicon, and placement verdicts near the
@@ -1389,7 +1583,10 @@ def _save_profiles(tables, ALL_QUERIES) -> None:
 
 def main() -> None:
     if os.environ.get("BENCH_OOM"):
-        oom_bench()
+        if os.environ.get("BENCH_OOM_ROWS"):
+            oom_merge_microbench()   # quick mode: synthetic merge capture
+        else:
+            oom_bench()
         return
     if os.environ.get("BENCH_MESH"):
         mesh_microbench()

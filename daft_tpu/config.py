@@ -175,6 +175,21 @@ class ExecutionConfig:
     spill_dir: str = field(
         default_factory=lambda: os.environ.get("DAFT_TPU_SPILL_DIR", "")
     )
+    # Spill IO thread pool size (daft_tpu/memory/spill.py): SpillFile.append
+    # enqueues into a bounded, ledger-capped per-file queue and compression +
+    # disk writes run off-thread, overlapping spill IO with operator compute;
+    # SpillFile.read(prefetch=N) decodes ahead on the same pool. 0 = today's
+    # fully synchronous spill path (the zero-overhead/compat guard: no pool,
+    # no queue, no overlap counters).
+    spill_io_threads: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_SPILL_IO_THREADS", 2)
+    )
+    # Per-reader spill read-ahead depth in batches (capped globally so a wide
+    # merge cannot hold fan-in x depth morsels). 0 disables decode-ahead.
+    # Only consulted when spill_io_threads > 0.
+    spill_prefetch_batches: int = field(
+        default_factory=lambda: _env_int("DAFT_TPU_SPILL_PREFETCH_BATCHES", 2)
+    )
     # Streaming-scan split/merge target (io/parquet.py split planning +
     # io/scan.py merge_small_tasks): files larger than this split into
     # row-group-aligned tasks, runs of smaller files merge toward it — so
@@ -304,6 +319,15 @@ class ExecutionConfig:
             raise ValueError(
                 f"scan_split_bytes must be >= 0 (0 disables split/merge), got "
                 f"{self.scan_split_bytes!r} (check DAFT_TPU_SCAN_SPLIT_BYTES)")
+        if self.spill_io_threads < 0:
+            raise ValueError(
+                f"spill_io_threads must be >= 0 (0 = synchronous spill), got "
+                f"{self.spill_io_threads!r} (check DAFT_TPU_SPILL_IO_THREADS)")
+        if self.spill_prefetch_batches < 0:
+            raise ValueError(
+                f"spill_prefetch_batches must be >= 0 (0 disables read-ahead), "
+                f"got {self.spill_prefetch_batches!r} "
+                f"(check DAFT_TPU_SPILL_PREFETCH_BATCHES)")
 
 
 _default: Optional[ExecutionConfig] = None

@@ -447,6 +447,15 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
     agg_lock = threading.Lock()
     agg = {"cum": 0.0, "first_send": None, "last_end": None, "hw": 0}
     seen: set = set()  # file names listed across every endpoint (agg_lock)
+    from ..memory.manager import manager
+
+    # budgeted reduce: a fetch thread stuck on the full prefetch queue may
+    # DIVERT to a spill file instead of blocking — decoded batches keep
+    # landing on disk at transfer speed rather than stalling the peer, and
+    # the consumer drains the overflow (prefetching reader) after the
+    # thread's queued batches. Unbudgeted queries never divert (and so never
+    # touch the spill pool): the queue block IS the backpressure contract.
+    divert_ok = manager().limit_bytes() > 0
 
     def _put(item) -> bool:
         # never block forever: a consumer that stopped draining (closed
@@ -460,6 +469,20 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                 continue
         return False
 
+    def _put_or_divert(item) -> str:
+        # "ok" | "stopped" | "divert" — divert only after the queue has been
+        # full long enough that this is sustained consumer backpressure,
+        # not a transient blip
+        t0 = time.perf_counter()
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return "ok"
+            except _queue.Full:
+                if divert_ok and time.perf_counter() - t0 > 0.25:
+                    return "divert"
+        return "stopped"
+
     def _note_send(t: float) -> None:
         with agg_lock:
             if agg["first_send"] is None or t < agg["first_send"]:
@@ -471,7 +494,7 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
             if agg["last_end"] is None or t_end > agg["last_end"]:
                 agg["last_end"] = t_end
 
-    def _fetch_endpoint(ep: Endpoint) -> None:
+    def _fetch_endpoint(ep: Endpoint, spill: dict) -> None:
         host, port, _key = ep
         conn = _connect_retrying(ep, shuffle_id, stop)
         try:
@@ -515,8 +538,18 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                 for rb in iter_ipc_batches(io.BufferedReader(frames)):
                     batch = RecordBatch.from_arrow(rb).cast_to_schema(schema)
                     rows += batch.num_rows
+                    if spill["f"] is not None:
+                        # this thread already diverted: all later batches
+                        # follow (per-thread arrival order is preserved —
+                        # the overflow file replays after the queued prefix)
+                        spill["f"].append(batch)
+                        registry().inc("shuffle_reduce_spill_bytes",
+                                       batch.size_bytes())
+                        continue
                     t_put = time.perf_counter()
-                    if not _put(("batch", MicroPartition(schema, [batch]))):
+                    res = _put_or_divert(("batch",
+                                          MicroPartition(schema, [batch])))
+                    if res == "stopped":
                         # consumer gone mid-file: account the transfer that
                         # DID happen (received wire bytes, decoded rows)
                         # before unwinding
@@ -525,6 +558,14 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                             - (tally["blocked"] - sent_blocked[i]), 0.0))
                         return
                     tally["blocked"] += time.perf_counter() - t_put
+                    if res == "divert":
+                        from ..memory.spill import SpillFile
+
+                        spill["f"] = SpillFile(schema)
+                        spill["f"].append(batch)
+                        registry().inc("shuffle_reduce_spill_bytes",
+                                       batch.size_bytes())
+                        continue
                     sz = q.qsize()
                     with agg_lock:
                         if sz > agg["hw"]:
@@ -546,12 +587,13 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
             conn.close()
 
     def _run(eps: List[Endpoint]) -> None:
+        spill = {"f": None}  # this thread's overflow file, once diverted
         try:
             for ep in eps:
                 if stop.is_set():
                     return
                 try:
-                    _fetch_endpoint(ep)
+                    _fetch_endpoint(ep, spill)
                 except (EOFError, OSError) as e:
                     # peer vanished mid-stream (EOF, reset, broken pipe,
                     # timeout — ANY socket-level failure on an established
@@ -562,11 +604,18 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                         shuffle_id,
                         f"shuffle {shuffle_id}: peer {host}:{port} "
                         f"connection lost mid-fetch ({e})")
+            if spill["f"] is not None:
+                # hand the overflow to the consumer (it deletes after replay)
+                if _put(("spill", spill["f"])):
+                    spill["f"] = None
             _put(("done", None))
         except _FetchAborted:
             return  # consumer closed the generator; nothing to report
         except Exception as e:  # noqa: BLE001 — crossed to the consumer, re-raised there
             _put(("err", e))
+        finally:
+            if spill["f"] is not None:
+                spill["f"].delete()  # never handed off: clean up here
 
     threads = [threading.Thread(target=_run, args=(g,), daemon=True,
                                 name="daft-shuffle-fetch-client")
@@ -583,6 +632,13 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
                 if isinstance(payload, (ShuffleDataLost, ShufflePeerUnreachable)):
                     raise payload  # typed recovery triggers survive the fan-in
                 raise RuntimeError(f"shuffle fetch failed: {payload}") from payload
+            elif kind == "spill":
+                # replay one thread's diverted overflow (prefetching reader)
+                try:
+                    for b in payload.read():
+                        yield MicroPartition(schema, [b])
+                finally:
+                    payload.delete()
             else:
                 yield payload
         with agg_lock:
@@ -592,9 +648,11 @@ def _fetch_pipelined(endpoints: List[Endpoint], shuffle_id: str,
         stop.set()
         while True:  # unblock producers wedged in put()
             try:
-                q.get_nowait()
+                kind, payload = q.get_nowait()
             except _queue.Empty:
                 break
+            if kind == "spill":
+                payload.delete()  # overflow never replayed: remove the file
         for t in threads:
             t.join(timeout=5)
         with agg_lock:

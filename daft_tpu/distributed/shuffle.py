@@ -387,7 +387,22 @@ def read_partition(base: str, shuffle_id: str, partition_idx: int,
     # shared-dir transport), covering the whole consumption window
     from ..observability.runtime_stats import span_iter
 
-    yield from span_iter("shuffle.read", "io", _read_partition_inner(d, schema),
+    inner = _read_partition_inner(d, schema)
+    from ..memory.manager import manager
+
+    if manager().limit_bytes() > 0:
+        # budgeted reduce: decode ahead on the spill IO pool so decompress
+        # overlaps the consumer's reduce compute (depth-bounded, and gated
+        # on the budget so unbudgeted queries never touch the pool)
+        from ..config import execution_config
+        from ..memory.spill import prefetch_iter
+
+        cfg = execution_config()
+        if cfg.spill_io_threads > 0 and cfg.spill_prefetch_batches > 0:
+            inner = prefetch_iter(lambda: _read_partition_inner(d, schema),
+                                  cfg.spill_prefetch_batches,
+                                  cfg.spill_io_threads, counters=False)
+    yield from span_iter("shuffle.read", "io", inner,
                          shuffle_id=shuffle_id, partition=partition_idx)
 
 

@@ -612,6 +612,12 @@ class WorkerPool:
                        str(cfg.shuffle_fetch_parallelism))
         env.setdefault("DAFT_TPU_SHUFFLE_PREFETCH",
                        str(cfg.shuffle_prefetch_batches))
+        # spill IO knobs: budgeted reduce tasks spill and prefetch in worker
+        # processes (fetch-queue diversion, spill read-back), so the
+        # driver's async-spill configuration must follow them too
+        env.setdefault("DAFT_TPU_SPILL_IO_THREADS", str(cfg.spill_io_threads))
+        env.setdefault("DAFT_TPU_SPILL_PREFETCH_BATCHES",
+                       str(cfg.spill_prefetch_batches))
         # heartbeat cadence: driver (liveness timeout) and workers (beat
         # interval) must agree — mirror the effective interval into the
         # children; an explicit env entry passed by the caller wins

@@ -2476,7 +2476,10 @@ def _sort_exec(node: pp.PhysSort) -> Iterator[MicroPartition]:
                 # chunked append so read-back streams morsel-sized batches
                 for s in range(0, srt.num_rows, step):
                     f.append(srt.slice(s, min(s + step, srt.num_rows)))
-                f.finish()
+                # publish behind the queued writes without joining: the
+                # producer goes back to buffering the next run while this
+                # run's tail lands on the spill IO pool
+                f.finish_async()
             registry().inc("spill_runs")
             runs.append(f)
             budget.release_all()  # the buffer now lives on disk
@@ -2517,7 +2520,6 @@ def _merge_sorted_runs(node: pp.PhysSort, runs) -> Iterator[MicroPartition]:
     live = [f for f in runs if f.rows > 0]
     intermediates: List = []
     try:
-        step = _agg_morsel_rows()
         while len(live) > _MERGE_FANIN:
             merged = []
             for i in range(0, len(live), _MERGE_FANIN):
@@ -2528,13 +2530,10 @@ def _merge_sorted_runs(node: pp.PhysSort, runs) -> Iterator[MicroPartition]:
                 f = mem.SpillFile(node.schema)
                 for part in _kway_merge(node, chunk):
                     for b in part.batches:
-                        # re-chunk like flush_run: a merge round can emit up
-                        # to fan-in concatenated batches, and without this
-                        # the batch size (= next level's per-run memory)
-                        # would multiply by the fan-in per cascade level
-                        for s in range(0, b.num_rows, step):
-                            f.append(b.slice(s, min(s + step, b.num_rows)))
-                f.finish()
+                        # already morsel-sized: the merge emits step-row
+                        # chunks directly, so no re-chunk loop here
+                        f.append(b)
+                f.finish_async()
                 registry().inc("spill_merge_passes")
                 intermediates.append(f)
                 merged.append(f)
@@ -2547,53 +2546,170 @@ def _merge_sorted_runs(node: pp.PhysSort, runs) -> Iterator[MicroPartition]:
             f.delete()
 
 
+def _merge_ord_col(series, descending: bool, nulls_first: bool):
+    """Cross-batch comparable ordering arrays for one sort column:
+    ``(null_key, vals, flip)``. null_key compares ascending and dominates
+    (the kernels/sort._column_keys null-placement encoding); vals carries
+    the value order. For numeric/bool/temporal the value transform is
+    _column_keys' own (NaN->inf, bool->int8, descending via bitwise-not /
+    negation), so scalar comparisons agree with lexsort order EXACTLY. For
+    string/binary/decimal, _column_keys' np.unique rank codes are
+    batch-local, so vals keeps the raw comparable values (objects, the
+    encode_column domains) and ``flip`` asks the comparator to reverse —
+    descending baked into the comparison rather than the array. Nested
+    falls back to hash order, matching encode_column's fallback."""
+    dt = series.dtype
+    valid = series.validity_numpy()
+    null_key = np.where(valid, np.int8(0), np.int8(-1 if nulls_first else 1))
+    if (dt.is_numeric() or dt.is_boolean() or dt.is_temporal()) \
+            and not dt.is_decimal():
+        vals = np.asarray(series.to_numpy())
+        if vals.dtype.kind == "f":
+            nan = np.isnan(vals)
+            if nan.any():
+                vals = np.where(nan, np.inf, vals)
+        if vals.dtype.kind == "b":
+            vals = vals.astype(np.int8)
+        if descending:
+            vals = np.bitwise_not(vals) if vals.dtype.kind in "iu" else -vals
+        vals = np.where(valid, vals, vals.dtype.type(0))
+        return null_key, vals, False
+    if dt.is_decimal():
+        from decimal import Decimal
+
+        pyvals = series.to_pylist()
+        vals = np.empty(len(series), dtype=object)
+        for i in range(len(pyvals)):
+            vals[i] = pyvals[i] if pyvals[i] is not None else Decimal(0)
+        return null_key, vals, descending
+    if dt.is_string() or dt.is_binary():
+        vals = np.asarray(series.to_arrow().to_numpy(zero_copy_only=False))
+        vals = np.where(valid, vals, "" if dt.is_string() else b"")
+        return null_key, vals, descending
+    vals = series.hash().to_numpy()  # nested: hash order, as encode_column
+    if descending:
+        vals = np.bitwise_not(vals) if vals.dtype.kind in "iu" else -vals
+    vals = np.where(valid, vals, vals.dtype.type(0))
+    return null_key, vals, False
+
+
+def _cmp_rows(a_cols, ai: int, b_cols, bi: int) -> int:
+    """Compare row ai of one segment against row bi of another under the
+    user sort order (-1 / 0 / 1). Null placement decides first; two nulls in
+    a column tie (value slots hold fill garbage); valid values compare by
+    the _merge_ord_col transform, reversed where flip is set."""
+    for (a_nk, a_v, flip), (b_nk, b_v, _f) in zip(a_cols, b_cols):
+        an, bn = a_nk[ai], b_nk[bi]
+        if an != bn:
+            return -1 if an < bn else 1
+        if an:
+            continue  # both null: equal in this column
+        x, y = a_v[ai], b_v[bi]
+        if x < y:
+            return 1 if flip else -1
+        if y < x:
+            return -1 if flip else 1
+    return 0
+
+
+class _MergeSeg:
+    """One sorted in-memory slice of a run inside _kway_merge: the batch,
+    its once-evaluated sort-key Series, the comparable ordering arrays, and
+    a consumed-prefix cursor. Segments never re-sort or re-key."""
+
+    __slots__ = ("run", "batch", "keys", "ords", "pos", "n")
+
+    def __init__(self, run: int, batch: RecordBatch, keys, ords):
+        self.run = run
+        self.batch = batch
+        self.keys = keys
+        self.ords = ords
+        self.pos = 0
+        self.n = batch.num_rows
+
+
 def _kway_merge(node: pp.PhysSort, files) -> Iterator[MicroPartition]:
-    """Streaming k-way merge of sorted runs with bounded memory: one batch
-    per run in flight plus the carried (not-yet-emittable) overflow.
+    """Streaming carry-preserving k-way merge of sorted runs with bounded
+    memory: one batch per run in flight plus the carried (not-yet-emittable)
+    overflow.
 
-    Per round, each run's current batch contributes its LAST row as a
-    boundary marker; everything that sorts before the first marker is safely
-    emittable (any unread row of run j is >= run j's boundary >= the first
-    marker in the total order). The total order is the user sort key
-    extended with a final int64 merge key = run_index*2 for data rows and
-    run_index*2+1 for markers — ties across runs resolve by run (= stream)
-    order, and a run's marker sorts after that run's real rows without
-    relying on sort stability.
+    Every pulled batch becomes a _MergeSeg: sort keys evaluated ONCE, plus
+    cross-batch comparable ordering arrays (_merge_ord_col). Per round, each
+    live run's newest segment contributes its LAST row as that run's
+    boundary; the horizon is the smallest boundary (run index breaks ties).
+    A row is emittable iff it sorts strictly before the horizon, or ties
+    with it from a run index <= the horizon run — exactly the
+    marker-ordering rule (data key run*2 vs marker key run*2+1) the previous
+    implementation encoded into a per-round full argsort. Because segments
+    stay sorted, each segment's emittable prefix falls out of one binary
+    search against the horizon row, and only the EMITTED rows (each exactly
+    once per merge level) pay a lexsort — interleaving the prefixes via
+    multi_argsort over the already-evaluated key Series plus an int64
+    run-index tiebreak column, so cross-run ties resolve by run (= stream)
+    order and within-run order rides on lexsort stability. Total key-eval /
+    sort work drops from O(rows x fan-in) per level to O(rows) key-eval +
+    O(rows log rows) sort, counted by spill_merge_sort_rows (rows through
+    the interleave argsort; single-source rounds skip it entirely).
 
-    Cost: the carried overflow is bounded by one batch per run (a run's
-    batch leaves carry the round its boundary becomes the horizon), and
-    each round re-keys and re-argsorts carry + pool — so total merge work
-    is O(total_rows x fan-in) key-eval/lexsort, a bounded constant factor
-    over the input, not quadratic. A carry-preserving two-way merge would
-    shave that factor; not worth the added state machine at current run
-    counts (_MERGE_FANIN caps the factor at 16)."""
+    Output is emitted in morsel-sized batches (_agg_morsel_rows) directly,
+    so cascade levels append merge output without re-chunking."""
+    from ..core.kernels.sort import multi_argsort
     from ..core.series import Series
     from ..datatype import DataType
+    from ..observability.metrics import registry
 
     if not files:
         return
     nkeys = len(node.sort_by)
     desc = list(node.descending) if node.descending else [False] * nkeys
     nf = list(node.nulls_first) if node.nulls_first else list(desc)
-    desc_m = desc + [False]
-    nf_m = nf + [False]
 
     if len(files) == 1:
         for b in files[0].read():
             yield MicroPartition(node.schema, [b])
         return
 
-    def merge_key(batch, mrg):
-        keys = [eval_expression(batch, e) for e in node.sort_by]
-        keys.append(Series.from_numpy(mrg, "__mrg__", DataType.int64()))
-        return keys
-
+    step = _agg_morsel_rows()
     its = [f.read() for f in files]
     need = set(range(len(its)))
-    bounds: dict = {}                      # run idx -> 1-row boundary batch
-    carry: Optional[RecordBatch] = None    # rows held past the safe horizon
-    carry_mrg: Optional[np.ndarray] = None
-    pool: List[tuple] = []                 # (batch, run idx) taken this round
+    segs: List[_MergeSeg] = []   # within a run, in pull (= stream) order
+    bounds: dict = {}            # run idx -> (ord arrays, last-row index)
+    outbuf: List[RecordBatch] = []
+    out_rows = 0
+
+    def sorted_pieces(pieces) -> Optional[RecordBatch]:
+        """Interleave emittable prefixes into one batch in the total order."""
+        if not pieces:
+            return None
+        bats = [s.batch.slice(a, b) for s, a, b in pieces]
+        if len(bats) == 1:
+            return bats[0]  # one source segment: already sorted, no argsort
+        big = RecordBatch.concat(bats)
+        key_cols = []
+        for k in range(nkeys):
+            sl = [s.keys[k].slice(a, b).rename("k") for s, a, b in pieces]
+            key_cols.append(Series.concat(sl))
+        mrg = np.concatenate([np.full(b - a, s.run, dtype=np.int64)
+                              for s, a, b in pieces])
+        key_cols.append(Series.from_numpy(mrg, "__mrg__", DataType.int64()))
+        idx = multi_argsort(key_cols, desc + [False], nf + [False])
+        registry().inc("spill_merge_sort_rows", len(idx))
+        return big.take(idx)
+
+    def push(batch: RecordBatch) -> Iterator[MicroPartition]:
+        """Accumulate sorted output; release exact morsel-sized batches."""
+        nonlocal out_rows, outbuf
+        outbuf.append(batch)
+        out_rows += batch.num_rows
+        if out_rows < step:
+            return
+        big = RecordBatch.concat(outbuf) if len(outbuf) > 1 else outbuf[0]
+        full = (out_rows // step) * step
+        for s in range(0, full, step):
+            yield MicroPartition(node.schema, [big.slice(s, s + step)])
+        rest = big.slice(full, out_rows)
+        outbuf = [rest] if rest.num_rows else []
+        out_rows = rest.num_rows
 
     while True:
         for i in sorted(need):
@@ -2603,53 +2719,55 @@ def _kway_merge(node: pp.PhysSort, files) -> Iterator[MicroPartition]:
             if b is None:
                 bounds.pop(i, None)        # run exhausted: no boundary
             else:
-                pool.append((b, i))
-                bounds[i] = b.slice(b.num_rows - 1, b.num_rows)
+                keys = [eval_expression(b, e) for e in node.sort_by]
+                ords = [_merge_ord_col(k, d, n)
+                        for k, d, n in zip(keys, desc, nf)]
+                segs.append(_MergeSeg(i, b, keys, ords))
+                bounds[i] = (ords, b.num_rows - 1)
         need.clear()
-
-        data_batches: List[RecordBatch] = []
-        mrg_parts: List[np.ndarray] = []
-        if carry is not None and carry.num_rows:
-            data_batches.append(carry)
-            mrg_parts.append(carry_mrg)
-        for b, i in pool:
-            data_batches.append(b)
-            mrg_parts.append(np.full(b.num_rows, 2 * i, dtype=np.int64))
-        pool = []
 
         if not bounds:
             # every run exhausted: the remainder is emittable wholesale
-            if data_batches:
-                big = RecordBatch.concat(data_batches) \
-                    if len(data_batches) > 1 else data_batches[0]
-                mrg = np.concatenate(mrg_parts)
-                idx = big.argsort(merge_key(big, mrg), desc_m, nf_m)
-                yield MicroPartition(node.schema, [big.take(idx)])
+            big = sorted_pieces([(s, s.pos, s.n) for s in segs
+                                 if s.pos < s.n])
+            if big is not None:
+                yield from push(big)
+            if outbuf:
+                tail = RecordBatch.concat(outbuf) \
+                    if len(outbuf) > 1 else outbuf[0]
+                yield MicroPartition(node.schema, [tail])
             return
 
+        # horizon: smallest boundary; equal boundaries go to the smaller
+        # run index (whose equal-keyed rows sort first in stream order)
+        r = -1
         for i in sorted(bounds):
-            data_batches.append(bounds[i])
-            mrg_parts.append(np.array([2 * i + 1], dtype=np.int64))
-        big = RecordBatch.concat(data_batches)
-        mrg = np.concatenate(mrg_parts)
-        idx = big.argsort(merge_key(big, mrg), desc_m, nf_m)
-        sorted_mrg = mrg[idx]
-        markers = np.flatnonzero(sorted_mrg & 1)
-        first = int(markers[0])
-        if first:
-            yield MicroPartition(node.schema, [big.take(idx[:first])])
-        # refill the run whose boundary was the horizon; everything past it
-        # (minus the marker rows, which are copies) carries to the next round
-        r = int(sorted_mrg[first] >> 1)
+            if r < 0 or _cmp_rows(bounds[i][0], bounds[i][1],
+                                  bounds[r][0], bounds[r][1]) < 0:
+                r = i
+        b_ord, b_idx = bounds[r]
+
+        pieces = []
+        for s in segs:
+            lo, hi = s.pos, s.n
+            while lo < hi:
+                mid = (lo + hi) // 2
+                c = _cmp_rows(s.ords, mid, b_ord, b_idx)
+                if c < 0 or (c == 0 and s.run <= r):
+                    lo = mid + 1
+                else:
+                    hi = mid
+            if lo > s.pos:
+                pieces.append((s, s.pos, lo))
+                s.pos = lo
+        segs = [s for s in segs if s.pos < s.n]
+        big = sorted_pieces(pieces)
+        if big is not None:
+            yield from push(big)
+        # refill the horizon run (its in-memory rows all drained: every row
+        # is <= its boundary and ties from run r are emittable)
         need.add(r)
         del bounds[r]
-        rest, rest_mrg = idx[first + 1:], sorted_mrg[first + 1:]
-        keep = (rest_mrg & 1) == 0
-        carry_idx = rest[keep]
-        if len(carry_idx):
-            carry, carry_mrg = big.take(carry_idx), rest_mrg[keep]
-        else:
-            carry = carry_mrg = None
 
 
 def _window_exec(node) -> Iterator[MicroPartition]:

@@ -263,7 +263,7 @@ def _row_group_split_tasks(path: str, md, columns, out_schema: Schema,
                 t = pa.Table.from_batches([rb])
                 yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
 
-        return read
+        return _maybe_prefetch(read)
 
     from ..observability.metrics import registry
 
@@ -280,6 +280,33 @@ def _row_group_split_tasks(path: str, md, columns, out_schema: Schema,
         )
         for g, nb, nr in zip(groups, sizes, rows)
     ]
+
+
+def _maybe_prefetch(read_factory):
+    """Budgeted decode-ahead for scan readers: under a host memory budget,
+    run the parquet decode loop on the spill IO pool with a depth-bounded
+    queue (DAFT_TPU_SPILL_PREFETCH_BATCHES), overlapping decompress with the
+    operators consuming the scan. Unbudgeted queries get the factory back
+    untouched — they never see the pool, queue, or counters (the
+    zero-overhead guard); the budget check runs at READ time, not task-build
+    time, so tasks built outside a query scope still honor the budget their
+    executing query runs under."""
+
+    def read_prefetched():
+        from ..config import execution_config
+        from ..memory.manager import manager
+
+        cfg = execution_config()
+        if (manager().limit_bytes() > 0 and cfg.spill_io_threads > 0
+                and cfg.spill_prefetch_batches > 0):
+            from ..memory.spill import prefetch_iter
+
+            yield from prefetch_iter(read_factory, cfg.spill_prefetch_batches,
+                                     cfg.spill_io_threads, counters=False)
+        else:
+            yield from read_factory()
+
+    return read_prefetched
 
 
 def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
@@ -303,7 +330,7 @@ def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
                 produced += t.num_rows
                 yield MicroPartition.from_arrow(t).cast_to_schema(out_schema)
 
-        return read_remote
+        return _maybe_prefetch(read_remote)
 
     def read():
         ds = pads.dataset(path, format="parquet")
@@ -320,7 +347,7 @@ def _make_reader(path: str, columns, arrow_filter, limit, out_schema: Schema):
             mp = MicroPartition.from_arrow(t)
             yield mp.cast_to_schema(out_schema)
 
-    return read
+    return _maybe_prefetch(read)
 
 
 def _expr_to_arrow_filter(expr) -> Optional[pads.Expression]:

@@ -235,6 +235,9 @@ SHUFFLE_COUNTER_NAMES = (
     "shuffle_overlap_seconds",    # cumulative - wall = transfer overlapped
     "shuffle_fetch_server_requests",
     "shuffle_fetch_server_bytes",
+    "shuffle_reduce_spill_bytes",  # reduce-input bytes diverted to spill when
+                                   # the budgeted consumer's prefetch queue
+                                   # stayed full (fetch_server._fetch_pipelined)
 )
 
 # Elastic fault tolerance (distributed/worker.py liveness monitor,
@@ -288,6 +291,21 @@ SPILL_COUNTER_NAMES = (
     "spill_runs",           # sorted runs generated by the external sort
     "spill_merge_passes",   # intermediate k-way merge passes (fan-in capping)
     "spill_dirs_gced",      # stale spill artifacts swept from dead processes
+    # async spill IO attribution (spill_io_threads > 0 only — the synchronous
+    # threads=0 path never touches these, preserving the compat guard).
+    # Overlap discipline mirrors the PR 5 shuffle fetch split: cumulative
+    # off-thread seconds vs the wall seconds the CALLER actually paid
+    # (queue-full stalls + finish joins / prefetch-queue waits); the derived
+    # spill_io_overlap_seconds = max(write - write_wall, 0) +
+    # max(read - read_wall, 0) is attached by bench.py.
+    "spill_write_seconds",       # cumulative IO-thread compress+write time
+    "spill_write_wall_seconds",  # wall seconds spill writes cost the producer
+    "spill_read_seconds",        # cumulative IO-thread decode time (prefetch)
+    "spill_read_wall_seconds",   # wall seconds consumers blocked on read-ahead
+    "spill_merge_sort_rows",     # rows through the k-way merge's argsort —
+                                 # the carry-preserving merge's work bound
+                                 # (<= total rows; the old merge re-sorted
+                                 # the carry every round, ~rows x fan-in)
 )
 
 # Out-of-core streaming scans (execution/executor.py _streaming_scan over
@@ -322,6 +340,7 @@ DECLARED_GAUGES = (
     "host_bytes_tracked",      # host bytes admitted against the memory ledger
     "host_bytes_high_water",   # ledger high-water since process start / clear()
     "shuffle_fetch_inflight",  # high-water concurrent fetch requests
+    "spill_prefetch_inflight",  # high-water decoded batches queued per reader
     "mesh_devices_used",       # devices of the last mesh dispatch
     "bucket_fill_ratio",       # coalescer padding efficiency (per run)
     # cost-model observability (ops/costmodel.py + observability/placement.py)

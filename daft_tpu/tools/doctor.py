@@ -11,6 +11,11 @@ Two input shapes, one question — "what ate the time?":
   matches a known signature (streaming-scan/host-ledger, device->host
   placement flips). ``bench.py --compare`` prints the same attribution via
   :func:`attribution_lines` whenever its gate fails.
+- ``python -m daft_tpu.tools.doctor CAPTURE.json`` where the JSON is a
+  bench capture record (it carries ``metric``) triages it as an
+  out-of-core capture: spill volume, IO-overlap attribution, budget
+  headroom, the sync-vs-async A/B verdict, and the query with the worst
+  spill-write wall share.
 - ``python -m daft_tpu.tools.doctor DUMP.json ...`` reads flight-recorder
   anomaly dumps (observability/flight.py) and emits a ranked triage report:
   errors and worker deaths first, then stall attribution (scan
@@ -321,6 +326,101 @@ def triage_dump(dump: dict, path: str = "") -> List[str]:
     return lines
 
 
+# ---- OOM-capture triage --------------------------------------------------------------
+
+def triage_oom_capture(cap: dict, path: str = "") -> List[str]:
+    """Ranked triage over one BENCH_OOM capture (bench.py one-line JSON):
+    where the out-of-core run's time went. Names the query with the worst
+    spill-write wall share — spill-write stalls as a fraction of that
+    query's best wall time, i.e. the query the spill path starved hardest —
+    plus spill volume/compression, IO-overlap attribution (cumulative vs
+    wall discipline), budget headroom, and the sync-vs-async A/B verdict
+    when the capture carries one."""
+    m = cap.get("metrics", {}) or {}
+    lines = [f"doctor: OOM capture {path or '(stdin)'}",
+             f"headline: {cap.get('metric', '?')} = {cap.get('value', 0):g} "
+             f"{cap.get('unit', '')}".rstrip()]
+    findings: List[tuple] = []  # (severity, line) — rendered ranked
+
+    # worst spill-write wall share: per-query spill_write_wall_seconds
+    # (from the instrumented profile pass) over the query's best wall time.
+    # The profile pass is a separate run under the same budget, so the
+    # share is an attribution estimate, not an exact decomposition.
+    per_q_ms = cap.get("per_query_ms", {}) or {}
+    per_q_prof = cap.get("per_query_profile", {}) or {}
+    shares = []
+    for q, prof in per_q_prof.items():
+        wall_s = per_q_ms.get(q, 0.0) / 1000.0
+        stall = (prof.get("counters", {}) or {}).get(
+            "spill_write_wall_seconds", 0.0)
+        if wall_s > 0 and stall > 0:
+            shares.append((stall / wall_s, stall, q))
+    if shares:
+        shares.sort(reverse=True)
+        share, stall, q = shares[0]
+        findings.append((90, f"worst spill-write wall share: {q} spent "
+                         f"{stall:.3f}s stalled on spill writes "
+                         f"({share:.0%} of its {per_q_ms[q]:.1f} ms wall) — "
+                         f"the query the spill path starved hardest"))
+    elif per_q_prof:
+        findings.append((20, "no query recorded spill-write stalls in the "
+                         "profile pass — spill writes fully overlapped (or "
+                         "never happened per-query)"))
+
+    spill = m.get("spill_bytes", 0)
+    if spill:
+        wire = m.get("spill_wire_bytes", 0)
+        comp = f", {wire / spill:.2f}x on the wire" if wire else ""
+        findings.append((70, f"spilled {_fmt_bytes(spill)} across "
+                         f"{int(m.get('spill_files', 0))} file(s), "
+                         f"{int(m.get('spill_runs', 0))} sort run(s), "
+                         f"{int(m.get('spill_merge_passes', 0))} cascade "
+                         f"merge pass(es){comp}"))
+    w_cum = m.get("spill_write_seconds", 0.0)
+    if w_cum or m.get("spill_read_seconds", 0.0):
+        ratio = m.get("spill_io_overlap_ratio", 0.0)
+        overlap = m.get("spill_io_overlap_seconds", 0.0)
+        if ratio:
+            findings.append((60, f"spill IO overlap: {overlap:.3f}s "
+                             f"({ratio:.0%} of cumulative spill IO) hidden "
+                             f"behind compute by the async pool"))
+        else:
+            findings.append((75, "spill IO never overlapped (overlap ratio "
+                             "0 with nonzero IO time) — synchronous compat "
+                             "path, or the pool never got ahead; check "
+                             "DAFT_TPU_SPILL_IO_THREADS"))
+    budget = cap.get("memory_limit_bytes", 0)
+    rss = cap.get("rss_high_water_bytes", 0)
+    ledger = cap.get("host_bytes_high_water", 0)
+    if budget and (ledger or rss):
+        over = " <-- OVER LEDGER BUDGET" if ledger > budget else ""
+        findings.append((50 if over else 30,
+                         f"budget {_fmt_bytes(budget)}: ledger high-water "
+                         f"{_fmt_bytes(ledger)}{over}; process RSS peak "
+                         f"{_fmt_bytes(rss)}"))
+    ab = cap.get("spill_ab") or {}
+    if ab:
+        findings.append((55, f"sync-vs-async A/B: {ab.get('speedup', 0):.2f}x "
+                         f"({ab.get('sync_wall_seconds', 0):.2f}s -> "
+                         f"{ab.get('async_wall_seconds', 0):.2f}s), async "
+                         f"overlap ratio "
+                         f"{(ab.get('async_metrics', {}) or {}).get('spill_io_overlap_ratio', 0):.0%}"))
+    if not findings:
+        findings.append((0, "no spill activity recorded — not an "
+                         "out-of-core capture (or counters absent)"))
+    findings.sort(key=lambda t: t[0], reverse=True)
+    lines.append("findings (ranked):")
+    lines.extend(f"  {i + 1}. {msg}" for i, (_, msg) in enumerate(findings))
+    if per_q_ms:
+        lines.append("slowest queries:")
+        for q in sorted(per_q_ms, key=per_q_ms.get, reverse=True)[:5]:
+            stall = (per_q_prof.get(q, {}).get("counters", {}) or {}).get(
+                "spill_write_wall_seconds", 0.0)
+            lines.append(f"  {q}  {per_q_ms[q]:.1f} ms"
+                         f"  spill-write stall {stall:.3f}s")
+    return lines
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -340,7 +440,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print()
         with open(path) as f:
             dump = json.load(f)
-        print("\n".join(triage_dump(dump, path)))
+        # shape dispatch: bench capture records (raw or driver-wrapped)
+        # carry "metric"; everything else is a flight-recorder dump
+        if isinstance(dump, dict) and "metric" not in dump \
+                and isinstance(dump.get("parsed"), dict):
+            dump = dump["parsed"]
+        if isinstance(dump, dict) and "metric" in dump:
+            print("\n".join(triage_oom_capture(dump, path)))
+        else:
+            print("\n".join(triage_dump(dump, path)))
     return 0
 
 

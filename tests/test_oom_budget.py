@@ -202,6 +202,7 @@ def test_spill_file_tmp_publish_discipline(tmp_path):
     batch = RecordBatch.from_arrow(pa.table({"a": np.arange(1000)}))
     f = SpillFile(batch.schema, spill_dir=str(tmp_path))
     f.append(batch)
+    f._join_queue()  # async appends land in .tmp off-thread; join to observe
     assert os.path.exists(f._tmp) and not os.path.exists(f.path)
     f.finish()
     assert os.path.exists(f.path) and not os.path.exists(f._tmp)
